@@ -1,0 +1,49 @@
+"""siddhi_tpu — a TPU-native streaming & complex event processing framework.
+
+A ground-up re-design of the capabilities of the Siddhi CEP engine (reference:
+io.siddhi 5.1.x, Java) for TPU hardware: SiddhiQL streaming SQL compiled to
+jitted JAX/XLA kernels over columnar event micro-batches, window/NFA state in
+device ring buffers, group-by as segment reductions, keyed partitioning as a
+sharded axis over a `jax.sharding.Mesh`.
+
+Public API mirrors the reference's user surface (core/SiddhiManager.java:50):
+
+    from siddhi_tpu import SiddhiManager
+
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime('''
+        define stream StockStream (symbol string, price float, volume long);
+        @info(name='q1')
+        from StockStream[price > 20.0] select symbol, price insert into OutStream;
+    ''')
+    rt.add_callback("OutStream", lambda events: print(events))
+    rt.start()
+    rt.get_input_handler("StockStream").send(("IBM", 75.6, 100))
+    rt.flush()
+"""
+
+# LONG attributes and millisecond timestamps are int64 on device, matching the
+# reference's Java longs; jax x64 must be enabled before any tracing happens.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from . import compiler  # noqa: E402
+from .core.dtypes import config  # noqa: E402
+from .core.event import Event  # noqa: E402
+from .core.manager import SiddhiManager  # noqa: E402
+from .errors import SiddhiError, SiddhiParserError  # noqa: E402
+from .query_api import SiddhiApp  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "SiddhiManager",
+    "SiddhiApp",
+    "Event",
+    "compiler",
+    "config",
+    "SiddhiError",
+    "SiddhiParserError",
+    "__version__",
+]

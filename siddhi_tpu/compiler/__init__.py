@@ -1,0 +1,76 @@
+"""SiddhiQL text → SiddhiApp AST (reference: siddhi-query-compiler's
+SiddhiCompiler.java:63)."""
+
+from __future__ import annotations
+
+import functools
+import os
+import re
+
+from lark import Lark
+from lark.exceptions import UnexpectedInput, VisitError
+
+from ..errors import SiddhiParserError
+from ..query_api import Query, SiddhiApp, StreamDefinition
+from .grammar import GRAMMAR
+from .transformer import AstTransformer
+
+
+@functools.lru_cache(maxsize=1)
+def _parser() -> Lark:
+    return Lark(GRAMMAR, parser="earley", lexer="dynamic", maybe_placeholders=False)
+
+
+_VAR_PATTERN = re.compile(r"\$\{(\w+)\}")
+
+
+def update_variables(siddhi_ql: str, env: dict | None = None) -> str:
+    """`${var}` substitution from env/system properties (reference:
+    SiddhiCompiler.updateVariables, called from SiddhiManager.java:95)."""
+    source = env if env is not None else os.environ
+
+    def sub(m: re.Match) -> str:
+        name = m.group(1)
+        if name not in source:
+            raise SiddhiParserError(f"no system/environment variable for ${{{name}}}")
+        return source[name]
+
+    return _VAR_PATTERN.sub(sub, siddhi_ql)
+
+
+def parse(siddhi_ql: str) -> SiddhiApp:
+    """Parse a full SiddhiQL app definition string into a SiddhiApp AST."""
+    try:
+        tree = _parser().parse(siddhi_ql)
+    except UnexpectedInput as e:
+        line = getattr(e, "line", None)
+        column = getattr(e, "column", None)
+        raise SiddhiParserError(str(e).split("\n")[0], line, column) from e
+    try:
+        return AstTransformer().transform(tree)
+    except VisitError as e:
+        raise SiddhiParserError(f"error building AST: {e.orig_exc}") from e
+
+
+def parse_query(query_text: str) -> Query:
+    """Parse a single query (reference: SiddhiCompiler.parseQuery)."""
+    app = parse(query_text)
+    if len(app.queries) != 1:
+        raise SiddhiParserError("expected exactly one query")
+    return app.queries[0]
+
+
+def parse_stream_definition(text: str) -> StreamDefinition:
+    app = parse(text)
+    if len(app.stream_definitions) != 1:
+        raise SiddhiParserError("expected exactly one stream definition")
+    return next(iter(app.stream_definitions.values()))
+
+
+class SiddhiCompiler:
+    """Facade matching the reference's static API shape."""
+
+    parse = staticmethod(parse)
+    parse_query = staticmethod(parse_query)
+    parse_stream_definition = staticmethod(parse_stream_definition)
+    update_variables = staticmethod(update_variables)

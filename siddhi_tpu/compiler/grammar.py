@@ -1,0 +1,290 @@
+"""SiddhiQL grammar (Lark, earley).
+
+Re-derived from the language surface described by the reference grammar
+(modules/siddhi-query-compiler/src/main/antlr4/.../SiddhiQL.g4, 927 lines) —
+NOT a translation of it: rule names and factoring follow Lark idioms, and the
+AST is built by compiler/transformer.py. Keywords are case-insensitive like
+SiddhiQL. Comments: `-- line` and block comments.
+"""
+
+GRAMMAR = r'''
+start: (definition | execution_element | app_annotation)*
+
+definition: define_stream ";"?
+          | define_table ";"?
+          | define_window ";"?
+          | define_trigger ";"?
+          | define_function ";"?
+          | define_aggregation ";"?
+
+execution_element: query ";"? | partition ";"?
+
+// ---------------- annotations ----------------
+// only `@app:...` is app-level (matches the reference grammar's app_annotation)
+app_annotation.5: "@" APP_KW ":" NAME ("(" annotation_body? ")")?
+APP_KW: "app"i
+annotation: "@" qualified_name ("(" annotation_body? ")")?
+qualified_name: NAME (":" NAME)?
+annotation_body: annotation_item ("," annotation_item)*
+annotation_item: annotation | keyed_element | bare_element
+keyed_element: NAME ("." NAME)* "=" literal_value
+bare_element: literal_value
+literal_value: STRING_LITERAL | NUMBER_FOR_ANNOTATION | TRUE | FALSE
+NUMBER_FOR_ANNOTATION: /-?\d+(\.\d+)?[fFlLdD]?/
+
+// ---------------- definitions ----------------
+define_stream: annotation* DEFINE STREAM stream_id "(" attr_list ")"
+define_table: annotation* DEFINE TABLE NAME "(" attr_list ")"
+define_window: annotation* DEFINE WINDOW NAME "(" attr_list ")" window_spec? output_event_kw?
+window_spec: function_id "(" expr_list? ")"
+output_event_kw: OUTPUT event_type EVENTS
+define_trigger: annotation* DEFINE TRIGGER NAME AT trigger_at
+trigger_at: EVERY time_value   -> trigger_every
+          | STRING_LITERAL     -> trigger_cron_or_start
+define_function: annotation* DEFINE FUNCTION NAME "[" NAME "]" RETURN attr_type FUNCTION_BODY
+FUNCTION_BODY: /\{[^}]*\}/
+define_aggregation: annotation* DEFINE AGGREGATION NAME FROM stream_id select_clause group_by_clause? aggregate_clause
+aggregate_clause: AGGREGATE (BY variable_ref)? EVERY duration_range
+duration_range: duration_name "..." duration_name     -> duration_dots
+              | duration_name ("," duration_name)+    -> duration_list
+              | duration_name                          -> duration_single
+duration_name: NAME
+
+attr_list: attr_def ("," attr_def)*
+attr_def: NAME attr_type
+attr_type: NAME
+
+// ---------------- query ----------------
+query: annotation* FROM query_input select_clause? group_by_clause? having_clause? order_by_clause? limit_clause? offset_clause? output_rate? query_output
+
+query_input: join_stream | state_stream | standard_stream
+
+// standard single stream (priority: a bare `S[f]#window.w()` must win over a
+// single-element pattern chain)
+standard_stream.10: source handler_chain
+source: INNER_STREAM_ID | FAULT_STREAM_ID | stream_id
+stream_id: NAME
+INNER_STREAM_ID: /#[A-Za-z_][A-Za-z_0-9]*/
+FAULT_STREAM_ID: /![A-Za-z_][A-Za-z_0-9]*/
+handler_chain: stream_handler*
+stream_handler: filter | stream_function_h | window_h
+filter: "[" expression "]"
+stream_function_h: "#" function_id_pair "(" expr_list? ")"
+window_h: "#" WINDOW_KW "." function_id "(" expr_list? ")"
+WINDOW_KW: "window"i
+function_id_pair: NAME (":" NAME)?
+function_id: NAME
+
+// join
+join_stream: join_side join_kw join_side right_unidirectional? (ON expression)? within_clause? per_clause?
+join_side: source handler_chain (AS alias_name)? UNIDIRECTIONAL?
+alias_name: NAME
+join_kw: LEFT OUTER JOIN -> left_outer_join
+       | RIGHT OUTER JOIN -> right_outer_join
+       | FULL OUTER JOIN -> full_outer_join
+       | (INNER)? JOIN -> inner_join
+right_unidirectional: UNIDIRECTIONAL
+within_clause: WITHIN time_value
+per_clause: PER expression
+
+// patterns & sequences
+state_stream: every_pattern_chain                     -> pattern_stream
+            | sequence_chain                          -> sequence_stream
+every_pattern_chain: pattern_part (ARROW pattern_part)* within_clause?
+ARROW: "->"
+pattern_part: EVERY "(" pattern_inner ")" -> every_group
+            | EVERY pattern_inner          -> every_part
+            | pattern_inner                -> plain_part
+pattern_inner: logical_state
+logical_state: primary_state (AND primary_state | OR primary_state)?
+primary_state: NOT event_def (FOR time_value)?   -> absent_state
+             | event_def count_spec?              -> counted_state
+             | "(" every_pattern_chain ")"        -> nested_chain
+event_def: (event_ref "=")? source handler_chain
+event_ref: NAME
+count_spec: "<" INT_LITERAL ":" INT_LITERAL ">"  -> count_min_max
+          | "<" INT_LITERAL ":" ">"              -> count_min
+          | "<" ":" INT_LITERAL ">"              -> count_max
+          | "<" INT_LITERAL ">"                  -> count_exact
+sequence_chain: seq_first ("," seq_part)+ within_clause?
+seq_first: (EVERY)? seq_part
+seq_part: logical_state_seq
+logical_state_seq: primary_seq (AND primary_seq | OR primary_seq)?
+primary_seq: NOT event_def (FOR time_value)? -> absent_seq
+           | event_def regex_spec?            -> counted_seq
+regex_spec: "*" -> zero_or_more
+          | "+" -> one_or_more
+          | "?" -> zero_or_one
+
+// select
+select_clause: SELECT (STAR | output_attr ("," output_attr)*)
+STAR: "*"
+output_attr: expression (AS NAME)?
+group_by_clause: GROUP BY variable_ref ("," variable_ref)*
+having_clause: HAVING expression
+order_by_clause: ORDER BY order_item ("," order_item)*
+order_item: variable_ref (ASC | DESC)?
+limit_clause: LIMIT INT_LITERAL
+offset_clause: OFFSET INT_LITERAL
+
+// output rate
+output_rate: OUTPUT rate_kind? EVERY time_value        -> rate_time
+           | OUTPUT rate_kind? EVERY INT_LITERAL EVENTS -> rate_events
+           | OUTPUT SNAPSHOT EVERY time_value           -> rate_snapshot
+rate_kind: ALL | FIRST | LAST
+
+// query output
+query_output: INSERT (event_type EVENTS)? INTO sink_target            -> insert_into
+            | DELETE NAME (FOR event_type EVENTS)? ON expression      -> delete_from
+            | UPDATE OR INSERT INTO NAME set_clause? ON expression    -> update_or_insert
+            | UPDATE NAME (FOR event_type EVENTS)? set_clause? ON expression -> update_table
+            | RETURN (event_type EVENTS)?                             -> return_query
+sink_target: INNER_STREAM_ID | FAULT_STREAM_ID | NAME
+set_clause: SET set_item ("," set_item)*
+set_item: variable_ref "=" expression
+event_type: CURRENT | EXPIRED | ALL
+
+// partition
+partition: annotation* PARTITION WITH "(" partition_item ("," partition_item)* ")" BEGIN (query ";"?)+ END
+partition_item: expression AS STRING_LITERAL (OR expression AS STRING_LITERAL)* OF stream_id -> range_partition
+              | expression OF stream_id                                                       -> value_partition
+
+// ---------------- expressions ----------------
+expression: or_expr
+or_expr: and_expr (OR and_expr)*
+and_expr: not_expr (AND not_expr)*
+not_expr: NOT not_expr -> not_op
+        | comparison
+comparison: addsub (comp_op addsub)?
+          | addsub IS NULL -> is_null_op
+          | addsub IN NAME -> in_op
+comp_op: EQ | NEQ | GTE | LTE | GT | LT
+EQ: "=="
+NEQ: "!="
+GTE: ">="
+LTE: "<="
+GT: ">"
+LT: "<"
+addsub: muldiv (addsub_op muldiv)*
+addsub_op: PLUS | MINUS
+PLUS: "+"
+MINUS: "-"
+muldiv: unary (muldiv_op unary)*
+muldiv_op: MUL | DIV | MOD_OP
+MUL: "*"
+DIV: "/"
+MOD_OP: "%"
+unary: MINUS unary -> neg
+     | atom
+atom: "(" expression ")"
+    | function_call
+    | time_value
+    | constant
+    | variable_ref
+function_call: NAME ":" NAME "(" expr_list? ")" -> ns_function
+             | NAME "(" expr_list? ")"          -> plain_function
+expr_list: expression ("," expression)*
+
+variable_ref: NAME "[" stream_index "]" "." NAME  -> indexed_variable
+            | NAME "." NAME                        -> qualified_variable
+            | NAME                                 -> simple_variable
+stream_index: INT_LITERAL | LAST_KW
+LAST_KW: "last"i
+
+constant: STRING_LITERAL        -> string_const
+        | BOOL_LITERAL          -> bool_const
+        | SIGNED_FLOAT_LITERAL  -> float_const
+        | SIGNED_DOUBLE_LITERAL -> double_const
+        | SIGNED_LONG_LITERAL   -> long_const
+        | SIGNED_INT_LITERAL    -> int_const
+
+time_value: time_part+
+time_part: INT_LITERAL time_unit
+time_unit: YEARS | MONTHS | WEEKS | DAYS | HOURS | MINUTES | SECONDS | MILLISECONDS
+
+// ---------------- keywords (case-insensitive) ----------------
+DEFINE: "define"i
+STREAM: "stream"i
+TABLE: "table"i
+WINDOW: "window"i
+TRIGGER: "trigger"i
+FUNCTION: "function"i
+AGGREGATION: "aggregation"i
+FROM: "from"i
+SELECT: "select"i
+GROUP: "group"i
+BY: "by"i
+HAVING: "having"i
+ORDER: "order"i
+LIMIT: "limit"i
+OFFSET: "offset"i
+ASC: "asc"i
+DESC: "desc"i
+INSERT: "insert"i
+DELETE: "delete"i
+UPDATE: "update"i
+RETURN: "return"i
+INTO: "into"i
+SET: "set"i
+ON: "on"i
+OUTPUT: "output"i
+EVENTS: "events"i
+EVERY: "every"i
+AT: "at"i
+SNAPSHOT: "snapshot"i
+CURRENT: "current"i
+EXPIRED: "expired"i
+ALL: "all"i
+FIRST: "first"i
+LAST: "last"i
+JOIN: "join"i
+INNER: "inner"i
+OUTER: "outer"i
+LEFT: "left"i
+RIGHT: "right"i
+FULL: "full"i
+UNIDIRECTIONAL: "unidirectional"i
+WITHIN: "within"i
+PER: "per"i
+PARTITION: "partition"i
+WITH: "with"i
+BEGIN: "begin"i
+END: "end"i
+AND: "and"i
+OR: "or"i
+NOT: "not"i
+IS: "is"i
+NULL: "null"i
+IN: "in"i
+FOR: "for"i
+AS: "as"i
+OF: "of"i
+AGGREGATE: "aggregate"i
+
+YEARS: /years?/i
+MONTHS: /months?/i
+WEEKS: /weeks?/i
+DAYS: /days?/i
+HOURS: /hours?/i
+MINUTES: /min(utes?)?/i
+SECONDS: /sec(onds?)?/i
+MILLISECONDS: /milli(sec(onds?)?)?/i
+
+BOOL_LITERAL: /true|false/i
+TRUE: "true"i
+FALSE: "false"i
+
+NAME: /[A-Za-z_][A-Za-z_0-9]*/
+SIGNED_INT_LITERAL: /-?\d+/
+INT_LITERAL: /\d+/
+SIGNED_LONG_LITERAL: /-?\d+[lL]/
+SIGNED_FLOAT_LITERAL: /-?(\d+\.\d*|\.\d+|\d+)[fF]/
+SIGNED_DOUBLE_LITERAL: /-?(\d+\.\d*|\.\d+)[dD]?|-?\d+[dD]/
+STRING_LITERAL: /'[^']*'|"[^"]*"|"""(.|\n)*?"""/
+
+LINE_COMMENT: /--[^\n]*/
+BLOCK_COMMENT: "/*" /(.|\n)*?/ "*/"
+%ignore LINE_COMMENT
+%ignore BLOCK_COMMENT
+%ignore /\s+/
+'''

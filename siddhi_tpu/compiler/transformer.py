@@ -1,0 +1,909 @@
+"""Lark parse tree → query_api AST (the TPU build's equivalent of the
+reference's SiddhiQLBaseVisitorImpl.java, 3,080 LoC)."""
+
+from __future__ import annotations
+
+from lark import Token, Transformer, v_args
+
+from ..query_api import (
+    AbsentStreamStateElement,
+    AggregationDefinition,
+    And,
+    Annotation,
+    Attribute,
+    AttributeFunction,
+    AttributeType,
+    Compare,
+    CompareOp,
+    Constant,
+    CountStateElement,
+    Duration,
+    Element,
+    EventTrigger,
+    EveryStateElement,
+    Expression,
+    FunctionDefinition,
+    In,
+    IsNull,
+    JoinInputStream,
+    JoinType,
+    LogicalStateElement,
+    MathExpression,
+    MathOp,
+    NextStateElement,
+    Not,
+    Or,
+    OrderByAttribute,
+    OrderByOrder,
+    OutputAction,
+    OutputAttribute,
+    OutputEventType,
+    OutputRate,
+    OutputRateType,
+    OutputStream,
+    Partition,
+    Query,
+    RangePartitionProperty,
+    RangePartitionType,
+    Selector,
+    SiddhiApp,
+    SingleInputStream,
+    StateInputStream,
+    StateType,
+    StreamDefinition,
+    StreamHandlerChain,
+    StreamStateElement,
+    TableDefinition,
+    TriggerDefinition,
+    UpdateSetAttribute,
+    ValuePartitionType,
+    Variable,
+    WindowDefinition,
+    WindowHandler,
+)
+from ..query_api.execution import StreamHandlerChain as HandlerChain
+
+
+def _unquote(tok: str) -> str:
+    s = str(tok)
+    if s.startswith('"""') and s.endswith('"""'):
+        return s[3:-3]
+    return s[1:-1]
+
+
+_TIME_UNIT_MS = {
+    "year": 31_536_000_000, "month": 2_592_000_000, "week": 604_800_000,
+    "day": 86_400_000, "hour": 3_600_000, "min": 60_000, "sec": 1_000,
+    "milli": 1,
+}
+
+
+def _unit_ms(tok: Token) -> int:
+    t = tok.type
+    return {
+        "YEARS": _TIME_UNIT_MS["year"], "MONTHS": _TIME_UNIT_MS["month"],
+        "WEEKS": _TIME_UNIT_MS["week"], "DAYS": _TIME_UNIT_MS["day"],
+        "HOURS": _TIME_UNIT_MS["hour"], "MINUTES": _TIME_UNIT_MS["min"],
+        "SECONDS": _TIME_UNIT_MS["sec"], "MILLISECONDS": _TIME_UNIT_MS["milli"],
+    }[t]
+
+
+class _Filter:
+    def __init__(self, expr: Expression):
+        self.expr = expr
+
+
+class _StreamFn:
+    def __init__(self, handler: WindowHandler):
+        self.handler = handler
+
+
+class _Window:
+    def __init__(self, handler: WindowHandler):
+        self.handler = handler
+
+
+def _build_chain(handlers: list) -> HandlerChain:
+    filters, pre_fns, post_fns, post_filters = [], [], [], []
+    window = None
+    for h in handlers:
+        if isinstance(h, _Filter):
+            (post_filters if window else filters).append(h.expr)
+        elif isinstance(h, _StreamFn):
+            (post_fns if window else pre_fns).append(h.handler)
+        elif isinstance(h, _Window):
+            window = h.handler
+    return HandlerChain(
+        filters=tuple(filters),
+        pre_window_functions=tuple(pre_fns),
+        window=window,
+        post_window_functions=tuple(post_fns),
+        post_window_filters=tuple(post_filters),
+    )
+
+
+@v_args(inline=True)
+class AstTransformer(Transformer):
+    # ---------------- expressions ----------------
+
+    def expression(self, e):
+        return e
+
+    def or_expr(self, first, *rest):
+        out = first
+        for item in rest:
+            if isinstance(item, Token):  # OR token
+                continue
+            out = Or(out, item)
+        return out
+
+    def and_expr(self, first, *rest):
+        out = first
+        for item in rest:
+            if isinstance(item, Token):
+                continue
+            out = And(out, item)
+        return out
+
+    def not_op(self, _not, e):
+        return Not(e)
+
+    def not_expr(self, e):
+        return e
+
+    def unary(self, e):
+        return e
+
+    def comparison(self, left, *rest):
+        if not rest:
+            return left
+        op_tok, right = rest
+        return Compare(left, CompareOp(str(op_tok)), right)
+
+    def comp_op(self, tok):
+        return tok
+
+    def is_null_op(self, e, _is, _null):
+        if isinstance(e, Variable) and e.stream_id is None and e.stream_index is None:
+            # bare `e2 is null` in patterns refers to a stream ref; the planner
+            # decides variable-vs-stream by name resolution. Keep both.
+            return IsNull(expression=e, stream_id=e.attribute)
+        return IsNull(expression=e)
+
+    def in_op(self, e, _in, name):
+        return In(e, str(name))
+
+    def addsub(self, first, *rest):
+        out = first
+        for i in range(0, len(rest), 2):
+            op, operand = rest[i], rest[i + 1]
+            out = MathExpression(MathOp(str(op)), out, operand)
+        return out
+
+    def addsub_op(self, tok):
+        return tok
+
+    def muldiv(self, first, *rest):
+        out = first
+        for i in range(0, len(rest), 2):
+            op, operand = rest[i], rest[i + 1]
+            out = MathExpression(MathOp(str(op)), out, operand)
+        return out
+
+    def muldiv_op(self, tok):
+        return tok
+
+    def neg(self, _minus, e):
+        if isinstance(e, Constant) and isinstance(e.value, (int, float)):
+            return Constant(-e.value, e.type_name)
+        return MathExpression(MathOp.SUBTRACT, Constant(0, "int"), e)
+
+    def atom(self, e):
+        return e
+
+    def ns_function(self, ns, name, *args):
+        params = args[0] if args else ()
+        return AttributeFunction(str(ns), str(name), tuple(params))
+
+    def plain_function(self, name, *args):
+        params = args[0] if args else ()
+        return AttributeFunction("", str(name), tuple(params))
+
+    def expr_list(self, *exprs):
+        return list(exprs)
+
+    def indexed_variable(self, stream, index, attr):
+        if isinstance(index, Token) and index.type == "LAST_KW":
+            return Variable(str(attr), stream_id=str(stream), is_last=True)
+        return Variable(str(attr), stream_id=str(stream), stream_index=int(index))
+
+    def stream_index(self, tok):
+        return tok
+
+    def qualified_variable(self, stream, attr):
+        return Variable(str(attr), stream_id=str(stream))
+
+    def simple_variable(self, name):
+        return Variable(str(name))
+
+    def string_const(self, tok):
+        return Constant(_unquote(tok), "string")
+
+    def bool_const(self, tok):
+        return Constant(str(tok).lower() == "true", "bool")
+
+    def int_const(self, tok):
+        return Constant(int(str(tok)), "int")
+
+    def long_const(self, tok):
+        return Constant(int(str(tok)[:-1]), "long")
+
+    def float_const(self, tok):
+        return Constant(float(str(tok)[:-1]), "float")
+
+    def double_const(self, tok):
+        s = str(tok)
+        if s[-1] in "dD":
+            s = s[:-1]
+        return Constant(float(s), "double")
+
+    def time_value(self, *parts):
+        return Constant(int(sum(parts)), "time")
+
+    def time_part(self, value, unit):
+        return int(str(value)) * _unit_ms(unit)
+
+    def time_unit(self, tok):
+        return tok
+
+    # ---------------- annotations ----------------
+
+    def qualified_name(self, *names):
+        return ":".join(str(n) for n in names)
+
+    def annotation(self, name, *body):
+        elements, nested = [], []
+        if body:
+            for item in body[0]:
+                if isinstance(item, Annotation):
+                    nested.append(item)
+                else:
+                    elements.append(item)
+        return Annotation(str(name), tuple(elements), tuple(nested))
+
+    def app_annotation(self, _app_kw, name, *body):
+        elements, nested = [], []
+        if body:
+            for item in body[0]:
+                if isinstance(item, Annotation):
+                    nested.append(item)
+                else:
+                    elements.append(item)
+        return Annotation(f"app:{name}", tuple(elements), tuple(nested))
+
+    def annotation_body(self, *items):
+        return list(items)
+
+    def annotation_item(self, item):
+        return item
+
+    def keyed_element(self, *parts):
+        *keys, value = parts
+        return Element(".".join(str(k) for k in keys), value)
+
+    def bare_element(self, value):
+        return Element(None, value)
+
+    def literal_value(self, tok):
+        if tok.type == "STRING_LITERAL":
+            return _unquote(tok)
+        return str(tok)
+
+    # ---------------- definitions ----------------
+
+    def attr_type(self, tok):
+        return AttributeType.parse(str(tok))
+
+    def attr_def(self, name, type_):
+        return Attribute(str(name), type_)
+
+    def attr_list(self, *attrs):
+        return tuple(attrs)
+
+    def stream_id(self, tok):
+        return str(tok)
+
+    def define_stream(self, *parts):
+        anns, rest = _split_annotations(parts)
+        _define, _stream, name, attrs = rest
+        return StreamDefinition(id=str(name), attributes=attrs, annotations=anns)
+
+    def define_table(self, *parts):
+        anns, rest = _split_annotations(parts)
+        _define, _table, name, attrs = rest
+        return TableDefinition(id=str(name), attributes=attrs, annotations=anns)
+
+    def window_spec(self, name, *args):
+        params = args[0] if args else ()
+        return WindowHandler("", str(name), tuple(params))
+
+    def output_event_kw(self, _out, etype, _events):
+        return etype
+
+    def define_window(self, *parts):
+        anns, rest = _split_annotations(parts)
+        _define, _window, name, attrs, *extra = rest
+        window = None
+        out_type = "all"
+        for e in extra:
+            if isinstance(e, WindowHandler):
+                window = e
+            elif isinstance(e, OutputEventType):
+                out_type = e.name.lower()
+        return WindowDefinition(id=str(name), attributes=attrs, annotations=anns,
+                                window=window, output_event_type=out_type)
+
+    def trigger_every(self, _every, tv):
+        return ("every", tv.value)
+
+    def trigger_cron_or_start(self, tok):
+        s = _unquote(tok)
+        return ("start", None) if s.lower() == "start" else ("cron", s)
+
+    def define_trigger(self, *parts):
+        anns, rest = _split_annotations(parts)
+        _define, _trigger, name, _at, at = rest
+        kind, val = at
+        return TriggerDefinition(
+            id=str(name),
+            at_every_ms=val if kind == "every" else None,
+            at_cron=val if kind == "cron" else None,
+            at_start=kind == "start",
+            annotations=anns,
+        )
+
+    def define_function(self, *parts):
+        anns, rest = _split_annotations(parts)
+        _define, _function, name, lang, _ret, rtype, body = rest
+        return FunctionDefinition(id=str(name), language=str(lang),
+                                  return_type=rtype, body=str(body)[1:-1].strip())
+
+    def duration_name(self, tok):
+        return Duration.parse(str(tok))
+
+    def duration_dots(self, lo, *rest):
+        hi = rest[0] if rest else lo
+        durs = list(Duration)
+        return tuple(durs[lo.order:hi.order + 1])
+
+    def duration_list(self, *durs):
+        return tuple(sorted(set(durs), key=lambda d: d.order))
+
+    def duration_single(self, d):
+        return (d,)
+
+    def aggregate_clause(self, _agg, *rest):
+        by_attr = None
+        items = list(rest)
+        if items and isinstance(items[0], Token) and items[0].type == "BY":
+            by_attr = items[1].attribute
+            items = items[2:]
+        # items: [EVERY token, durations tuple]
+        durations = items[-1]
+        return (by_attr, durations)
+
+    def define_aggregation(self, *parts):
+        anns, rest = _split_annotations(parts)
+        _define, _aggregation, name, _from, stream, *clauses = rest
+        selector = Selector()
+        group_by = ()
+        agg = (None, ())
+        for c in clauses:
+            if isinstance(c, Selector):
+                selector = c
+            elif isinstance(c, tuple) and c and isinstance(c[0], Variable):
+                group_by = c
+            elif isinstance(c, tuple):
+                agg = c
+        by_attr, durations = agg
+        return AggregationDefinition(
+            id=str(name), input_stream_id=str(stream),
+            selector=Selector(attributes=selector.attributes,
+                              group_by=group_by, having=selector.having),
+            group_by=group_by, aggregate_attribute=by_attr,
+            durations=durations, annotations=anns)
+
+    def definition(self, d):
+        return d
+
+    # ---------------- query input ----------------
+
+    def source(self, tok):
+        s = str(tok)
+        if s.startswith("#"):
+            return ("inner", s[1:])
+        if s.startswith("!"):
+            return ("fault", s[1:])
+        return ("plain", s)
+
+    def handler_chain(self, *handlers):
+        return list(handlers)
+
+    def stream_handler(self, h):
+        return h
+
+    def filter(self, expr):
+        return _Filter(expr)
+
+    def function_id_pair(self, *names):
+        if len(names) == 2:
+            return (str(names[0]), str(names[1]))
+        return ("", str(names[0]))
+
+    def function_id(self, name):
+        return str(name)
+
+    def stream_function_h(self, pair, *args):
+        ns, name = pair
+        params = args[0] if args else ()
+        return _StreamFn(WindowHandler(ns, name, tuple(params)))
+
+    def window_h(self, _window_kw, name, *args):
+        params = args[0] if args else ()
+        return _Window(WindowHandler("", str(name), tuple(params)))
+
+    def standard_stream(self, source, handlers):
+        kind, sid = source
+        return SingleInputStream(
+            stream_id=sid,
+            handlers=_build_chain(handlers),
+            is_inner=kind == "inner",
+            is_fault=kind == "fault",
+        )
+
+    def alias_name(self, tok):
+        return str(tok)
+
+    def join_side(self, source, handlers, *rest):
+        kind, sid = source
+        alias = None
+        unidirectional = False
+        for r in rest:
+            if isinstance(r, str):
+                alias = r
+            elif isinstance(r, Token) and r.type == "UNIDIRECTIONAL":
+                unidirectional = True
+        s = SingleInputStream(stream_id=sid, alias=alias,
+                              handlers=_build_chain(handlers),
+                              is_inner=kind == "inner", is_fault=kind == "fault")
+        return (s, unidirectional)
+
+    def inner_join(self, *_):
+        return JoinType.INNER
+
+    def left_outer_join(self, *_):
+        return JoinType.LEFT_OUTER
+
+    def right_outer_join(self, *_):
+        return JoinType.RIGHT_OUTER
+
+    def full_outer_join(self, *_):
+        return JoinType.FULL_OUTER
+
+    def right_unidirectional(self, tok):
+        return ("right_uni",)
+
+    def within_clause(self, _within, tv):
+        return ("within", tv.value)
+
+    def per_clause(self, _per, e):
+        return ("per", e)
+
+    def join_stream(self, left_pair, join_type, right_pair, *rest):
+        left, left_uni = left_pair
+        right, right_uni = right_pair
+        on = None
+        within_ms = None
+        per = None
+        for r in rest:
+            if isinstance(r, tuple) and r[0] == "within":
+                within_ms = r[1]
+            elif isinstance(r, tuple) and r[0] == "per":
+                per = r[1]
+            elif isinstance(r, tuple) and r[0] == "right_uni":
+                right_uni = True
+            elif isinstance(r, Expression):
+                on = r
+            elif isinstance(r, Token):
+                continue
+        if left_uni and right_uni:
+            raise ValueError("both sides cannot be unidirectional")
+        trigger = EventTrigger.ALL
+        if left_uni:
+            trigger = EventTrigger.LEFT
+        elif right_uni:
+            trigger = EventTrigger.RIGHT
+        return JoinInputStream(left=left, right=right, join_type=join_type,
+                               on=on, trigger=trigger, within_ms=within_ms, per=per)
+
+    # ---------------- patterns / sequences ----------------
+
+    def event_ref(self, tok):
+        return str(tok)
+
+    def event_def(self, *parts):
+        ref = None
+        items = list(parts)
+        if isinstance(items[0], str) and not isinstance(items[0], tuple):
+            ref = items.pop(0)
+        source, handlers = items
+        kind, sid = source
+        s = SingleInputStream(stream_id=sid, alias=ref,
+                              handlers=_build_chain(handlers),
+                              is_inner=kind == "inner", is_fault=kind == "fault")
+        return StreamStateElement(s)
+
+    def count_min_max(self, lo, hi):
+        return (int(lo), int(hi))
+
+    def count_min(self, lo):
+        return (int(lo), CountStateElement.ANY)
+
+    def count_max(self, hi):
+        return (1, int(hi))
+
+    def count_exact(self, n):
+        return (int(n), int(n))
+
+    def counted_state(self, elem, *count):
+        if count:
+            lo, hi = count[0]
+            return CountStateElement(elem, lo, hi)
+        return elem
+
+    def absent_state(self, _not, elem, *rest):
+        wait = None
+        for r in rest:
+            if isinstance(r, Constant):
+                wait = r.value
+            elif isinstance(r, Token) and r.type == "FOR":
+                continue
+        return AbsentStreamStateElement(elem.stream, waiting_time_ms=wait)
+
+    def nested_chain(self, chain):
+        return chain
+
+    def logical_state(self, first, *rest):
+        if not rest:
+            return first
+        op_tok, right = rest
+        return LogicalStateElement(first, str(op_tok).lower(), right)
+
+    def pattern_inner(self, e):
+        return e
+
+    def every_group(self, _every, inner):
+        return EveryStateElement(inner)
+
+    def every_part(self, _every, inner):
+        return EveryStateElement(inner)
+
+    def plain_part(self, inner):
+        return inner
+
+    def every_pattern_chain(self, *parts):
+        within_ms = None
+        elems = []
+        for p in parts:
+            if isinstance(p, tuple) and p and p[0] == "within":
+                within_ms = p[1]
+            elif isinstance(p, Token):
+                continue
+            else:
+                elems.append(p)
+        state = elems[0]
+        for nxt in elems[1:]:
+            state = NextStateElement(state, nxt)
+        return ("chain", state, within_ms)
+
+    def pattern_stream(self, chain):
+        _tag, state, within_ms = chain
+        return StateInputStream(StateType.PATTERN, state, within_ms)
+
+    # sequences
+    def counted_seq(self, elem, *spec):
+        if spec:
+            lo, hi = spec[0]
+            return CountStateElement(elem, lo, hi)
+        return elem
+
+    def zero_or_more(self):
+        return (0, CountStateElement.ANY)
+
+    def one_or_more(self):
+        return (1, CountStateElement.ANY)
+
+    def zero_or_one(self):
+        return (0, 1)
+
+    def absent_seq(self, _not, elem, *rest):
+        wait = None
+        for r in rest:
+            if isinstance(r, Constant):
+                wait = r.value
+        return AbsentStreamStateElement(elem.stream, waiting_time_ms=wait)
+
+    def logical_state_seq(self, first, *rest):
+        if not rest:
+            return first
+        op_tok, right = rest
+        return LogicalStateElement(first, str(op_tok).lower(), right)
+
+    def seq_part(self, e):
+        return e
+
+    def seq_first(self, *parts):
+        if len(parts) == 2:  # EVERY part
+            return EveryStateElement(parts[1])
+        return parts[0]
+
+    def sequence_chain(self, *parts):
+        within_ms = None
+        elems = []
+        for p in parts:
+            if isinstance(p, tuple) and p and p[0] == "within":
+                within_ms = p[1]
+            else:
+                elems.append(p)
+        state = elems[0]
+        for nxt in elems[1:]:
+            state = NextStateElement(state, nxt)
+        return ("seq", state, within_ms)
+
+    def sequence_stream(self, chain):
+        _tag, state, within_ms = chain
+        return StateInputStream(StateType.SEQUENCE, state, within_ms)
+
+    def state_stream(self, s):
+        return s
+
+    def query_input(self, s):
+        return s
+
+    # ---------------- select / output ----------------
+
+    def output_attr(self, expr, *rename):
+        name = None
+        for r in rename:
+            if isinstance(r, Token) and r.type == "NAME":
+                name = str(r)
+        if name is None:
+            if isinstance(expr, Variable):
+                name = expr.attribute
+            elif isinstance(expr, AttributeFunction):
+                name = expr.name
+            else:
+                name = "expr"
+        return OutputAttribute(name, expr)
+
+    def select_clause(self, _select, *attrs):
+        if len(attrs) == 1 and isinstance(attrs[0], Token) and attrs[0].type == "STAR":
+            return Selector()
+        return Selector(attributes=tuple(a for a in attrs if isinstance(a, OutputAttribute)))
+
+    def group_by_clause(self, _group, _by, *vars_):
+        return tuple(vars_)
+
+    def having_clause(self, _having, e):
+        return ("having", e)
+
+    def order_item(self, var, *order):
+        o = OrderByOrder.ASC
+        for t in order:
+            if isinstance(t, Token) and t.type == "DESC":
+                o = OrderByOrder.DESC
+        return OrderByAttribute(var, o)
+
+    def order_by_clause(self, _order, _by, *items):
+        return ("order_by", tuple(items))
+
+    def limit_clause(self, _limit, n):
+        return ("limit", int(n))
+
+    def offset_clause(self, _offset, n):
+        return ("offset", int(n))
+
+    def rate_kind(self, tok):
+        return tok
+
+    def rate_time(self, _output, *rest):
+        kind = OutputRateType.ALL
+        tv = rest[-1]
+        for r in rest:
+            if isinstance(r, Token) and r.type in ("ALL", "FIRST", "LAST"):
+                kind = OutputRateType(str(r).lower())
+        return OutputRate(type=kind, time_ms=tv.value)
+
+    def rate_events(self, _output, *rest):
+        kind = OutputRateType.ALL
+        n = None
+        for r in rest:
+            if isinstance(r, Token) and r.type in ("ALL", "FIRST", "LAST"):
+                kind = OutputRateType(str(r).lower())
+            elif isinstance(r, Token) and r.type == "INT_LITERAL":
+                n = int(r)
+        return OutputRate(type=kind, event_count=n)
+
+    def rate_snapshot(self, _output, _snapshot, _every, tv):
+        return OutputRate(type=OutputRateType.SNAPSHOT, time_ms=tv.value)
+
+    def event_type(self, tok):
+        return OutputEventType[str(tok).upper()]
+
+    def sink_target(self, tok):
+        return tok
+
+    def insert_into(self, _insert, *rest):
+        etype = OutputEventType.CURRENT
+        target = None
+        for r in rest:
+            if isinstance(r, OutputEventType):
+                etype = r
+            elif isinstance(r, Token) and r.type in ("NAME", "INNER_STREAM_ID", "FAULT_STREAM_ID"):
+                target = str(r)
+        is_fault = target.startswith("!")
+        if target.startswith(("#", "!")):
+            target = target[1:]
+        return OutputStream(OutputAction.INSERT, target_id=target,
+                            event_type=etype, is_fault=is_fault)
+
+    def set_item(self, var, expr):
+        return UpdateSetAttribute(var, expr)
+
+    def set_clause(self, _set, *items):
+        return ("set", tuple(items))
+
+    def delete_from(self, _delete, name, *rest):
+        etype, cond, _ = _parse_output_rest(rest)
+        return OutputStream(OutputAction.DELETE, target_id=str(name),
+                            event_type=etype, on_condition=cond)
+
+    def update_table(self, _update, name, *rest):
+        etype, cond, sets = _parse_output_rest(rest)
+        return OutputStream(OutputAction.UPDATE, target_id=str(name),
+                            event_type=etype, on_condition=cond, set_attributes=sets)
+
+    def update_or_insert(self, _update, _or, _insert, _into, name, *rest):
+        etype, cond, sets = _parse_output_rest(rest)
+        return OutputStream(OutputAction.UPDATE_OR_INSERT, target_id=str(name),
+                            event_type=etype, on_condition=cond, set_attributes=sets)
+
+    def return_query(self, _return, *rest):
+        etype = OutputEventType.CURRENT
+        for r in rest:
+            if isinstance(r, OutputEventType):
+                etype = r
+        return OutputStream(OutputAction.RETURN, event_type=etype)
+
+    def query_output(self, o):
+        return o
+
+    def query(self, *parts):
+        anns, rest = _split_annotations(parts)
+        input_stream = None
+        selector_parts = {"selector": Selector(), "group_by": (), "having": None,
+                          "order_by": (), "limit": None, "offset": None}
+        output_rate = None
+        output_stream = None
+        for p in rest:
+            if isinstance(p, Token):
+                continue
+            if isinstance(p, (SingleInputStream, JoinInputStream, StateInputStream)):
+                input_stream = p
+            elif isinstance(p, Selector):
+                selector_parts["selector"] = p
+            elif isinstance(p, tuple) and p and isinstance(p[0], Variable):
+                selector_parts["group_by"] = p
+            elif isinstance(p, tuple) and p and p[0] == "having":
+                selector_parts["having"] = p[1]
+            elif isinstance(p, tuple) and p and p[0] == "order_by":
+                selector_parts["order_by"] = p[1]
+            elif isinstance(p, tuple) and p and p[0] == "limit":
+                selector_parts["limit"] = p[1]
+            elif isinstance(p, tuple) and p and p[0] == "offset":
+                selector_parts["offset"] = p[1]
+            elif isinstance(p, OutputRate):
+                output_rate = p
+            elif isinstance(p, OutputStream):
+                output_stream = p
+        base = selector_parts["selector"]
+        selector = Selector(
+            attributes=base.attributes,
+            group_by=selector_parts["group_by"],
+            having=selector_parts["having"],
+            order_by=selector_parts["order_by"],
+            limit=selector_parts["limit"],
+            offset=selector_parts["offset"],
+        )
+        return Query(input_stream=input_stream, selector=selector,
+                     output_stream=output_stream or OutputStream(OutputAction.RETURN),
+                     output_rate=output_rate, annotations=anns)
+
+    # ---------------- partition ----------------
+
+    def value_partition(self, expr, _of, stream):
+        return ValuePartitionType(stream_id=str(stream), expression=expr)
+
+    def range_partition(self, *parts):
+        stream = str(parts[-1])
+        exprs, keys = [], []
+        for p in parts[:-1]:
+            if isinstance(p, Expression):
+                exprs.append(p)
+            elif isinstance(p, Token) and p.type == "STRING_LITERAL":
+                keys.append(_unquote(p))
+        ranges = tuple(RangePartitionProperty(k, e) for e, k in zip(exprs, keys))
+        return RangePartitionType(stream_id=stream, ranges=ranges)
+
+    def partition_item(self, item):
+        return item
+
+    def partition(self, *parts):
+        anns, rest = _split_annotations(parts)
+        ptypes = []
+        queries = []
+        for p in rest:
+            if isinstance(p, (ValuePartitionType, RangePartitionType)):
+                ptypes.append(p)
+            elif isinstance(p, Query):
+                queries.append(p)
+        return Partition(partition_types=tuple(ptypes), queries=tuple(queries),
+                         annotations=anns)
+
+    def execution_element(self, e):
+        return e
+
+    # ---------------- app ----------------
+
+    def start(self, *items):
+        app = SiddhiApp()
+        for item in items:
+            if isinstance(item, Annotation):
+                app.annotations.append(item)
+            elif isinstance(item, StreamDefinition):
+                app.define_stream(item)
+            elif isinstance(item, TableDefinition):
+                app.define_table(item)
+            elif isinstance(item, WindowDefinition):
+                app.define_window(item)
+            elif isinstance(item, TriggerDefinition):
+                app.define_trigger(item)
+            elif isinstance(item, AggregationDefinition):
+                app.define_aggregation(item)
+            elif isinstance(item, FunctionDefinition):
+                app.define_function(item)
+            elif isinstance(item, Query):
+                app.add_query(item)
+            elif isinstance(item, Partition):
+                app.add_partition(item)
+        return app
+
+
+def _split_annotations(parts):
+    anns = tuple(p for p in parts if isinstance(p, Annotation))
+    rest = [p for p in parts if not isinstance(p, Annotation)]
+    return anns, rest
+
+
+def _parse_output_rest(rest):
+    etype = OutputEventType.CURRENT
+    cond = None
+    sets = ()
+    for r in rest:
+        if isinstance(r, OutputEventType):
+            etype = r
+        elif isinstance(r, tuple) and r and r[0] == "set":
+            sets = r[1]
+        elif isinstance(r, Expression):
+            cond = r
+    return etype, cond, sets

@@ -1,0 +1,181 @@
+"""SiddhiAppRuntime — one planned, running app.
+
+Reference: core/SiddhiAppRuntimeImpl.java:103 (junction map:124, query map:122,
+start():449, shutdown():552, persist():686). The TPU build keeps the same user
+surface but execution is synchronous single-controller: sends stage rows into
+junction buffers; flush() drives every staged batch through the jitted query
+pipeline and cascades device-to-device until quiescent.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..errors import (
+    DefinitionNotExistError,
+    SiddhiAppCreationError,
+)
+from ..extension.registry import Registry
+from ..query_api import Query, SiddhiApp, StreamDefinition
+from ..query_api.execution import OutputAction, SingleInputStream
+from .context import SiddhiAppContext, Statistics, TimestampGenerator
+from .event import StreamCodec
+from .query_runtime import FunctionQueryCallback, QueryCallback, QueryRuntime
+from .stream import (
+    FunctionStreamCallback,
+    InputHandler,
+    StreamCallback,
+    StreamJunction,
+)
+
+
+class SiddhiAppRuntime:
+    def __init__(self, app: SiddhiApp, registry: Registry,
+                 batch_size: int = 0, group_capacity: int = 0) -> None:
+        self.app = app
+        playback_ann = app.annotation("app:playback")
+        self.ctx = SiddhiAppContext(
+            name=app.name,
+            registry=registry,
+            timestamp_generator=TimestampGenerator(playback=playback_ann is not None),
+            batch_size=batch_size,
+            group_capacity=group_capacity,
+            playback=playback_ann is not None,
+        )
+        self.ctx.runtime = self
+        stats_ann = app.annotation("app:statistics")
+        if stats_ann is not None:
+            self.ctx.statistics = Statistics(enabled=True, level="BASIC")
+
+        self.junctions: dict[str, StreamJunction] = {}
+        self.input_handlers: dict[str, InputHandler] = {}
+        self.query_runtimes: dict[str, QueryRuntime] = {}
+        self.tables: dict = {}
+        self._started = False
+
+        self._build()
+
+    # ------------------------------------------------------------------ build
+
+    def _build(self) -> None:
+        app, ctx = self.app, self.ctx
+
+        for sd in app.stream_definitions.values():
+            self.junctions[sd.id] = StreamJunction(sd, ctx)
+
+        from .table import InMemoryTable
+        for td in app.table_definitions.values():
+            self.tables[td.id] = InMemoryTable(td, ctx)
+
+        for i, query in enumerate(app.queries):
+            self._add_query(query, f"query{i + 1}")
+
+        if app.partitions:
+            raise SiddhiAppCreationError("partitions are not yet supported")
+
+    def _add_query(self, query: Query, default_name: str) -> None:
+        if not isinstance(query.input_stream, SingleInputStream):
+            raise SiddhiAppCreationError(
+                f"{type(query.input_stream).__name__} queries are not yet supported")
+        sid = query.input_stream.stream_id
+        junction = self.junctions.get(sid)
+        if junction is None:
+            raise DefinitionNotExistError(f"stream {sid!r} is not defined")
+
+        name = query.name or default_name
+        qr = QueryRuntime(query, self.ctx, junction, self.ctx.registry, name=name)
+        junction.subscribe(qr)
+        self.query_runtimes[name] = qr
+
+        out = query.output_stream
+        if out.action == OutputAction.INSERT and out.target_id:
+            if out.target_id in self.tables:
+                qr.table = self.tables[out.target_id]
+                qr.output_junction = None
+                qr.query.output_stream = out  # keep INSERT → table routing
+
+                def _to_table(batch, now, t=qr.table, q=qr):
+                    t.insert_batch(batch)
+                qr.output_junction = _TableJunctionAdapter(qr.table)
+            else:
+                target = self.junctions.get(out.target_id)
+                if target is None:
+                    # auto-define the output stream from the select list
+                    # (reference: OutputParser infers output stream definitions)
+                    sd = qr.output_definition
+                    target = StreamJunction(sd, self.ctx, codec=qr.output_codec)
+                    self.junctions[sd.id] = target
+                qr.output_junction = target
+        elif out.action in (OutputAction.DELETE, OutputAction.UPDATE,
+                            OutputAction.UPDATE_OR_INSERT):
+            table = self.tables.get(out.target_id)
+            if table is None:
+                raise DefinitionNotExistError(f"table {out.target_id!r} is not defined")
+            qr.table = table
+
+    # ---------------------------------------------------------------- control
+
+    def start(self) -> None:
+        self._started = True
+
+    def shutdown(self) -> None:
+        self._started = False
+
+    # ------------------------------------------------------------------- I/O
+
+    def get_input_handler(self, stream_id: str) -> InputHandler:
+        if stream_id not in self.input_handlers:
+            junction = self.junctions.get(stream_id)
+            if junction is None:
+                raise DefinitionNotExistError(f"stream {stream_id!r} is not defined")
+            self.input_handlers[stream_id] = InputHandler(junction)
+        return self.input_handlers[stream_id]
+
+    def add_callback(self, stream_id: str, callback) -> None:
+        junction = self.junctions.get(stream_id)
+        if junction is None:
+            raise DefinitionNotExistError(f"stream {stream_id!r} is not defined")
+        if not isinstance(callback, StreamCallback):
+            callback = FunctionStreamCallback(callback)
+        junction.subscribe(callback)
+
+    def add_query_callback(self, query_name: str, callback) -> None:
+        qr = self.query_runtimes.get(query_name)
+        if qr is None:
+            raise DefinitionNotExistError(f"query {query_name!r} is not defined")
+        if not isinstance(callback, QueryCallback):
+            callback = FunctionQueryCallback(callback)
+        qr.add_callback(callback)
+
+    def flush(self, now: Optional[int] = None) -> None:
+        """Drive every staged batch through the pipeline (source junctions
+        first; device-to-device chaining cascades synchronously)."""
+        for j in self.junctions.values():
+            j.flush(now)
+
+    def heartbeat(self, now: Optional[int] = None) -> None:
+        """Advance watermarks: flush + deliver empty timer batches to queries
+        with time-driven windows (the reference Scheduler's TIMER events)."""
+        t = now if now is not None else self.ctx.timestamp_generator.current_time()
+        self.flush(t)
+        seen: set[int] = set()
+        for qr in self.query_runtimes.values():
+            if qr.has_time_semantics and id(qr.input_junction) not in seen:
+                seen.add(id(qr.input_junction))
+                qr.input_junction.heartbeat(t)
+
+    # -------------------------------------------------------------- statistics
+
+    @property
+    def statistics(self) -> Statistics:
+        return self.ctx.statistics
+
+
+class _TableJunctionAdapter:
+    """Adapts the query-output junction interface onto a table insert."""
+
+    def __init__(self, table) -> None:
+        self.table = table
+
+    def publish_batch(self, batch, now) -> None:
+        self.table.insert_batch(batch)

@@ -1,0 +1,93 @@
+"""Per-app shared services (reference: core/config/SiddhiAppContext.java:53).
+
+The TPU build's context is much smaller: no thread pools or locks — execution
+is single-controller and synchronous per micro-batch; state is functional. What
+remains: the timestamp generator (wall clock vs playback virtual time,
+reference core/util/timestamp/TimestampGeneratorImpl.java:31), the extension
+registry snapshot, batching knobs, and statistics.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..extension.registry import Registry
+from . import dtypes
+
+
+class TimestampGenerator:
+    """Wall-clock by default; in playback mode (@app:playback) time is driven
+    by event timestamps (reference TimestampGeneratorImpl.java:78-131)."""
+
+    def __init__(self, playback: bool = False,
+                 playback_increment_ms: int = 0) -> None:
+        self.playback = playback
+        self.playback_increment_ms = playback_increment_ms
+        self._last_event_ts: Optional[int] = None
+
+    def current_time(self) -> int:
+        if self.playback:
+            if self._last_event_ts is None:
+                return 0
+            return self._last_event_ts + self.playback_increment_ms
+        return int(time.time() * 1000)
+
+    def observe_event_time(self, ts: int) -> None:
+        if self._last_event_ts is None or ts > self._last_event_ts:
+            self._last_event_ts = ts
+
+
+@dataclass
+class Statistics:
+    """Per-app counters (reference: core/util/statistics/ — codahale registry;
+    here simple host counters; per-query latency tracked in QueryRuntime)."""
+
+    enabled: bool = False
+    level: str = "OFF"  # OFF | BASIC | DETAIL
+    events_in: dict = field(default_factory=dict)  # stream -> count
+    events_out: dict = field(default_factory=dict)
+    batches: dict = field(default_factory=dict)
+    query_latency_ns: dict = field(default_factory=dict)  # query -> (total, count)
+
+    def track_in(self, stream_id: str, n: int) -> None:
+        if self.enabled:
+            self.events_in[stream_id] = self.events_in.get(stream_id, 0) + n
+
+    def track_batch(self, stream_id: str) -> None:
+        if self.enabled:
+            self.batches[stream_id] = self.batches.get(stream_id, 0) + 1
+
+    def track_latency(self, query: str, ns: int) -> None:
+        if self.enabled:
+            t, c = self.query_latency_ns.get(query, (0, 0))
+            self.query_latency_ns[query] = (t + ns, c + 1)
+
+    def report(self) -> dict:
+        out = {"events_in": dict(self.events_in), "batches": dict(self.batches)}
+        out["query_latency_ms"] = {
+            q: (t / c / 1e6 if c else 0.0)
+            for q, (t, c) in self.query_latency_ns.items()}
+        return out
+
+
+@dataclass
+class SiddhiAppContext:
+    name: str
+    registry: Registry
+    timestamp_generator: TimestampGenerator
+    batch_size: int = 0  # 0 = dtypes.config.default_batch_size
+    group_capacity: int = 0
+    statistics: Statistics = field(default_factory=Statistics)
+    playback: bool = False
+    #: root runtime back-reference (set by SiddhiAppRuntime)
+    runtime: object = None
+
+    @property
+    def effective_batch_size(self) -> int:
+        return self.batch_size or dtypes.config.default_batch_size
+
+    @property
+    def effective_group_capacity(self) -> int:
+        return self.group_capacity or dtypes.config.default_group_capacity

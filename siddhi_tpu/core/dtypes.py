@@ -1,0 +1,96 @@
+"""Attribute-type → device dtype mapping.
+
+Reference semantics: Siddhi attributes are STRING/INT/LONG/FLOAT/DOUBLE/BOOL/OBJECT
+(query/api/definition/Attribute.java). On TPU:
+
+- INT  -> int32            (native)
+- LONG -> int64            (requires jax x64; we enable it at package import —
+                            timestamps are int64 milliseconds like the reference)
+- FLOAT -> float32         (native, VPU/MXU friendly)
+- DOUBLE -> float32 by default. Java doubles sequentially accumulated and f64 on
+  TPU is software-emulated and ~10x slower; tests use tolerances. Set
+  `siddhi_tpu.config.double_dtype = jnp.float64` for bit-closer parity.
+- BOOL -> bool_
+- STRING -> int32 dictionary codes. Strings are interned host-side per
+  (stream, attribute) in a StringTable at ingestion; device sees codes, so
+  string equality/group-by are integer ops. Code 0 is reserved for null/missing.
+- OBJECT -> host-only (kept in a Python list column; cannot enter device exprs).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..query_api.definition import AttributeType
+
+#: sentinel string code for null
+NULL_CODE = 0
+
+#: timestamp dtype — milliseconds since epoch, matching the reference's long ts.
+TS_DTYPE = jnp.int64
+
+
+class _Config:
+    double_dtype = jnp.float32
+    #: default micro-batch capacity per stream (events); the batching unit that
+    #: replaces the reference's Disruptor ring (StreamJunction.java:68 batchSize).
+    default_batch_size = 8192
+    #: default window ring-buffer capacity when not statically inferable.
+    default_window_capacity = 1 << 16
+    #: default max distinct group-by keys tracked on device per query.
+    default_group_capacity = 1 << 20
+
+
+config = _Config()
+
+
+def device_dtype(t: AttributeType):
+    if t == AttributeType.INT:
+        return jnp.int32
+    if t == AttributeType.LONG:
+        return jnp.int64
+    if t == AttributeType.FLOAT:
+        return jnp.float32
+    if t == AttributeType.DOUBLE:
+        return config.double_dtype
+    if t == AttributeType.BOOL:
+        return jnp.bool_
+    if t == AttributeType.STRING:
+        return jnp.int32  # dictionary codes
+    raise ValueError(f"attribute type {t} has no device dtype (OBJECT is host-only)")
+
+
+def numpy_dtype(t: AttributeType):
+    return np.dtype(device_dtype(t).__name__ if hasattr(device_dtype(t), "__name__") else device_dtype(t))
+
+
+def null_value(t: AttributeType):
+    """Fill value used in padded/invalid lanes."""
+    if t in (AttributeType.INT, AttributeType.LONG, AttributeType.STRING):
+        return 0
+    if t in (AttributeType.FLOAT, AttributeType.DOUBLE):
+        return 0.0
+    if t == AttributeType.BOOL:
+        return False
+    return None
+
+
+def is_numeric(t: AttributeType) -> bool:
+    return t in (AttributeType.INT, AttributeType.LONG, AttributeType.FLOAT, AttributeType.DOUBLE)
+
+
+#: promotion lattice for binary math, mirroring the reference's per-type-pair
+#: executor selection (core/executor/math/*): int < long < float < double.
+_RANK = {
+    AttributeType.INT: 0,
+    AttributeType.LONG: 1,
+    AttributeType.FLOAT: 2,
+    AttributeType.DOUBLE: 3,
+}
+
+
+def promote(a: AttributeType, b: AttributeType) -> AttributeType:
+    if not (is_numeric(a) and is_numeric(b)):
+        raise TypeError(f"cannot apply arithmetic to {a}/{b}")
+    return a if _RANK[a] >= _RANK[b] else b

@@ -1,0 +1,243 @@
+"""Columnar event model — the TPU data plane.
+
+Reference design (core/event/): events are heap objects (`StreamEvent.java:38`
+with three `Object[]` segments) chained into linked lists and walked one at a
+time. That shape cannot feed a systolic array. The TPU-native replacement is a
+**struct-of-arrays micro-batch**:
+
+    EventBatch
+      ts     : int64[B]            arrival/event timestamps (ms)
+      cols   : {attr: dtype[B]}    one fixed-dtype array per attribute
+      valid  : bool[B]             lane validity (filters mask, never compact
+                                   on device — compaction happens host-side)
+      types  : int8[B]             CURRENT/EXPIRED/TIMER/RESET, matching
+                                   ComplexEvent.Type semantics
+
+Batches are padded to fixed capacities so every query step compiles once and
+reuses the executable (XLA static shapes). `Event` remains as the host-side
+user-facing single event (reference: core/event/Event.java).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from dataclasses import dataclass
+from typing import Iterable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..query_api.definition import AttributeType, StreamDefinition
+from . import dtypes
+from .dtypes import NULL_CODE
+
+
+class EventType(enum.IntEnum):
+    """Reference: core/event/ComplexEvent.java Type enum."""
+
+    CURRENT = 0
+    EXPIRED = 1
+    TIMER = 2
+    RESET = 3
+
+
+@dataclass
+class Event:
+    """Host-side single event (reference: core/event/Event.java)."""
+
+    timestamp: int
+    data: tuple
+    is_expired: bool = False
+
+    def __iter__(self):
+        return iter(self.data)
+
+
+class StringTable:
+    """Host-side string interner for one stream attribute. Device arrays carry
+    int32 codes; the table maps code <-> string. Code 0 is null.
+
+    TPU rationale: string group-by keys in the reference are Java string-concat
+    HashMap keys (GroupByKeyGenerator.java:37); dictionary encoding turns them
+    into device integer ops.
+    """
+
+    def __init__(self) -> None:
+        self._to_code: dict[str, int] = {}
+        self._to_str: list[Optional[str]] = [None]  # code 0 = null
+
+    def encode(self, s: Optional[str]) -> int:
+        if s is None:
+            return NULL_CODE
+        code = self._to_code.get(s)
+        if code is None:
+            code = len(self._to_str)
+            self._to_code[s] = code
+            self._to_str.append(s)
+        return code
+
+    def decode(self, code: int) -> Optional[str]:
+        return self._to_str[code] if 0 <= code < len(self._to_str) else None
+
+    def encode_many(self, values: Sequence[Optional[str]]) -> np.ndarray:
+        return np.fromiter((self.encode(v) for v in values), dtype=np.int32, count=len(values))
+
+    def __len__(self) -> int:
+        return len(self._to_str)
+
+    # snapshot support
+    def snapshot(self) -> list:
+        return list(self._to_str)
+
+    def restore(self, strings: list) -> None:
+        self._to_str = list(strings)
+        self._to_code = {s: i for i, s in enumerate(strings) if s is not None}
+
+
+class StreamCodec:
+    """Per-stream encoder/decoder between host tuples and columnar arrays.
+
+    Owns one StringTable per STRING attribute and the column dtype layout; this
+    is the TPU analogue of the reference's StreamEventConverter family
+    (core/event/stream/converter/) which maps external Events onto the internal
+    StreamEvent layout chosen by MetaStreamEvent.
+    """
+
+    def __init__(self, definition: StreamDefinition) -> None:
+        self.definition = definition
+        self.string_tables: dict[str, StringTable] = {
+            a.name: StringTable()
+            for a in definition.attributes
+            if a.type == AttributeType.STRING
+        }
+        self.np_dtypes = {
+            a.name: np.dtype(jnp.dtype(dtypes.device_dtype(a.type)).name)
+            for a in definition.attributes
+            if a.type != AttributeType.OBJECT
+        }
+        self.object_attrs = tuple(
+            a.name for a in definition.attributes if a.type == AttributeType.OBJECT
+        )
+
+    def encode_value(self, attr_name: str, attr_type: AttributeType, value):
+        if attr_type == AttributeType.STRING:
+            return self.string_tables[attr_name].encode(value)
+        if value is None:
+            return dtypes.null_value(attr_type)
+        return value
+
+    def rows_to_columns(
+        self, rows: Sequence[Sequence], n_pad: Optional[int] = None
+    ) -> dict[str, np.ndarray]:
+        """Encode host rows (tuples in attribute order) into numpy columns,
+        zero-padded to n_pad lanes."""
+        n = len(rows)
+        cap = n_pad if n_pad is not None else n
+        cols: dict[str, np.ndarray] = {}
+        for i, attr in enumerate(self.definition.attributes):
+            if attr.type == AttributeType.OBJECT:
+                continue
+            arr = np.zeros(cap, dtype=self.np_dtypes[attr.name])
+            if attr.type == AttributeType.STRING:
+                tbl = self.string_tables[attr.name]
+                for r in range(n):
+                    arr[r] = tbl.encode(rows[r][i])
+            else:
+                for r in range(n):
+                    v = rows[r][i]
+                    arr[r] = dtypes.null_value(attr.type) if v is None else v
+            cols[attr.name] = arr
+        return cols
+
+    def decode_value(self, attr_name: str, attr_type: AttributeType, raw):
+        if attr_type == AttributeType.STRING:
+            return self.string_tables[attr_name].decode(int(raw))
+        if attr_type == AttributeType.BOOL:
+            return bool(raw)
+        if attr_type in (AttributeType.INT, AttributeType.LONG):
+            return int(raw)
+        if attr_type in (AttributeType.FLOAT, AttributeType.DOUBLE):
+            return float(raw)
+        return raw
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class EventBatch:
+    """Columnar micro-batch of events — a JAX pytree, so it flows through jit,
+    scan, and shard_map directly."""
+
+    ts: jax.Array  # int64[B]
+    cols: dict[str, jax.Array]  # each [B]
+    valid: jax.Array  # bool[B]
+    types: jax.Array  # int8[B] EventType
+
+    @property
+    def capacity(self) -> int:
+        return self.ts.shape[0]
+
+    # -- constructors ----------------------------------------------------------
+
+    @staticmethod
+    def empty(definition: StreamDefinition, capacity: int) -> "EventBatch":
+        cols = {
+            a.name: jnp.zeros((capacity,), dtype=dtypes.device_dtype(a.type))
+            for a in definition.attributes
+            if a.type != AttributeType.OBJECT
+        }
+        return EventBatch(
+            ts=jnp.zeros((capacity,), dtype=dtypes.TS_DTYPE),
+            cols=cols,
+            valid=jnp.zeros((capacity,), dtype=jnp.bool_),
+            types=jnp.zeros((capacity,), dtype=jnp.int8),
+        )
+
+    @staticmethod
+    def from_numpy(
+        ts: np.ndarray,
+        cols: dict[str, np.ndarray],
+        n_valid: int,
+        types: Optional[np.ndarray] = None,
+    ) -> "EventBatch":
+        cap = ts.shape[0]
+        valid = np.zeros(cap, dtype=bool)
+        valid[:n_valid] = True
+        t = types if types is not None else np.zeros(cap, dtype=np.int8)
+        return EventBatch(
+            ts=jnp.asarray(ts, dtype=dtypes.TS_DTYPE),
+            cols={k: jnp.asarray(v) for k, v in cols.items()},
+            valid=jnp.asarray(valid),
+            types=jnp.asarray(t, dtype=jnp.int8),
+        )
+
+    # -- device-side ops (all mask-based, shape-preserving) --------------------
+
+    def where_valid(self, mask: jax.Array) -> "EventBatch":
+        return dataclasses.replace(self, valid=self.valid & mask)
+
+    def count(self) -> jax.Array:
+        return jnp.sum(self.valid.astype(jnp.int32))
+
+    # -- host-side decode ------------------------------------------------------
+
+    def to_host_events(self, codec: StreamCodec) -> list[Event]:
+        """Compact valid lanes, in lane order, into host Events."""
+        ts = np.asarray(self.ts)
+        valid = np.asarray(self.valid)
+        types = np.asarray(self.types)
+        host_cols = {k: np.asarray(v) for k, v in self.cols.items()}
+        out: list[Event] = []
+        attrs = codec.definition.attributes
+        for i in np.nonzero(valid)[0]:
+            data = tuple(
+                codec.decode_value(a.name, a.type, host_cols[a.name][i])
+                if a.type != AttributeType.OBJECT
+                else None
+                for a in attrs
+            )
+            out.append(
+                Event(int(ts[i]), data, is_expired=bool(types[i] == EventType.EXPIRED))
+            )
+        return out

@@ -1,0 +1,62 @@
+"""SiddhiManager — top-level factory (reference: core/SiddhiManager.java:50)."""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from .. import compiler
+from ..errors import SiddhiAppCreationError
+from ..extension.registry import GLOBAL, ExtensionKind, Registry
+from ..query_api import SiddhiApp
+from .app_runtime import SiddhiAppRuntime
+
+# built-in extension registration side effects
+from ..ops import aggregators as _aggregators  # noqa: F401
+from ..ops import builtin_functions as _builtin_functions  # noqa: F401
+from ..ops import window_factories as _window_factories  # noqa: F401
+
+
+class SiddhiManager:
+    def __init__(self) -> None:
+        self.registry = GLOBAL.copy()
+        self.runtimes: dict[str, SiddhiAppRuntime] = {}
+        self._env_overrides: dict[str, str] = {}
+
+    def create_siddhi_app_runtime(
+        self, app: Union[str, SiddhiApp], *,
+        batch_size: int = 0, group_capacity: int = 0,
+    ) -> SiddhiAppRuntime:
+        if isinstance(app, str):
+            text = compiler.update_variables(app) if "${" in app else app
+            app = compiler.parse(text)
+        rt = SiddhiAppRuntime(app, self.registry, batch_size=batch_size,
+                              group_capacity=group_capacity)
+        self.runtimes[app.name] = rt
+        return rt
+
+    def get_siddhi_app_runtime(self, name: str) -> Optional[SiddhiAppRuntime]:
+        return self.runtimes.get(name)
+
+    def set_extension(self, name: str, impl, kind: ExtensionKind = None) -> None:
+        """Register a per-manager extension as `namespace:name` (reference:
+        SiddhiManager.setExtension). `kind` defaults by probing impl type."""
+        if kind is None:
+            from ..ops.aggregators import AggregatorFactory
+            from ..ops.expr_compile import ScalarFunction
+            from ..ops.window_factories import WindowFactory
+            if isinstance(impl, AggregatorFactory):
+                kind = ExtensionKind.AGGREGATOR
+            elif isinstance(impl, ScalarFunction):
+                kind = ExtensionKind.FUNCTION
+            elif isinstance(impl, WindowFactory):
+                kind = ExtensionKind.WINDOW
+            else:
+                raise SiddhiAppCreationError(
+                    f"cannot infer extension kind for {impl!r}; pass kind=")
+        ns, _, nm = name.rpartition(":")
+        self.registry.register(kind, ns, nm, impl)
+
+    def shutdown(self) -> None:
+        for rt in self.runtimes.values():
+            rt.shutdown()
+        self.runtimes.clear()

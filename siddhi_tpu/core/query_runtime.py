@@ -1,0 +1,297 @@
+"""Query planner & runtime — AST query → one jitted step function.
+
+Reference counterpart: core/util/parser/QueryParser.java:70 builds a chain of
+Processor objects walked per event (ProcessStreamReceiver → FilterProcessor →
+WindowProcessor → QuerySelector → OutputRateLimiter → OutputCallback,
+call stack SURVEY §3.2). The TPU build collapses that chain into ONE pure
+function per query:
+
+    step(state, batch, now) -> (state', out_batch)
+
+traced once and jit-compiled; filters become masks, the window emits a typed
+chunk, the selector runs grouped scans — all fused by XLA into a handful of
+kernels per micro-batch. State is a pytree (window rings + group tables),
+donated on each call so device buffers are reused in place.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..errors import SiddhiAppCreationError
+from ..extension.registry import ExtensionKind, Registry
+from ..ops.expr_compile import Scope, TypeResolver, compile_expression
+from ..ops.selector import CompiledSelector
+from ..ops.window_factories import WindowFactory
+from ..ops.windows import PassThroughWindow, WindowOp
+from ..query_api.definition import AttributeType, StreamDefinition, Attribute
+from ..query_api.execution import (
+    OutputAction,
+    OutputEventType,
+    Query,
+    SingleInputStream,
+)
+from ..query_api.expression import Constant, Expression, Variable
+from . import dtypes
+from .context import SiddhiAppContext
+from .event import EventBatch, EventType, StreamCodec
+from .stream import Receiver, StreamJunction
+
+
+class QueryCallback:
+    """Reference: core/query/output/callback/QueryCallback.java:37 — receives
+    (timestamp, inEvents, removeEvents) per emission chunk."""
+
+    def receive(self, timestamp: int, in_events, remove_events) -> None:
+        raise NotImplementedError
+
+
+class FunctionQueryCallback(QueryCallback):
+    def __init__(self, fn):
+        self.fn = fn
+
+    def receive(self, timestamp: int, in_events, remove_events) -> None:
+        self.fn(timestamp, in_events, remove_events)
+
+
+def eval_constant(expr: Expression):
+    """Evaluate a compile-time-constant expression parameter (window sizes,
+    time periods...)."""
+    if isinstance(expr, Constant):
+        return expr.value
+    raise SiddhiAppCreationError(f"expected a constant parameter, got {expr!r}")
+
+
+@dataclass
+class QueryPlanInputs:
+    definition: StreamDefinition
+    codec: StreamCodec
+    frame_ref: str
+
+
+class QueryRuntime(Receiver):
+    """Runtime for a single-input-stream query (joins/patterns have their own
+    runtimes). Subscribes to the input junction; publishes to the output
+    junction and/or query callbacks."""
+
+    def __init__(
+        self,
+        query: Query,
+        ctx: SiddhiAppContext,
+        input_junction: StreamJunction,
+        registry: Registry,
+        name: Optional[str] = None,
+    ) -> None:
+        assert isinstance(query.input_stream, SingleInputStream)
+        self.query = query
+        self.ctx = ctx
+        self.name = name or query.name or f"query_{id(self)}"
+        self.registry = registry
+        self.input_junction = input_junction
+        self.callbacks: list[QueryCallback] = []
+        self.output_junction: Optional[StreamJunction] = None
+        self.table = None  # set by app runtime for table CRUD outputs
+
+        in_stream = query.input_stream
+        definition = input_junction.definition
+        self.frame_ref = in_stream.reference_id
+        self.codec = input_junction.codec
+
+        # --- type resolver over the input frame ---
+        attr_types = {a.name: a.type for a in definition.attributes
+                      if a.type != AttributeType.OBJECT}
+        frames = {self.frame_ref: attr_types}
+        if self.frame_ref != definition.id:
+            frames[definition.id] = attr_types
+        codecs = {self.frame_ref: self.codec, definition.id: self.codec}
+        self.resolver = TypeResolver(frames, self.frame_ref, codecs)
+
+        # --- filters ---
+        self.filters = [compile_expression(f, self.resolver, registry)
+                        for f in in_stream.handlers.filters]
+        for f in self.filters:
+            if f.type != AttributeType.BOOL:
+                raise SiddhiAppCreationError("filter must be boolean")
+        if in_stream.handlers.pre_window_functions or in_stream.handlers.post_window_functions:
+            raise SiddhiAppCreationError(
+                "stream functions in FROM chains are not yet supported")
+        self.post_filters = [compile_expression(f, self.resolver, registry)
+                             for f in in_stream.handlers.post_window_filters]
+
+        # --- window ---
+        batch_cap = input_junction.batch_size
+        layout = {a.name: dtypes.device_dtype(a.type)
+                  for a in definition.attributes if a.type != AttributeType.OBJECT}
+        # query callbacks always see removeEvents (reference wires
+        # outputExpectsExpiredEvents from the callback/output type); keep
+        # expired lanes on unless profiling shows it matters.
+        expired_on = True
+        wh = in_stream.handlers.window
+        if wh is not None:
+            factory = registry.require(ExtensionKind.WINDOW, wh.namespace, wh.name)
+            assert isinstance(factory, WindowFactory)
+            params = [eval_constant(p) for p in wh.parameters]
+            self.window: WindowOp = factory.make(layout, batch_cap, params, expired_on)
+        else:
+            self.window = PassThroughWindow(layout, batch_cap)
+        self.is_sliding_window = wh is not None and type(self.window).__name__ == "SlidingWindow"
+
+        # --- selector ---
+        select_all = [(a.name, a.type) for a in definition.attributes
+                      if a.type != AttributeType.OBJECT]
+        self.selector = CompiledSelector(
+            query.selector, self.resolver, registry,
+            ctx.effective_group_capacity, self.frame_ref,
+            select_all_attrs=select_all)
+        # sliding-window removal support check (min/max)
+        if self.is_sliding_window:
+            for _, spec, _ in self.selector.agg_specs:
+                if not spec.supports_removal:
+                    raise SiddhiAppCreationError(
+                        "min/max aggregators over sliding windows are not yet "
+                        "supported (no removal); use minForever/maxForever or a "
+                        "batch window")
+
+        # --- output stream definition ---
+        self.output_attributes = tuple(
+            Attribute(name, t) for name, t in self.selector.out_types.items())
+        self.output_definition = StreamDefinition(
+            id=query.output_stream.target_id or f"{self.name}_out",
+            attributes=self.output_attributes)
+        self.output_codec = self._build_output_codec()
+
+        # --- the jitted step ---
+        self._step = jax.jit(self._make_step(), donate_argnums=(0,))
+        self.state = self._init_state()
+        #: time-driven windows need heartbeats to flush expirations
+        self.has_time_semantics = (
+            getattr(self.window, "time_ms", None) is not None
+            or type(self.window).__name__ == "TimeBatchWindow")
+
+    # ----------------------------------------------------------------- plan
+
+    def _build_output_codec(self) -> StreamCodec:
+        """Output codec shares StringTables with source attrs so string codes
+        flow through unchanged (provenance-tracked per output attribute)."""
+        codec = StreamCodec(self.output_definition)
+        for name, expr in zip(self.selector.out_types,
+                              [a.expression for a in self._select_attrs()]):
+            if self.selector.out_types[name] == AttributeType.STRING:
+                var = _first_string_variable(expr)
+                if var is not None:
+                    src_attr = var.attribute
+                    if src_attr in self.codec.string_tables:
+                        codec.string_tables[name] = self.codec.string_tables[src_attr]
+        return codec
+
+    def _select_attrs(self):
+        attrs = self.query.selector.attributes
+        if not attrs:
+            from ..query_api.execution import OutputAttribute
+            attrs = tuple(OutputAttribute(a.name, Variable(a.name))
+                          for a in self.output_attributes)
+        return attrs
+
+    def _init_state(self):
+        return (self.window.init_state(), self.selector.init_state())
+
+    def _make_step(self):
+        filters = self.filters
+        post_filters = self.post_filters
+        window = self.window
+        selector = self.selector
+        frame_ref = self.frame_ref
+
+        def step(state, batch: EventBatch, now):
+            wstate, sstate = state
+
+            scope = Scope()
+            scope.add_frame(frame_ref, batch.cols, batch.ts, batch.valid, default=True)
+            scope.extras["now"] = now
+            mask = batch.valid
+            for f in filters:
+                mask = mask & f(scope)
+            batch = batch.where_valid(mask)
+
+            wstate, chunk = window.step(wstate, batch, now)
+
+            cscope = Scope()
+            cscope.add_frame(frame_ref, chunk.cols, chunk.ts, chunk.valid, default=True)
+            cscope.extras["now"] = now
+            for f in post_filters:
+                chunk = chunk.where_valid(
+                    f(cscope) | (chunk.types != EventType.CURRENT))
+            sstate, out = selector.step(sstate, chunk, cscope)
+
+            return (wstate, sstate), out
+
+        return step
+
+    # -------------------------------------------------------------- runtime
+
+    def on_batch(self, batch: EventBatch, now: int) -> None:
+        t0 = time.perf_counter_ns()
+        self.state, out = self._step(self.state, batch, jnp.int64(now))
+        self._distribute(out, now)
+        self.ctx.statistics.track_latency(self.name, time.perf_counter_ns() - t0)
+
+    def _distribute(self, out: EventBatch, now: int) -> None:
+        action = self.query.output_stream.action
+        etype = self.query.output_stream.event_type
+
+        if self.callbacks:
+            events = out.to_host_events(self.output_codec)
+            in_events = [e for e in events if not e.is_expired] or None
+            remove_events = [e for e in events if e.is_expired] or None
+            if in_events or remove_events:
+                for cb in self.callbacks:
+                    cb.receive(now, in_events, remove_events)
+
+        if action == OutputAction.INSERT and self.output_junction is not None:
+            fwd = self._select_event_type(out, etype)
+            self.output_junction.publish_batch(fwd, now)
+        elif action in (OutputAction.DELETE, OutputAction.UPDATE,
+                        OutputAction.UPDATE_OR_INSERT) and self.table is not None:
+            fwd = self._select_event_type(out, etype)
+            self.table.apply_output(action, fwd, self.query.output_stream)
+
+    @staticmethod
+    def _select_event_type(out: EventBatch, etype: OutputEventType) -> EventBatch:
+        import dataclasses as dc
+        if etype == OutputEventType.CURRENT:
+            keep = out.types == EventType.CURRENT
+        elif etype == OutputEventType.EXPIRED:
+            keep = out.types == EventType.EXPIRED
+        else:
+            keep = (out.types == EventType.CURRENT) | (out.types == EventType.EXPIRED)
+        # forwarded events enter the next stream as fresh CURRENT arrivals
+        return dc.replace(out, valid=out.valid & keep,
+                          types=jnp.zeros_like(out.types))
+
+    def add_callback(self, cb: QueryCallback) -> None:
+        self.callbacks.append(cb)
+
+
+def _first_string_variable(expr) -> Optional[Variable]:
+    from ..query_api.expression import (
+        AttributeFunction, MathExpression, Compare, And, Or, Not)
+    if isinstance(expr, Variable):
+        return expr
+    if isinstance(expr, AttributeFunction):
+        for p in expr.parameters:
+            v = _first_string_variable(p)
+            if v is not None:
+                return v
+    for attr in ("left", "right", "expression"):
+        sub = getattr(expr, attr, None)
+        if isinstance(sub, Expression):
+            v = _first_string_variable(sub)
+            if v is not None:
+                return v
+    return None

@@ -1,0 +1,179 @@
+"""Stream junctions, input handlers, callbacks — the ingestion/dispatch plane.
+
+Reference: core/stream/StreamJunction.java:64 is a per-stream pub/sub hub backed
+by the LMAX Disruptor for async mode. The TPU replacement is a **host-side
+columnar micro-batcher**: producers append rows into numpy staging buffers; a
+flush converts the staged rows to one device EventBatch and synchronously
+delivers it to every receiver (query runtimes consume device batches directly;
+stream callbacks decode to host events). Micro-batch size is the backpressure /
+latency knob that replaces the Disruptor ring size (StreamJunction.java:68).
+
+Device-to-device chaining: a query whose output feeds another stream publishes
+its output EventBatch straight into the target junction (`publish_batch`),
+so multi-query pipelines stay on device until a host callback needs decoding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..errors import SiddhiAppRuntimeError
+from ..query_api.definition import AttributeType, StreamDefinition
+from . import dtypes
+from .context import SiddhiAppContext
+from .event import Event, EventBatch, EventType, StreamCodec
+
+
+class Receiver:
+    """Junction subscriber (reference: StreamJunction.Receiver)."""
+
+    def on_batch(self, batch: EventBatch, now: int) -> None:
+        raise NotImplementedError
+
+
+class StreamCallback(Receiver):
+    """User-facing stream subscriber (reference:
+    core/stream/output/StreamCallback.java:38). Subclass and override
+    `receive`, or wrap a plain function with FunctionStreamCallback."""
+
+    _junction: "StreamJunction" = None
+
+    def receive(self, events: list[Event]) -> None:
+        raise NotImplementedError
+
+    def on_batch(self, batch: EventBatch, now: int) -> None:
+        events = batch.to_host_events(self._junction.codec)
+        if events:
+            self.receive(events)
+
+
+class FunctionStreamCallback(StreamCallback):
+    def __init__(self, fn: Callable[[list[Event]], None]):
+        self.fn = fn
+
+    def receive(self, events: list[Event]) -> None:
+        self.fn(events)
+
+
+class StreamJunction:
+    """Per-stream hub: staging buffers + receiver fan-out."""
+
+    def __init__(self, definition: StreamDefinition, ctx: SiddhiAppContext,
+                 codec: Optional[StreamCodec] = None) -> None:
+        self.definition = definition
+        self.ctx = ctx
+        self.codec = codec or StreamCodec(definition)
+        self.receivers: list[Receiver] = []
+        self.batch_size = ctx.effective_batch_size
+        # async annotation: in the reference this switches to a Disruptor ring
+        # (StreamJunction.java:104-134); here it only tunes the batch size.
+        ann = definition.annotation("async") if definition.annotations else None
+        if ann is not None:
+            bs = ann.element("buffer.size")
+            if bs:
+                self.batch_size = int(bs)
+        self._staged_rows: list = []
+        self._staged_ts: list[int] = []
+        self.on_error: Optional[Callable] = None
+        self._flushing = False
+
+    # ------------------------------------------------------------- subscribe
+
+    def subscribe(self, receiver: Receiver) -> None:
+        if isinstance(receiver, StreamCallback):
+            receiver._junction = self
+        self.receivers.append(receiver)
+
+    # ---------------------------------------------------------------- ingest
+
+    def send_row(self, ts: int, data: Sequence) -> None:
+        self._staged_ts.append(ts)
+        self._staged_rows.append(data)
+        self.ctx.timestamp_generator.observe_event_time(ts)
+        if len(self._staged_rows) >= self.batch_size:
+            self.flush()
+
+    def publish_batch(self, batch: EventBatch, now: int) -> None:
+        """Device-side publication (query output chaining). Staged host rows
+        are flushed first to preserve arrival order."""
+        if self._staged_rows:
+            self.flush()
+        self._deliver(batch, now)
+
+    # ----------------------------------------------------------------- flush
+
+    def flush(self, now: Optional[int] = None) -> None:
+        if self._flushing:
+            # re-entrant flush (a callback sending into its own stream): defer
+            return
+        if not self._staged_rows:
+            return
+        rows, tss = self._staged_rows, self._staged_ts
+        self._staged_rows, self._staged_ts = [], []
+
+        cap = self.batch_size
+        n = len(rows)
+        for start in range(0, n, cap):
+            chunk_rows = rows[start:start + cap]
+            chunk_ts = tss[start:start + cap]
+            m = len(chunk_rows)
+            ts_arr = np.zeros(cap, dtype=np.int64)
+            ts_arr[:m] = chunk_ts
+            # pad timestamps monotonically so searchsorted stays correct
+            if m < cap and m > 0:
+                ts_arr[m:] = chunk_ts[-1]
+            cols = self.codec.rows_to_columns(chunk_rows, n_pad=cap)
+            batch = EventBatch.from_numpy(ts_arr, cols, m)
+            self._deliver(batch, now if now is not None else
+                          self.ctx.timestamp_generator.current_time())
+
+    def heartbeat(self, now: int) -> None:
+        """Advance time with no data: flush staged rows then deliver an empty
+        batch so time-window expirations fire (the watermark analogue of the
+        reference's Scheduler TIMER events, core/util/Scheduler.java:48)."""
+        self.flush(now)
+        empty = EventBatch.empty(self.definition, self.batch_size)
+        self._deliver(empty, now)
+
+    def _deliver(self, batch: EventBatch, now: int) -> None:
+        self._flushing = True
+        try:
+            n = int(batch.count()) if self.ctx.statistics.enabled else 0
+            self.ctx.statistics.track_in(self.definition.id, n)
+            self.ctx.statistics.track_batch(self.definition.id)
+            for r in self.receivers:
+                try:
+                    r.on_batch(batch, now)
+                except Exception as e:  # noqa: BLE001
+                    if self.on_error is not None:
+                        self.on_error(e, batch)
+                    else:
+                        raise
+        finally:
+            self._flushing = False
+        # deliver rows staged re-entrantly during callbacks
+        if self._staged_rows and len(self._staged_rows) >= self.batch_size:
+            self.flush()
+
+
+class InputHandler:
+    """User ingestion facade (reference: core/stream/input/InputHandler.java:28).
+    send() stages rows; delivery happens on batch-full or runtime.flush()."""
+
+    def __init__(self, junction: StreamJunction) -> None:
+        self.junction = junction
+
+    def send(self, data, timestamp: Optional[int] = None) -> None:
+        if isinstance(data, Event):
+            self.junction.send_row(data.timestamp, data.data)
+            return
+        if isinstance(data, (list,)) and data and isinstance(data[0], Event):
+            for ev in data:
+                self.junction.send_row(ev.timestamp, ev.data)
+            return
+        ts = timestamp if timestamp is not None else \
+            self.junction.ctx.timestamp_generator.current_time()
+        self.junction.send_row(ts, tuple(data))

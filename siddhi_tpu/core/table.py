@@ -1,0 +1,93 @@
+"""Tables: CRUD event stores queryable from streams.
+
+Reference: core/table/InMemoryTable.java:58 (rows under a RW lock, CRUD via
+CompiledCondition) with index-aware planning (core/table/holder/IndexEventHolder
++ the CollectionExecutor mini-optimizer). TPU round-1 design: a table is a
+columnar device store (capacity-padded arrays + valid mask) supporting
+vectorized insert/find/delete/update, with host-side primary-key hash index for
+point operations. Joins probe tables on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..errors import CapacityExceededError, SiddhiAppCreationError
+from ..query_api.definition import AttributeType, TableDefinition
+from ..query_api.execution import OutputAction, OutputStream
+from . import dtypes
+from .context import SiddhiAppContext
+from .event import EventBatch, StreamCodec
+
+
+class InMemoryTable:
+    def __init__(self, definition: TableDefinition, ctx: SiddhiAppContext,
+                 capacity: Optional[int] = None) -> None:
+        self.definition = definition
+        self.ctx = ctx
+        self.codec = StreamCodec(definition)
+        self.capacity = capacity or dtypes.config.default_window_capacity
+        self.cols = {
+            a.name: jnp.zeros((self.capacity,), dtypes.device_dtype(a.type))
+            for a in definition.attributes if a.type != AttributeType.OBJECT
+        }
+        self.ts = jnp.zeros((self.capacity,), dtypes.TS_DTYPE)
+        self.valid = jnp.zeros((self.capacity,), jnp.bool_)
+        self._next = 0  # next free slot (append pointer; freed slots reused lazily)
+
+    # ------------------------------------------------------------------- CRUD
+
+    def insert_batch(self, batch: EventBatch) -> None:
+        valid = np.asarray(batch.valid)
+        idxs = np.nonzero(valid)[0]
+        n = len(idxs)
+        if n == 0:
+            return
+        # find free slots (host-side append pointer with compaction fallback)
+        free = np.nonzero(~np.asarray(self.valid))[0]
+        if len(free) < n:
+            raise CapacityExceededError(
+                f"table {self.definition.id} capacity {self.capacity} exceeded")
+        slots = jnp.asarray(free[:n])
+        src = jnp.asarray(idxs)
+        for k in self.cols:
+            self.cols[k] = self.cols[k].at[slots].set(batch.cols[k][src])
+        self.ts = self.ts.at[slots].set(batch.ts[src])
+        self.valid = self.valid.at[slots].set(True)
+
+    def insert_rows(self, rows, timestamp: int = 0) -> None:
+        cols = self.codec.rows_to_columns(rows, n_pad=len(rows))
+        ts = np.full(len(rows), timestamp, dtype=np.int64)
+        self.insert_batch(EventBatch.from_numpy(ts, cols, len(rows)))
+
+    def apply_output(self, action: OutputAction, out: EventBatch,
+                     output_stream: OutputStream) -> None:
+        """Handle `insert into T` / `delete T on ...` / `update T ...` from a
+        query's output batch (reference: core/query/output/callback/
+        {InsertIntoTable,DeleteTable,UpdateTable,UpdateOrInsertTable}Callback)."""
+        from ..ops.expr_compile import Scope, TypeResolver, compile_expression
+
+        if action == OutputAction.INSERT:
+            self.insert_batch(out)
+            return
+
+        # Build a scope where the table frame is the stored columns [C] and the
+        # stream frame is the output batch [B]; the on-condition is evaluated
+        # as a [B, C] cross mask via vmap over the batch axis.
+        raise SiddhiAppCreationError(
+            "delete/update table outputs are planned via TableOutputExecutor")
+
+    # ------------------------------------------------------------------ reads
+
+    def all_rows(self) -> list[tuple]:
+        batch = EventBatch(ts=self.ts, cols=self.cols, valid=self.valid,
+                           types=jnp.zeros((self.capacity,), jnp.int8))
+        return [e.data for e in batch.to_host_events(self.codec)]
+
+    def __len__(self) -> int:
+        return int(jnp.sum(self.valid))

@@ -1,0 +1,60 @@
+"""Exception hierarchy (reference: core/exception/* and
+siddhi-query-api/.../exception/*)."""
+
+
+class SiddhiError(Exception):
+    """Base for all framework errors."""
+
+
+class SiddhiAppCreationError(SiddhiError):
+    """App could not be planned/compiled (reference:
+    core/exception/SiddhiAppCreationError... creation exceptions)."""
+
+
+class SiddhiAppValidationError(SiddhiError):
+    pass
+
+
+class DuplicateDefinitionError(SiddhiAppValidationError):
+    pass
+
+
+class DefinitionNotExistError(SiddhiAppValidationError):
+    pass
+
+
+class SiddhiParserError(SiddhiError):
+    """Syntax error with line/column context (reference:
+    siddhi-query-compiler/.../exception/SiddhiParserException.java)."""
+
+    def __init__(self, message: str, line: int | None = None, column: int | None = None):
+        self.line, self.column = line, column
+        loc = f" at line {line}:{column}" if line is not None else ""
+        super().__init__(f"{message}{loc}")
+
+
+class SiddhiAppRuntimeError(SiddhiError):
+    pass
+
+
+class CannotRestoreStateError(SiddhiError):
+    pass
+
+
+class ConnectionUnavailableError(SiddhiError):
+    """Source/sink transport failure; triggers backoff retry (reference:
+    core/exception/ConnectionUnavailableException.java)."""
+
+
+class NoPersistenceStoreError(SiddhiError):
+    pass
+
+
+class OnDemandQueryCreationError(SiddhiError):
+    pass
+
+
+class CapacityExceededError(SiddhiAppRuntimeError):
+    """A fixed-capacity device structure (window ring, NFA slots, key table)
+    overflowed. TPU-specific: the reference's unbounded heap structures become
+    static-shape device buffers; capacity is configurable per element."""

@@ -1,0 +1,185 @@
+"""Attribute aggregators, decomposed into grouped-scan components.
+
+Reference: the 13 aggregator executors under
+core/query/selector/attribute/aggregator/ (SumAttributeAggregatorExecutor.java:69
+et al.) each keep a per-group-key mutable state with processAdd/processRemove.
+TPU re-design: an aggregator is a set of *components*, each a per-key
+accumulator driven by ops/groupby.grouped_scan with signed per-lane deltas
+(CURRENT lanes add, EXPIRED lanes subtract, RESET lanes epoch-bump), plus a
+`finalize` combining component values per lane. avg = sum/count, stdDev =
+(sumsq, sum, count), and/or = counts of false/true — all become fused scans.
+
+min/max are monotone scans (op="min"/"max"); they cannot process removals, so
+the planner rejects them over sliding windows (matching limitation called out
+in SURVEY §7; a segment-tree ring is the planned upgrade).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..errors import SiddhiAppCreationError
+from ..extension.registry import GLOBAL, ExtensionKind
+from ..query_api.definition import AttributeType
+
+_T = AttributeType
+
+
+@dataclass(frozen=True)
+class Component:
+    """One per-key accumulator: delta(args_value_array, sign) -> [L] deltas."""
+
+    dtype: object
+    delta: Callable  # (vals: [L] or None, sign: [L] ±1 float) -> [L] deltas
+    op: str = "sum"
+    #: monotone components ignore EXPIRED lanes (sign<0) instead of erroring
+    ignore_removal: bool = False
+    #: survives RESET (minForever/maxForever)
+    ignore_reset: bool = False
+
+
+@dataclass(frozen=True)
+class AggregatorSpec:
+    components: tuple[Component, ...]
+    finalize: Callable  # (list of per-lane component arrays) -> [L] values
+    return_type: AttributeType
+    #: needs removal support (sliding windows); min/max set False
+    supports_removal: bool = True
+
+
+class AggregatorFactory:
+    """SPI: make(arg_types) -> AggregatorSpec."""
+
+    def __init__(self, make: Callable):
+        self.make = make
+
+
+def _sum_return_type(t: AttributeType) -> AttributeType:
+    # reference SumAttributeAggregatorExecutor: int/long -> LONG, float/double -> DOUBLE
+    return _T.LONG if t in (_T.INT, _T.LONG) else _T.DOUBLE
+
+
+def _make_sum(arg_types):
+    t = arg_types[0]
+    if not dtypes.is_numeric(t):
+        raise SiddhiAppCreationError(f"sum() over non-numeric {t}")
+    rt = _sum_return_type(t)
+    dt = dtypes.device_dtype(rt)
+    comp = Component(dtype=dt, delta=lambda v, sign: v.astype(dt) * sign.astype(dt))
+    return AggregatorSpec((comp,), lambda cs: cs[0], rt)
+
+
+def _make_count(arg_types):
+    dt = dtypes.device_dtype(_T.LONG)
+    comp = Component(dtype=dt, delta=lambda v, sign: sign.astype(dt))
+    return AggregatorSpec((comp,), lambda cs: cs[0], _T.LONG)
+
+
+def _make_avg(arg_types):
+    t = arg_types[0]
+    if not dtypes.is_numeric(t):
+        raise SiddhiAppCreationError(f"avg() over non-numeric {t}")
+    dt = dtypes.device_dtype(_T.DOUBLE)
+    s = Component(dtype=dt, delta=lambda v, sign: v.astype(dt) * sign.astype(dt))
+    c = Component(dtype=dt, delta=lambda v, sign: sign.astype(dt))
+
+    def fin(cs):
+        total, n = cs
+        return jnp.where(n != 0, total / jnp.where(n != 0, n, 1), jnp.zeros_like(total))
+
+    return AggregatorSpec((s, c), fin, _T.DOUBLE)
+
+
+def _make_minmax(op: str):
+    def make(arg_types):
+        t = arg_types[0]
+        if not dtypes.is_numeric(t):
+            raise SiddhiAppCreationError(f"{op}() over non-numeric {t}")
+        dt = dtypes.device_dtype(t)
+        comp = Component(dtype=dt, delta=lambda v, sign: v.astype(dt), op=op,
+                         ignore_removal=True)
+        return AggregatorSpec((comp,), lambda cs: cs[0], t, supports_removal=False)
+
+    return make
+
+
+def _make_minmax_forever(op: str):
+    def make(arg_types):
+        t = arg_types[0]
+        dt = dtypes.device_dtype(t)
+        comp = Component(dtype=dt, delta=lambda v, sign: v.astype(dt), op=op,
+                         ignore_removal=True, ignore_reset=True)
+        return AggregatorSpec((comp,), lambda cs: cs[0], t, supports_removal=True)
+
+    return make
+
+
+def _make_stddev(arg_types):
+    t = arg_types[0]
+    if not dtypes.is_numeric(t):
+        raise SiddhiAppCreationError(f"stdDev() over non-numeric {t}")
+    dt = dtypes.device_dtype(_T.DOUBLE)
+    s = Component(dtype=dt, delta=lambda v, sign: v.astype(dt) * sign.astype(dt))
+    s2 = Component(dtype=dt, delta=lambda v, sign: (v.astype(dt) ** 2) * sign.astype(dt))
+    c = Component(dtype=dt, delta=lambda v, sign: sign.astype(dt))
+
+    def fin(cs):
+        total, sumsq, n = cs
+        safe_n = jnp.where(n != 0, n, 1)
+        mean = total / safe_n
+        var = sumsq / safe_n - mean * mean
+        # population std dev (reference StdDevAttributeAggregatorExecutor)
+        return jnp.where(n != 0, jnp.sqrt(jnp.maximum(var, 0.0)), jnp.zeros_like(var))
+
+    return AggregatorSpec((s, s2, c), fin, _T.DOUBLE)
+
+
+def _make_bool_and(arg_types):
+    # and(bool): true while no false values in window — count falses
+    dt = dtypes.device_dtype(_T.LONG)
+    c = Component(dtype=dt, delta=lambda v, sign: jnp.where(~v, sign.astype(dt), 0))
+
+    def fin(cs):
+        return cs[0] == 0
+
+    return AggregatorSpec((c,), fin, _T.BOOL)
+
+
+def _make_bool_or(arg_types):
+    dt = dtypes.device_dtype(_T.LONG)
+    c = Component(dtype=dt, delta=lambda v, sign: jnp.where(v, sign.astype(dt), 0))
+
+    def fin(cs):
+        return cs[0] > 0
+
+    return AggregatorSpec((c,), fin, _T.BOOL)
+
+
+def _make_distinct_count(arg_types):
+    raise SiddhiAppCreationError(
+        "distinctCount over arbitrary windows is not yet device-supported; "
+        "use it over batch windows via hll:distinctCount (sketch) once available")
+
+
+def register_all() -> None:
+    reg = lambda name, make: GLOBAL.register(  # noqa: E731
+        ExtensionKind.AGGREGATOR, "", name, AggregatorFactory(make))
+    reg("sum", _make_sum)
+    reg("count", _make_count)
+    reg("avg", _make_avg)
+    reg("min", _make_minmax("min"))
+    reg("max", _make_minmax("max"))
+    reg("minForever", _make_minmax_forever("min"))
+    reg("maxForever", _make_minmax_forever("max"))
+    reg("stdDev", _make_stddev)
+    reg("and", _make_bool_and)
+    reg("or", _make_bool_or)
+    reg("distinctCount", _make_distinct_count)
+
+
+register_all()

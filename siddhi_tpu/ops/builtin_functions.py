@@ -1,0 +1,162 @@
+"""Built-in scalar functions (reference: core/executor/function/*.java — 20
+built-ins). Each registers a ScalarFunction whose `make` receives static arg
+types and returns a traceable jnp lambda + return type, mirroring the
+reference's parse-time monomorphic executor selection."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.dtypes import NULL_CODE
+from ..errors import SiddhiAppCreationError
+from ..extension.registry import GLOBAL, ExtensionKind
+from ..query_api.definition import AttributeType
+from .expr_compile import ScalarFunction
+
+_T = AttributeType
+
+
+def _register(name: str, make, namespace: str = "") -> None:
+    GLOBAL.register(ExtensionKind.FUNCTION, namespace, name, ScalarFunction(make))
+
+
+# -- type conversion -------------------------------------------------------------
+
+_NAME_TO_TYPE = {
+    "int": _T.INT, "long": _T.LONG, "float": _T.FLOAT, "double": _T.DOUBLE,
+    "bool": _T.BOOL, "string": _T.STRING,
+}
+
+
+def _make_convert(arg_types):
+    # convert(x, 'type') — the target type is a compile-time string constant;
+    # expr_compile passes string constants through as host strings.
+    if len(arg_types) != 2:
+        raise SiddhiAppCreationError("convert(value, 'type') takes 2 args")
+
+    def fn(x, target):
+        t = _NAME_TO_TYPE[str(target).lower()]
+        if t == _T.STRING or arg_types[0] == _T.STRING:
+            raise SiddhiAppCreationError("string conversion is host-side only")
+        return x.astype(dtypes.device_dtype(t))
+
+    # return type depends on the constant — resolved on first trace; for typing
+    # purposes we conservatively report DOUBLE unless target known statically.
+    return fn, _T.DOUBLE
+
+
+def _make_cast(arg_types):
+    return _make_convert(arg_types)
+
+
+def _make_if_then_else(arg_types):
+    if len(arg_types) != 3 or arg_types[0] != _T.BOOL:
+        raise SiddhiAppCreationError("ifThenElse(bool, then, else)")
+    if arg_types[1] != arg_types[2]:
+        if dtypes.is_numeric(arg_types[1]) and dtypes.is_numeric(arg_types[2]):
+            out_t = dtypes.promote(arg_types[1], arg_types[2])
+        else:
+            raise SiddhiAppCreationError("ifThenElse branches must share a type")
+    else:
+        out_t = arg_types[1]
+    dt = dtypes.device_dtype(out_t)
+    return (lambda c, a, b: jnp.where(c, jnp.asarray(a, dt), jnp.asarray(b, dt))), out_t
+
+
+def _make_coalesce(arg_types):
+    # Numeric columns carry no per-attribute null on device (core/dtypes.py), so
+    # coalesce over numerics returns the first arg; over strings it picks the
+    # first non-null code.
+    t0 = arg_types[0]
+    if all(t == _T.STRING for t in arg_types):
+        def fn(*args):
+            out = args[-1]
+            for a in reversed(args[:-1]):
+                out = jnp.where(a != NULL_CODE, a, out)
+            return out
+        return fn, _T.STRING
+    out_t = t0
+    for t in arg_types[1:]:
+        out_t = dtypes.promote(out_t, t)
+    return (lambda *args: args[0].astype(dtypes.device_dtype(out_t))), out_t
+
+
+def _make_default(arg_types):
+    if arg_types[0] == _T.STRING:
+        return (lambda a, d: jnp.where(a != NULL_CODE, a, d)), _T.STRING
+    return (lambda a, d: a), arg_types[0]
+
+
+def _make_minmax(reducer):
+    def make(arg_types):
+        out_t = arg_types[0]
+        for t in arg_types[1:]:
+            out_t = dtypes.promote(out_t, t)
+        dt = dtypes.device_dtype(out_t)
+
+        def fn(*args):
+            out = args[0].astype(dt)
+            for a in args[1:]:
+                out = reducer(out, a.astype(dt))
+            return out
+
+        return fn, out_t
+
+    return make
+
+
+def _make_event_timestamp(arg_types):
+    def fn(*args):
+        raise SiddhiAppCreationError("eventTimestamp resolved by planner")
+    return fn, _T.LONG
+
+
+def _make_current_time(arg_types):
+    def fn(*args):
+        raise SiddhiAppCreationError("currentTimeMillis resolved by planner")
+    return fn, _T.LONG
+
+
+def _make_instance_of(target: AttributeType):
+    def make(arg_types):
+        result = arg_types[0] == target
+        return (lambda x, r=result: jnp.full(jnp.shape(x), r, dtype=bool)), _T.BOOL
+    return make
+
+
+def _make_math_unary(jfn, out=_T.DOUBLE):
+    def make(arg_types):
+        dt = dtypes.device_dtype(out)
+        return (lambda x: jfn(x.astype(dt))), out
+    return make
+
+
+def register_all() -> None:
+    _register("convert", _make_convert)
+    _register("cast", _make_cast)
+    _register("ifThenElse", _make_if_then_else)
+    _register("coalesce", _make_coalesce)
+    _register("default", _make_default)
+    _register("maximum", _make_minmax(jnp.maximum))
+    _register("minimum", _make_minmax(jnp.minimum))
+    _register("instanceOfInteger", _make_instance_of(_T.INT))
+    _register("instanceOfLong", _make_instance_of(_T.LONG))
+    _register("instanceOfFloat", _make_instance_of(_T.FLOAT))
+    _register("instanceOfDouble", _make_instance_of(_T.DOUBLE))
+    _register("instanceOfBoolean", _make_instance_of(_T.BOOL))
+    _register("instanceOfString", _make_instance_of(_T.STRING))
+    # math namespace conveniences (subset of siddhi-execution-math)
+    _register("abs", _make_math_unary(jnp.abs), "math")
+    _register("sqrt", _make_math_unary(jnp.sqrt), "math")
+    _register("log", _make_math_unary(jnp.log), "math")
+    _register("exp", _make_math_unary(jnp.exp), "math")
+    _register("floor", _make_math_unary(jnp.floor), "math")
+    _register("ceil", _make_math_unary(jnp.ceil), "math")
+    _register("round", _make_math_unary(jnp.round), "math")
+    _register("sin", _make_math_unary(jnp.sin), "math")
+    _register("cos", _make_math_unary(jnp.cos), "math")
+    _register("power", _make_minmax(jnp.power), "math")
+
+
+register_all()

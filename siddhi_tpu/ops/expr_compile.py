@@ -1,0 +1,352 @@
+"""Expression AST → jitted JAX column functions.
+
+Reference counterpart: core/util/parser/ExpressionParser.java:225 builds an
+interpreter tree of monomorphic ExpressionExecutor objects that is walked per
+event (virtual dispatch + boxing). Here the tree is *traced once*: compilation
+returns a Python closure over columnar scopes which, evaluated inside the
+query's jitted step function, fuses into a single XLA kernel — filters become
+vectorized boolean masks over whole micro-batches (FilterProcessor.java:48-60's
+hot loop disappears into the VPU).
+
+Typing mirrors the reference's parse-time executor selection: every node gets a
+static AttributeType; math promotes int<long<float<double
+(core/executor/math/*); comparisons across numeric types promote before
+comparing (core/executor/condition/compare/*).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.dtypes import NULL_CODE
+from ..core.event import StreamCodec
+from ..errors import SiddhiAppCreationError
+from ..extension.registry import ExtensionKind, Registry
+from ..query_api.definition import AttributeType
+from ..query_api.expression import (
+    And,
+    AttributeFunction,
+    Compare,
+    CompareOp,
+    Constant,
+    Expression,
+    In,
+    IsNull,
+    MathExpression,
+    MathOp,
+    Not,
+    Or,
+    Variable,
+)
+
+
+class Scope:
+    """Column environment for one trace: maps (stream_ref, attr) -> array[B].
+
+    For single-stream queries there is one default frame; joins/patterns add
+    one frame per stream reference (the analogue of the reference's
+    MetaStateEvent position addressing, StreamEvent.getAttribute:131).
+    Also carries the batch timestamp vector and per-frame validity.
+    """
+
+    def __init__(self) -> None:
+        self.frames: dict[str, dict[str, jax.Array]] = {}
+        self.valids: dict[str, jax.Array] = {}
+        self.ts: dict[str, jax.Array] = {}
+        self.default_frame: Optional[str] = None
+        #: extra context (e.g. tables for `in` lookups)
+        self.extras: dict[str, object] = {}
+
+    def add_frame(self, ref: str, cols: dict[str, jax.Array], ts: jax.Array,
+                  valid: jax.Array, default: bool = False) -> None:
+        self.frames[ref] = cols
+        self.ts[ref] = ts
+        self.valids[ref] = valid
+        if default or self.default_frame is None:
+            self.default_frame = ref
+
+    def col(self, ref: Optional[str], attr: str) -> jax.Array:
+        if ref is not None:
+            return self.frames[ref][attr]
+        # unqualified: search default frame first, then unique match
+        if self.default_frame and attr in self.frames[self.default_frame]:
+            return self.frames[self.default_frame][attr]
+        hits = [f for f in self.frames.values() if attr in f]
+        if len(hits) != 1:
+            raise KeyError(attr)
+        return hits[0][attr]
+
+
+@dataclass
+class CompiledExpr:
+    """A typed, traceable column function."""
+
+    fn: Callable[[Scope], jax.Array]
+    type: AttributeType
+
+    def __call__(self, scope: Scope) -> jax.Array:
+        return self.fn(scope)
+
+
+@dataclass
+class ScalarFunction:
+    """SPI for scalar function extensions (reference:
+    core/executor/function/FunctionExecutor.java). `make(arg_types)` returns
+    (jax_fn, return_type); jax_fn maps arg arrays -> result array and must be
+    traceable (no Python control flow on values)."""
+
+    make: Callable[[tuple[AttributeType, ...]], tuple[Callable, AttributeType]]
+
+
+class TypeResolver:
+    """Resolves Variable -> (frame_ref, attr, AttributeType). Built by the query
+    planner from the FROM-clause stream definitions."""
+
+    def __init__(self, frames: dict[str, dict[str, AttributeType]],
+                 default_frame: Optional[str] = None,
+                 codecs: Optional[dict[str, StreamCodec]] = None) -> None:
+        self.frames = frames
+        self.default_frame = default_frame or (next(iter(frames)) if frames else None)
+        self.codecs = codecs or {}
+
+    def resolve(self, v: Variable) -> tuple[Optional[str], str, AttributeType]:
+        if v.stream_id is not None:
+            frame = self.frames.get(v.stream_id)
+            if frame is None or v.attribute not in frame:
+                raise SiddhiAppCreationError(
+                    f"unknown attribute {v.stream_id}.{v.attribute}")
+            return v.stream_id, v.attribute, frame[v.attribute]
+        if self.default_frame and v.attribute in self.frames[self.default_frame]:
+            return None, v.attribute, self.frames[self.default_frame][v.attribute]
+        hits = [(ref, f[v.attribute]) for ref, f in self.frames.items() if v.attribute in f]
+        if len(hits) == 1:
+            return hits[0][0], v.attribute, hits[0][1]
+        raise SiddhiAppCreationError(
+            f"attribute {v.attribute!r} is {'ambiguous' if hits else 'undefined'}")
+
+    def string_code(self, frame_ref: Optional[str], attr: str, s: str) -> int:
+        """Intern a string constant against the codec of the frame that owns
+        `attr` so device comparison is code equality."""
+        ref = frame_ref or self.default_frame
+        codec = self.codecs.get(ref)
+        if codec is None or attr not in codec.string_tables:
+            raise SiddhiAppCreationError(
+                f"no string table for {ref}.{attr}; string comparison unsupported here")
+        return codec.string_tables[attr].encode(s)
+
+
+_CONST_TYPES = {
+    "int": AttributeType.INT, "long": AttributeType.LONG,
+    "float": AttributeType.FLOAT, "double": AttributeType.DOUBLE,
+    "bool": AttributeType.BOOL, "string": AttributeType.STRING,
+    "time": AttributeType.LONG,
+}
+
+
+def compile_expression(
+    expr: Expression,
+    resolver: TypeResolver,
+    registry: Registry,
+) -> CompiledExpr:
+    """Recursively compile an AST node into a CompiledExpr."""
+
+    if isinstance(expr, Constant):
+        t = _CONST_TYPES[expr.type_name]
+        if t == AttributeType.STRING:
+            # bare string constant with no comparison context — return as host
+            # string; comparisons special-case this (see _compile_compare).
+            return CompiledExpr(lambda s, v=expr.value: v, t)
+        dt = dtypes.device_dtype(t)
+        val = expr.value
+        return CompiledExpr(lambda s, v=val, d=dt: jnp.asarray(v, dtype=d), t)
+
+    if isinstance(expr, Variable):
+        ref, attr, t = resolver.resolve(expr)
+        return CompiledExpr(lambda s, r=ref, a=attr: s.col(r, a), t)
+
+    if isinstance(expr, MathExpression):
+        return _compile_math(expr, resolver, registry)
+
+    if isinstance(expr, Compare):
+        return _compile_compare(expr, resolver, registry)
+
+    if isinstance(expr, And):
+        l = compile_expression(expr.left, resolver, registry)
+        r = compile_expression(expr.right, resolver, registry)
+        _require_bool(l, r)
+        return CompiledExpr(lambda s: l(s) & r(s), AttributeType.BOOL)
+
+    if isinstance(expr, Or):
+        l = compile_expression(expr.left, resolver, registry)
+        r = compile_expression(expr.right, resolver, registry)
+        _require_bool(l, r)
+        return CompiledExpr(lambda s: l(s) | r(s), AttributeType.BOOL)
+
+    if isinstance(expr, Not):
+        e = compile_expression(expr.expression, resolver, registry)
+        _require_bool(e)
+        return CompiledExpr(lambda s: ~e(s), AttributeType.BOOL)
+
+    if isinstance(expr, IsNull):
+        return _compile_is_null(expr, resolver, registry)
+
+    if isinstance(expr, In):
+        return _compile_in(expr, resolver, registry)
+
+    if isinstance(expr, AttributeFunction):
+        return _compile_function(expr, resolver, registry)
+
+    raise SiddhiAppCreationError(f"cannot compile expression node {type(expr).__name__}")
+
+
+def _require_bool(*exprs: CompiledExpr) -> None:
+    for e in exprs:
+        if e.type != AttributeType.BOOL:
+            raise SiddhiAppCreationError(
+                f"logical operator requires bool operands, got {e.type}")
+
+
+def _compile_math(expr: MathExpression, resolver: TypeResolver, registry: Registry) -> CompiledExpr:
+    l = compile_expression(expr.left, resolver, registry)
+    r = compile_expression(expr.right, resolver, registry)
+    out_t = dtypes.promote(l.type, r.type)
+    if expr.op == MathOp.DIVIDE:
+        # Java semantics (reference DivideExpressionExecutor*): int/long pairs
+        # use integer division truncating toward zero (lax.div); div-by-zero
+        # lanes are zeroed instead of trapping (they are masked out upstream).
+        if out_t in (AttributeType.INT, AttributeType.LONG):
+            return CompiledExpr(
+                lambda s: jnp.where(r(s) != 0, jax.lax.div(l(s), r(s)), jnp.zeros_like(l(s))),
+                out_t)
+        return CompiledExpr(lambda s: _cast(l(s), out_t) / _cast(r(s), out_t), out_t)
+    if expr.op == MathOp.MOD:
+        if out_t in (AttributeType.INT, AttributeType.LONG):
+            # Java % truncates toward zero (lax.rem), unlike jnp.mod (floor).
+            return CompiledExpr(lambda s: jnp.where(r(s) != 0, jax.lax.rem(l(s), r(s)), jnp.zeros_like(l(s))), out_t)
+        return CompiledExpr(lambda s: jax.lax.rem(_cast(l(s), out_t), _cast(r(s), out_t)), out_t)
+    ops = {MathOp.ADD: jnp.add, MathOp.SUBTRACT: jnp.subtract, MathOp.MULTIPLY: jnp.multiply}
+    op = ops[expr.op]
+    return CompiledExpr(lambda s: op(_cast(l(s), out_t), _cast(r(s), out_t)), out_t)
+
+
+def _cast(arr: jax.Array, t: AttributeType) -> jax.Array:
+    return arr.astype(dtypes.device_dtype(t))
+
+
+_CMP = {
+    CompareOp.EQUAL: jnp.equal,
+    CompareOp.NOT_EQUAL: jnp.not_equal,
+    CompareOp.GREATER_THAN: jnp.greater,
+    CompareOp.GREATER_THAN_EQUAL: jnp.greater_equal,
+    CompareOp.LESS_THAN: jnp.less,
+    CompareOp.LESS_THAN_EQUAL: jnp.less_equal,
+}
+
+
+def _compile_compare(expr: Compare, resolver: TypeResolver, registry: Registry) -> CompiledExpr:
+    # String comparisons: intern the constant side into the variable side's
+    # string table so the device compares int32 codes.
+    lc, rc = expr.left, expr.right
+    l_str_const = isinstance(lc, Constant) and lc.type_name == "string"
+    r_str_const = isinstance(rc, Constant) and rc.type_name == "string"
+    if l_str_const or r_str_const:
+        var_side, const_side = (rc, lc) if l_str_const else (lc, rc)
+        if not isinstance(var_side, Variable):
+            raise SiddhiAppCreationError(
+                "string comparison requires an attribute on one side")
+        ref, attr, t = resolver.resolve(var_side)
+        if t != AttributeType.STRING:
+            raise SiddhiAppCreationError(f"cannot compare {t} with string constant")
+        if expr.op not in (CompareOp.EQUAL, CompareOp.NOT_EQUAL):
+            raise SiddhiAppCreationError(
+                "string constants support only ==/!= on device")
+        code = resolver.string_code(ref, attr, const_side.value)
+        op = _CMP[expr.op]
+        return CompiledExpr(lambda s, c=code: op(s.col(ref, attr), jnp.int32(c)),
+                            AttributeType.BOOL)
+
+    l = compile_expression(lc, resolver, registry)
+    r = compile_expression(rc, resolver, registry)
+    op = _CMP[expr.op]
+    if l.type == AttributeType.STRING and r.type == AttributeType.STRING:
+        # code equality is only sound for == / != (codes are not ordered)
+        if expr.op not in (CompareOp.EQUAL, CompareOp.NOT_EQUAL):
+            raise SiddhiAppCreationError("string ordering comparisons unsupported on device")
+        return CompiledExpr(lambda s: op(l(s), r(s)), AttributeType.BOOL)
+    if l.type == AttributeType.BOOL or r.type == AttributeType.BOOL:
+        if l.type != r.type:
+            raise SiddhiAppCreationError(f"cannot compare {l.type} with {r.type}")
+        return CompiledExpr(lambda s: op(l(s), r(s)), AttributeType.BOOL)
+    out_t = dtypes.promote(l.type, r.type)
+    return CompiledExpr(lambda s: op(_cast(l(s), out_t), _cast(r(s), out_t)),
+                        AttributeType.BOOL)
+
+
+def _compile_is_null(expr: IsNull, resolver: TypeResolver, registry: Registry) -> CompiledExpr:
+    if expr.stream_id is not None:
+        # `e2 is null` — pattern-stream nullness: tests the frame validity mask.
+        sid = expr.stream_id
+        return CompiledExpr(lambda s: ~s.valids[sid], AttributeType.BOOL)
+    inner = expr.expression
+    if isinstance(inner, Variable):
+        ref, attr, t = resolver.resolve(inner)
+        if t == AttributeType.STRING:
+            return CompiledExpr(
+                lambda s: s.col(ref, attr) == jnp.int32(NULL_CODE), AttributeType.BOOL)
+        # numeric columns have no per-attribute null on device (see dtypes.py);
+        # null only arises from invalid frames (outer joins / absent patterns).
+        if ref is not None:
+            return CompiledExpr(lambda s: ~s.valids[ref] if ref in s.valids
+                                else jnp.zeros_like(s.col(ref, attr), dtype=bool),
+                                AttributeType.BOOL)
+        return CompiledExpr(
+            lambda s: jnp.zeros(s.col(ref, attr).shape, dtype=bool), AttributeType.BOOL)
+    e = compile_expression(inner, resolver, registry)
+    return CompiledExpr(lambda s: jnp.zeros(jnp.shape(e(s)), dtype=bool), AttributeType.BOOL)
+
+
+def _compile_in(expr: In, resolver: TypeResolver, registry: Registry) -> CompiledExpr:
+    # Planned by the query runtime: it registers a membership probe closure under
+    # scope.extras['in:<table>'] that maps the compiled condition over the table.
+    inner = compile_expression(expr.expression, resolver, registry) if expr.expression else None
+    source = expr.source_id
+
+    def fn(s: Scope):
+        probe = s.extras.get(f"in:{source}")
+        if probe is None:
+            raise SiddhiAppCreationError(
+                f"`in {source}` used outside a table-aware context")
+        return probe(s, inner)
+
+    return CompiledExpr(fn, AttributeType.BOOL)
+
+
+def _compile_function(expr: AttributeFunction, resolver: TypeResolver,
+                      registry: Registry) -> CompiledExpr:
+    # Planner-resolved built-ins (reference: EventTimestampFunctionExecutor,
+    # CurrentTimeMillisFunctionExecutor): these read batch context, not columns.
+    if not expr.namespace and expr.name == "eventTimestamp":
+        if expr.parameters:
+            sid = expr.parameters[0]
+            if isinstance(sid, Variable):
+                return CompiledExpr(lambda s, r=sid.attribute: s.ts[r], AttributeType.LONG)
+        return CompiledExpr(lambda s: s.ts[s.default_frame], AttributeType.LONG)
+    if not expr.namespace and expr.name == "currentTimeMillis":
+        return CompiledExpr(
+            lambda s: jnp.broadcast_to(s.extras["now"], s.ts[s.default_frame].shape),
+            AttributeType.LONG)
+
+    args = tuple(compile_expression(p, resolver, registry) for p in expr.parameters)
+    impl = registry.lookup(ExtensionKind.FUNCTION, expr.namespace, expr.name)
+    if impl is None:
+        raise SiddhiAppCreationError(
+            f"no function extension {expr.full_name!r} "
+            f"(aggregators are valid only in SELECT)")
+    assert isinstance(impl, ScalarFunction)
+    jax_fn, ret_t = impl.make(tuple(a.type for a in args))
+    return CompiledExpr(lambda s: jax_fn(*(a(s) for a in args)), ret_t)

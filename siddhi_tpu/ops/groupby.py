@@ -1,0 +1,292 @@
+"""Grouped segmented prefix scan — the TPU replacement for the reference's
+per-event HashMap group-by (core/query/selector/QuerySelector.java:207,
+GroupByKeyGenerator.java:37 string-concat keys + per-key AggregatorState).
+
+Semantics to reproduce: events are processed one at a time; each CURRENT lane
+adds its delta to the per-key accumulator and the *post-update* value is
+emitted for that lane; EXPIRED lanes subtract (window removal); RESET lanes
+zero the accumulator (batch windows). Batched faithfully as:
+
+  1. each lane carries (slot, delta, sign) — slot is a dense int32 key id
+  2. lanes are stably sorted by slot; signed deltas are prefix-summed within
+     each slot segment; carry-in comes from the persistent state table
+  3. results scatter back to original lane order; segment totals update state
+
+RESET is handled with *epochs*: a per-key epoch counter increments on reset;
+a state-table value whose epoch is stale reads as the aggregator's zero. This
+keeps the scan a pure prefix-sum (no data-dependent control flow, XLA-friendly).
+
+All arrays are fixed-shape; invalid lanes carry slot = capacity sentinel so they
+sort to the end and never touch real segments.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GroupState(NamedTuple):
+    """Persistent per-key accumulator table (one per aggregator component).
+
+    values: [K] accumulator per key slot
+    epoch:  [K] int32 epoch of last write; values with epoch < current read as 0
+    """
+
+    values: jax.Array
+    epoch: jax.Array
+
+
+def init_group_state(capacity: int, dtype) -> GroupState:
+    return GroupState(
+        values=jnp.zeros((capacity,), dtype=dtype),
+        epoch=jnp.zeros((capacity,), dtype=jnp.int32),
+    )
+
+
+def grouped_scan(
+    state: GroupState,
+    slots: jax.Array,  # int32[L] dense key ids; invalid lanes = any value
+    deltas: jax.Array,  # [L] signed per-lane contribution (already sign-applied)
+    valid: jax.Array,  # bool[L]
+    resets: jax.Array,  # bool[L] lanes that zero their key's accumulator first
+    current_epoch: jax.Array,  # int32 scalar epoch counter (increments per reset batch)
+    op: str = "sum",  # "sum" | "min" | "max"
+) -> tuple[GroupState, jax.Array]:
+    """Returns (new_state, per-lane post-update accumulator values).
+
+    `current_epoch` must be >= max(state.epoch); reset lanes bump the epoch of
+    *all* keys (batch-window RESET clears every group, matching the reference's
+    QuerySelector RESET pass). Keys untouched after a reset read as zero via
+    epoch mismatch — no O(K) clear.
+
+    op="min"/"max" support monotone aggregators (no EXPIRED removal — the
+    planner forbids min/max over sliding windows until the segment-tree ring
+    lands); identity is +/-inf (or dtype extremes for ints).
+    """
+    L = slots.shape[0]
+    K = state.values.shape[0]
+    sentinel = jnp.int32(K)
+
+    combine, identity = _OPS[op](deltas.dtype)
+
+    slots_v = jnp.where(valid, slots, sentinel)
+
+    # epoch id per lane: lanes after the r-th reset belong to epoch
+    # current_epoch + r. cumsum of resets gives r per lane (reset lane itself
+    # starts the new epoch).
+    reset_rank = jnp.cumsum(resets.astype(jnp.int32))
+    lane_epoch = current_epoch + reset_rank
+
+    # stable sort by (slot, lane) — lane order inside a slot is preserved
+    order = jnp.argsort(slots_v, stable=True)
+    inv = jnp.argsort(order, stable=True)
+    s_slots = slots_v[order]
+    s_deltas = jnp.where(valid, deltas, jnp.full_like(deltas, identity))[order]
+    s_epochs = lane_epoch[order]
+
+    # within-segment, within-epoch scan:
+    # a new segment starts when slot changes OR lane epoch changes
+    prev_slot = jnp.concatenate([jnp.full((1,), -1, s_slots.dtype), s_slots[:-1]])
+    prev_epoch = jnp.concatenate([jnp.full((1,), -1, s_epochs.dtype), s_epochs[:-1]])
+    seg_start = (s_slots != prev_slot) | (s_epochs != prev_epoch)
+
+    within = _segmented_scan(s_deltas, seg_start, combine, identity)
+
+    # carry-in: only the segment whose epoch matches the state's stored epoch
+    # for that slot gets the stored value; stale epochs read the identity.
+    safe_slots = jnp.minimum(s_slots, K - 1)
+    stored_vals = state.values[safe_slots]
+    stored_epoch = state.epoch[safe_slots]
+    carry = jnp.where(
+        (s_slots < K) & (stored_epoch == s_epochs), stored_vals,
+        jnp.full_like(stored_vals, identity))
+    # carry applies uniformly within a segment; take it from the segment start
+    carry_at_start = jnp.where(seg_start, carry, jnp.full_like(carry, identity))
+    carry_seg = _segment_broadcast_op(carry_at_start, seg_start, identity)
+
+    s_out = combine(carry_seg, within)
+    out = s_out[inv]
+
+    # new state: written from the last lane of each *slot* run (unique per slot,
+    # so the scatter has no duplicate indices; the last epoch's value wins).
+    next_slot = jnp.concatenate([s_slots[1:], jnp.full((1,), -1, s_slots.dtype)])
+    is_slot_end = s_slots != next_slot
+    write_slot = jnp.where((s_slots < K) & is_slot_end, s_slots, sentinel)
+    new_values = state.values.at[write_slot].set(s_out, mode="drop")
+    new_epoch = state.epoch.at[write_slot].set(s_epochs, mode="drop")
+
+    return GroupState(new_values, new_epoch), out
+
+
+def _op_sum(dtype):
+    if dtype == jnp.bool_:
+        return jnp.logical_or, False
+    return jnp.add, jnp.zeros((), dtype)
+
+
+def _op_min(dtype):
+    ident = jnp.iinfo(dtype).max if jnp.issubdtype(dtype, jnp.integer) else jnp.inf
+    return jnp.minimum, jnp.asarray(ident, dtype)
+
+
+def _op_max(dtype):
+    ident = jnp.iinfo(dtype).min if jnp.issubdtype(dtype, jnp.integer) else -jnp.inf
+    return jnp.maximum, jnp.asarray(ident, dtype)
+
+
+_OPS = {"sum": _op_sum, "min": _op_min, "max": _op_max}
+
+
+def _segmented_scan(vals: jax.Array, seg_start: jax.Array, combine, identity) -> jax.Array:
+    """Inclusive scan that restarts at each segment start (classic conditional
+    associative scan: carry a (reset_flag, value) pair)."""
+
+    def op(a, b):
+        af, av = a
+        bf, bv = b
+        return af | bf, jnp.where(bf, bv, combine(av, bv))
+
+    flags = seg_start
+    _, out = jax.lax.associative_scan(op, (flags, vals))
+    return out
+
+
+def _segment_broadcast_op(vals_at_start: jax.Array, seg_start: jax.Array, identity) -> jax.Array:
+    """Broadcast each segment-start value across its segment."""
+    L = seg_start.shape[0]
+    idx = jnp.arange(L)
+    start_idx = jnp.where(seg_start, idx, 0)
+    start_idx = jax.lax.associative_scan(jnp.maximum, start_idx)
+    return vals_at_start[start_idx]
+
+
+# --- device-side key table ------------------------------------------------------
+
+
+class KeyTable(NamedTuple):
+    """Append-only device dictionary: 64-bit composite keys → dense int32 ids.
+
+    Replaces the reference's string-concat HashMap group-by keys
+    (GroupByKeyGenerator.java:37) for non-string keys, fully on device: lookup
+    is a binary search over a sorted copy; inserts merge the batch's new unique
+    keys and re-sort. Ids are assigned in order of first appearance.
+    """
+
+    sorted_keys: jax.Array  # int64[K], padded with INT64_MAX
+    sorted_ids: jax.Array  # int32[K]
+    count: jax.Array  # int32 number of live keys
+
+
+_KEY_PAD = jnp.iinfo(jnp.int64).max
+
+
+def init_key_table(capacity: int) -> KeyTable:
+    return KeyTable(
+        sorted_keys=jnp.full((capacity,), _KEY_PAD, dtype=jnp.int64),
+        sorted_ids=jnp.zeros((capacity,), dtype=jnp.int32),
+        count=jnp.int32(0),
+    )
+
+
+def key_lookup_or_insert(
+    table: KeyTable, keys: jax.Array, valid: jax.Array
+) -> tuple[KeyTable, jax.Array]:
+    """Resolve each lane's key to a dense id, inserting unseen keys.
+
+    Returns (new_table, ids[L]). Invalid lanes get id 0 (callers mask them).
+    Overflow beyond capacity silently reuses id 0 — callers size K generously
+    and monitor table.count.
+    """
+    L = keys.shape[0]
+    K = table.sorted_keys.shape[0]
+    keys = keys.astype(jnp.int64)
+    # avoid colliding with the pad sentinel
+    keys = jnp.where(keys == _KEY_PAD, _KEY_PAD - 1, keys)
+
+    pos = jnp.searchsorted(table.sorted_keys, keys)
+    pos_c = jnp.clip(pos, 0, K - 1)
+    found = table.sorted_keys[pos_c] == keys
+    existing_ids = table.sorted_ids[pos_c]
+
+    # identify first occurrence of each new key within the batch, in lane order
+    is_new = valid & ~found
+    nk = jnp.where(is_new, keys, _KEY_PAD)
+    order = jnp.argsort(nk, stable=True)  # groups duplicates, keeps lane order
+    snk = nk[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), snk[1:] != snk[:-1]]) & (snk != _KEY_PAD)
+    # rank new unique keys by first-appearance lane index for deterministic ids
+    first_lane = jnp.where(first, order, L)
+    lane_rank = jnp.argsort(jnp.argsort(first_lane))  # position after sorting by lane
+    new_id_sorted = table.count + lane_rank.astype(jnp.int32)
+
+    # each lane's id: for new keys, find their unique-key id via the sorted run
+    run_id = _segment_broadcast_op(
+        jnp.where(first, new_id_sorted, 0), first | (snk == _KEY_PAD), 0)
+    lane_new_ids = jnp.zeros((L,), jnp.int32).at[order].set(
+        jnp.where(snk != _KEY_PAD, run_id, 0))
+
+    ids = jnp.where(found, existing_ids, lane_new_ids)
+    ids = jnp.where(valid, ids, 0)
+
+    # merge new unique keys into the sorted table
+    n_new = jnp.sum(first.astype(jnp.int32))
+    merged_keys = jnp.concatenate([table.sorted_keys,
+                                   jnp.where(first, snk, _KEY_PAD)])
+    merged_ids = jnp.concatenate([table.sorted_ids,
+                                  jnp.where(first, new_id_sorted, 0)])
+    morder = jnp.argsort(merged_keys, stable=True)[:K]
+    new_table = KeyTable(
+        sorted_keys=merged_keys[morder],
+        sorted_ids=merged_ids[morder],
+        count=jnp.minimum(table.count + n_new, K),
+    )
+    return new_table, ids
+
+
+def hash_columns(cols: list[jax.Array]) -> jax.Array:
+    """Combine multiple key columns into one int64 key (fxhash-style mix).
+    Collision probability over 64 bits is negligible for CEP key cardinalities."""
+    h = jnp.uint64(0xCBF29CE484222325)
+    for c in cols:
+        x = c.astype(jnp.int64).astype(jnp.uint64)
+        h = (h ^ x) * jnp.uint64(0x100000001B3)
+        h = h ^ (h >> 29)
+    return h.astype(jnp.int64)
+
+
+# --- host-side key dictionaries -------------------------------------------------
+
+
+class KeyDictionary:
+    """Host-side composite-key → dense slot assignment for group-by keys that are
+    not already dense codes. Append-only; snapshot/restorable. The TPU analogue
+    of the reference's group-by key strings: here a key becomes one int32 the
+    device can scatter with."""
+
+    def __init__(self) -> None:
+        self._map: dict[tuple, int] = {}
+
+    def assign(self, keys) -> "list[int]":
+        out = []
+        m = self._map
+        for k in keys:
+            slot = m.get(k)
+            if slot is None:
+                slot = len(m)
+                m[k] = slot
+            out.append(slot)
+        return out
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def snapshot(self) -> list:
+        return sorted(self._map.items(), key=lambda kv: kv[1])
+
+    def restore(self, items) -> None:
+        self._map = {tuple(k) if isinstance(k, list) else k: v for k, v in items}

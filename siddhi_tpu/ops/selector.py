@@ -1,0 +1,297 @@
+"""Compiled SELECT engine (reference: core/query/selector/QuerySelector.java:44).
+
+Consumes window chunks (typed lanes CURRENT/EXPIRED/RESET) and produces an
+output EventBatch of projected attributes, reproducing per-event semantics:
+
+- aggregator components update per-key via grouped scans with signed deltas
+  (CURRENT=+1, EXPIRED=-1, RESET=epoch bump), emitting the post-update value on
+  every lane — exactly QuerySelector.processGroupBy's per-event emission;
+- HAVING filters output lanes (QuerySelector.java:228);
+- ORDER BY / LIMIT / OFFSET apply per chunk (QuerySelector.java:230-235).
+
+Aggregator calls may be nested inside arbitrary expressions
+(`sum(price)/count()`); they are rewritten to references into a synthetic
+`__agg__` frame evaluated first.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.event import EventBatch, EventType
+from ..errors import SiddhiAppCreationError
+from ..extension.registry import ExtensionKind, Registry
+from ..query_api.definition import AttributeType
+from ..query_api.execution import OrderByOrder, Selector
+from ..query_api.expression import (
+    And,
+    AttributeFunction,
+    Compare,
+    Expression,
+    In,
+    IsNull,
+    MathExpression,
+    Not,
+    Or,
+    Variable,
+)
+from .aggregators import AggregatorFactory, AggregatorSpec
+from .expr_compile import CompiledExpr, Scope, TypeResolver, compile_expression
+from .groupby import (
+    GroupState,
+    KeyTable,
+    grouped_scan,
+    hash_columns,
+    init_group_state,
+    init_key_table,
+    key_lookup_or_insert,
+)
+
+AGG_FRAME = "__agg__"
+
+
+def _rewrite_aggregators(expr: Expression, registry: Registry, found: list):
+    """Replace aggregator AttributeFunction nodes with Variables into the
+    __agg__ frame; collect (name, node) into `found`. Mirrors the reference's
+    aggregator detection at parse time (ExpressionParser.java:462)."""
+    if isinstance(expr, AttributeFunction):
+        impl = registry.lookup(ExtensionKind.AGGREGATOR, expr.namespace, expr.name)
+        if impl is not None:
+            slot_name = f"agg{len(found)}"
+            found.append((slot_name, expr))
+            return Variable(slot_name, stream_id=AGG_FRAME)
+        new_params = tuple(_rewrite_aggregators(p, registry, found)
+                           for p in expr.parameters)
+        return AttributeFunction(expr.namespace, expr.name, new_params)
+    if isinstance(expr, MathExpression):
+        return dataclasses.replace(
+            expr,
+            left=_rewrite_aggregators(expr.left, registry, found),
+            right=_rewrite_aggregators(expr.right, registry, found))
+    if isinstance(expr, Compare):
+        return dataclasses.replace(
+            expr,
+            left=_rewrite_aggregators(expr.left, registry, found),
+            right=_rewrite_aggregators(expr.right, registry, found))
+    if isinstance(expr, (And, Or)):
+        return dataclasses.replace(
+            expr,
+            left=_rewrite_aggregators(expr.left, registry, found),
+            right=_rewrite_aggregators(expr.right, registry, found))
+    if isinstance(expr, Not):
+        return dataclasses.replace(
+            expr, expression=_rewrite_aggregators(expr.expression, registry, found))
+    return expr
+
+
+@dataclass
+class SelectorState:
+    """Pytree of selector persistent state."""
+
+    groups: list  # list[GroupState], one per aggregator component
+    key_table: Optional[KeyTable]
+    epoch: jax.Array  # int32
+
+
+jax.tree_util.register_dataclass(SelectorState)
+
+
+class CompiledSelector:
+    """Plans one Selector against an input frame layout."""
+
+    def __init__(
+        self,
+        selector: Selector,
+        resolver: TypeResolver,
+        registry: Registry,
+        group_capacity: int,
+        chunk_frame: str,
+        select_all_attrs: Optional[list[tuple[str, AttributeType]]] = None,
+    ):
+        self.registry = registry
+        self.group_capacity = group_capacity
+        self.chunk_frame = chunk_frame
+        self.selector = selector
+
+        # --- select list: rewrite aggregators, compile expressions ---
+        agg_nodes: list[tuple[str, AttributeFunction]] = []
+        attrs = selector.attributes
+        if not attrs:
+            # select * — project every input attribute
+            if select_all_attrs is None:
+                raise SiddhiAppCreationError("select * needs input attribute list")
+            from ..query_api.execution import OutputAttribute
+            attrs = tuple(OutputAttribute(n, Variable(n)) for n, _ in select_all_attrs)
+        rewritten = [(a.rename, _rewrite_aggregators(a.expression, registry, agg_nodes))
+                     for a in attrs]
+
+        # --- aggregator specs ---
+        self.agg_specs: list[tuple[str, AggregatorSpec, list[CompiledExpr]]] = []
+        for slot_name, node in agg_nodes:
+            factory = registry.require(ExtensionKind.AGGREGATOR, node.namespace, node.name)
+            assert isinstance(factory, AggregatorFactory)
+            args = [compile_expression(p, resolver, registry) for p in node.parameters]
+            spec = factory.make(tuple(a.type for a in args))
+            self.agg_specs.append((slot_name, spec, args))
+        self.has_aggregators = bool(self.agg_specs)
+
+        # --- resolver extended with the __agg__ frame ---
+        frames = dict(resolver.frames)
+        frames[AGG_FRAME] = {slot: spec.return_type
+                             for slot, spec, _ in self.agg_specs}
+        self.resolver = TypeResolver(frames, resolver.default_frame, resolver.codecs)
+
+        self.out_exprs: list[tuple[str, CompiledExpr]] = [
+            (name, compile_expression(e, self.resolver, registry))
+            for name, e in rewritten]
+        self.out_types: dict[str, AttributeType] = {
+            name: ce.type for name, ce in self.out_exprs}
+
+        # --- group-by key plan ---
+        self.group_by = selector.group_by
+        self.group_vars = [resolver.resolve(v) for v in selector.group_by]
+        self.use_string_code = (
+            len(self.group_vars) == 1 and self.group_vars[0][2] == AttributeType.STRING)
+        self.needs_key_table = bool(self.group_vars) and not self.use_string_code
+
+        # --- having / order by compiled against the output frame ---
+        out_frames = dict(frames)
+        out_frames["__out__"] = dict(self.out_types)
+        out_resolver = TypeResolver(out_frames, "__out__", resolver.codecs)
+        self.having = (compile_expression(selector.having, out_resolver, registry)
+                       if selector.having is not None else None)
+        self.order_by = [(out_resolver.resolve(ob.variable), ob.order)
+                         for ob in selector.order_by]
+        self.limit = selector.limit
+        self.offset = selector.offset
+
+    # ------------------------------------------------------------------ state
+
+    def init_state(self) -> SelectorState:
+        groups = []
+        K = self.group_capacity if self.group_vars else 1
+        for _, spec, _ in self.agg_specs:
+            for comp in spec.components:
+                groups.append(init_group_state(K, comp.dtype))
+        return SelectorState(
+            groups=groups,
+            key_table=init_key_table(K) if self.needs_key_table else None,
+            epoch=jnp.int32(0),
+        )
+
+    # ------------------------------------------------------------------- step
+
+    def step(self, state: SelectorState, chunk: EventBatch,
+             scope: Scope) -> tuple[SelectorState, EventBatch]:
+        L = chunk.capacity
+        valid = chunk.valid
+        types = chunk.types
+        is_current = types == EventType.CURRENT
+        is_expired = types == EventType.EXPIRED
+        is_reset = valid & (types == EventType.RESET)
+        data_valid = valid & (is_current | is_expired)
+
+        new_key_table = state.key_table
+        if self.group_vars:
+            if self.use_string_code:
+                ref, attr, _ = self.group_vars[0]
+                slots = scope.col(ref, attr)
+            else:
+                key_cols = [scope.col(ref, attr) for ref, attr, _ in self.group_vars]
+                hashed = hash_columns(key_cols)
+                new_key_table, slots = key_lookup_or_insert(
+                    state.key_table, hashed, data_valid)
+        else:
+            slots = jnp.zeros((L,), jnp.int32)
+
+        sign = jnp.where(is_expired, -1, 1).astype(jnp.int32)
+
+        # --- run aggregator components ---
+        new_groups = []
+        gi = 0
+        agg_values: dict[str, jax.Array] = {}
+        any_reset = is_reset
+        no_reset = jnp.zeros((L,), bool)
+        for slot_name, spec, args in self.agg_specs:
+            arg_vals = [a(scope) for a in args] if args else [None]
+            comp_outs = []
+            for comp in spec.components:
+                deltas = comp.delta(arg_vals[0], sign)
+                lane_valid = data_valid if not comp.ignore_removal else (
+                    valid & is_current)
+                resets = no_reset if comp.ignore_reset else any_reset
+                g, out_vals = grouped_scan(
+                    state.groups[gi], slots.astype(jnp.int32), deltas,
+                    lane_valid, resets, state.epoch, op=comp.op)
+                new_groups.append(g)
+                comp_outs.append(out_vals)
+                gi += 1
+            agg_values[slot_name] = spec.finalize(comp_outs)
+
+        new_epoch = state.epoch + jnp.sum(is_reset.astype(jnp.int32))
+
+        # --- project output attributes ---
+        if self.agg_specs:
+            scope.frames[AGG_FRAME] = agg_values
+            scope.valids[AGG_FRAME] = data_valid
+            scope.ts[AGG_FRAME] = chunk.ts
+        out_cols = {name: ce(scope) for name, ce in self.out_exprs}
+
+        out_valid = data_valid
+
+        # --- having on the output frame ---
+        if self.having is not None or self.order_by:
+            scope.frames["__out__"] = out_cols
+            scope.valids["__out__"] = out_valid
+            scope.ts["__out__"] = chunk.ts
+        if self.having is not None:
+            out_valid = out_valid & self.having(scope)
+
+        out = EventBatch(ts=chunk.ts, cols=out_cols, valid=out_valid, types=types)
+
+        # --- order by / offset / limit (per chunk, like the reference) ---
+        if self.order_by:
+            out = self._order_chunk(out)
+        if self.offset is not None or self.limit is not None:
+            out = self._limit_chunk(out)
+
+        return SelectorState(new_groups, new_key_table, new_epoch), out
+
+    def _order_chunk(self, out: EventBatch) -> EventBatch:
+        keys = []
+        for (ref, attr, _), order in reversed(self.order_by):
+            col = out.cols[attr]
+            if order == OrderByOrder.DESC:
+                col = -col if jnp.issubdtype(col.dtype, jnp.number) else ~col
+            keys.append(col)
+        # push invalid lanes to the end, stable within
+        perm = jnp.arange(out.capacity)
+        for k in keys:
+            k = jnp.where(out.valid[perm], k[perm].astype(jnp.float64),
+                          jnp.inf)
+            perm = perm[jnp.argsort(k, stable=True)]
+        # single final ordering: invalid last
+        final_key = jnp.where(out.valid[perm], 0, 1)
+        perm = perm[jnp.argsort(final_key, stable=True)]
+        return EventBatch(
+            ts=out.ts[perm],
+            cols={k: v[perm] for k, v in out.cols.items()},
+            valid=out.valid[perm],
+            types=out.types[perm],
+        )
+
+    def _limit_chunk(self, out: EventBatch) -> EventBatch:
+        rank = jnp.cumsum(out.valid.astype(jnp.int32)) - 1
+        keep = out.valid
+        if self.offset is not None:
+            keep = keep & (rank >= self.offset)
+            rank = rank - self.offset
+        if self.limit is not None:
+            keep = keep & (rank < self.limit)
+        return dataclasses.replace(out, valid=keep)

@@ -1,0 +1,82 @@
+"""Window extension registrations (reference: the @Extension window processors
+under core/query/processor/stream/window/). Each factory receives the stream's
+column layout, the junction batch capacity, evaluated constant parameters, and
+whether the query consumes expired events."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+from ..errors import SiddhiAppCreationError
+from ..extension.registry import GLOBAL, ExtensionKind
+from .windows import (
+    LengthBatchWindow,
+    PassThroughWindow,
+    SlidingWindow,
+    TimeBatchWindow,
+    WindowOp,
+)
+
+
+@dataclass
+class WindowFactory:
+    make: Callable  # (layout, batch_cap, params: list, expired_on: bool) -> WindowOp
+
+
+def _int_param(params, i, name, what="window"):
+    if len(params) <= i:
+        raise SiddhiAppCreationError(f"{what} {name!r} needs parameter {i + 1}")
+    v = params[i]
+    if not isinstance(v, int):
+        raise SiddhiAppCreationError(f"{name} parameter {i + 1} must be int/time, got {v!r}")
+    return v
+
+
+def _make_length(layout, batch_cap, params, expired_on):
+    n = _int_param(params, 0, "length")
+    return SlidingWindow(layout, batch_cap, length=n)
+
+
+def _make_length_batch(layout, batch_cap, params, expired_on):
+    n = _int_param(params, 0, "lengthBatch")
+    return LengthBatchWindow(layout, batch_cap, n, expired_on=expired_on)
+
+
+def _make_time(layout, batch_cap, params, expired_on):
+    w = _int_param(params, 0, "time")
+    return SlidingWindow(layout, batch_cap, time_ms=w)
+
+
+def _make_time_batch(layout, batch_cap, params, expired_on):
+    w = _int_param(params, 0, "timeBatch")
+    start = params[1] if len(params) > 1 else None
+    return TimeBatchWindow(layout, batch_cap, w, expired_on=expired_on,
+                           start_time=start)
+
+
+def _make_time_length(layout, batch_cap, params, expired_on):
+    w = _int_param(params, 0, "timeLength")
+    n = _int_param(params, 1, "timeLength")
+    return SlidingWindow(layout, batch_cap, time_ms=w, length=n, capacity=n)
+
+
+def _make_delay(layout, batch_cap, params, expired_on):
+    w = _int_param(params, 0, "delay")
+    return SlidingWindow(layout, batch_cap, time_ms=w, is_delay=True)
+
+
+def register_all() -> None:
+    reg = lambda name, make: GLOBAL.register(  # noqa: E731
+        ExtensionKind.WINDOW, "", name, WindowFactory(make))
+    reg("length", _make_length)
+    reg("lengthBatch", _make_length_batch)
+    reg("time", _make_time)
+    reg("timeBatch", _make_time_batch)
+    reg("timeLength", _make_time_length)
+    reg("delay", _make_delay)
+    reg("batch", lambda l, b, p, e: PassThroughWindow(l, b) if not p
+        else LengthBatchWindow(l, b, p[0], expired_on=e))
+
+
+register_all()

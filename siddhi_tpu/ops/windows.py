@@ -1,0 +1,561 @@
+"""Window operators as pure `(state, batch) -> (state, chunk)` device functions.
+
+Reference counterpart: the 30 WindowProcessor classes under
+core/query/processor/stream/window/ that walk per-event linked lists and keep
+`SnapshotableStreamEventQueue` heaps. TPU re-design:
+
+- window contents live in **fixed-capacity device ring buffers** (one array per
+  column + timestamps), addressed by monotonically growing 64-bit "overall
+  arrival indices" (slot = idx % capacity);
+- a step consumes a columnar micro-batch and emits a **chunk**: a wider
+  EventBatch whose lanes are typed CURRENT / EXPIRED / RESET and ordered
+  exactly as the reference's per-event chunk would interleave them
+  (e.g. LengthWindowProcessor.java:118-122 emits [expired, current] per
+  arrival; LengthBatchWindowProcessor.java:210-243 emits
+  [expired(prev flush), RESET, current(flush)] at each flush boundary);
+- ordering is produced by a single stable sort on an emission key, so the
+  whole window step is one fused XLA program with static shapes.
+
+The downstream selector consumes chunks with signed-delta grouped scans
+(ops/groupby.py), reproducing per-event aggregate semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.event import EventBatch, EventType
+from ..errors import SiddhiAppCreationError
+
+# emission-key kinds: expired lanes sort before reset before current at the
+# same trigger position (matches reference chunk insertion order).
+KIND_EXPIRED = 0
+KIND_RESET = 1
+KIND_CURRENT = 2
+
+BIG = jnp.int64(2**62)
+
+
+# --------------------------------------------------------------------------- #
+# helpers
+# --------------------------------------------------------------------------- #
+
+
+def compact(batch: EventBatch) -> tuple[dict, jax.Array, jax.Array, jax.Array]:
+    """Stable-partition valid CURRENT lanes to the front.
+
+    Returns (cols, ts, n_valid, order). Lanes >= n_valid hold garbage.
+    """
+    live = batch.valid & (batch.types == EventType.CURRENT)
+    order = jnp.argsort(~live, stable=True)
+    cols = {k: v[order] for k, v in batch.cols.items()}
+    ts = batch.ts[order]
+    return cols, ts, jnp.sum(live.astype(jnp.int32)), order
+
+
+def _gather_overall(
+    ring_cols: dict,
+    ring_ts: jax.Array,
+    comp_cols: dict,
+    comp_ts: jax.Array,
+    appended0: jax.Array,
+    o_idx: jax.Array,
+):
+    """Fetch events by overall arrival index: from the ring for pre-batch
+    events, from the compacted batch for this batch's arrivals."""
+    C = ring_ts.shape[0]
+    B = comp_ts.shape[0]
+    from_batch = o_idx >= appended0
+    ring_slot = jnp.clip(o_idx, 0, None) % C
+    batch_slot = jnp.clip(o_idx - appended0, 0, B - 1)
+    cols = {
+        k: jnp.where(from_batch, comp_cols[k][batch_slot], ring_cols[k][ring_slot])
+        for k in ring_cols
+    }
+    ts = jnp.where(from_batch, comp_ts[batch_slot], ring_ts[ring_slot])
+    return cols, ts
+
+
+def _scatter_append(ring_cols, ring_ts, comp_cols, comp_ts, appended0, n_valid):
+    """Write the batch's valid events into the ring at slot (appended0+p)%C.
+    When more than C events arrive in one batch only the last C survive —
+    earlier lanes are masked out so the scatter has no duplicate slots."""
+    C = ring_ts.shape[0]
+    B = comp_ts.shape[0]
+    p = jnp.arange(B)
+    keep = (p < n_valid) & (p >= n_valid - C)
+    slot = jnp.where(keep, (appended0 + p) % C, C)  # C = drop sentinel
+    new_cols = {k: ring_cols[k].at[slot].set(comp_cols[k], mode="drop")
+                for k in ring_cols}
+    new_ts = ring_ts.at[slot].set(comp_ts, mode="drop")
+    return new_cols, new_ts
+
+
+def _sort_chunk(keys, cols, ts, valid, types, width):
+    """Order lanes by emission key (invalid lanes pushed to the end) and trim
+    to `width` lanes."""
+    k = jnp.where(valid, keys, BIG)
+    order = jnp.argsort(k, stable=True)[:width]
+    return EventBatch(
+        ts=ts[order],
+        cols={n: v[order] for n, v in cols.items()},
+        valid=valid[order],
+        types=types[order],
+    )
+
+
+def _empty_like_cols(layout: dict, n: int) -> dict:
+    return {k: jnp.zeros((n,), dtype=dt) for k, dt in layout.items()}
+
+
+class WindowOp:
+    """Base window operator. Subclasses define init_state/step; both must be
+    traceable (called inside the query's jitted step)."""
+
+    #: chunk width produced per step (static)
+    chunk_width: int
+
+    def init_state(self):
+        raise NotImplementedError
+
+    def step(self, state, batch: EventBatch, now: jax.Array):
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------------- #
+# sliding windows (length, time, timeLength, delay)
+# --------------------------------------------------------------------------- #
+
+
+class SlidingState(NamedTuple):
+    ring_cols: dict
+    ring_ts: jax.Array
+    appended: jax.Array  # int64 total valid arrivals ever
+    expired: jax.Array  # int64 total expirations ever
+
+
+class SlidingWindow(WindowOp):
+    """Unified FIFO sliding window: length(N) and time(W) (and timeLength) are
+    the same machine with different expiry rules. Events expire strictly in
+    arrival order (timestamps are monotone per stream junction), so the window
+    is always a contiguous [expired, appended) range of overall indices.
+
+    Reference: LengthWindowProcessor.java:105-143, TimeWindowProcessor.java:133
+    (scheduler-driven TIMER expiry becomes watermark-driven: the `now` scalar
+    advances with each batch / heartbeat and flushes due expirations).
+    """
+
+    def __init__(self, layout: dict, batch_cap: int, *,
+                 length: Optional[int] = None,
+                 time_ms: Optional[int] = None,
+                 capacity: Optional[int] = None,
+                 max_expired: Optional[int] = None,
+                 is_delay: bool = False):
+        self.layout = layout
+        self.B = batch_cap
+        self.length = length
+        self.time_ms = time_ms
+        self.is_delay = is_delay
+        if length is not None and time_ms is None:
+            self.C = max(length, 1)
+        else:
+            self.C = capacity or max(dtypes.config.default_window_capacity, batch_cap)
+        self.E = max_expired if max_expired is not None else (
+            batch_cap if (length is not None and time_ms is None) else max(batch_cap, 1024))
+        self.chunk_width = self.B + self.E
+
+    def init_state(self) -> SlidingState:
+        return SlidingState(
+            ring_cols=_empty_like_cols(self.layout, self.C),
+            ring_ts=jnp.zeros((self.C,), dtypes.TS_DTYPE),
+            appended=jnp.int64(0),
+            expired=jnp.int64(0),
+        )
+
+    def step(self, state: SlidingState, batch: EventBatch, now: jax.Array):
+        B, E, C = self.B, self.E, self.C
+        comp_cols, comp_ts, n_valid, _ = compact(batch)
+
+        appended1 = state.appended + n_valid
+
+        # ---- expiry candidates: the E oldest in-window events ----
+        e_idx = state.expired + jnp.arange(E, dtype=jnp.int64)
+        cand_exists = e_idx < appended1
+        cand_cols, cand_ts = _gather_overall(
+            state.ring_cols, state.ring_ts, comp_cols, comp_ts, state.appended, e_idx)
+
+        if self.time_ms is not None and self.length is None:
+            # time(W): candidate expires once now >= cand_ts + W; the trigger
+            # position is the first batch arrival with ts >= cand_ts + W (ties:
+            # expire before processing the arrival), or end-of-batch if only
+            # the final watermark covers it.
+            deadline = cand_ts + jnp.int64(self.time_ms)
+            trig = jnp.searchsorted(
+                jnp.where(jnp.arange(B) < n_valid, comp_ts, BIG), deadline,
+                side="left").astype(jnp.int64)
+            expires = cand_exists & (deadline <= now)
+            emit_ts = deadline
+        elif self.time_ms is None:
+            # length(N): candidate o is evicted by arrival with overall index
+            # o + N (the N+1'th event); trigger position within this batch:
+            N = jnp.int64(self.length)
+            trig_overall = e_idx + N
+            trig = trig_overall - state.appended
+            expires = cand_exists & (trig_overall < appended1)
+            # reference stamps evicted events with current time
+            # (LengthWindowProcessor.java:121)
+            safe_trig = jnp.clip(trig, 0, B - 1)
+            emit_ts = comp_ts[safe_trig]
+        else:
+            # timeLength(W, N): expire on whichever rule fires first.
+            N = jnp.int64(self.length)
+            deadline = cand_ts + jnp.int64(self.time_ms)
+            trig_time = jnp.searchsorted(
+                jnp.where(jnp.arange(B) < n_valid, comp_ts, BIG), deadline,
+                side="left").astype(jnp.int64)
+            trig_len = e_idx + N - state.appended
+            time_fires = deadline <= now
+            len_fires = (e_idx + N) < appended1
+            trig = jnp.where(
+                time_fires & len_fires, jnp.minimum(trig_time, trig_len),
+                jnp.where(time_fires, trig_time, trig_len))
+            expires = cand_exists & (time_fires | len_fires)
+            safe_trig = jnp.clip(trig, 0, B - 1)
+            emit_ts = jnp.where(
+                time_fires & (trig_time <= trig_len), deadline, comp_ts[safe_trig])
+
+        n_expired_new = jnp.sum(expires.astype(jnp.int64))
+        # Expirations are FIFO: `expires` is a prefix of candidates by
+        # construction for length windows; for time windows with monotone ts
+        # it is also a prefix. (Non-prefix would indicate ts disorder.)
+
+        # ---- assemble chunk: E expired lanes + B current lanes ----
+        p = jnp.arange(B, dtype=jnp.int64)
+        cur_valid = p < n_valid
+
+        keys_exp = jnp.clip(trig, 0, jnp.int64(B)) * 4 + KIND_EXPIRED
+        keys_cur = p * 4 + KIND_CURRENT
+
+        all_keys = jnp.concatenate([keys_exp, keys_cur])
+        all_cols = {k: jnp.concatenate([cand_cols[k], comp_cols[k]])
+                    for k in self.layout}
+        all_ts = jnp.concatenate([emit_ts, comp_ts])
+        all_valid = jnp.concatenate([expires, cur_valid])
+        all_types = jnp.concatenate([
+            jnp.full((E,), EventType.EXPIRED, jnp.int8),
+            jnp.full((B,), EventType.CURRENT, jnp.int8),
+        ])
+
+        if self.is_delay:
+            # delay(W): expired lanes are re-emitted as CURRENT after the
+            # delay; arrivals are swallowed (reference DelayWindowProcessor).
+            all_types = jnp.concatenate([
+                jnp.full((E,), EventType.CURRENT, jnp.int8),
+                jnp.full((B,), EventType.CURRENT, jnp.int8),
+            ])
+            all_valid = jnp.concatenate([expires, jnp.zeros((B,), bool)])
+
+        chunk = _sort_chunk(all_keys, all_cols, all_ts, all_valid, all_types,
+                            self.chunk_width)
+
+        # ---- ring update ----
+        new_ring_cols, new_ring_ts = _scatter_append(
+            state.ring_cols, state.ring_ts, comp_cols, comp_ts,
+            state.appended, n_valid)
+
+        new_state = SlidingState(
+            ring_cols=new_ring_cols,
+            ring_ts=new_ring_ts,
+            appended=appended1,
+            expired=state.expired + n_expired_new,
+        )
+        return new_state, chunk
+
+
+# --------------------------------------------------------------------------- #
+# batch (tumbling) windows: lengthBatch, timeBatch, batch
+# --------------------------------------------------------------------------- #
+
+
+class BatchState(NamedTuple):
+    ring_cols: dict
+    ring_ts: jax.Array
+    appended: jax.Array  # int64 total arrivals
+    flushed: jax.Array  # int64 arrivals already emitted (flush boundary)
+    prev_start: jax.Array  # int64 start overall idx of the previous flush
+    epoch_base: jax.Array  # int64 ts base for time flushes (first-event ts)
+    has_base: jax.Array  # bool
+
+
+class LengthBatchWindow(WindowOp):
+    """lengthBatch(N): tumbling count window. At each flush boundary emits
+    [expired lanes of the previous flush, RESET, N current lanes]
+    (reference: LengthBatchWindowProcessor.java:210-243)."""
+
+    def __init__(self, layout: dict, batch_cap: int, length: int,
+                 expired_on: bool = True):
+        if length <= 0:
+            raise SiddhiAppCreationError("lengthBatch length must be > 0")
+        self.layout = layout
+        self.B = batch_cap
+        self.N = length
+        self.expired_on = expired_on
+        self.C = 2 * length + batch_cap  # holds prev flush + partial + batch
+        max_flushes = batch_cap // length + 2
+        width = batch_cap + length  # current lanes possible
+        if expired_on:
+            width += batch_cap + length  # expired lanes
+        width += max_flushes  # RESET lanes
+        self.chunk_width = width
+        self._max_flushes = max_flushes
+
+    def init_state(self) -> BatchState:
+        return BatchState(
+            ring_cols=_empty_like_cols(self.layout, self.C),
+            ring_ts=jnp.zeros((self.C,), dtypes.TS_DTYPE),
+            appended=jnp.int64(0),
+            flushed=jnp.int64(0),
+            prev_start=jnp.int64(-1),
+            epoch_base=jnp.int64(0),
+            has_base=jnp.bool_(False),
+        )
+
+    def step(self, state: BatchState, batch: EventBatch, now: jax.Array):
+        B, N, C = self.B, self.N, self.C
+        Nl = jnp.int64(N)
+        comp_cols, comp_ts, n_valid, _ = compact(batch)
+        appended1 = state.appended + n_valid
+
+        f_done = state.flushed // Nl  # flushes completed before this batch
+        f_now = appended1 // Nl  # flushes completed after this batch
+        # completion position (within this batch) of flush f: arrival index of
+        # the flush's last event = (f+1)*N - 1 - appended0
+        # Candidate currents: overall indices [flushed, f_now*N)
+        cur_count_max = B + N
+        o_cur = state.flushed + jnp.arange(cur_count_max, dtype=jnp.int64)
+        cur_exists = o_cur < f_now * Nl
+        cur_cols, cur_ts = _gather_overall(
+            state.ring_cols, state.ring_ts, comp_cols, comp_ts,
+            state.appended, o_cur)
+        cur_flush = o_cur // Nl
+        cur_comp = (cur_flush + 1) * Nl - 1 - state.appended  # batch pos of flush end
+        cur_keys = _emit_key(cur_comp, KIND_CURRENT, o_cur % Nl, N, B)
+
+        # RESET lanes: one per completing flush
+        MF = self._max_flushes
+        f_ids = f_done + jnp.arange(MF, dtype=jnp.int64)
+        reset_exists = f_ids < f_now
+        reset_comp = (f_ids + 1) * Nl - 1 - state.appended
+        reset_keys = _emit_key(reset_comp, KIND_RESET, jnp.zeros((MF,), jnp.int64), N, B)
+        reset_cols = _empty_like_cols(self.layout, MF)
+        safe_rc = jnp.clip(reset_comp, 0, B - 1)
+        reset_ts = comp_ts[safe_rc]
+
+        keys = [cur_keys, reset_keys]
+        colss = [cur_cols, reset_cols]
+        tss = [cur_ts, reset_ts]
+        valids = [cur_exists, reset_exists]
+        types = [jnp.full((cur_count_max,), EventType.CURRENT, jnp.int8),
+                 jnp.full((MF,), EventType.RESET, jnp.int8)]
+
+        if self.expired_on:
+            # expired lanes: events of flush f-1 re-emitted when flush f
+            # completes (only if a previous flush exists)
+            o_exp = (f_done - 1) * Nl + jnp.arange(cur_count_max, dtype=jnp.int64)
+            exp_flush = o_exp // Nl
+            # event of flush f is re-emitted as expired when flush f+1 completes
+            exp_exists = (o_exp >= 0) & ((exp_flush + 1) < f_now)
+            exp_cols, exp_ts_orig = _gather_overall(
+                state.ring_cols, state.ring_ts, comp_cols, comp_ts,
+                state.appended, jnp.clip(o_exp, 0, None))
+            exp_comp = (exp_flush + 2) * Nl - 1 - state.appended
+            exp_keys = _emit_key(exp_comp, KIND_EXPIRED, o_exp % Nl, N, B)
+            safe_ec = jnp.clip(exp_comp, 0, B - 1)
+            exp_ts = comp_ts[safe_ec]  # reference re-stamps with current time
+            keys.append(exp_keys)
+            colss.append(exp_cols)
+            tss.append(exp_ts)
+            valids.append(exp_exists)
+            types.append(jnp.full((cur_count_max,), EventType.EXPIRED, jnp.int8))
+
+        all_keys = jnp.concatenate(keys)
+        all_cols = {k: jnp.concatenate([c[k] for c in colss]) for k in self.layout}
+        all_ts = jnp.concatenate(tss)
+        all_valid = jnp.concatenate(valids)
+        all_types = jnp.concatenate(types)
+
+        chunk = _sort_chunk(all_keys, all_cols, all_ts, all_valid, all_types,
+                            self.chunk_width)
+
+        new_ring_cols, new_ring_ts = _scatter_append(
+            state.ring_cols, state.ring_ts, comp_cols, comp_ts,
+            state.appended, n_valid)
+        new_state = BatchState(
+            ring_cols=new_ring_cols,
+            ring_ts=new_ring_ts,
+            appended=appended1,
+            flushed=f_now * Nl,
+            prev_start=(f_now - 1) * Nl,
+            epoch_base=state.epoch_base,
+            has_base=state.has_base,
+        )
+        return new_state, chunk
+
+
+def _emit_key(comp_pos, kind, within, N, B):
+    """Emission sort key: (completion batch position, kind, within-flush seq)."""
+    return (jnp.clip(comp_pos, -1, jnp.int64(B)) * 4 + kind) * (2 * N + 2) + within
+
+
+class TimeBatchWindow(WindowOp):
+    """timeBatch(W): tumbling time window. Buckets are [base + k*W, base +
+    (k+1)*W); a bucket flushes when an arrival or the watermark crosses its end
+    (reference: TimeBatchWindowProcessor — scheduler-driven flush becomes
+    watermark-driven). Emits [expired(prev bucket), RESET, currents] like
+    lengthBatch."""
+
+    def __init__(self, layout: dict, batch_cap: int, time_ms: int,
+                 capacity: Optional[int] = None, expired_on: bool = True,
+                 start_time: Optional[int] = None):
+        self.layout = layout
+        self.B = batch_cap
+        self.W = time_ms
+        self.expired_on = expired_on
+        self.start_time = start_time
+        self.C = capacity or max(dtypes.config.default_window_capacity, 2 * batch_cap)
+        self.E = max(batch_cap, 1024)  # max emitted current/expired lanes per step
+        width = self.E + 1 + (self.E if expired_on else 0)
+        self.chunk_width = width
+
+    def init_state(self) -> BatchState:
+        return BatchState(
+            ring_cols=_empty_like_cols(self.layout, self.C),
+            ring_ts=jnp.zeros((self.C,), dtypes.TS_DTYPE),
+            appended=jnp.int64(0),
+            flushed=jnp.int64(0),
+            prev_start=jnp.int64(0),
+            epoch_base=jnp.int64(self.start_time if self.start_time is not None else 0),
+            has_base=jnp.bool_(self.start_time is not None),
+        )
+
+    def step(self, state: BatchState, batch: EventBatch, now: jax.Array):
+        B, E, C = self.B, self.E, self.C
+        W = jnp.int64(self.W)
+        comp_cols, comp_ts, n_valid, _ = compact(batch)
+        appended1 = state.appended + n_valid
+
+        # establish bucket base from the first-ever event
+        first_ts = jnp.where(n_valid > 0, comp_ts[0], now)
+        base = jnp.where(state.has_base, state.epoch_base, first_ts)
+        has_base = state.has_base | (n_valid > 0)
+
+        bucket = lambda ts: (ts - base) // W  # noqa: E731
+        # bucket of each arrival; a flush of bucket k happens at the first
+        # arrival with bucket > k (or at watermark end)
+        arr_bucket = bucket(comp_ts)
+        now_bucket = bucket(now)
+        # final flushed bucket boundary: all buckets < flush_hi are emitted
+        flush_hi = jnp.where(has_base, now_bucket, jnp.int64(0))
+
+        # candidate currents: pending events [flushed, appended1) whose bucket
+        # flushes this step
+        o_cur = state.flushed + jnp.arange(E, dtype=jnp.int64)
+        cur_exists_idx = o_cur < appended1
+        cur_cols, cur_ts = _gather_overall(
+            state.ring_cols, state.ring_ts, comp_cols, comp_ts,
+            state.appended, o_cur)
+        cur_bucket = bucket(cur_ts)
+        cur_emit = cur_exists_idx & (cur_bucket < flush_hi)
+        # trigger position: first arrival in a later bucket
+        padded_buckets = jnp.where(jnp.arange(B) < n_valid, arr_bucket, BIG)
+        trig = jnp.searchsorted(padded_buckets, cur_bucket + 1, side="left").astype(jnp.int64)
+        cur_keys = _emit_key(trig, KIND_CURRENT, o_cur % jnp.int64(E), E, B)
+
+        # RESET: one per flushed bucket — approximate with one reset per step
+        # boundary between buckets (sufficient: grouped_scan's reset zeroes all
+        # keys; consecutive empty buckets collapse into one reset).
+        # reset fires right after the last current of each flushed bucket; we
+        # emit a reset lane per candidate position where the *next* candidate
+        # is in a different bucket.
+        next_bucket = jnp.concatenate([cur_bucket[1:], jnp.full((1,), -1, jnp.int64)])
+        is_bucket_end = cur_emit & ((next_bucket != cur_bucket) | ~jnp.concatenate(
+            [cur_emit[1:], jnp.zeros((1,), bool)]))
+        reset_keys = _emit_key(trig, KIND_RESET, o_cur % jnp.int64(E), E, B)
+        reset_cols = _empty_like_cols(self.layout, E)
+        reset_ts = cur_ts
+
+        keys = [cur_keys, reset_keys]
+        colss = [cur_cols, reset_cols]
+        tss = [cur_ts, reset_ts]
+        valids = [cur_emit, is_bucket_end]
+        types = [jnp.full((E,), EventType.CURRENT, jnp.int8),
+                 jnp.full((E,), EventType.RESET, jnp.int8)]
+
+        if self.expired_on:
+            # previous flushed bucket's events re-emitted as expired when the
+            # next bucket flushes: events in [prev_start, flushed)
+            o_exp = state.prev_start + jnp.arange(E, dtype=jnp.int64)
+            exp_cols, exp_ts0 = _gather_overall(
+                state.ring_cols, state.ring_ts, comp_cols, comp_ts,
+                state.appended, jnp.clip(o_exp, 0, None))
+            exp_bucket = bucket(exp_ts0)
+            exp_emit = (o_exp >= state.prev_start) & (o_exp < state.flushed) & (
+                exp_bucket + 1 < flush_hi)
+            trig_e = jnp.searchsorted(padded_buckets, exp_bucket + 2, side="left").astype(jnp.int64)
+            exp_keys = _emit_key(trig_e, KIND_EXPIRED, o_exp % jnp.int64(E), E, B)
+            keys.append(exp_keys)
+            colss.append(exp_cols)
+            tss.append(exp_ts0)
+            valids.append(exp_emit)
+            types.append(jnp.full((E,), EventType.EXPIRED, jnp.int8))
+
+        all_keys = jnp.concatenate(keys)
+        all_cols = {k: jnp.concatenate([c[k] for c in colss]) for k in self.layout}
+        all_ts = jnp.concatenate(tss)
+        all_valid = jnp.concatenate(valids)
+        all_types = jnp.concatenate(types)
+        chunk = _sort_chunk(all_keys, all_cols, all_ts, all_valid, all_types,
+                            self.chunk_width)
+
+        n_emitted = jnp.sum(cur_emit.astype(jnp.int64))
+        new_flushed = state.flushed + n_emitted
+        new_ring_cols, new_ring_ts = _scatter_append(
+            state.ring_cols, state.ring_ts, comp_cols, comp_ts,
+            state.appended, n_valid)
+        new_state = BatchState(
+            ring_cols=new_ring_cols,
+            ring_ts=new_ring_ts,
+            appended=appended1,
+            flushed=new_flushed,
+            prev_start=jnp.where(n_emitted > 0, state.flushed, state.prev_start),
+            epoch_base=base,
+            has_base=has_base,
+        )
+        return new_state, chunk
+
+
+# --------------------------------------------------------------------------- #
+# pass-through (no window)
+# --------------------------------------------------------------------------- #
+
+
+class PassThroughWindow(WindowOp):
+    """No window: batch lanes flow through as CURRENT (the query still gets
+    chunk semantics so the selector path is uniform)."""
+
+    def __init__(self, layout: dict, batch_cap: int):
+        self.layout = layout
+        self.B = batch_cap
+        self.chunk_width = batch_cap
+
+    def init_state(self):
+        return ()
+
+    def step(self, state, batch: EventBatch, now: jax.Array):
+        return state, batch

@@ -1,0 +1,72 @@
+"""Query object model (AST) for SiddhiQL — the TPU build's equivalent of the
+reference's siddhi-query-api module. Pure frozen dataclasses; constructed either
+by the compiler (siddhi_tpu.compiler) or programmatically."""
+
+from .annotation import Annotation, Element
+from .definition import (
+    AbstractDefinition,
+    AggregationDefinition,
+    Attribute,
+    AttributeType,
+    Duration,
+    DURATION_MS,
+    FunctionDefinition,
+    StreamDefinition,
+    TableDefinition,
+    TriggerDefinition,
+    WindowDefinition,
+    WindowHandler,
+)
+from .expression import (
+    And,
+    AttributeFunction,
+    Compare,
+    CompareOp,
+    Constant,
+    Expression,
+    In,
+    IsNull,
+    MathExpression,
+    MathOp,
+    Not,
+    Or,
+    Variable,
+    const,
+    time_constant_ms,
+)
+from .execution import (
+    AbsentStreamStateElement,
+    CountStateElement,
+    EventTrigger,
+    EveryStateElement,
+    InputStream,
+    JoinInputStream,
+    JoinType,
+    LogicalStateElement,
+    NextStateElement,
+    OnDemandQuery,
+    OrderByAttribute,
+    OrderByOrder,
+    OutputAction,
+    OutputAttribute,
+    OutputEventType,
+    OutputRate,
+    OutputRateType,
+    OutputStream,
+    Partition,
+    PartitionType,
+    Query,
+    RangePartitionProperty,
+    RangePartitionType,
+    Selector,
+    SingleInputStream,
+    StateInputStream,
+    StateType,
+    StreamHandlerChain,
+    StreamStateElement,
+    UpdateSetAttribute,
+    ValuePartitionType,
+)
+from .siddhi_app import SiddhiApp
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,34 @@
+"""Generic `@name(key='value', ...)` annotations attachable to any definition or
+query (reference: modules/siddhi-query-api/.../api/annotation/Annotation.java,
+Element.java)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class Element:
+    key: Optional[str]
+    value: str
+
+
+@dataclass(frozen=True)
+class Annotation:
+    name: str
+    elements: tuple[Element, ...] = ()
+    nested: tuple["Annotation", ...] = ()
+
+    def element(self, key: Optional[str] = None, default: Optional[str] = None) -> Optional[str]:
+        """Value of the element with `key` (None matches the bare positional value)."""
+        for e in self.elements:
+            if (e.key.lower() if e.key else None) == (key.lower() if key else None):
+                return e.value
+        return default
+
+    def nested_annotation(self, name: str) -> Optional["Annotation"]:
+        for a in self.nested:
+            if a.name.lower() == name.lower():
+                return a
+        return None

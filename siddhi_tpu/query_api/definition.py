@@ -1,0 +1,206 @@
+"""Definitions: typed schemas for streams, tables, windows, triggers, aggregations.
+
+TPU-native re-design of the reference AST definition layer
+(reference: modules/siddhi-query-api/src/main/java/io/siddhi/query/api/definition/).
+Unlike the reference's mutable builder classes, these are frozen dataclasses: a
+definition is a static schema that the compiler lowers to fixed dtypes/shapes, which
+is what XLA needs (static shapes, no per-event polymorphism).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .annotation import Annotation
+
+
+class AttributeType(enum.Enum):
+    """Typed attributes (reference: query/api/definition/Attribute.java Type enum).
+
+    Device mapping (see core/dtypes.py): STRING is dictionary-encoded to int32 codes
+    at ingestion so string equality/group-by runs on device as integer ops; OBJECT
+    attributes stay host-side (opaque) and cannot participate in device expressions.
+    """
+
+    STRING = "string"
+    INT = "int"
+    LONG = "long"
+    FLOAT = "float"
+    DOUBLE = "double"
+    BOOL = "bool"
+    OBJECT = "object"
+
+    @classmethod
+    def parse(cls, name: str) -> "AttributeType":
+        try:
+            return cls(name.lower())
+        except ValueError:
+            raise ValueError(f"unknown attribute type: {name!r}")
+
+
+@dataclass(frozen=True)
+class Attribute:
+    name: str
+    type: AttributeType
+
+    def __repr__(self) -> str:
+        return f"{self.name} {self.type.value}"
+
+
+@dataclass(frozen=True)
+class AbstractDefinition:
+    """Base for all named definitions (reference: AbstractDefinition.java)."""
+
+    id: str
+    attributes: tuple[Attribute, ...] = ()
+    annotations: tuple[Annotation, ...] = ()
+
+    @property
+    def attribute_names(self) -> tuple[str, ...]:
+        return tuple(a.name for a in self.attributes)
+
+    def attribute_index(self, name: str) -> int:
+        for i, a in enumerate(self.attributes):
+            if a.name == name:
+                return i
+        raise KeyError(f"attribute {name!r} not in {self.id} {self.attribute_names}")
+
+    def attribute_type(self, name: str) -> AttributeType:
+        return self.attributes[self.attribute_index(name)].type
+
+    def annotation(self, name: str) -> Optional[Annotation]:
+        for ann in self.annotations:
+            if ann.name.lower() == name.lower():
+                return ann
+        return None
+
+
+@dataclass(frozen=True)
+class StreamDefinition(AbstractDefinition):
+    """`define stream S (a int, b string, ...)`
+    (reference: definition/StreamDefinition.java)."""
+
+
+@dataclass(frozen=True)
+class TableDefinition(AbstractDefinition):
+    """`define table T (...)` — @PrimaryKey / @Index annotations select indexing
+    (reference: definition/TableDefinition.java; holder selection in
+    core/table/holder/EventHolderPasser via @PrimaryKey/@Index)."""
+
+    @property
+    def primary_keys(self) -> tuple[str, ...]:
+        ann = self.annotation("PrimaryKey")
+        return tuple(e.value for e in ann.elements) if ann else ()
+
+    @property
+    def indexes(self) -> tuple[str, ...]:
+        ann = self.annotation("Index")
+        return tuple(e.value for e in ann.elements) if ann else ()
+
+
+@dataclass(frozen=True)
+class WindowHandler:
+    """A `#window:name(args)` or `#ns:fn(args)` handler reference used in
+    definitions and FROM-clause chains (reference: api/execution/query/input/
+    handler/Window.java, StreamFunction.java)."""
+
+    namespace: str
+    name: str
+    # Expression args; typed as object to avoid circular import with expression.py.
+    parameters: tuple[object, ...] = ()
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.namespace}:{self.name}" if self.namespace else self.name
+
+
+@dataclass(frozen=True)
+class WindowDefinition(AbstractDefinition):
+    """`define window W (...) length(10) output all events`
+    (reference: definition/WindowDefinition.java)."""
+
+    window: Optional[WindowHandler] = None
+    output_event_type: str = "all"  # current | expired | all
+
+
+@dataclass(frozen=True)
+class TriggerDefinition:
+    """`define trigger T at every 5 sec | at 'cron' | at 'start'`
+    (reference: definition/TriggerDefinition.java)."""
+
+    id: str
+    at_every_ms: Optional[int] = None  # periodic interval
+    at_cron: Optional[str] = None  # cron expression
+    at_start: bool = False
+    annotations: tuple[Annotation, ...] = ()
+
+
+@dataclass(frozen=True)
+class FunctionDefinition:
+    """`define function f[lang] return type { body }`
+    (reference: definition/FunctionDefinition.java). The TPU build supports
+    language 'python' / 'jax': the body is compiled to a traced JAX callable."""
+
+    id: str
+    language: str
+    return_type: AttributeType
+    body: str
+
+
+# --- Incremental aggregation ---------------------------------------------------
+
+
+class Duration(enum.Enum):
+    """Time hierarchy for `define aggregation ... aggregate every sec...year`
+    (reference: api/aggregation/TimePeriod.java Duration)."""
+
+    SECONDS = "sec"
+    MINUTES = "min"
+    HOURS = "hours"
+    DAYS = "days"
+    MONTHS = "months"
+    YEARS = "years"
+
+    @classmethod
+    def parse(cls, name: str) -> "Duration":
+        n = name.lower().rstrip("s")
+        aliases = {
+            "sec": cls.SECONDS, "second": cls.SECONDS, "minute": cls.MINUTES,
+            "min": cls.MINUTES, "hour": cls.HOURS, "day": cls.DAYS,
+            "month": cls.MONTHS, "year": cls.YEARS,
+        }
+        if n in aliases:
+            return aliases[n]
+        raise ValueError(f"unknown duration: {name!r}")
+
+    @property
+    def order(self) -> int:
+        return list(Duration).index(self)
+
+
+#: Bucket length in milliseconds for fixed-length durations. MONTHS/YEARS need
+#: calendar math (see aggregation/time.py) and are resolved per-timestamp.
+DURATION_MS = {
+    Duration.SECONDS: 1_000,
+    Duration.MINUTES: 60_000,
+    Duration.HOURS: 3_600_000,
+    Duration.DAYS: 86_400_000,
+}
+
+
+@dataclass(frozen=True)
+class AggregationDefinition:
+    """`define aggregation A from S select ... group by ... aggregate by ts every
+    sec ... year` (reference: definition/AggregationDefinition.java;
+    runtime in core/aggregation/AggregationRuntime.java:82)."""
+
+    id: str
+    input_stream_id: str
+    # selection is a Selector (execution.py); typed object to avoid circularity.
+    selector: object = None
+    group_by: tuple[object, ...] = ()
+    aggregate_attribute: Optional[str] = None  # `aggregate by <attr>`; None = arrival ts
+    durations: tuple[Duration, ...] = ()
+    annotations: tuple[Annotation, ...] = ()

@@ -1,0 +1,332 @@
+"""Execution elements: queries, input streams, selection, output, patterns,
+partitions (reference: modules/siddhi-query-api/.../api/execution/).
+
+All pure data. The runtime planner (core/query_runtime.py) lowers these to
+jitted `(state, batch) -> (state, outputs)` step functions.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .annotation import Annotation
+from .definition import WindowHandler
+from .expression import Expression, Variable
+
+
+# --- FROM clause: input streams ------------------------------------------------
+
+
+@dataclass(frozen=True)
+class StreamHandlerChain:
+    """Handlers applied to one stream in arrival order: filters, stream
+    functions, at most one window (reference: api/execution/query/input/handler/;
+    ordering enforced by BasicSingleInputStream)."""
+
+    filters: tuple[Expression, ...] = ()
+    pre_window_functions: tuple[WindowHandler, ...] = ()
+    window: Optional[WindowHandler] = None
+    post_window_functions: tuple[WindowHandler, ...] = ()
+    post_window_filters: tuple[Expression, ...] = ()
+
+
+@dataclass(frozen=True)
+class SingleInputStream:
+    """`from S[filter]#fn(...)#window:w(...)` (reference:
+    input/stream/SingleInputStream.java)."""
+
+    stream_id: str
+    alias: Optional[str] = None  # `from S as e`
+    handlers: StreamHandlerChain = field(default_factory=StreamHandlerChain)
+    is_inner: bool = False  # `#InnerStream` inside partitions
+    is_fault: bool = False  # `!FaultStream`
+
+    @property
+    def reference_id(self) -> str:
+        return self.alias or self.stream_id
+
+
+class JoinType(enum.Enum):
+    INNER = "join"
+    LEFT_OUTER = "left outer join"
+    RIGHT_OUTER = "right outer join"
+    FULL_OUTER = "full outer join"
+
+
+class EventTrigger(enum.Enum):
+    """Which side's arrivals trigger join output (reference:
+    JoinInputStream.EventTrigger)."""
+
+    LEFT = "left"
+    RIGHT = "right"
+    ALL = "all"
+
+
+@dataclass(frozen=True)
+class JoinInputStream:
+    """`from A#window.x() join B#window.y() on <cond>` (reference:
+    input/stream/JoinInputStream.java; runtime core/query/input/stream/join/)."""
+
+    left: SingleInputStream
+    right: SingleInputStream
+    join_type: JoinType = JoinType.INNER
+    on: Optional[Expression] = None
+    trigger: EventTrigger = EventTrigger.ALL
+    within_ms: Optional[int] = None
+    per: Optional[Expression] = None  # aggregation joins: `per "days"`
+
+
+# --- Patterns / sequences (NFA AST) -------------------------------------------
+
+
+@dataclass(frozen=True)
+class StreamStateElement:
+    """A single condition in a pattern: `e1=StockStream[price > 20]`
+    (reference: input/state/StreamStateElement.java)."""
+
+    stream: SingleInputStream
+
+
+@dataclass(frozen=True)
+class AbsentStreamStateElement:
+    """`not StockStream[...] for 5 sec` (reference:
+    input/state/AbsentStreamStateElement.java)."""
+
+    stream: SingleInputStream
+    waiting_time_ms: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class CountStateElement:
+    """`e1=S[...] <2:5>` (reference: input/state/CountStateElement.java).
+    max == ANY (-1) means unbounded."""
+
+    element: StreamStateElement
+    min_count: int
+    max_count: int  # -1 = unbounded
+
+    ANY = -1
+
+
+@dataclass(frozen=True)
+class LogicalStateElement:
+    """`A and B`, `A or B` (reference: input/state/LogicalStateElement.java)."""
+
+    left: object  # StateElement
+    logical_type: str  # "and" | "or"
+    right: object  # StateElement
+
+
+@dataclass(frozen=True)
+class NextStateElement:
+    """`A -> B` (pattern) or `A , B` (sequence) (reference:
+    input/state/NextStateElement.java)."""
+
+    state: object  # StateElement
+    next: object  # StateElement
+
+
+@dataclass(frozen=True)
+class EveryStateElement:
+    """`every (A -> B)` — re-arm on match (reference:
+    input/state/EveryStateElement.java)."""
+
+    state: object  # StateElement
+
+
+StateElement = (
+    StreamStateElement | AbsentStreamStateElement | CountStateElement |
+    LogicalStateElement | NextStateElement | EveryStateElement
+)
+
+
+class StateType(enum.Enum):
+    PATTERN = "pattern"  # `->` skip-till-any-match
+    SEQUENCE = "sequence"  # `,` strict contiguity
+
+
+@dataclass(frozen=True)
+class StateInputStream:
+    """`from every e1=A -> e2=B within 5 sec` (reference:
+    input/stream/StateInputStream.java)."""
+
+    state_type: StateType
+    state: StateElement
+    within_ms: Optional[int] = None
+
+
+InputStream = SingleInputStream | JoinInputStream | StateInputStream
+
+
+# --- SELECT clause -------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class OutputAttribute:
+    """`expr as name` (reference: selection/OutputAttribute.java)."""
+
+    rename: str
+    expression: Expression
+
+
+class OrderByOrder(enum.Enum):
+    ASC = "asc"
+    DESC = "desc"
+
+
+@dataclass(frozen=True)
+class OrderByAttribute:
+    variable: Variable
+    order: OrderByOrder = OrderByOrder.ASC
+
+
+@dataclass(frozen=True)
+class Selector:
+    """SELECT + GROUP BY + HAVING + ORDER BY + LIMIT/OFFSET (reference:
+    selection/Selector.java; runtime core/query/selector/QuerySelector.java:44)."""
+
+    attributes: tuple[OutputAttribute, ...] = ()  # empty = select *
+    group_by: tuple[Variable, ...] = ()
+    having: Optional[Expression] = None
+    order_by: tuple[OrderByAttribute, ...] = ()
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+
+    @property
+    def is_select_all(self) -> bool:
+        return not self.attributes
+
+
+# --- Output --------------------------------------------------------------------
+
+
+class OutputEventType(enum.Enum):
+    """`insert [current|expired|all] events into ...` (reference:
+    api/execution/query/output/stream/OutputStream.OutputEventType)."""
+
+    CURRENT = "current events"
+    EXPIRED = "expired events"
+    ALL = "all events"
+
+
+class OutputAction(enum.Enum):
+    INSERT = "insert"
+    DELETE = "delete"
+    UPDATE = "update"
+    UPDATE_OR_INSERT = "update or insert"
+    RETURN = "return"
+
+
+@dataclass(frozen=True)
+class UpdateSetAttribute:
+    table_variable: Variable
+    expression: Expression
+
+
+@dataclass(frozen=True)
+class OutputStream:
+    """Terminal action of a query (reference:
+    api/execution/query/output/stream/*.java)."""
+
+    action: OutputAction
+    target_id: Optional[str] = None  # None for RETURN
+    event_type: OutputEventType = OutputEventType.CURRENT
+    on_condition: Optional[Expression] = None  # delete/update ... on <cond>
+    set_attributes: tuple[UpdateSetAttribute, ...] = ()
+    is_fault: bool = False  # `insert into !Stream`
+
+
+class OutputRateType(enum.Enum):
+    ALL = "all"
+    FIRST = "first"
+    LAST = "last"
+    SNAPSHOT = "snapshot"
+
+
+@dataclass(frozen=True)
+class OutputRate:
+    """`output [all|first|last] every 5 sec / every 3 events / snapshot every ...`
+    (reference: api/execution/query/output/ratelimit/)."""
+
+    type: OutputRateType = OutputRateType.ALL
+    time_ms: Optional[int] = None
+    event_count: Optional[int] = None
+
+
+# --- Query & partition ---------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Query:
+    """One continuous query (reference: api/execution/query/Query.java)."""
+
+    input_stream: InputStream
+    selector: Selector = field(default_factory=Selector)
+    output_stream: OutputStream = field(default_factory=lambda: OutputStream(OutputAction.RETURN))
+    output_rate: Optional[OutputRate] = None
+    annotations: tuple[Annotation, ...] = ()
+
+    @property
+    def name(self) -> Optional[str]:
+        for ann in self.annotations:
+            if ann.name.lower() == "info":
+                return ann.element("name")
+        return None
+
+
+@dataclass(frozen=True)
+class ValuePartitionType:
+    """`partition with (attr of Stream)` (reference:
+    api/execution/partition/ValuePartitionType.java)."""
+
+    stream_id: str
+    expression: Expression
+
+
+@dataclass(frozen=True)
+class RangePartitionProperty:
+    partition_key: str
+    condition: Expression
+
+
+@dataclass(frozen=True)
+class RangePartitionType:
+    """`partition with (cond as 'key' or ... of Stream)` (reference:
+    api/execution/partition/RangePartitionType.java)."""
+
+    stream_id: str
+    ranges: tuple[RangePartitionProperty, ...]
+
+
+PartitionType = ValuePartitionType | RangePartitionType
+
+
+@dataclass(frozen=True)
+class Partition:
+    """`partition with (...) begin <queries> end` (reference:
+    api/execution/partition/Partition.java; runtime
+    core/partition/PartitionRuntimeImpl.java:75)."""
+
+    partition_types: tuple[PartitionType, ...]
+    queries: tuple[Query, ...]
+    annotations: tuple[Annotation, ...] = ()
+
+
+@dataclass(frozen=True)
+class OnDemandQuery:
+    """Ad-hoc pull query against a table/window/aggregation (reference:
+    api/execution/query/OnDemandQuery.java)."""
+
+    input_store_id: str
+    on_condition: Optional[Expression] = None
+    within_range: Optional[tuple[Expression, Expression]] = None  # aggregations
+    per: Optional[Expression] = None
+    selector: Selector = field(default_factory=Selector)
+    action: OutputAction = OutputAction.RETURN
+    set_attributes: tuple[UpdateSetAttribute, ...] = ()
+    target_id: Optional[str] = None
+
+
+ExecutionElement = Query | Partition

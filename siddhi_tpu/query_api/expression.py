@@ -1,0 +1,170 @@
+"""Expression AST (reference: modules/siddhi-query-api/.../api/expression/).
+
+Where the reference walks this tree per event with an interpreter
+(core/util/parser/ExpressionParser.java:225 building monomorphic
+ExpressionExecutor objects), the TPU build traces it ONCE into a jitted JAX
+function over columnar batches (ops/expr_compile.py). The AST is therefore pure
+data — frozen dataclasses with no behavior.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional, Union
+
+
+class Expression:
+    """Marker base class for all expression nodes."""
+
+    __slots__ = ()
+
+
+# --- Constants -----------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Constant(Expression):
+    """Typed literal (reference: api/expression/constant/*). `value` is a Python
+    scalar; `type_name` one of int/long/float/double/bool/string/time."""
+
+    value: object
+    type_name: str
+
+
+def time_constant_ms(value: float, unit: str) -> Constant:
+    """`5 sec`, `1 min`, ... → milliseconds (reference: constant/TimeConstant.java)."""
+    ms = {
+        "millisec": 1, "milliseconds": 1, "sec": 1000, "second": 1000,
+        "min": 60_000, "minute": 60_000, "hour": 3_600_000,
+        "day": 86_400_000, "week": 604_800_000, "month": 2_592_000_000,
+        "year": 31_536_000_000,
+    }
+    key = unit.lower().rstrip("s") if unit.lower() not in ("milliseconds", "millisec") else "millisec"
+    if key not in ms:
+        raise ValueError(f"unknown time unit {unit!r}")
+    return Constant(int(value * ms[key]), "long")
+
+
+# --- Variables -----------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Variable(Expression):
+    """`[stream.]attr` optionally with a stream index for patterns: `e1[0].price`
+    (reference: api/expression/Variable.java)."""
+
+    attribute: str
+    stream_id: Optional[str] = None
+    stream_index: Optional[int] = None  # pattern count-group element index
+    is_last: bool = False  # e1[last]
+
+
+# --- Math ----------------------------------------------------------------------
+
+
+class MathOp(enum.Enum):
+    ADD = "+"
+    SUBTRACT = "-"
+    MULTIPLY = "*"
+    DIVIDE = "/"
+    MOD = "%"
+
+
+@dataclass(frozen=True)
+class MathExpression(Expression):
+    op: MathOp
+    left: Expression
+    right: Expression
+
+
+# --- Conditions ----------------------------------------------------------------
+
+
+class CompareOp(enum.Enum):
+    EQUAL = "=="
+    NOT_EQUAL = "!="
+    GREATER_THAN = ">"
+    GREATER_THAN_EQUAL = ">="
+    LESS_THAN = "<"
+    LESS_THAN_EQUAL = "<="
+
+
+@dataclass(frozen=True)
+class Compare(Expression):
+    left: Expression
+    op: CompareOp
+    right: Expression
+
+
+@dataclass(frozen=True)
+class And(Expression):
+    left: Expression
+    right: Expression
+
+
+@dataclass(frozen=True)
+class Or(Expression):
+    left: Expression
+    right: Expression
+
+
+@dataclass(frozen=True)
+class Not(Expression):
+    expression: Expression
+
+
+@dataclass(frozen=True)
+class IsNull(Expression):
+    """`x is null` — with columnar batches this tests the per-attribute validity
+    mask (reference: api/expression/condition/IsNull.java). The stream variant
+    (`e2 is null` in patterns) carries stream_id only."""
+
+    expression: Optional[Expression] = None
+    stream_id: Optional[str] = None
+    stream_index: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class In(Expression):
+    """`<cond> in TableName` — membership test against a table
+    (reference: api/expression/condition/In.java)."""
+
+    expression: Expression
+    source_id: str
+
+
+# --- Functions -----------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AttributeFunction(Expression):
+    """`[ns:]name(arg, ...)` — scalar function OR aggregator; the selector parser
+    decides which by registry lookup, mirroring the reference's aggregator
+    detection (ExpressionParser.java:462)."""
+
+    namespace: str
+    name: str
+    parameters: tuple[Expression, ...] = ()
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.namespace}:{self.name}" if self.namespace else self.name
+
+
+ExpressionLike = Union[Expression, int, float, bool, str]
+
+
+def const(value: ExpressionLike) -> Expression:
+    """Coerce a Python literal into a Constant node (builder-API convenience)."""
+    if isinstance(value, Expression):
+        return value
+    if isinstance(value, bool):
+        return Constant(value, "bool")
+    if isinstance(value, int):
+        return Constant(value, "long" if abs(value) > 2**31 - 1 else "int")
+    if isinstance(value, float):
+        return Constant(value, "double")
+    if isinstance(value, str):
+        return Constant(value, "string")
+    raise TypeError(f"cannot make a constant from {value!r}")

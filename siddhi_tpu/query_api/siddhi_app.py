@@ -1,0 +1,106 @@
+"""SiddhiApp: the top-level AST container (reference:
+modules/siddhi-query-api/.../api/SiddhiApp.java)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .annotation import Annotation
+from .definition import (
+    AggregationDefinition,
+    FunctionDefinition,
+    StreamDefinition,
+    TableDefinition,
+    TriggerDefinition,
+    WindowDefinition,
+)
+from .execution import ExecutionElement, Partition, Query
+
+
+@dataclass
+class SiddhiApp:
+    """Holds every definition + execution element of one app. Mutable during
+    construction (the parser appends), treated as immutable afterwards."""
+
+    stream_definitions: dict[str, StreamDefinition] = field(default_factory=dict)
+    table_definitions: dict[str, TableDefinition] = field(default_factory=dict)
+    window_definitions: dict[str, WindowDefinition] = field(default_factory=dict)
+    trigger_definitions: dict[str, TriggerDefinition] = field(default_factory=dict)
+    aggregation_definitions: dict[str, AggregationDefinition] = field(default_factory=dict)
+    function_definitions: dict[str, FunctionDefinition] = field(default_factory=dict)
+    execution_elements: list[ExecutionElement] = field(default_factory=list)
+    annotations: list[Annotation] = field(default_factory=list)
+
+    def define_stream(self, d: StreamDefinition) -> "SiddhiApp":
+        self._check_unique(d.id)
+        self.stream_definitions[d.id] = d
+        return self
+
+    def define_table(self, d: TableDefinition) -> "SiddhiApp":
+        self._check_unique(d.id)
+        self.table_definitions[d.id] = d
+        return self
+
+    def define_window(self, d: WindowDefinition) -> "SiddhiApp":
+        self._check_unique(d.id)
+        self.window_definitions[d.id] = d
+        return self
+
+    def define_trigger(self, d: TriggerDefinition) -> "SiddhiApp":
+        self._check_unique(d.id)
+        self.trigger_definitions[d.id] = d
+        return self
+
+    def define_aggregation(self, d: AggregationDefinition) -> "SiddhiApp":
+        self._check_unique(d.id)
+        self.aggregation_definitions[d.id] = d
+        return self
+
+    def define_function(self, d: FunctionDefinition) -> "SiddhiApp":
+        self.function_definitions[d.id] = d
+        return self
+
+    def add_query(self, q: Query) -> "SiddhiApp":
+        self.execution_elements.append(q)
+        return self
+
+    def add_partition(self, p: Partition) -> "SiddhiApp":
+        self.execution_elements.append(p)
+        return self
+
+    def annotation(self, name: str):
+        for ann in self.annotations:
+            if ann.name.lower() == name.lower():
+                return ann
+        return None
+
+    @property
+    def queries(self) -> list[Query]:
+        return [e for e in self.execution_elements if isinstance(e, Query)]
+
+    @property
+    def partitions(self) -> list[Partition]:
+        return [e for e in self.execution_elements if isinstance(e, Partition)]
+
+    @property
+    def name(self) -> str:
+        # `@app:name('X')` parses as an annotation literally named "app:name"
+        # with one bare element (matching the reference's app-level annotation
+        # addressing, SiddhiAppParser.java:91).
+        ann = self.annotation("app:name")
+        if ann and ann.elements:
+            return ann.elements[0].value
+        ann = self.annotation("app")
+        if ann:
+            v = ann.element("name")
+            if v:
+                return v
+        return "SiddhiApp"
+
+    def _check_unique(self, id_: str) -> None:
+        for m in (self.stream_definitions, self.table_definitions,
+                  self.window_definitions, self.trigger_definitions,
+                  self.aggregation_definitions):
+            if id_ in m:
+                from ..errors import DuplicateDefinitionError
+                raise DuplicateDefinitionError(f"{id_!r} is already defined")

@@ -1,0 +1,334 @@
+"""SiddhiQL parser tests (model: reference siddhi-query-compiler test suite —
+modules/siddhi-query-compiler/src/test/java/io/siddhi/query/test/, grammar →
+AST equality)."""
+
+import pytest
+
+from siddhi_tpu.compiler import parse, parse_query, parse_stream_definition, update_variables
+from siddhi_tpu.errors import SiddhiParserError
+from siddhi_tpu.query_api import (
+    AbsentStreamStateElement,
+    And,
+    AttributeFunction,
+    AttributeType,
+    Compare,
+    CompareOp,
+    Constant,
+    CountStateElement,
+    Duration,
+    EveryStateElement,
+    JoinInputStream,
+    JoinType,
+    MathExpression,
+    MathOp,
+    NextStateElement,
+    OutputAction,
+    OutputEventType,
+    OutputRateType,
+    SingleInputStream,
+    StateInputStream,
+    StateType,
+    StreamStateElement,
+    Variable,
+)
+
+
+class TestDefinitions:
+    def test_stream_definition(self):
+        d = parse_stream_definition(
+            "define stream StockStream (symbol string, price float, volume long);")
+        assert d.id == "StockStream"
+        assert d.attribute_names == ("symbol", "price", "volume")
+        assert d.attribute_type("price") == AttributeType.FLOAT
+
+    def test_all_attribute_types(self):
+        d = parse_stream_definition(
+            "define stream S (a string, b int, c long, d float, e double, f bool, g object);")
+        assert [a.type for a in d.attributes] == [
+            AttributeType.STRING, AttributeType.INT, AttributeType.LONG,
+            AttributeType.FLOAT, AttributeType.DOUBLE, AttributeType.BOOL,
+            AttributeType.OBJECT]
+
+    def test_table_with_primary_key_and_index(self):
+        app = parse("""
+            @PrimaryKey('sym')
+            @Index('vol')
+            define table T (sym string, price double, vol long);
+        """)
+        t = app.table_definitions["T"]
+        assert t.primary_keys == ("sym",)
+        assert t.indexes == ("vol",)
+
+    def test_window_definition(self):
+        app = parse("define window W (x int) length(10) output all events;")
+        w = app.window_definitions["W"]
+        assert w.window.name == "length"
+        assert w.output_event_type == "all"
+
+    def test_trigger_definitions(self):
+        app = parse("""
+            define trigger T1 at every 5 sec;
+            define trigger T2 at 'start';
+            define trigger T3 at '*/5 * * * * ?';
+        """)
+        assert app.trigger_definitions["T1"].at_every_ms == 5000
+        assert app.trigger_definitions["T2"].at_start
+        assert app.trigger_definitions["T3"].at_cron == "*/5 * * * * ?"
+
+    def test_aggregation_definition(self):
+        app = parse("""
+            define stream S (sym string, price double, ts long);
+            define aggregation Agg
+            from S select sym, sum(price) as total, avg(price) as mean
+            group by sym
+            aggregate by ts every sec ... day;
+        """)
+        a = app.aggregation_definitions["Agg"]
+        assert a.input_stream_id == "S"
+        assert a.aggregate_attribute == "ts"
+        assert a.durations == (Duration.SECONDS, Duration.MINUTES,
+                               Duration.HOURS, Duration.DAYS)
+
+    def test_function_definition(self):
+        app = parse("""
+            define function concatFn[python] return string { return x + y };
+        """)
+        f = app.function_definitions["concatFn"]
+        assert f.language == "python"
+        assert f.return_type == AttributeType.STRING
+        assert "return x + y" in f.body
+
+    def test_app_annotation(self):
+        app = parse("@app:name('MyApp')\ndefine stream S (x int);")
+        assert app.name == "MyApp"
+
+    def test_duplicate_definition_rejected(self):
+        with pytest.raises(Exception):
+            parse("define stream S (x int); define stream S (y int);")
+
+
+class TestExpressions:
+    def _filter(self, expr_text):
+        q = parse_query(
+            f"define stream S (a int, b long, p double, s string, f bool);\n"
+            f"from S[{expr_text}] select a insert into Out;")
+        return q.input_stream.handlers.filters[0]
+
+    def test_precedence_mul_over_add(self):
+        e = self._filter("a + b * 2 > 10")
+        assert isinstance(e, Compare)
+        assert isinstance(e.left, MathExpression)
+        assert e.left.op == MathOp.ADD
+        assert e.left.right.op == MathOp.MULTIPLY
+
+    def test_and_or_not(self):
+        e = self._filter("not f and (a > 1 or b < 2)")
+        assert isinstance(e, And)
+
+    def test_string_compare(self):
+        e = self._filter("s == 'IBM'")
+        assert e.right == Constant("IBM", "string")
+
+    def test_time_constant(self):
+        q = parse_query(
+            "define stream S (x int);"
+            "from every e1=S -> e2=S within 1 min 30 sec select e1.x insert into O;")
+        assert q.input_stream.within_ms == 90_000
+
+    def test_typed_literals(self):
+        e = self._filter("p > 5.5f")
+        assert e.right.type_name == "float"
+        e = self._filter("b > 100L")
+        assert e.right.type_name == "long"
+        e = self._filter("a > -3")
+        assert e.right.value == -3
+
+    def test_function_call(self):
+        e = self._filter("math:abs(a - b) > 5")
+        assert isinstance(e.left, AttributeFunction)
+        assert e.left.namespace == "math"
+
+    def test_is_null(self):
+        from siddhi_tpu.query_api import IsNull
+        e = self._filter("s is null")
+        assert isinstance(e, IsNull)
+
+
+class TestQueries:
+    def test_filter_window_groupby(self):
+        q = parse_query("""
+            define stream S (sym string, price double, vol long);
+            @info(name='q1')
+            from S[price > 20.0]#window.lengthBatch(10000)
+            select sym, sum(price) as total
+            group by sym having total > 5.0
+            order by total desc limit 10 offset 2
+            insert all events into Out;
+        """)
+        assert q.name == "q1"
+        h = q.input_stream.handlers
+        assert len(h.filters) == 1
+        assert h.window.name == "lengthBatch"
+        assert h.window.parameters[0].value == 10000
+        assert q.selector.group_by[0].attribute == "sym"
+        assert q.selector.having is not None
+        assert q.selector.limit == 10 and q.selector.offset == 2
+        assert q.selector.order_by[0].variable.attribute == "total"
+        assert q.output_stream.event_type == OutputEventType.ALL
+
+    def test_select_star(self):
+        q = parse_query("define stream S (x int); from S select * insert into O;")
+        assert q.selector.is_select_all
+
+    def test_output_rate(self):
+        q = parse_query(
+            "define stream S (x int);"
+            "from S select x output last every 3 events insert into O;")
+        assert q.output_rate.type == OutputRateType.LAST
+        assert q.output_rate.event_count == 3
+        q = parse_query(
+            "define stream S (x int);"
+            "from S select x output snapshot every 5 sec insert into O;")
+        assert q.output_rate.type == OutputRateType.SNAPSHOT
+        assert q.output_rate.time_ms == 5000
+
+    def test_join(self):
+        q = parse_query("""
+            define stream A (x int); define stream B (x int, v double);
+            from A#window.length(100) as l
+            left outer join B#window.length(200) as r
+            on l.x == r.x within 2 sec
+            select l.x as x, r.v as v insert into J;
+        """)
+        j = q.input_stream
+        assert isinstance(j, JoinInputStream)
+        assert j.join_type == JoinType.LEFT_OUTER
+        assert j.left.alias == "l" and j.right.alias == "r"
+        assert j.within_ms == 2000
+
+    def test_pattern(self):
+        q = parse_query("""
+            define stream A (x int); define stream B (y int);
+            from every e1=A[x > 5] -> e2=B[y > e1.x] within 5 sec
+            select e1.x as ax, e2.y as doubled insert into P;
+        """)
+        s = q.input_stream
+        assert isinstance(s, StateInputStream)
+        assert s.state_type == StateType.PATTERN
+        assert s.within_ms == 5000
+        assert isinstance(s.state, NextStateElement)
+        assert isinstance(s.state.state, EveryStateElement)
+
+    def test_pattern_count_and_absent(self):
+        q = parse_query("""
+            define stream A (x int); define stream B (y int);
+            from e1=A<2:5> -> not B[y > 1] for 3 sec
+            select e1[0].x as first insert into P;
+        """)
+        s = q.input_stream.state
+        assert isinstance(s.state, CountStateElement)
+        assert (s.state.min_count, s.state.max_count) == (2, 5)
+        assert isinstance(s.next, AbsentStreamStateElement)
+        assert s.next.waiting_time_ms == 3000
+        # indexed variable
+        v = q.selector.attributes[0].expression
+        assert v.stream_index == 0
+
+    def test_logical_pattern(self):
+        q = parse_query("""
+            define stream A (x int); define stream B (y int); define stream C (z int);
+            from every (e1=A and e2=B) -> e3=C
+            select e1.x, e2.y, e3.z insert into O;
+        """)
+        from siddhi_tpu.query_api import LogicalStateElement
+        st = q.input_stream.state
+        assert isinstance(st.state, EveryStateElement)
+        assert isinstance(st.state.state, LogicalStateElement)
+        assert st.state.state.logical_type == "and"
+
+    def test_sequence(self):
+        q = parse_query("""
+            define stream A (x int);
+            from every e1=A, e2=A[x > e1.x]
+            select e1.x as a, e2.x as b insert into Sq;
+        """)
+        s = q.input_stream
+        assert s.state_type == StateType.SEQUENCE
+
+    def test_table_crud_queries(self):
+        app = parse("""
+            define stream S (sym string, price double);
+            define table T (sym string, price double);
+            from S select sym, price insert into T;
+            from S delete T on T.sym == sym;
+            from S update T set T.price = price on T.sym == sym;
+            from S update or insert into T set T.price = price on T.sym == sym;
+        """)
+        actions = [q.output_stream.action for q in app.queries]
+        assert actions == [OutputAction.INSERT, OutputAction.DELETE,
+                           OutputAction.UPDATE, OutputAction.UPDATE_OR_INSERT]
+
+    def test_partition(self):
+        app = parse("""
+            define stream S (sym string, price double);
+            partition with (sym of S)
+            begin
+              from S select sym, sum(price) as t insert into #inner;
+              from #inner select sym, t insert into Out;
+            end;
+        """)
+        p = app.partitions[0]
+        assert len(p.queries) == 2
+        assert p.queries[1].input_stream.is_inner
+
+    def test_range_partition(self):
+        app = parse("""
+            define stream S (price double);
+            partition with (price < 100.0 as 'cheap' or price >= 100.0 as 'pricey' of S)
+            begin
+              from S select price insert into Out;
+            end;
+        """)
+        from siddhi_tpu.query_api import RangePartitionType
+        pt = app.partitions[0].partition_types[0]
+        assert isinstance(pt, RangePartitionType)
+        assert [r.partition_key for r in pt.ranges] == ["cheap", "pricey"]
+
+    def test_fault_stream_output(self):
+        q = parse_query(
+            "define stream S (x int); from S select x insert into !S;")
+        assert q.output_stream.is_fault
+
+
+class TestMisc:
+    def test_update_variables(self):
+        out = update_variables("define stream ${NAME} (x int);", {"NAME": "S"})
+        assert "stream S" in out
+
+    def test_update_variables_missing(self):
+        with pytest.raises(SiddhiParserError):
+            update_variables("${MISSING}", {})
+
+    def test_syntax_error_has_location(self):
+        with pytest.raises(SiddhiParserError):
+            parse("define stream S x int);")
+
+    def test_comments_ignored(self):
+        app = parse("""
+            -- a line comment
+            /* a block
+               comment */
+            define stream S (x int);
+        """)
+        assert "S" in app.stream_definitions
+
+    def test_source_sink_annotations(self):
+        app = parse("""
+            @source(type='inMemory', topic='t1', @map(type='passThrough'))
+            define stream In (x int);
+            @sink(type='log', prefix='OUT')
+            define stream Out (x int);
+        """)
+        src = app.stream_definitions["In"].annotation("source")
+        assert src.element("type") == "inMemory"
+        assert src.nested_annotation("map").element("type") == "passThrough"
