@@ -1,0 +1,105 @@
+"""Driver benchmark — prints ONE JSON line.
+
+Config: BASELINE.md #2 — lengthBatch(10000) window, sum/avg group-by over 1M
+distinct keys (the north-star sliding-window group-by shape). Events are
+synthesized host-side as pre-encoded columnar batches (dictionary interning is
+amortized in steady state) and pushed through the jitted query step on the
+default device (real TPU under the driver; CPU elsewhere).
+
+vs_baseline: BASELINE.json `published` is empty and no JVM exists in this image
+to measure the reference, so the denominator defaults to a nominal 1.0M
+events/sec single-JVM CPU figure (WSO2's published order-of-magnitude for
+simple Siddhi queries; documented assumption). If a measured number is added to
+BASELINE.json under published["groupby_window_events_per_sec"], it is used
+instead.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BATCH = 8192
+N_KEYS = 1_000_000
+WINDOW = 10_000
+WARMUP = 3
+STEPS = 40
+
+APP = f"""
+define stream TradeStream (symbol string, price double, volume long);
+@info(name = 'bench')
+from TradeStream#window.lengthBatch({WINDOW})
+select symbol, sum(price) as total, avg(price) as avgPrice
+group by symbol
+insert into SummaryStream;
+"""
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.event import EventBatch
+
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        APP, batch_size=BATCH, group_capacity=1 << 20)
+    qr = rt.query_runtimes["bench"]
+
+    rng = np.random.default_rng(7)
+    n_distinct_batches = 8  # cycle through pre-built batches
+    batches = []
+    ts0 = 1
+    for i in range(n_distinct_batches):
+        ts = np.arange(ts0, ts0 + BATCH, dtype=np.int64)
+        ts0 += BATCH
+        cols = {
+            # pre-encoded dictionary codes (1..N_KEYS); code 0 is null
+            "symbol": rng.integers(1, N_KEYS + 1, BATCH, dtype=np.int32),
+            "price": rng.uniform(1.0, 100.0, BATCH).astype(np.float32),
+            "volume": rng.integers(1, 1000, BATCH, dtype=np.int64),
+        }
+        batches.append(EventBatch.from_numpy(ts, cols, BATCH))
+
+    state = qr.state
+    step = qr._step
+
+    # warmup / compile
+    for i in range(WARMUP):
+        state, out = step(state, batches[i % n_distinct_batches], jnp.int64(ts0))
+    jax.block_until_ready(out)
+
+    lat = []
+    t_start = time.perf_counter()
+    for i in range(STEPS):
+        t0 = time.perf_counter()
+        state, out = step(state, batches[i % n_distinct_batches], jnp.int64(ts0))
+        jax.block_until_ready(out)
+        lat.append(time.perf_counter() - t0)
+    elapsed = time.perf_counter() - t_start
+
+    events_per_sec = BATCH * STEPS / elapsed
+    p99_ms = float(np.percentile(np.array(lat), 99) * 1e3)
+
+    baseline = 1_000_000.0
+    try:
+        with open("BASELINE.json") as f:
+            pub = json.load(f).get("published", {})
+        baseline = float(pub.get("groupby_window_events_per_sec", baseline))
+    except Exception:
+        pass
+
+    print(json.dumps({
+        "metric": "lengthBatch10k_groupby_1M_keys_events_per_sec",
+        "value": round(events_per_sec, 1),
+        "unit": "events/sec",
+        "vs_baseline": round(events_per_sec / baseline, 3),
+        "p99_batch_latency_ms": round(p99_ms, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
