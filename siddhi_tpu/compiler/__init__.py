@@ -18,7 +18,8 @@ from .transformer import AstTransformer
 
 @functools.lru_cache(maxsize=1)
 def _parser() -> Lark:
-    return Lark(GRAMMAR, parser="earley", lexer="dynamic", maybe_placeholders=False)
+    return Lark(GRAMMAR, parser="earley", lexer="dynamic", maybe_placeholders=False,
+                start=["start", "on_demand_query"])
 
 
 _VAR_PATTERN = re.compile(r"\$\{(\w+)\}")
@@ -41,11 +42,26 @@ def update_variables(siddhi_ql: str, env: dict | None = None) -> str:
 def parse(siddhi_ql: str) -> SiddhiApp:
     """Parse a full SiddhiQL app definition string into a SiddhiApp AST."""
     try:
-        tree = _parser().parse(siddhi_ql)
+        tree = _parser().parse(siddhi_ql, start="start")
     except UnexpectedInput as e:
         line = getattr(e, "line", None)
         column = getattr(e, "column", None)
         raise SiddhiParserError(str(e).split("\n")[0], line, column) from e
+    try:
+        return AstTransformer().transform(tree)
+    except VisitError as e:
+        raise SiddhiParserError(f"error building AST: {e.orig_exc}") from e
+
+
+def parse_on_demand_query(text: str):
+    """Parse an on-demand (store) query — `from Store [on cond] [within a,b]
+    [per d] select ...` (reference: SiddhiCompiler.parseOnDemandQuery /
+    parseStoreQuery)."""
+    try:
+        tree = _parser().parse(text, start="on_demand_query")
+    except UnexpectedInput as e:
+        raise SiddhiParserError(str(e).split("\n")[0], getattr(e, "line", None),
+                                getattr(e, "column", None)) from e
     try:
         return AstTransformer().transform(tree)
     except VisitError as e:

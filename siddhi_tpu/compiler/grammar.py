@@ -144,6 +144,13 @@ set_clause: SET set_item ("," set_item)*
 set_item: variable_ref "=" expression
 event_type: CURRENT | EXPIRED | ALL
 
+// on-demand (store) query — reference grammar rule store_query; executed via
+// SiddhiAppRuntime.query() against tables/windows/aggregations
+on_demand_query: FROM NAME od_on? od_within? od_per? select_clause? group_by_clause? having_clause? order_by_clause? limit_clause? offset_clause?
+od_on: ON expression
+od_within: WITHIN expression ("," expression)?
+od_per: PER expression
+
 // partition
 partition: annotation* PARTITION WITH "(" partition_item ("," partition_item)* ")" BEGIN (query ";"?)+ END
 partition_item: expression AS STRING_LITERAL (OR expression AS STRING_LITERAL)* OF stream_id -> range_partition
@@ -154,10 +161,14 @@ expression: or_expr
 or_expr: and_expr (OR and_expr)*
 and_expr: not_expr (AND not_expr)*
 not_expr: NOT not_expr -> not_op
-        | comparison
+        | in_expr
+// `cond in Table` binds tighter than AND/OR but looser than comparison, so
+// `S.sym == T.sym in T` is (S.sym == T.sym) in T and
+// `a in T and b > 5` is And(a in T, b > 5)
+in_expr: comparison IN NAME -> in_op
+       | comparison
 comparison: addsub (comp_op addsub)?
           | addsub IS NULL -> is_null_op
-          | addsub IN NAME -> in_op
 comp_op: EQ | NEQ | GTE | LTE | GT | LT
 EQ: "=="
 NEQ: "!="

@@ -32,6 +32,7 @@ from ..query_api import (
     MathOp,
     NextStateElement,
     Not,
+    OnDemandQuery,
     Or,
     OrderByAttribute,
     OrderByOrder,
@@ -172,6 +173,9 @@ class AstTransformer(Transformer):
 
     def in_op(self, e, _in, name):
         return In(e, str(name))
+
+    def in_expr(self, e):
+        return e
 
     def addsub(self, first, *rest):
         out = first
@@ -828,6 +832,52 @@ class AstTransformer(Transformer):
         return Query(input_stream=input_stream, selector=selector,
                      output_stream=output_stream or OutputStream(OutputAction.RETURN),
                      output_rate=output_rate, annotations=anns)
+
+    # ---------------- on-demand (store) query ----------------
+
+    def od_on(self, _on, e):
+        return ("od_on", e)
+
+    def od_within(self, _within, *exprs):
+        return ("od_within", tuple(exprs))
+
+    def od_per(self, _per, e):
+        return ("od_per", e)
+
+    def on_demand_query(self, _from, name, *clauses):
+        parts = {"selector": Selector(), "group_by": (), "having": None,
+                 "order_by": (), "limit": None, "offset": None}
+        on_cond = None
+        within = None
+        per = None
+        for c in clauses:
+            if isinstance(c, Selector):
+                parts["selector"] = c
+            elif isinstance(c, tuple) and c and isinstance(c[0], Variable):
+                parts["group_by"] = c
+            elif isinstance(c, tuple) and c and c[0] == "having":
+                parts["having"] = c[1]
+            elif isinstance(c, tuple) and c and c[0] == "order_by":
+                parts["order_by"] = c[1]
+            elif isinstance(c, tuple) and c and c[0] == "limit":
+                parts["limit"] = c[1]
+            elif isinstance(c, tuple) and c and c[0] == "offset":
+                parts["offset"] = c[1]
+            elif isinstance(c, tuple) and c and c[0] == "od_on":
+                on_cond = c[1]
+            elif isinstance(c, tuple) and c and c[0] == "od_within":
+                w = c[1]
+                within = (w[0], w[1] if len(w) > 1 else None)
+            elif isinstance(c, tuple) and c and c[0] == "od_per":
+                per = c[1]
+        base = parts["selector"]
+        selector = Selector(
+            attributes=base.attributes, group_by=parts["group_by"],
+            having=parts["having"], order_by=parts["order_by"],
+            limit=parts["limit"], offset=parts["offset"])
+        return OnDemandQuery(
+            input_store_id=str(name), on_condition=on_cond,
+            within_range=within, per=per, selector=selector)
 
     # ---------------- partition ----------------
 

@@ -43,6 +43,8 @@ class SiddhiAppRuntime:
             playback=playback_ann is not None,
         )
         self.ctx.runtime = self
+        from .event import StringTable
+        self.ctx.global_strings = StringTable()
         stats_ann = app.annotation("app:statistics")
         if stats_ann is not None:
             self.ctx.statistics = Statistics(enabled=True, level="BASIC")
@@ -83,20 +85,15 @@ class SiddhiAppRuntime:
             raise DefinitionNotExistError(f"stream {sid!r} is not defined")
 
         name = query.name or default_name
-        qr = QueryRuntime(query, self.ctx, junction, self.ctx.registry, name=name)
+        qr = QueryRuntime(query, self.ctx, junction, self.ctx.registry, name=name,
+                          tables=self.tables)
         junction.subscribe(qr)
         self.query_runtimes[name] = qr
 
         out = query.output_stream
         if out.action == OutputAction.INSERT and out.target_id:
             if out.target_id in self.tables:
-                qr.table = self.tables[out.target_id]
-                qr.output_junction = None
-                qr.query.output_stream = out  # keep INSERT → table routing
-
-                def _to_table(batch, now, t=qr.table, q=qr):
-                    t.insert_batch(batch)
-                qr.output_junction = _TableJunctionAdapter(qr.table)
+                qr.output_junction = _TableJunctionAdapter(self.tables[out.target_id])
             else:
                 target = self.junctions.get(out.target_id)
                 if target is None:
@@ -108,10 +105,15 @@ class SiddhiAppRuntime:
                 qr.output_junction = target
         elif out.action in (OutputAction.DELETE, OutputAction.UPDATE,
                             OutputAction.UPDATE_OR_INSERT):
+            from .table import TableOutputExecutor
             table = self.tables.get(out.target_id)
             if table is None:
                 raise DefinitionNotExistError(f"table {out.target_id!r} is not defined")
-            qr.table = table
+            aliases = [query.input_stream.stream_id,
+                       query.input_stream.reference_id]
+            qr.table_executor = TableOutputExecutor(
+                table, out, qr.selector.out_types, qr.output_codec,
+                self.ctx.registry, out_frame_aliases=aliases)
 
     # ---------------------------------------------------------------- control
 
@@ -146,6 +148,27 @@ class SiddhiAppRuntime:
         if not isinstance(callback, QueryCallback):
             callback = FunctionQueryCallback(callback)
         qr.add_callback(callback)
+
+    def query(self, on_demand_text: str, now: Optional[int] = None):
+        """Execute an on-demand (pull) query against a table (reference:
+        SiddhiAppRuntimeImpl.query:309-371). Returns a list of Events."""
+        from .. import compiler
+        from .ondemand import OnDemandQueryRuntime
+
+        if not hasattr(self, "_ondemand_cache"):
+            self._ondemand_cache = {}
+        rt = self._ondemand_cache.get(on_demand_text)
+        if rt is None:
+            odq = compiler.parse_on_demand_query(on_demand_text)
+            store = self.tables.get(odq.input_store_id)
+            if store is None:
+                raise DefinitionNotExistError(
+                    f"store {odq.input_store_id!r} is not defined")
+            rt = OnDemandQueryRuntime(odq, store, self.ctx, self.ctx.registry)
+            self._ondemand_cache[on_demand_text] = rt
+        self.flush()
+        t = now if now is not None else self.ctx.timestamp_generator.current_time()
+        return rt.execute(t)
 
     def flush(self, now: Optional[int] = None) -> None:
         """Drive every staged batch through the pipeline (source junctions

@@ -83,6 +83,9 @@ class SiddhiAppContext:
     playback: bool = False
     #: root runtime back-reference (set by SiddhiAppRuntime)
     runtime: object = None
+    #: app-global string interning table shared by every codec (stream, table,
+    #: window, query output) so dictionary codes are consistent app-wide
+    global_strings: object = None
 
     @property
     def effective_batch_size(self) -> int:

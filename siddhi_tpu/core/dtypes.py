@@ -40,6 +40,8 @@ class _Config:
     default_window_capacity = 1 << 16
     #: default max distinct group-by keys tracked on device per query.
     default_group_capacity = 1 << 20
+    #: default table row capacity (rows are capacity-padded device arrays).
+    default_table_capacity = 1 << 16
 
 
 config = _Config()

@@ -105,10 +105,16 @@ class StreamCodec:
     StreamEvent layout chosen by MetaStreamEvent.
     """
 
-    def __init__(self, definition: StreamDefinition) -> None:
+    def __init__(self, definition: StreamDefinition,
+                 shared_strings: Optional[StringTable] = None) -> None:
+        """`shared_strings`: app-global interning table. Sharing one table
+        across every stream/table/window codec keeps codes consistent when
+        events flow between entities (insert into table, joins, chained
+        streams) — string identity is app-wide, like JVM string equality in
+        the reference."""
         self.definition = definition
         self.string_tables: dict[str, StringTable] = {
-            a.name: StringTable()
+            a.name: (shared_strings if shared_strings is not None else StringTable())
             for a in definition.attributes
             if a.type == AttributeType.STRING
         }

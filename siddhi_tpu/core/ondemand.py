@@ -1,0 +1,89 @@
+"""On-demand (pull) queries against tables / named windows / aggregations.
+
+Reference: core/util/parser/OnDemandQueryParser.java:87 builds
+{Find,Select,...}OnDemandQueryRuntime objects executed from
+SiddhiAppRuntimeImpl.query():309-371. TPU design: one jitted pull function per
+(query text) — table rows form a CURRENT chunk, the optional ON condition masks
+it, and the shared CompiledSelector runs in `emit_final_per_group` mode so
+aggregates produce one row per group (not per-event running values).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..errors import DefinitionNotExistError, SiddhiAppCreationError
+from ..ops.expr_compile import Scope, TypeResolver, compile_expression
+from ..ops.selector import CompiledSelector
+from ..query_api.definition import Attribute, AttributeType, StreamDefinition
+from ..query_api.execution import OnDemandQuery
+from ..query_api.expression import Variable
+from .event import Event, EventBatch, StreamCodec
+from .table import InMemoryTable, TableState
+
+
+class OnDemandQueryRuntime:
+    """Compiled pull query over one table store."""
+
+    def __init__(self, odq: OnDemandQuery, table: InMemoryTable, ctx,
+                 registry) -> None:
+        self.odq = odq
+        self.table = table
+        tid = table.definition.id
+
+        frames = {tid: dict(table.attr_types)}
+        resolver = TypeResolver(frames, tid, {tid: table.codec})
+
+        self.cond = None
+        if odq.on_condition is not None:
+            self.cond = compile_expression(odq.on_condition, resolver, registry)
+            if self.cond.type != AttributeType.BOOL:
+                raise SiddhiAppCreationError("ON condition must be boolean")
+
+        select_all = list(table.attr_types.items())
+        self.selector = CompiledSelector(
+            odq.selector, resolver, registry, ctx.effective_group_capacity,
+            tid, select_all_attrs=select_all, emit_final_per_group=True)
+
+        if odq.within_range is not None or odq.per is not None:
+            # within/per apply to aggregation stores (reference:
+            # AggregationRuntime.find); meaningless on plain tables
+            raise SiddhiAppCreationError(
+                f"within/per are not applicable to table {tid!r} "
+                "(only to aggregation stores)")
+
+        out_attrs = tuple(Attribute(n, t)
+                          for n, t in self.selector.out_types.items())
+        self.output_definition = StreamDefinition(id=f"{tid}_find", attributes=out_attrs)
+        # app-global string interning: codes in output columns decode directly
+        self.output_codec = StreamCodec(self.output_definition, ctx.global_strings)
+
+        self._fn = jax.jit(self._make())
+
+    def _make(self):
+        tid = self.table.definition.id
+        cond = self.cond
+        selector = self.selector
+
+        def run(tstate: TableState, now):
+            C = tstate.ts.shape[0]
+            scope = Scope()
+            scope.add_frame(tid, tstate.cols, tstate.ts, tstate.valid, default=True)
+            scope.extras["now"] = now
+            valid = tstate.valid
+            if cond is not None:
+                valid = valid & cond(scope)
+            chunk = EventBatch(ts=tstate.ts, cols=tstate.cols, valid=valid,
+                               types=jnp.zeros((C,), jnp.int8))
+            scope.valids[tid] = valid
+            _, out = selector.step(selector.init_state(), chunk, scope)
+            return out
+
+        return run
+
+    def execute(self, now: int = 0) -> list[Event]:
+        out = self._fn(self.table.state, jnp.int64(now))
+        return out.to_host_events(self.output_codec)

@@ -87,6 +87,7 @@ class QueryRuntime(Receiver):
         input_junction: StreamJunction,
         registry: Registry,
         name: Optional[str] = None,
+        tables: Optional[dict] = None,
     ) -> None:
         assert isinstance(query.input_stream, SingleInputStream)
         self.query = query
@@ -96,7 +97,12 @@ class QueryRuntime(Receiver):
         self.input_junction = input_junction
         self.callbacks: list[QueryCallback] = []
         self.output_junction: Optional[StreamJunction] = None
-        self.table = None  # set by app runtime for table CRUD outputs
+        self.table_executor = None  # set by app runtime for table CRUD outputs
+        self.tables = tables or {}
+        # tables referenced by `in Table` conditions: their states become step
+        # arguments (contents must not be baked into the trace as constants)
+        self.dep_tables = sorted(
+            tid for tid in _collect_in_sources(query) if tid in self.tables)
 
         in_stream = query.input_stream
         definition = input_junction.definition
@@ -110,6 +116,11 @@ class QueryRuntime(Receiver):
         if self.frame_ref != definition.id:
             frames[definition.id] = attr_types
         codecs = {self.frame_ref: self.codec, definition.id: self.codec}
+        # `in Table` conditions reference table attributes (T.attr): add the
+        # dep tables' frames so their inner conditions resolve
+        for tid in self.dep_tables:
+            frames[tid] = dict(self.tables[tid].attr_types)
+            codecs[tid] = self.tables[tid].codec
         self.resolver = TypeResolver(frames, self.frame_ref, codecs)
 
         # --- filters ---
@@ -177,26 +188,9 @@ class QueryRuntime(Receiver):
     # ----------------------------------------------------------------- plan
 
     def _build_output_codec(self) -> StreamCodec:
-        """Output codec shares StringTables with source attrs so string codes
-        flow through unchanged (provenance-tracked per output attribute)."""
-        codec = StreamCodec(self.output_definition)
-        for name, expr in zip(self.selector.out_types,
-                              [a.expression for a in self._select_attrs()]):
-            if self.selector.out_types[name] == AttributeType.STRING:
-                var = _first_string_variable(expr)
-                if var is not None:
-                    src_attr = var.attribute
-                    if src_attr in self.codec.string_tables:
-                        codec.string_tables[name] = self.codec.string_tables[src_attr]
-        return codec
-
-    def _select_attrs(self):
-        attrs = self.query.selector.attributes
-        if not attrs:
-            from ..query_api.execution import OutputAttribute
-            attrs = tuple(OutputAttribute(a.name, Variable(a.name))
-                          for a in self.output_attributes)
-        return attrs
+        """String codes are app-global (ctx.global_strings), so output string
+        columns decode directly regardless of which source attr produced them."""
+        return StreamCodec(self.output_definition, self.ctx.global_strings)
 
     def _init_state(self):
         return (self.window.init_state(), self.selector.init_state())
@@ -207,13 +201,19 @@ class QueryRuntime(Receiver):
         window = self.window
         selector = self.selector
         frame_ref = self.frame_ref
+        dep_tables = self.dep_tables
+        probes = {tid: self.tables[tid].contains_probe for tid in dep_tables}
 
-        def step(state, batch: EventBatch, now):
+        def step(state, batch: EventBatch, now, table_states=None):
             wstate, sstate = state
 
             scope = Scope()
             scope.add_frame(frame_ref, batch.cols, batch.ts, batch.valid, default=True)
             scope.extras["now"] = now
+            if table_states:
+                for tid, tstate in table_states.items():
+                    scope.extras[f"table:{tid}"] = tstate
+                    scope.extras[f"in:{tid}"] = probes[tid]
             mask = batch.valid
             for f in filters:
                 mask = mask & f(scope)
@@ -223,7 +223,7 @@ class QueryRuntime(Receiver):
 
             cscope = Scope()
             cscope.add_frame(frame_ref, chunk.cols, chunk.ts, chunk.valid, default=True)
-            cscope.extras["now"] = now
+            cscope.extras = dict(scope.extras)
             for f in post_filters:
                 chunk = chunk.where_valid(
                     f(cscope) | (chunk.types != EventType.CURRENT))
@@ -237,7 +237,8 @@ class QueryRuntime(Receiver):
 
     def on_batch(self, batch: EventBatch, now: int) -> None:
         t0 = time.perf_counter_ns()
-        self.state, out = self._step(self.state, batch, jnp.int64(now))
+        tstates = {tid: self.tables[tid].state for tid in self.dep_tables}
+        self.state, out = self._step(self.state, batch, jnp.int64(now), tstates)
         self._distribute(out, now)
         self.ctx.statistics.track_latency(self.name, time.perf_counter_ns() - t0)
 
@@ -257,9 +258,9 @@ class QueryRuntime(Receiver):
             fwd = self._select_event_type(out, etype)
             self.output_junction.publish_batch(fwd, now)
         elif action in (OutputAction.DELETE, OutputAction.UPDATE,
-                        OutputAction.UPDATE_OR_INSERT) and self.table is not None:
+                        OutputAction.UPDATE_OR_INSERT) and self.table_executor is not None:
             fwd = self._select_event_type(out, etype)
-            self.table.apply_output(action, fwd, self.query.output_stream)
+            self.table_executor.apply(fwd)
 
     @staticmethod
     def _select_event_type(out: EventBatch, etype: OutputEventType) -> EventBatch:
@@ -278,20 +279,32 @@ class QueryRuntime(Receiver):
         self.callbacks.append(cb)
 
 
-def _first_string_variable(expr) -> Optional[Variable]:
-    from ..query_api.expression import (
-        AttributeFunction, MathExpression, Compare, And, Or, Not)
-    if isinstance(expr, Variable):
-        return expr
-    if isinstance(expr, AttributeFunction):
-        for p in expr.parameters:
-            v = _first_string_variable(p)
-            if v is not None:
-                return v
-    for attr in ("left", "right", "expression"):
-        sub = getattr(expr, attr, None)
-        if isinstance(sub, Expression):
-            v = _first_string_variable(sub)
-            if v is not None:
-                return v
-    return None
+def _collect_in_sources(query: Query) -> set[str]:
+    """Table ids referenced by `in Table` conditions anywhere in the query."""
+    from ..query_api.expression import In
+
+    found: set[str] = set()
+
+    def walk(node):
+        if node is None or not isinstance(node, Expression):
+            return
+        if isinstance(node, In):
+            found.add(node.source_id)
+            walk(node.expression)
+            return
+        for attr in ("left", "right", "expression"):
+            sub = getattr(node, attr, None)
+            if isinstance(sub, Expression):
+                walk(sub)
+        for p in getattr(node, "parameters", ()) or ():
+            walk(p)
+
+    ins = query.input_stream
+    for f in getattr(ins.handlers, "filters", ()):
+        walk(f)
+    for f in getattr(ins.handlers, "post_window_filters", ()):
+        walk(f)
+    for a in query.selector.attributes:
+        walk(a.expression)
+    walk(query.selector.having)
+    return found

@@ -65,7 +65,7 @@ class StreamJunction:
                  codec: Optional[StreamCodec] = None) -> None:
         self.definition = definition
         self.ctx = ctx
-        self.codec = codec or StreamCodec(definition)
+        self.codec = codec or StreamCodec(definition, ctx.global_strings)
         self.receivers: list[Receiver] = []
         self.batch_size = ctx.effective_batch_size
         # async annotation: in the reference this switches to a Disruptor ring

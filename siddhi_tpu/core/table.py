@@ -1,17 +1,36 @@
 """Tables: CRUD event stores queryable from streams.
 
 Reference: core/table/InMemoryTable.java:58 (rows under a RW lock, CRUD via
-CompiledCondition) with index-aware planning (core/table/holder/IndexEventHolder
-+ the CollectionExecutor mini-optimizer). TPU round-1 design: a table is a
-columnar device store (capacity-padded arrays + valid mask) supporting
-vectorized insert/find/delete/update, with host-side primary-key hash index for
-point operations. Joins probe tables on device.
+CompiledCondition built by OperatorParser; index planning via
+core/table/holder/IndexEventHolder + the CollectionExecutor mini-optimizer).
+
+TPU-native design: a table is a **columnar device store** — capacity-padded
+arrays + validity mask held as a pytree (`TableState`) so table contents can be
+passed *into* jitted query steps as arguments (contents change between
+batches; they must never be baked into a trace as constants). CRUD is
+vectorized:
+
+- conditions compile once into broadcastable column functions: stream frames
+  enter the scope as [B,1] columns, the table frame as [C] columns, so any
+  mixed condition evaluates to a [B,C] cross mask — the TPU analogue of the
+  reference's per-event `Operator.find` walks;
+- delete = any-over-B of the mask clears row validity;
+- update = last-matching-event-wins gather (the reference applies events
+  sequentially; per-row multi-event read-modify-write chains are the one
+  divergence, documented in tests);
+- insert/update-or-insert scatter into free slots computed by stable argsort
+  of the validity mask.
+
+The reference's primary-key/index holders become: primary key = compiled
+key-equality condition used by update-or-insert/contains fast paths; duplicate
+primary-key inserts are dropped (reference throws; we surface a counter).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from functools import partial
+from typing import Callable, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -19,75 +38,267 @@ import numpy as np
 
 from ..errors import CapacityExceededError, SiddhiAppCreationError
 from ..query_api.definition import AttributeType, TableDefinition
-from ..query_api.execution import OutputAction, OutputStream
+from ..query_api.execution import OutputAction, OutputStream, UpdateSetAttribute
+from ..query_api.expression import Compare, CompareOp, Expression, Variable
 from . import dtypes
 from .context import SiddhiAppContext
-from .event import EventBatch, StreamCodec
+from .event import EventBatch, EventType, StreamCodec
+
+
+class TableState(NamedTuple):
+    """Device-resident table contents (a pytree; jit-argument friendly)."""
+
+    cols: dict
+    ts: jax.Array  # int64[C]
+    valid: jax.Array  # bool[C]
+
+
+def _broadcast_scope(scope, table_id: str, tstate: TableState):
+    """Clone a [B]-shaped scope into a [B,1]-shaped one and add the [C] table
+    frame, so compiled conditions evaluate to [B,C] cross masks."""
+    from ..ops.expr_compile import Scope
+
+    s2 = Scope()
+    for ref, cols in scope.frames.items():
+        s2.add_frame(
+            ref,
+            {k: v[:, None] for k, v in cols.items()},
+            scope.ts[ref][:, None],
+            scope.valids[ref][:, None],
+            default=(ref == scope.default_frame),
+        )
+    s2.add_frame(table_id, tstate.cols, tstate.ts, tstate.valid)
+    s2.extras = dict(scope.extras)
+    return s2
 
 
 class InMemoryTable:
+    """Host handle owning the device TableState + compiled per-query ops."""
+
     def __init__(self, definition: TableDefinition, ctx: SiddhiAppContext,
                  capacity: Optional[int] = None) -> None:
         self.definition = definition
         self.ctx = ctx
-        self.codec = StreamCodec(definition)
-        self.capacity = capacity or dtypes.config.default_window_capacity
-        self.cols = {
-            a.name: jnp.zeros((self.capacity,), dtypes.device_dtype(a.type))
-            for a in definition.attributes if a.type != AttributeType.OBJECT
-        }
-        self.ts = jnp.zeros((self.capacity,), dtypes.TS_DTYPE)
-        self.valid = jnp.zeros((self.capacity,), jnp.bool_)
-        self._next = 0  # next free slot (append pointer; freed slots reused lazily)
+        self.codec = StreamCodec(definition, ctx.global_strings)
+        cap_ann = definition.annotation("capacity") if definition.annotations else None
+        self.capacity = capacity or (
+            int(cap_ann.element(None)) if cap_ann is not None and cap_ann.element(None)
+            else dtypes.config.default_table_capacity)
+        self.attr_types = {a.name: a.type for a in definition.attributes
+                          if a.type != AttributeType.OBJECT}
+        self.state = TableState(
+            cols={n: jnp.zeros((self.capacity,), dtypes.device_dtype(t))
+                  for n, t in self.attr_types.items()},
+            ts=jnp.zeros((self.capacity,), dtypes.TS_DTYPE),
+            valid=jnp.zeros((self.capacity,), jnp.bool_),
+        )
+        # @PrimaryKey('a' [, 'b']) — reference: EventHolderPasser.java reads it
+        # to pick an IndexEventHolder.
+        pk = definition.annotation("PrimaryKey") if definition.annotations else None
+        self.primary_keys: tuple[str, ...] = tuple(
+            e.value for e in pk.elements) if pk is not None else ()
+        self.dropped_duplicates = 0
+        self._insert_fn = jax.jit(self._make_insert())
 
-    # ------------------------------------------------------------------- CRUD
+    # ------------------------------------------------------------------ insert
+
+    def _make_insert(self):
+        pk = self.primary_keys
+
+        def insert(tstate: TableState, batch: EventBatch):
+            C = tstate.ts.shape[0]
+            B = batch.ts.shape[0]
+            ins = batch.valid
+            if pk:
+                # drop rows whose primary key already exists (reference throws
+                # PrimaryKeyViolationException; we drop + count host-side)
+                eq = jnp.ones((B, C), bool)
+                for k in pk:
+                    eq = eq & (batch.cols[k][:, None] == tstate.cols[k][None, :])
+                dup = (eq & tstate.valid[None, :]).any(axis=1)
+                # also dedupe within the batch: keep first occurrence
+                eq_b = jnp.ones((B, B), bool)
+                for k in pk:
+                    eq_b = eq_b & (batch.cols[k][:, None] == batch.cols[k][None, :])
+                earlier = jnp.tril(jnp.ones((B, B), bool), k=-1)
+                dup_in_batch = (eq_b & earlier & ins[None, :]).any(axis=1)
+                ins = ins & ~dup & ~dup_in_batch
+            n_ins = jnp.sum(ins.astype(jnp.int32))
+            # free slots in row order: argsort(valid) puts False (free) first
+            free_order = jnp.argsort(tstate.valid, stable=True)
+            n_free = jnp.sum((~tstate.valid).astype(jnp.int32))
+            rank = jnp.cumsum(ins.astype(jnp.int32)) - 1
+            fits = ins & (rank < n_free)
+            slot = jnp.where(fits, free_order[jnp.clip(rank, 0, C - 1)], C)
+            new_cols = {k: v.at[slot].set(batch.cols[k], mode="drop")
+                        for k, v in tstate.cols.items()}
+            new_ts = tstate.ts.at[slot].set(batch.ts, mode="drop")
+            new_valid = tstate.valid.at[slot].set(True, mode="drop")
+            overflow = n_ins - jnp.sum(fits.astype(jnp.int32))
+            dropped = jnp.sum((batch.valid & ~ins).astype(jnp.int32))
+            return TableState(new_cols, new_ts, new_valid), overflow, dropped
+
+        return insert
 
     def insert_batch(self, batch: EventBatch) -> None:
-        valid = np.asarray(batch.valid)
-        idxs = np.nonzero(valid)[0]
-        n = len(idxs)
-        if n == 0:
-            return
-        # find free slots (host-side append pointer with compaction fallback)
-        free = np.nonzero(~np.asarray(self.valid))[0]
-        if len(free) < n:
+        new_state, overflow, dropped = self._insert_fn(self.state, batch)
+        ov = int(overflow)
+        if ov:
+            # all-or-nothing: leave self.state untouched on overflow
             raise CapacityExceededError(
-                f"table {self.definition.id} capacity {self.capacity} exceeded")
-        slots = jnp.asarray(free[:n])
-        src = jnp.asarray(idxs)
-        for k in self.cols:
-            self.cols[k] = self.cols[k].at[slots].set(batch.cols[k][src])
-        self.ts = self.ts.at[slots].set(batch.ts[src])
-        self.valid = self.valid.at[slots].set(True)
+                f"table {self.definition.id} capacity {self.capacity} exceeded "
+                f"({ov} rows would be dropped)")
+        self.state = new_state
+        self.dropped_duplicates += int(dropped)
 
     def insert_rows(self, rows, timestamp: int = 0) -> None:
         cols = self.codec.rows_to_columns(rows, n_pad=len(rows))
         ts = np.full(len(rows), timestamp, dtype=np.int64)
         self.insert_batch(EventBatch.from_numpy(ts, cols, len(rows)))
 
-    def apply_output(self, action: OutputAction, out: EventBatch,
-                     output_stream: OutputStream) -> None:
-        """Handle `insert into T` / `delete T on ...` / `update T ...` from a
-        query's output batch (reference: core/query/output/callback/
-        {InsertIntoTable,DeleteTable,UpdateTable,UpdateOrInsertTable}Callback)."""
-        from ..ops.expr_compile import Scope, TypeResolver, compile_expression
-
-        if action == OutputAction.INSERT:
-            self.insert_batch(out)
-            return
-
-        # Build a scope where the table frame is the stored columns [C] and the
-        # stream frame is the output batch [B]; the on-condition is evaluated
-        # as a [B, C] cross mask via vmap over the batch axis.
-        raise SiddhiAppCreationError(
-            "delete/update table outputs are planned via TableOutputExecutor")
-
     # ------------------------------------------------------------------ reads
 
+    def find_mask(self, cond: Optional[Callable], scope) -> jax.Array:
+        """[B,C] cross mask of (stream event, table row) matches. `cond` is a
+        compiled condition; None matches every valid row."""
+        s2 = _broadcast_scope(scope, self.definition.id, self.state)
+        B = next(iter(scope.valids.values())).shape[0]
+        m = jnp.ones((B, self.capacity), bool) if cond is None else \
+            jnp.broadcast_to(cond(s2), (B, self.capacity))
+        return m & self.state.valid[None, :]
+
+    def contains_probe(self, scope, inner) -> jax.Array:
+        """`expr in Table` membership (reference: InConditionExpressionExecutor):
+        any-match over table rows per stream lane. Reads the table state from
+        scope.extras so jitted steps see fresh contents each call."""
+        tstate: TableState = scope.extras.get(f"table:{self.definition.id}", self.state)
+        s2 = _broadcast_scope(scope, self.definition.id, tstate)
+        if inner is None:
+            raise SiddhiAppCreationError("`in Table` requires a condition")
+        m = inner(s2) & tstate.valid
+        return m.any(axis=-1)
+
     def all_rows(self) -> list[tuple]:
-        batch = EventBatch(ts=self.ts, cols=self.cols, valid=self.valid,
+        batch = EventBatch(ts=self.state.ts, cols=self.state.cols,
+                           valid=self.state.valid,
                            types=jnp.zeros((self.capacity,), jnp.int8))
         return [e.data for e in batch.to_host_events(self.codec)]
 
     def __len__(self) -> int:
-        return int(jnp.sum(self.valid))
+        return int(jnp.sum(self.state.valid))
+
+
+class TableOutputExecutor:
+    """Compiled runtime for one query output targeting a table — the analogue
+    of the reference's {Delete,Update,UpdateOrInsert}TableCallback +
+    OperatorParser-compiled Operator.
+
+    Built once per query at plan time; executes as one jitted device function
+    `(table_state, out_batch) -> table_state'`.
+    """
+
+    def __init__(self, table: InMemoryTable, output_stream: OutputStream,
+                 out_types: dict[str, AttributeType],
+                 out_codec: StreamCodec, registry,
+                 out_frame_aliases: Sequence[str] = ()) -> None:
+        from ..ops.expr_compile import Scope, TypeResolver, compile_expression
+
+        self.table = table
+        self.action = output_stream.action
+        tid = table.definition.id
+
+        # resolver over {output-stream frame} + {table frame}; the ON condition
+        # may reference output attrs via the query's input-stream name
+        # (reference: the matching meta carries the stream alias)
+        frames = {"__out__": dict(out_types), tid: dict(table.attr_types)}
+        codecs = {"__out__": out_codec, tid: table.codec}
+        for alias in out_frame_aliases:
+            if alias and alias not in frames:
+                frames[alias] = dict(out_types)
+                codecs[alias] = out_codec
+        self.out_frame_aliases = tuple(
+            a for a in out_frame_aliases if a and a != tid)
+        resolver = TypeResolver(frames, "__out__", codecs)
+
+        cond = output_stream.on_condition
+        if cond is None:
+            raise SiddhiAppCreationError(
+                f"{self.action.name} into table requires an ON condition")
+        self.cond = compile_expression(cond, resolver, registry)
+        if self.cond.type != AttributeType.BOOL:
+            raise SiddhiAppCreationError("table ON condition must be boolean")
+
+        # SET clause (update/update-or-insert); default: set every table attr
+        # from the same-named output attr (reference: UpdateSet defaults)
+        sets: list[tuple[str, object]] = []
+        if output_stream.set_attributes:
+            for sa in output_stream.set_attributes:
+                if sa.table_variable.stream_id not in (None, tid):
+                    raise SiddhiAppCreationError(
+                        f"SET target must be a {tid} attribute")
+                sets.append((sa.table_variable.attribute,
+                             compile_expression(sa.expression, resolver, registry)))
+        else:
+            for name, t in table.attr_types.items():
+                if name in out_types:
+                    sets.append((name, compile_expression(
+                        Variable(name, stream_id="__out__"), resolver, registry)))
+        self.sets = sets
+
+        self._fn = jax.jit(self._make())
+
+    def _make(self):
+        from ..ops.expr_compile import Scope
+
+        table = self.table
+        tid = table.definition.id
+        action = self.action
+        cond = self.cond
+        sets = self.sets
+
+        aliases = self.out_frame_aliases
+
+        def run(tstate: TableState, out: EventBatch):
+            B = out.ts.shape[0]
+            C = tstate.ts.shape[0]
+            scope = Scope()
+            scope.add_frame("__out__", out.cols, out.ts, out.valid, default=True)
+            for alias in aliases:
+                scope.add_frame(alias, out.cols, out.ts, out.valid)
+            s2 = _broadcast_scope(scope, tid, tstate)
+            mask = jnp.broadcast_to(cond(s2), (B, C))
+            mask = mask & out.valid[:, None] & tstate.valid[None, :]
+
+            if action == OutputAction.DELETE:
+                hit = mask.any(axis=0)
+                return TableState(tstate.cols, tstate.ts, tstate.valid & ~hit), \
+                    jnp.int32(0)
+
+            # update: last matching event wins per row
+            has = mask.any(axis=0)
+            b_star = (B - 1) - jnp.argmax(mask[::-1, :], axis=0)  # [C]
+            new_cols = dict(tstate.cols)
+            rows = jnp.arange(C)
+            for name, ce in sets:
+                vals = jnp.broadcast_to(ce(s2), (B, C))  # [B,C]
+                picked = vals[b_star, rows].astype(tstate.cols[name].dtype)
+                new_cols[name] = jnp.where(has, picked, tstate.cols[name])
+            updated = TableState(new_cols, tstate.ts, tstate.valid)
+
+            if action == OutputAction.UPDATE:
+                return updated, jnp.int32(0)
+
+            # update-or-insert: events matching no row are inserted
+            ev_matched = mask.any(axis=1)
+            to_insert = dataclasses.replace(out, valid=out.valid & ~ev_matched)
+            return updated, to_insert
+
+        return run
+
+    def apply(self, out: EventBatch) -> None:
+        if self.action == OutputAction.UPDATE_OR_INSERT:
+            new_state, to_insert = self._fn(self.table.state, out)
+            self.table.state = new_state
+            self.table.insert_batch(to_insert)
+        else:
+            self.table.state, _ = self._fn(self.table.state, out)

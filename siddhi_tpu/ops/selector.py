@@ -113,11 +113,16 @@ class CompiledSelector:
         group_capacity: int,
         chunk_frame: str,
         select_all_attrs: Optional[list[tuple[str, AttributeType]]] = None,
+        emit_final_per_group: bool = False,
     ):
         self.registry = registry
         self.group_capacity = group_capacity
         self.chunk_frame = chunk_frame
         self.selector = selector
+        #: on-demand (pull) mode: emit one lane per group — the final
+        #: aggregate — instead of per-event running values (reference:
+        #: FindOnDemandQueryRuntime returns one row per group)
+        self.emit_final_per_group = emit_final_per_group
 
         # --- select list: rewrite aggregators, compile expressions ---
         agg_nodes: list[tuple[str, AttributeFunction]] = []
@@ -244,6 +249,17 @@ class CompiledSelector:
         out_cols = {name: ce(scope) for name, ce in self.out_exprs}
 
         out_valid = data_valid
+
+        if self.emit_final_per_group and self.has_aggregators:
+            # keep only the last lane of each group — its running aggregate is
+            # the group's final value — BEFORE having, so HAVING judges the
+            # final aggregate, not an intermediate running value
+            idx = jnp.arange(L, dtype=jnp.int32)
+            K = self.group_capacity if self.group_vars else 1
+            last = jax.ops.segment_max(
+                jnp.where(out_valid, idx, -1), slots.astype(jnp.int32),
+                num_segments=K)
+            out_valid = out_valid & (idx == last[slots.astype(jnp.int32)])
 
         # --- having on the output frame ---
         if self.having is not None or self.order_by:
