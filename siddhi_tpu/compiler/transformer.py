@@ -473,10 +473,11 @@ class AstTransformer(Transformer):
         alias = None
         unidirectional = False
         for r in rest:
-            if isinstance(r, str):
-                alias = r
-            elif isinstance(r, Token) and r.type == "UNIDIRECTIONAL":
+            # NB: Token subclasses str — test Token first
+            if isinstance(r, Token) and r.type == "UNIDIRECTIONAL":
                 unidirectional = True
+            elif isinstance(r, str):
+                alias = str(r)
         s = SingleInputStream(stream_id=sid, alias=alias,
                               handlers=_build_chain(handlers),
                               is_inner=kind == "inner", is_fault=kind == "fault")

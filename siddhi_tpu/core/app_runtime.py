@@ -76,20 +76,37 @@ class SiddhiAppRuntime:
             raise SiddhiAppCreationError("partitions are not yet supported")
 
     def _add_query(self, query: Query, default_name: str) -> None:
-        if not isinstance(query.input_stream, SingleInputStream):
+        from ..query_api.execution import JoinInputStream
+        name = query.name or default_name
+
+        if isinstance(query.input_stream, JoinInputStream):
+            qr = self._add_join_query(query, name)
+        elif isinstance(query.input_stream, SingleInputStream):
+            sid = query.input_stream.stream_id
+            junction = self.junctions.get(sid)
+            if junction is None:
+                raise DefinitionNotExistError(f"stream {sid!r} is not defined")
+            qr = QueryRuntime(query, self.ctx, junction, self.ctx.registry,
+                              name=name, tables=self.tables)
+            junction.subscribe(qr)
+        else:
             raise SiddhiAppCreationError(
                 f"{type(query.input_stream).__name__} queries are not yet supported")
-        sid = query.input_stream.stream_id
-        junction = self.junctions.get(sid)
-        if junction is None:
-            raise DefinitionNotExistError(f"stream {sid!r} is not defined")
-
-        name = query.name or default_name
-        qr = QueryRuntime(query, self.ctx, junction, self.ctx.registry, name=name,
-                          tables=self.tables)
-        junction.subscribe(qr)
         self.query_runtimes[name] = qr
 
+        self._wire_output(qr, query)
+
+    def _add_join_query(self, query: Query, name: str):
+        from .join_runtime import JoinQueryRuntime, _JoinSideReceiver
+        qr = JoinQueryRuntime(query, self.ctx, self.junctions, self.tables,
+                              self.ctx.registry, name)
+        if not qr.left.is_table:
+            qr.left.junction.subscribe(_JoinSideReceiver(qr, True))
+        if not qr.right.is_table:
+            qr.right.junction.subscribe(_JoinSideReceiver(qr, False))
+        return qr
+
+    def _wire_output(self, qr, query: Query) -> None:
         out = query.output_stream
         if out.action == OutputAction.INSERT and out.target_id:
             if out.target_id in self.tables:
@@ -109,8 +126,8 @@ class SiddhiAppRuntime:
             table = self.tables.get(out.target_id)
             if table is None:
                 raise DefinitionNotExistError(f"table {out.target_id!r} is not defined")
-            aliases = [query.input_stream.stream_id,
-                       query.input_stream.reference_id]
+            aliases = [getattr(query.input_stream, "stream_id", None),
+                       getattr(query.input_stream, "reference_id", None)]
             qr.table_executor = TableOutputExecutor(
                 table, out, qr.selector.out_types, qr.output_codec,
                 self.ctx.registry, out_frame_aliases=aliases)

@@ -42,6 +42,8 @@ class _Config:
     default_group_capacity = 1 << 20
     #: default table row capacity (rows are capacity-padded device arrays).
     default_table_capacity = 1 << 16
+    #: max matched build rows per probe event in joins (static join fan-out).
+    join_max_matches = 16
 
 
 config = _Config()

@@ -1,0 +1,329 @@
+"""Join query runtime (reference: core/query/input/stream/join/JoinProcessor.java:45,
+JoinInputStreamParser.java:75).
+
+One runtime serves `from L#w() join R#w() on cond`. Each side keeps its own
+window ring; a batch arriving on a triggering side is appended to its own
+window and probed against the *opposite* side's current contents (the
+reference's `find()` with a CompiledCondition becomes a batched sort-merge /
+cross probe — ops/join.py). Table sides probe the table's device state.
+
+Ordering note (divergence, documented): within one micro-batch of a self-join,
+intra-batch pairs are not emitted (each batch probes the opposite ring as of
+the previous flush). Across junction flushes the reference's per-event
+interleaving is preserved.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..errors import DefinitionNotExistError, SiddhiAppCreationError
+from ..extension.registry import ExtensionKind, Registry
+from ..ops.expr_compile import Scope, TypeResolver, compile_expression
+from ..ops.join import JoinPlan, plan_join, probe_cross, probe_equi
+from ..ops.selector import CompiledSelector
+from ..ops.window_factories import WindowFactory
+from ..ops.windows import PassThroughWindow, WindowOp
+from ..query_api.definition import Attribute, AttributeType, StreamDefinition
+from ..query_api.execution import (
+    EventTrigger,
+    JoinInputStream,
+    JoinType,
+    OutputAction,
+    Query,
+    SingleInputStream,
+)
+from . import dtypes
+from .context import SiddhiAppContext
+from .event import EventBatch, EventType, StreamCodec
+from .query_runtime import QueryCallback, eval_constant
+from .stream import Receiver, StreamJunction
+
+
+class _Side:
+    """One join side: a stream (junction + window) or a table."""
+
+    def __init__(self, ins: SingleInputStream, ctx, registry, junctions, tables):
+        self.ref = ins.reference_id  # alias or stream id
+        self.stream_id = ins.stream_id
+        self.is_table = ins.stream_id in tables
+        self.table = tables.get(ins.stream_id)
+        self.junction: Optional[StreamJunction] = None
+        self.window: Optional[WindowOp] = None
+        self.filters = []
+        if self.is_table:
+            if ins.handlers.window is not None:
+                raise SiddhiAppCreationError("tables cannot take windows in joins")
+            self.attr_types = dict(self.table.attr_types)
+            self.codec = self.table.codec
+        else:
+            self.junction = junctions.get(ins.stream_id)
+            if self.junction is None:
+                raise DefinitionNotExistError(
+                    f"stream {ins.stream_id!r} is not defined")
+            self.codec = self.junction.codec
+            self.attr_types = {
+                a.name: a.type for a in self.junction.definition.attributes
+                if a.type != AttributeType.OBJECT}
+            layout = {n: dtypes.device_dtype(t) for n, t in self.attr_types.items()}
+            batch_cap = self.junction.batch_size
+            wh = ins.handlers.window
+            if wh is not None:
+                factory = registry.require(ExtensionKind.WINDOW, wh.namespace, wh.name)
+                assert isinstance(factory, WindowFactory)
+                params = [eval_constant(p) for p in wh.parameters]
+                self.window = factory.make(layout, batch_cap, params, True)
+            else:
+                self.window = PassThroughWindow(layout, batch_cap)
+        self.handlers = ins.handlers
+
+
+class JoinQueryRuntime:
+    def __init__(self, query: Query, ctx: SiddhiAppContext,
+                 junctions: dict, tables: dict, registry: Registry,
+                 name: str) -> None:
+        assert isinstance(query.input_stream, JoinInputStream)
+        jis: JoinInputStream = query.input_stream
+        self.query = query
+        self.ctx = ctx
+        self.name = name
+        self.registry = registry
+        self.callbacks: list[QueryCallback] = []
+        self.output_junction = None
+        self.table_executor = None
+        self.k_max = dtypes.config.join_max_matches
+
+        self.left = _Side(jis.left, ctx, registry, junctions, tables)
+        self.right = _Side(jis.right, ctx, registry, junctions, tables)
+        if self.left.is_table and self.right.is_table:
+            raise SiddhiAppCreationError("cannot join two tables in a stream query")
+        if self.left.ref == self.right.ref:
+            raise SiddhiAppCreationError(
+                "self-joins need an alias: `from S as a join S as b ...`")
+        self.join_type = jis.join_type
+        self.trigger = jis.trigger
+        self.within_ms = jis.within_ms
+
+        # --- resolver over both frames ---
+        frames = {self.left.ref: self.left.attr_types,
+                  self.right.ref: self.right.attr_types}
+        codecs = {self.left.ref: self.left.codec, self.right.ref: self.right.codec}
+        self.resolver = TypeResolver(frames, self.left.ref, codecs)
+
+        for side in (self.left, self.right):
+            side.filters = [compile_expression(f, self.resolver, registry)
+                            for f in side.handlers.filters]
+
+        # --- join plans (one per probe direction) ---
+        self.plan_from_left = plan_join(jis.on, self.left.ref, self.right.ref,
+                                        self.resolver, registry)
+        self.plan_from_right = plan_join(jis.on, self.right.ref, self.left.ref,
+                                         self.resolver, registry)
+
+        # --- selector over the pair frames ---
+        select_all = [(n, t) for n, t in self.left.attr_types.items()]
+        for n, t in self.right.attr_types.items():
+            if n not in dict(select_all):
+                select_all.append((n, t))
+        self.selector = CompiledSelector(
+            query.selector, self.resolver, registry,
+            ctx.effective_group_capacity, self.left.ref,
+            select_all_attrs=select_all)
+
+        self.output_attributes = tuple(
+            Attribute(n, t) for n, t in self.selector.out_types.items())
+        self.output_definition = StreamDefinition(
+            id=query.output_stream.target_id or f"{name}_out",
+            attributes=self.output_attributes)
+        self.output_codec = StreamCodec(self.output_definition, ctx.global_strings)
+
+        self.state = (
+            self.left.window.init_state() if not self.left.is_table else (),
+            self.right.window.init_state() if not self.right.is_table else (),
+            self.selector.init_state(),
+        )
+        self._step_left = jax.jit(self._make_step(from_left=True),
+                                  donate_argnums=(0,))
+        self._step_right = jax.jit(self._make_step(from_left=False),
+                                   donate_argnums=(0,))
+        self.has_time_semantics = any(
+            getattr(s.window, "time_ms", None) is not None
+            for s in (self.left, self.right) if not s.is_table)
+
+    # ------------------------------------------------------------------- plan
+
+    def _probe_outer(self, from_left: bool) -> bool:
+        if self.join_type == JoinType.FULL_OUTER:
+            return True
+        if self.join_type == JoinType.LEFT_OUTER:
+            return from_left
+        if self.join_type == JoinType.RIGHT_OUTER:
+            return not from_left
+        return False
+
+    def _make_step(self, from_left: bool):
+        probe_side = self.left if from_left else self.right
+        build_side = self.right if from_left else self.left
+        plan = self.plan_from_left if from_left else self.plan_from_right
+        selector = self.selector
+        k_max = self.k_max
+        within = self.within_ms
+        outer = self._probe_outer(from_left)
+        filters = probe_side.filters
+
+        def step(state, batch: EventBatch, now, build_tstate=None):
+            wl, wr, sel = state
+            w_probe, w_build = (wl, wr) if from_left else (wr, wl)
+
+            # --- probe-side filter + window append ---
+            pscope = Scope()
+            pscope.add_frame(probe_side.ref, batch.cols, batch.ts, batch.valid,
+                             default=True)
+            pscope.extras["now"] = now
+            mask = batch.valid
+            for f in filters:
+                mask = mask & f(pscope)
+            batch = dataclasses.replace(batch, valid=mask)
+            pscope.valids[probe_side.ref] = mask
+
+            if not probe_side.is_table:
+                w_probe, _chunk = probe_side.window.step(w_probe, batch, now)
+
+            # --- build-side contents ---
+            if build_side.is_table:
+                b_cols = build_tstate.cols
+                b_ts = build_tstate.ts
+                b_valid = build_tstate.valid
+            else:
+                b_cols, b_ts, b_valid = build_side.window.contents(w_build, now)
+
+            # --- candidate pairs ---
+            if plan.probe_keys:
+                lane, brow, pv = probe_equi(
+                    plan, pscope, mask, b_cols, b_ts, b_valid,
+                    build_side.ref, k_max)
+            else:
+                lane, brow, pv = probe_cross(mask, b_valid, k_max)
+
+            # --- pair frames ---
+            p_cols = {k: v[lane] for k, v in batch.cols.items()}
+            p_ts = batch.ts[lane]
+            g_cols = {k: v[brow] for k, v in b_cols.items()}
+            g_ts = b_ts[brow]
+
+            pair = Scope()
+            if from_left:
+                pair.add_frame(probe_side.ref, p_cols, p_ts, pv, default=True)
+                pair.add_frame(build_side.ref, g_cols, g_ts, pv)
+            else:
+                pair.add_frame(build_side.ref, g_cols, g_ts, pv)
+                pair.add_frame(probe_side.ref, p_cols, p_ts, pv, default=True)
+                pair.default_frame = probe_side.ref
+            pair.extras["now"] = now
+
+            # --- exact verification: full ON condition + within ---
+            if plan.residual is not None:
+                pv = pv & plan.residual(pair)
+            if within is not None:
+                pv = pv & (jnp.abs(p_ts - g_ts) <= jnp.int64(within))
+
+            P = lane.shape[0]
+            B = batch.ts.shape[0]
+            if outer:
+                # unmatched probe lanes join a null build frame
+                matched = jax.ops.segment_max(
+                    pv.astype(jnp.int32), lane, num_segments=B) > 0
+                o_valid = mask & ~matched
+                zero_g = {k: jnp.zeros((B,), v.dtype) for k, v in b_cols.items()}
+                lane = jnp.concatenate([lane, jnp.arange(B)])
+                all_pv = jnp.concatenate([pv, o_valid])
+                has_build = jnp.concatenate(
+                    [jnp.ones((P,), bool), jnp.zeros((B,), bool)])
+                p_cols = {k: jnp.concatenate([v, batch.cols[k]])
+                          for k, v in p_cols.items()}
+                p_ts = jnp.concatenate([p_ts, batch.ts])
+                g_cols = {k: jnp.concatenate([v, zero_g[k]])
+                          for k, v in g_cols.items()}
+                g_ts = jnp.concatenate([g_ts, jnp.zeros((B,), g_ts.dtype)])
+                pv = all_pv
+            else:
+                has_build = jnp.ones((P,), bool)
+
+            # zero the build frame on no-build lanes so projections emit nulls
+            bf_valid = pv & has_build
+            g_cols = {k: jnp.where(bf_valid, v, jnp.zeros((), v.dtype))
+                      for k, v in g_cols.items()}
+
+            out_scope = Scope()
+            lf_cols, lf_ts = (p_cols, p_ts) if from_left else (g_cols, g_ts)
+            rf_cols, rf_ts = (g_cols, g_ts) if from_left else (p_cols, p_ts)
+            lf_valid = pv if from_left else bf_valid
+            rf_valid = bf_valid if from_left else pv
+            out_scope.add_frame(self.left.ref, lf_cols, lf_ts, lf_valid,
+                                default=True)
+            out_scope.add_frame(self.right.ref, rf_cols, rf_ts, rf_valid)
+            out_scope.extras["now"] = now
+
+            W = pv.shape[0]
+            chunk = EventBatch(
+                ts=p_ts, cols={},
+                valid=pv,
+                types=jnp.zeros((W,), jnp.int8))  # CURRENT
+            sel, out = selector.step(sel, chunk, out_scope)
+
+            new_wl, new_wr = (w_probe, w_build) if from_left else (w_build, w_probe)
+            return (new_wl, new_wr, sel), out
+
+        return step
+
+    # ---------------------------------------------------------------- runtime
+
+    def on_side_batch(self, from_left: bool, batch: EventBatch, now: int) -> None:
+        side = self.left if from_left else self.right
+        build = self.right if from_left else self.left
+        triggers = (self.trigger == EventTrigger.ALL
+                    or (self.trigger == EventTrigger.LEFT and from_left)
+                    or (self.trigger == EventTrigger.RIGHT and not from_left))
+        step = self._step_left if from_left else self._step_right
+        tstate = build.table.state if build.is_table else None
+        if not triggers:
+            # non-triggering side still feeds its window
+            if side.is_table:
+                return
+            wl, wr, sel = self.state
+            w = wl if from_left else wr
+            w2, _ = self._append_only(side, w, batch, now)
+            self.state = (w2, wr, sel) if from_left else (wl, w2, sel)
+            return
+        self.state, out = step(self.state, batch, jnp.int64(now), tstate)
+        self._distribute(out, now)
+
+    def _append_only(self, side, wstate, batch, now):
+        if not hasattr(side, "_append_fn"):
+            side._append_fn = jax.jit(
+                lambda w, b, n: side.window.step(w, b, n))
+        return side._append_fn(wstate, batch, jnp.int64(now))
+
+    def _distribute(self, out: EventBatch, now: int) -> None:
+        from .query_runtime import QueryRuntime
+        QueryRuntime._distribute(self, out, now)
+
+    def _select_event_type(self, out, etype):
+        from .query_runtime import QueryRuntime
+        return QueryRuntime._select_event_type(out, etype)
+
+    def add_callback(self, cb: QueryCallback) -> None:
+        self.callbacks.append(cb)
+
+
+class _JoinSideReceiver(Receiver):
+    def __init__(self, runtime: JoinQueryRuntime, from_left: bool):
+        self.runtime = runtime
+        self.from_left = from_left
+
+    def on_batch(self, batch: EventBatch, now: int) -> None:
+        self.runtime.on_side_batch(self.from_left, batch, now)

@@ -117,8 +117,10 @@ def grouped_scan(
     next_slot = jnp.concatenate([s_slots[1:], jnp.full((1,), -1, s_slots.dtype)])
     is_slot_end = s_slots != next_slot
     write_slot = jnp.where((s_slots < K) & is_slot_end, s_slots, sentinel)
-    new_values = state.values.at[write_slot].set(s_out, mode="drop")
-    new_epoch = state.epoch.at[write_slot].set(s_epochs, mode="drop")
+    new_values = state.values.at[write_slot].set(
+        s_out.astype(state.values.dtype), mode="drop")
+    new_epoch = state.epoch.at[write_slot].set(
+        s_epochs.astype(state.epoch.dtype), mode="drop")
 
     return GroupState(new_values, new_epoch), out
 
@@ -228,7 +230,7 @@ def key_lookup_or_insert(
     run_id = _segment_broadcast_op(
         jnp.where(first, new_id_sorted, 0), first | (snk == _KEY_PAD), 0)
     lane_new_ids = jnp.zeros((L,), jnp.int32).at[order].set(
-        jnp.where(snk != _KEY_PAD, run_id, 0))
+        jnp.where(snk != _KEY_PAD, run_id, 0).astype(jnp.int32))
 
     ids = jnp.where(found, existing_ids, lane_new_ids)
     ids = jnp.where(valid, ids, 0)

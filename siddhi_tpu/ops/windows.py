@@ -127,6 +127,22 @@ class WindowOp:
     def step(self, state, batch: EventBatch, now: jax.Array):
         raise NotImplementedError
 
+    def contents(self, state, now: jax.Array):
+        """Current in-window rows as (cols, ts, valid) over the ring — the
+        FindableProcessor surface joins probe (reference:
+        core/query/processor/stream/window/SlidingFindableWindowProcessor).
+        Base: no findable contents."""
+        raise SiddhiAppCreationError(
+            f"window {type(self).__name__} is not findable (joins)")
+
+
+def _ring_live_mask(ring_len: int, lo: jax.Array, hi: jax.Array):
+    """Valid-slot mask for a ring holding overall indices [lo, hi): slot s's
+    most recent write is idx = hi-1 - ((hi-1-s) % C); it is live iff >= lo."""
+    s = jnp.arange(ring_len, dtype=jnp.int64)
+    last_written = hi - 1 - ((hi - 1 - s) % ring_len)
+    return (last_written >= 0) & (last_written >= lo) & (last_written < hi)
+
 
 # --------------------------------------------------------------------------- #
 # sliding windows (length, time, timeLength, delay)
@@ -277,6 +293,14 @@ class SlidingWindow(WindowOp):
         )
         return new_state, chunk
 
+    def contents(self, state: SlidingState, now: jax.Array):
+        live = _ring_live_mask(self.C, state.expired, state.appended)
+        if self.time_ms is not None:
+            # probe-time expiry: rows past their deadline are out even if no
+            # batch has flushed them yet
+            live = live & (state.ring_ts + jnp.int64(self.time_ms) > now)
+        return state.ring_cols, state.ring_ts, live
+
 
 # --------------------------------------------------------------------------- #
 # batch (tumbling) windows: lengthBatch, timeBatch, batch
@@ -406,6 +430,12 @@ class LengthBatchWindow(WindowOp):
             has_base=state.has_base,
         )
         return new_state, chunk
+
+    def contents(self, state: BatchState, now: jax.Array):
+        """Joins see the accumulating (unflushed) bucket (reference:
+        BatchingFindableWindowProcessor over the current batch buffer)."""
+        live = _ring_live_mask(self.C, state.flushed, state.appended)
+        return state.ring_cols, state.ring_ts, live
 
 
 def _emit_key(comp_pos, kind, within, N, B):
@@ -539,6 +569,10 @@ class TimeBatchWindow(WindowOp):
         )
         return new_state, chunk
 
+    def contents(self, state: BatchState, now: jax.Array):
+        live = _ring_live_mask(self.C, state.flushed, state.appended)
+        return state.ring_cols, state.ring_ts, live
+
 
 # --------------------------------------------------------------------------- #
 # pass-through (no window)
@@ -559,3 +593,9 @@ class PassThroughWindow(WindowOp):
 
     def step(self, state, batch: EventBatch, now: jax.Array):
         return state, batch
+
+    def contents(self, state, now: jax.Array):
+        """A windowless join side holds nothing (reference: a bare stream in a
+        join keeps a zero-length window — only the arriving event matches)."""
+        cols = {k: jnp.zeros((1,), dtype=dt) for k, dt in self.layout.items()}
+        return cols, jnp.zeros((1,), dtypes.TS_DTYPE), jnp.zeros((1,), bool)

@@ -1,0 +1,162 @@
+"""Stream-stream and stream-table join tests.
+
+Mirrors the reference's join suite
+(modules/siddhi-core/src/test/java/io/siddhi/core/query/join/JoinTestCase.java):
+black-box through the public API.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def make(app, batch_size=8):
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(app, batch_size=batch_size)
+    got = []
+    rt.add_callback("OutStream", lambda evs: got.extend(e.data for e in evs))
+    rt.start()
+    return rt, got
+
+
+class TestStreamStreamJoin:
+    APP = ("define stream TickStream (symbol string, price float);\n"
+           "define stream NewsStream (symbol string, headline string);\n"
+           "from TickStream#window.length(10) join NewsStream#window.length(10) "
+           "on TickStream.symbol == NewsStream.symbol "
+           "select TickStream.symbol as symbol, price, headline "
+           "insert into OutStream;")
+
+    def test_inner_join_basic(self):
+        rt, got = make(self.APP)
+        rt.get_input_handler("TickStream").send(("IBM", 75.0))
+        rt.flush()
+        rt.get_input_handler("NewsStream").send(("IBM", "up"))
+        rt.flush()
+        assert got == [("IBM", 75.0, "up")]
+
+    def test_inner_join_no_match(self):
+        rt, got = make(self.APP)
+        rt.get_input_handler("TickStream").send(("IBM", 75.0))
+        rt.flush()
+        rt.get_input_handler("NewsStream").send(("WSO2", "down"))
+        rt.flush()
+        assert got == []
+
+    def test_join_both_directions(self):
+        rt, got = make(self.APP)
+        rt.get_input_handler("NewsStream").send(("IBM", "up"))
+        rt.flush()
+        rt.get_input_handler("TickStream").send(("IBM", 10.0))
+        rt.flush()
+        # tick arrival probes news window
+        assert got == [("IBM", 10.0, "up")]
+
+    def test_multiple_matches(self):
+        rt, got = make(self.APP)
+        n = rt.get_input_handler("NewsStream")
+        n.send(("IBM", "a"))
+        n.send(("IBM", "b"))
+        rt.flush()
+        rt.get_input_handler("TickStream").send(("IBM", 5.0))
+        rt.flush()
+        assert sorted(got) == [("IBM", 5.0, "a"), ("IBM", 5.0, "b")]
+
+    def test_window_expiry_limits_matches(self):
+        app = ("define stream A (symbol string, x int);\n"
+               "define stream B (symbol string, y int);\n"
+               "from A#window.length(1) join B#window.length(10) "
+               "on A.symbol == B.symbol "
+               "select A.symbol as symbol, x, y insert into OutStream;")
+        rt, got = make(app)
+        a = rt.get_input_handler("A")
+        a.send(("IBM", 1))
+        rt.flush()
+        a.send(("IBM", 2))  # evicts x=1 from A's window
+        rt.flush()
+        rt.get_input_handler("B").send(("IBM", 9))
+        rt.flush()
+        assert got == [("IBM", 2, 9)]
+
+    def test_left_outer_join(self):
+        app = ("define stream A (symbol string, x int);\n"
+               "define stream B (symbol string, y int);\n"
+               "from A#window.length(5) left outer join B#window.length(5) "
+               "on A.symbol == B.symbol "
+               "select A.symbol as symbol, x, y insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("A").send(("IBM", 1))
+        rt.flush()
+        # no B match: left outer emits with null右 (numeric null -> 0)
+        assert got == [("IBM", 1, 0)]
+
+    def test_unidirectional(self):
+        app = ("define stream A (symbol string, x int);\n"
+               "define stream B (symbol string, y int);\n"
+               "from A#window.length(5) unidirectional join B#window.length(5) "
+               "on A.symbol == B.symbol "
+               "select A.symbol as symbol, x, y insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("B").send(("IBM", 7))
+        rt.flush()
+        assert got == []  # B arrivals don't trigger
+        rt.get_input_handler("A").send(("IBM", 1))
+        rt.flush()
+        assert got == [("IBM", 1, 7)]
+
+    def test_non_equi_cross_join(self):
+        app = ("define stream A (x int);\n"
+               "define stream B (y int);\n"
+               "from A#window.length(5) join B#window.length(5) on A.x < B.y "
+               "select x, y insert into OutStream;")
+        rt, got = make(app)
+        b = rt.get_input_handler("B")
+        b.send((5,))
+        b.send((1,))
+        rt.flush()
+        rt.get_input_handler("A").send((3,))
+        rt.flush()
+        assert got == [(3, 5)]
+
+    def test_join_with_aggregation(self):
+        app = ("define stream A (symbol string, x int);\n"
+               "define stream B (symbol string, y int);\n"
+               "from A#window.length(10) join B#window.length(10) "
+               "on A.symbol == B.symbol "
+               "select A.symbol as symbol, sum(y) as total group by symbol "
+               "insert into OutStream;")
+        rt, got = make(app)
+        b = rt.get_input_handler("B")
+        b.send(("IBM", 10))
+        b.send(("IBM", 20))
+        rt.flush()
+        rt.get_input_handler("A").send(("IBM", 1))
+        rt.flush()
+        # one arrival matching two B rows -> running sum emits per pair
+        assert got[-1] == ("IBM", 30)
+
+
+class TestStreamTableJoin:
+    APP = ("define stream S (symbol string, qty int);\n"
+           "define table Prices (symbol string, price float);\n"
+           "from S join Prices on S.symbol == Prices.symbol "
+           "select S.symbol as symbol, qty, price insert into OutStream;")
+
+    def test_table_join(self):
+        rt, got = make(self.APP)
+        rt.tables["Prices"].insert_rows([("IBM", 75.0), ("WSO2", 57.0)])
+        s = rt.get_input_handler("S")
+        s.send(("IBM", 5))
+        s.send(("ORCL", 3))
+        rt.flush()
+        assert got == [("IBM", 5, 75.0)]
+
+    def test_table_join_updated_contents(self):
+        rt, got = make(self.APP)
+        rt.tables["Prices"].insert_rows([("IBM", 75.0)])
+        rt.get_input_handler("S").send(("IBM", 1))
+        rt.flush()
+        rt.tables["Prices"].insert_rows([("ORCL", 10.0)])
+        rt.get_input_handler("S").send(("ORCL", 2))
+        rt.flush()
+        assert got == [("IBM", 1, 75.0), ("ORCL", 2, 10.0)]
