@@ -79,8 +79,11 @@ class SiddhiAppRuntime:
         from ..query_api.execution import JoinInputStream
         name = query.name or default_name
 
+        from ..query_api.execution import StateInputStream
         if isinstance(query.input_stream, JoinInputStream):
             qr = self._add_join_query(query, name)
+        elif isinstance(query.input_stream, StateInputStream):
+            qr = self._add_pattern_query(query, name)
         elif isinstance(query.input_stream, SingleInputStream):
             sid = query.input_stream.stream_id
             junction = self.junctions.get(sid)
@@ -104,6 +107,14 @@ class SiddhiAppRuntime:
             qr.left.junction.subscribe(_JoinSideReceiver(qr, True))
         if not qr.right.is_table:
             qr.right.junction.subscribe(_JoinSideReceiver(qr, False))
+        return qr
+
+    def _add_pattern_query(self, query: Query, name: str):
+        from .pattern_runtime import PatternQueryRuntime, _PatternSideReceiver
+        qr = PatternQueryRuntime(query, self.ctx, self.junctions, self.tables,
+                                 self.ctx.registry, name)
+        for sid in qr.junctions:
+            qr.junctions[sid].subscribe(_PatternSideReceiver(qr, sid))
         return qr
 
     def _wire_output(self, qr, query: Query) -> None:
@@ -200,9 +211,15 @@ class SiddhiAppRuntime:
         self.flush(t)
         seen: set[int] = set()
         for qr in self.query_runtimes.values():
-            if qr.has_time_semantics and id(qr.input_junction) not in seen:
-                seen.add(id(qr.input_junction))
-                qr.input_junction.heartbeat(t)
+            if not qr.has_time_semantics:
+                continue
+            if hasattr(qr, "heartbeat"):  # pattern runtimes drive themselves
+                qr.heartbeat(t)
+                continue
+            j = getattr(qr, "input_junction", None)
+            if j is not None and id(j) not in seen:
+                seen.add(id(j))
+                j.heartbeat(t)
 
     # -------------------------------------------------------------- statistics
 

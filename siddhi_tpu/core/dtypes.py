@@ -44,6 +44,10 @@ class _Config:
     default_table_capacity = 1 << 16
     #: max matched build rows per probe event in joins (static join fan-out).
     join_max_matches = 16
+    #: max concurrent partial matches per pattern position.
+    pattern_pending_capacity = 1024
+    #: expansion bound for unbounded pattern counts `<m:>`.
+    pattern_unbounded_count_extra = 8
 
 
 config = _Config()

@@ -1,0 +1,786 @@
+"""Pattern / sequence NFA runtime (reference: core/query/input/stream/state/ —
+StreamPreStateProcessor.java:46, StreamPostStateProcessor, Logical/Count/Absent
+processors, runtimes under state/runtime/; parsed by
+StateInputStreamParser.java:73).
+
+The reference walks per-event pending-StateEvent linked lists. The TPU
+redesign keeps, per pattern position p, a **fixed-capacity pending table** of
+partial matches waiting for position p's event:
+
+    pending[p]:
+      frames      {ref: {attr: [P]}}   captured columns of earlier positions
+      frame_valid {ref: [P]}           leg/absent frames may be missing
+      start_ts    [P]                  first captured event ts (within expiry)
+      last_seq    [P]                  arrival seq of latest captured event
+      armed_ts    [P]                  when the entry reached this position
+      valid       [P]
+
+A micro-batch on a stream junction is matched against every position fed by
+that stream **in ascending position order**, so intra-batch chains (A then B
+in one batch) complete exactly as the reference's per-event walk would:
+
+    [B,1] arrival frame x [P] pending frames -> [B,P] condition mask
+    qualify &= arrival_seq > last_seq   (pattern: skip-till-any-match)
+            or arrival_seq == last_seq+1 (sequence: strict contiguity)
+    per-entry FIRST qualifying arrival consumes the entry (reference:
+    pending state events are removed on match) -> advance or emit.
+
+`every` re-arms position 0 permanently; non-every patterns consume the start
+state on first match. `within` invalidates entries by start_ts. Absent
+(`not X for T`) entries are killed by a matching X and complete on watermark
+`now >= armed_ts + T` (heartbeat-driven — the reference's Scheduler TIMER,
+AbsentStreamPreStateProcessor.java:35-57). Logical and/or positions hold two
+legs filled in either order. Counts `<m:n>` expand at plan time into n
+positions (optional beyond m), with `e[k]`-indexed frames.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..errors import DefinitionNotExistError, SiddhiAppCreationError
+from ..extension.registry import Registry
+from ..ops.expr_compile import Scope, TypeResolver, compile_expression
+from ..ops.selector import CompiledSelector
+from ..query_api.definition import Attribute, AttributeType, StreamDefinition
+from ..query_api.execution import (
+    AbsentStreamStateElement,
+    CountStateElement,
+    EveryStateElement,
+    LogicalStateElement,
+    NextStateElement,
+    OutputAction,
+    Query,
+    SingleInputStream,
+    StateInputStream,
+    StateType,
+    StreamStateElement,
+)
+from ..query_api.expression import Expression, Variable
+from . import dtypes
+from .context import SiddhiAppContext
+from .event import EventBatch, EventType, StreamCodec
+from .query_runtime import QueryCallback
+from .stream import Receiver, StreamJunction
+
+BIGSEQ = jnp.int64(2**62)
+
+
+@dataclass
+class _Leg:
+    """One stream condition (a logical position has two)."""
+
+    ref: str
+    stream_id: str
+    filters: tuple  # Expression ASTs
+
+
+@dataclass
+class _Position:
+    index: int
+    kind: str  # 'normal' | 'absent' | 'logical'
+    legs: list  # [_Leg] (1 normal/absent, 2 logical)
+    logical_op: Optional[str] = None  # 'and' | 'or'
+    wait_ms: Optional[int] = None  # absent
+    optional: bool = False  # count occurrences beyond min_count
+
+    @property
+    def ref(self) -> str:
+        return self.legs[0].ref
+
+
+def _unwrap_chain(elem):
+    """EveryStateElement.state may hold a nested ('chain', state, within)."""
+    if isinstance(elem, tuple) and elem and elem[0] in ("chain", "seq"):
+        return elem[1]
+    return elem
+
+
+class _PatternPlan:
+    """Flattens the state AST into a linear position list."""
+
+    def __init__(self, sis: StateInputStream, ctx) -> None:
+        self.every = False
+        self.positions: list[_Position] = []
+        self.is_sequence = sis.state_type == StateType.SEQUENCE
+        self.within_ms = sis.within_ms
+        #: ref -> (base_ref, occurrence_index) for count groups
+        self.count_groups: dict[str, list[str]] = {}
+
+        chain = self._linearize(sis.state)
+        first = chain[0]
+        if isinstance(first, EveryStateElement):
+            self.every = True
+            inner = _unwrap_chain(first.state)
+            chain = self._linearize(inner) + chain[1:]
+        for e in chain:
+            if isinstance(e, EveryStateElement):
+                raise SiddhiAppCreationError(
+                    "`every` is only supported on the first pattern element")
+            self._add_element(e, ctx)
+        if not self.positions:
+            raise SiddhiAppCreationError("empty pattern")
+        if self.positions[0].kind == "absent":
+            raise SiddhiAppCreationError(
+                "absent (`not ... for`) as the first pattern element is not "
+                "yet supported")
+
+    def _linearize(self, state) -> list:
+        if isinstance(state, NextStateElement):
+            return self._linearize(state.state) + self._linearize(state.next)
+        return [state]
+
+    def _ref_of(self, stream: SingleInputStream, fallback: str) -> str:
+        return stream.alias or fallback
+
+    def _add_element(self, e, ctx) -> None:
+        i = len(self.positions)
+        if isinstance(e, StreamStateElement):
+            s = e.stream
+            ref = self._ref_of(s, f"_p{i}")
+            self.positions.append(_Position(
+                i, "normal",
+                [_Leg(ref, s.stream_id, tuple(s.handlers.filters))]))
+        elif isinstance(e, AbsentStreamStateElement):
+            s = e.stream
+            if e.waiting_time_ms is None:
+                raise SiddhiAppCreationError(
+                    "absent patterns need `for <time>` in this build")
+            ref = self._ref_of(s, f"_p{i}")
+            self.positions.append(_Position(
+                i, "absent",
+                [_Leg(ref, s.stream_id, tuple(s.handlers.filters))],
+                wait_ms=e.waiting_time_ms))
+        elif isinstance(e, LogicalStateElement):
+            l, r = e.left, e.right
+            if not (isinstance(l, StreamStateElement)
+                    and isinstance(r, StreamStateElement)):
+                raise SiddhiAppCreationError(
+                    "logical patterns combine two plain stream conditions")
+            lref = self._ref_of(l.stream, f"_p{i}a")
+            rref = self._ref_of(r.stream, f"_p{i}b")
+            self.positions.append(_Position(
+                i, "logical",
+                [_Leg(lref, l.stream.stream_id, tuple(l.stream.handlers.filters)),
+                 _Leg(rref, r.stream.stream_id, tuple(r.stream.handlers.filters))],
+                logical_op=e.logical_type))
+        elif isinstance(e, CountStateElement):
+            s = e.element.stream
+            base = self._ref_of(s, f"_p{len(self.positions)}")
+            lo = e.min_count
+            hi = e.max_count
+            if hi == CountStateElement.ANY:
+                hi = lo + dtypes.config.pattern_unbounded_count_extra
+            if lo < 0 or hi < max(lo, 1):
+                raise SiddhiAppCreationError(f"bad count range <{lo}:{hi}>")
+            refs = []
+            for k in range(hi):
+                idx = len(self.positions)
+                ref = f"{base}[{k}]"
+                refs.append(ref)
+                self.positions.append(_Position(
+                    idx, "normal",
+                    [_Leg(ref, s.stream_id, tuple(s.handlers.filters))],
+                    optional=k >= max(lo, 1)))
+            self.count_groups[base] = refs
+        else:
+            raise SiddhiAppCreationError(
+                f"unsupported pattern element {type(e).__name__}")
+
+
+class _RefRewriter:
+    """Rewrites e1[0].attr / e1[last].attr / bare count refs onto expanded
+    position frames."""
+
+    def __init__(self, count_groups: dict[str, list[str]]):
+        self.groups = count_groups
+
+    def rewrite(self, expr):
+        if expr is None:
+            return None
+        if isinstance(expr, Variable):
+            sid = expr.stream_id
+            if sid in self.groups:
+                refs = self.groups[sid]
+                if expr.is_last:
+                    new_sid = refs[-1]
+                elif expr.stream_index is not None:
+                    if expr.stream_index >= len(refs):
+                        raise SiddhiAppCreationError(
+                            f"{sid}[{expr.stream_index}] exceeds count bound")
+                    new_sid = refs[expr.stream_index]
+                else:
+                    new_sid = refs[0]
+                return Variable(expr.attribute, stream_id=new_sid)
+            return expr
+        kwargs = {}
+        for a in ("left", "right", "expression"):
+            sub = getattr(expr, a, None)
+            if isinstance(sub, Expression):
+                kwargs[a] = self.rewrite(sub)
+        if hasattr(expr, "parameters") and getattr(expr, "parameters", None):
+            return dataclasses.replace(expr, parameters=tuple(
+                self.rewrite(p) for p in expr.parameters))
+        if kwargs:
+            return dataclasses.replace(expr, **kwargs)
+        return expr
+
+
+class PendingTable(NamedTuple):
+    frames: dict  # {ref: {attr: [P]}}
+    frame_valid: dict  # {ref: [P] bool}
+    frame_ts: dict  # {ref: [P] int64}
+    start_ts: jax.Array  # int64[P]
+    last_seq: jax.Array  # int64[P]
+    armed_ts: jax.Array  # int64[P]
+    valid: jax.Array  # bool[P]
+    leg_done: jax.Array  # bool[P, 2] (logical positions)
+
+
+class PatternState(NamedTuple):
+    pending: tuple  # PendingTable per position 1..S-1 (position 0 implicit)
+    active0: jax.Array  # bool — start state armed (non-every consumes it)
+    seq: jax.Array  # int64 global arrival counter
+    sel_state: object
+
+
+class PatternQueryRuntime:
+    """Runtime for one pattern/sequence query."""
+
+    def __init__(self, query: Query, ctx: SiddhiAppContext, junctions: dict,
+                 tables: dict, registry: Registry, name: str) -> None:
+        assert isinstance(query.input_stream, StateInputStream)
+        sis: StateInputStream = query.input_stream
+        self.query = query
+        self.ctx = ctx
+        self.name = name
+        self.registry = registry
+        self.callbacks: list[QueryCallback] = []
+        self.output_junction = None
+        self.table_executor = None
+        self.tables = tables
+        self.P = dtypes.config.pattern_pending_capacity
+
+        self.plan = _PatternPlan(sis, ctx)
+        plan = self.plan
+        if plan.is_sequence:
+            jset = {leg.stream_id for pos in plan.positions for leg in pos.legs}
+            if len(jset) > 1:
+                raise SiddhiAppCreationError(
+                    "sequences across multiple streams are not yet supported "
+                    "(strict contiguity is per-stream in this build)")
+
+        # --- junctions / frames / codecs ---
+        self.junctions: dict[str, StreamJunction] = {}
+        frames: dict[str, dict] = {}
+        codecs: dict[str, StreamCodec] = {}
+        self.ref_types: dict[str, dict] = {}
+        for pos in plan.positions:
+            for leg in pos.legs:
+                j = junctions.get(leg.stream_id)
+                if j is None:
+                    raise DefinitionNotExistError(
+                        f"stream {leg.stream_id!r} is not defined")
+                self.junctions[leg.stream_id] = j
+                attr_types = {a.name: a.type for a in j.definition.attributes
+                              if a.type != AttributeType.OBJECT}
+                frames[leg.ref] = attr_types
+                codecs[leg.ref] = j.codec
+                self.ref_types[leg.ref] = attr_types
+        # bare stream names resolve when unambiguous
+        sid_count: dict[str, int] = {}
+        for pos in plan.positions:
+            for leg in pos.legs:
+                sid_count[leg.stream_id] = sid_count.get(leg.stream_id, 0) + 1
+        for sid, n in sid_count.items():
+            if n == 1 and sid not in frames:
+                for pos in plan.positions:
+                    for leg in pos.legs:
+                        if leg.stream_id == sid:
+                            frames[sid] = frames[leg.ref]
+                            codecs[sid] = codecs[leg.ref]
+
+        rewriter = _RefRewriter(plan.count_groups)
+        self.resolver = TypeResolver(frames, plan.positions[0].legs[0].ref, codecs)
+
+        # --- compile per-leg conditions (unqualified attrs resolve to the
+        # leg's own arrival frame, like the reference's per-state meta) ---
+        for pos in plan.positions:
+            for leg in pos.legs:
+                leg_resolver = TypeResolver(frames, leg.ref, codecs)
+                leg.compiled = [
+                    compile_expression(rewriter.rewrite(f), leg_resolver, registry)
+                    for f in leg.filters]
+
+        # --- selector over all captured frames ---
+        select_all = []
+        seen = set()
+        for pos in plan.positions:
+            for leg in pos.legs:
+                for n, t in self.ref_types[leg.ref].items():
+                    if n not in seen:
+                        seen.add(n)
+                        select_all.append((n, t))
+        sel = query.selector
+        sel = dataclasses.replace(
+            sel,
+            attributes=tuple(dataclasses.replace(a, expression=rewriter.rewrite(a.expression))
+                             for a in sel.attributes),
+            having=rewriter.rewrite(sel.having),
+            group_by=tuple(rewriter.rewrite(g) for g in sel.group_by))
+        self.selector = CompiledSelector(
+            sel, self.resolver, registry, ctx.effective_group_capacity,
+            plan.positions[0].legs[0].ref, select_all_attrs=select_all)
+
+        self.output_attributes = tuple(
+            Attribute(n, t) for n, t in self.selector.out_types.items())
+        self.output_definition = StreamDefinition(
+            id=query.output_stream.target_id or f"{name}_out",
+            attributes=self.output_attributes)
+        self.output_codec = StreamCodec(self.output_definition, ctx.global_strings)
+
+        # --- state & jitted steps (one per junction + heartbeat) ---
+        self.state = self._init_state()
+        self._steps = {
+            sid: jax.jit(self._make_step(sid), donate_argnums=(0,))
+            for sid in self.junctions
+        }
+        self._heartbeat_step = jax.jit(self._make_step(None), donate_argnums=(0,))
+        self.has_time_semantics = (
+            plan.within_ms is not None
+            or any(p.kind == "absent" for p in plan.positions))
+
+    # ------------------------------------------------------------------ state
+
+    def _captured_refs(self, pos_index: int) -> list[str]:
+        """Frame refs captured before reaching position pos_index (all legs of
+        earlier positions)."""
+        refs = []
+        for pos in self.plan.positions[:pos_index]:
+            for leg in pos.legs:
+                refs.append(leg.ref)
+        # logical positions also capture their own legs progressively
+        pos = self.plan.positions[pos_index]
+        if pos.kind == "logical":
+            for leg in pos.legs:
+                refs.append(leg.ref)
+        return refs
+
+    def _empty_pending(self, pos_index: int) -> PendingTable:
+        P = self.P
+        frames = {}
+        fvalid = {}
+        fts = {}
+        for ref in self._captured_refs(pos_index):
+            frames[ref] = {
+                n: jnp.zeros((P,), dtypes.device_dtype(t))
+                for n, t in self.ref_types[ref].items()}
+            fvalid[ref] = jnp.zeros((P,), bool)
+            fts[ref] = jnp.zeros((P,), dtypes.TS_DTYPE)
+        return PendingTable(
+            frames=frames, frame_valid=fvalid, frame_ts=fts,
+            start_ts=jnp.zeros((P,), dtypes.TS_DTYPE),
+            last_seq=jnp.zeros((P,), jnp.int64),
+            armed_ts=jnp.zeros((P,), dtypes.TS_DTYPE),
+            valid=jnp.zeros((P,), bool),
+            leg_done=jnp.zeros((P, 2), bool),
+        )
+
+    def _init_state(self) -> PatternState:
+        S = len(self.plan.positions)
+        return PatternState(
+            pending=tuple(self._empty_pending(p) for p in range(1, S)),
+            active0=jnp.bool_(True),
+            seq=jnp.int64(0),
+            sel_state=self.selector.init_state(),
+        )
+
+    # ------------------------------------------------------------------- step
+
+    def _leg_cond(self, leg, batch: EventBatch, pend: Optional[PendingTable],
+                  now) -> jax.Array:
+        """[B,P] (or [B,1] for position 0) filter mask for one leg."""
+        B = batch.ts.shape[0]
+        scope = Scope()
+        cols_b = {k: v[:, None] for k, v in batch.cols.items()}
+        scope.add_frame(leg.ref, cols_b, batch.ts[:, None],
+                        batch.valid[:, None], default=True)
+        # bare stream name alias
+        scope.frames.setdefault(leg.stream_id, cols_b)
+        scope.valids.setdefault(leg.stream_id, batch.valid[:, None])
+        scope.ts.setdefault(leg.stream_id, batch.ts[:, None])
+        if pend is not None:
+            for ref, cols in pend.frames.items():
+                scope.add_frame(ref, cols, pend.frame_ts[ref],
+                                pend.frame_valid[ref])
+        scope.extras["now"] = now
+        m = batch.valid[:, None]
+        for ce in leg.compiled:
+            m = m & ce(scope)
+        P = pend.valid.shape[0] if pend is not None else 1
+        return jnp.broadcast_to(m, (B, P))
+
+    def _make_step(self, junction_sid: Optional[str]):
+        plan = self.plan
+        selector = self.selector
+        S = len(plan.positions)
+        P = self.P
+        within = plan.within_ms
+        is_seq = plan.is_sequence
+        every = plan.every
+
+        def step(state: PatternState, batch: EventBatch, now):
+            pending = list(state.pending)
+            active0 = state.active0
+            B = batch.ts.shape[0]
+
+            n_valid = jnp.sum(batch.valid.astype(jnp.int64))
+            # arrival sequence per lane (valid lanes, in lane order)
+            lane_rank = jnp.cumsum(batch.valid.astype(jnp.int64)) - 1
+            arr_seq = jnp.where(batch.valid, state.seq + lane_rank, BIGSEQ)
+
+            # collected outputs: one block per completion source
+            out_blocks = []  # (frames {ref: cols}, fvalid {ref}, fts, ts, valid)
+
+            def expire(pend: PendingTable) -> PendingTable:
+                if within is None:
+                    return pend
+                ok = pend.valid & (now - pend.start_ts <= jnp.int64(within))
+                return pend._replace(valid=ok)
+
+            pending = [expire(p) for p in pending]
+
+            for pi, pos in enumerate(plan.positions):
+                pend = pending[pi - 1] if pi > 0 else None
+                feeds = junction_sid is not None and any(
+                    leg.stream_id == junction_sid for leg in pos.legs)
+
+                # ---- absent completion (time-driven, runs on every step) ----
+                if pos.kind == "absent" and pi > 0:
+                    due = pend.valid & (now >= pend.armed_ts +
+                                        jnp.int64(pos.wait_ms))
+                    if junction_sid is not None and \
+                            pos.legs[0].stream_id == junction_sid:
+                        # a matching event kills waiting entries first
+                        kill = self._leg_cond(pos.legs[0], batch, pend, now)
+                        kill = kill & (arr_seq[:, None] > pend.last_seq[None, :])
+                        kill = kill & (batch.ts[:, None] <
+                                       pend.armed_ts[None, :] + jnp.int64(pos.wait_ms))
+                        killed = kill.any(axis=0)
+                        pend = pend._replace(valid=pend.valid & ~killed)
+                        due = due & ~killed
+                    # completions advance with an invalid (absent) frame
+                    comp_frames = dict(pend.frames)
+                    comp_fvalid = dict(pend.frame_valid)
+                    comp_fts = dict(pend.frame_ts)
+                    ref = pos.legs[0].ref
+                    comp_frames[ref] = {
+                        n: jnp.zeros((P,), dtypes.device_dtype(t))
+                        for n, t in self.ref_types[ref].items()}
+                    comp_fvalid[ref] = jnp.zeros((P,), bool)
+                    comp_fts[ref] = jnp.zeros((P,), dtypes.TS_DTYPE)
+                    comp_ts = pend.armed_ts + jnp.int64(pos.wait_ms)
+                    self._advance(
+                        pending, out_blocks, pi + 1,
+                        comp_frames, comp_fvalid, comp_fts,
+                        jnp.where(pend.valid, pend.start_ts, 0),
+                        pend.last_seq, comp_ts, due)
+                    pend = pend._replace(valid=pend.valid & ~due)
+                    pending[pi - 1] = pend
+                    continue
+
+                if not feeds:
+                    continue
+
+                # ---- normal / logical positions fed by this junction ----
+                if pi == 0:
+                    # virtual empty pending: [B,1]
+                    leg = pos.legs[0]
+                    if pos.kind == "logical":
+                        raise SiddhiAppCreationError(
+                            "logical conditions at the first pattern position "
+                            "are not yet supported")
+                    if leg.stream_id != junction_sid:
+                        continue
+                    m = self._leg_cond(leg, batch, None, now)[:, 0]  # [B]
+                    if not every:
+                        # only the first match consumes the start state
+                        first_lane = jnp.argmax(m)
+                        only = jnp.zeros((B,), bool).at[first_lane].set(True)
+                        m = m & only & active0
+                        active0 = active0 & ~m.any()
+                    frames = {leg.ref: dict(batch.cols)}
+                    fvalid = {leg.ref: m}
+                    fts = {leg.ref: batch.ts}
+                    self._advance(pending, out_blocks, 1, frames, fvalid, fts,
+                                  batch.ts, arr_seq, batch.ts, m)
+                    continue
+
+                for li, leg in enumerate(pos.legs):
+                    if leg.stream_id != junction_sid:
+                        continue
+                    pend = pending[pi - 1]
+                    q = self._leg_cond(leg, batch, pend, now)  # [B,P]
+                    q = q & pend.valid[None, :]
+                    if is_seq:
+                        q = q & (arr_seq[:, None] == pend.last_seq[None, :] + 1)
+                    else:
+                        q = q & (arr_seq[:, None] > pend.last_seq[None, :])
+                    if within is not None:
+                        q = q & (batch.ts[:, None] - pend.start_ts[None, :]
+                                 <= jnp.int64(within))
+
+                    if is_seq:
+                        # strict: an arrival at seq == last_seq+1 that does NOT
+                        # match kills the entry
+                        nxt = (arr_seq[:, None] == pend.last_seq[None, :] + 1) \
+                            & batch.valid[:, None]
+                        killed = (nxt & ~q).any(axis=0)
+                        pend = pend._replace(valid=pend.valid & ~killed)
+                        q = q & pend.valid[None, :]
+
+                    # first qualifying arrival per entry
+                    qseq = jnp.where(q, arr_seq[:, None], BIGSEQ)
+                    b_star = jnp.argmin(qseq, axis=0)  # [P]
+                    matched = q.any(axis=0)
+
+                    cap = {n: v[b_star] for n, v in batch.cols.items()}
+                    cap_ts = batch.ts[b_star]
+
+                    if pos.kind == "logical":
+                        other = 1 - li
+                        # logical positions persist their legs in their own
+                        # pending table (both legs are captured refs)
+                        new_frames = dict(pend.frames)
+                        new_fvalid = dict(pend.frame_valid)
+                        new_fts = dict(pend.frame_ts)
+                        new_frames[leg.ref] = {
+                            n: jnp.where(matched, cap[n],
+                                         pend.frames[leg.ref][n])
+                            for n in cap}
+                        new_fvalid[leg.ref] = pend.frame_valid[leg.ref] | matched
+                        new_fts[leg.ref] = jnp.where(
+                            matched, cap_ts, pend.frame_ts[leg.ref])
+                        complete = (
+                            matched if pos.logical_op == "or"
+                            else (matched & pend.leg_done[:, other]))
+                        pend = pend._replace(
+                            frames=new_frames, frame_valid=new_fvalid,
+                            frame_ts=new_fts,
+                            leg_done=pend.leg_done.at[:, li].set(
+                                pend.leg_done[:, li] | matched),
+                            last_seq=jnp.where(matched, arr_seq[b_star],
+                                               pend.last_seq))
+                        adv_valid = complete
+                        ins_frames = pend.frames
+                        ins_fvalid = pend.frame_valid
+                        ins_fts = pend.frame_ts
+                        consumed = complete
+                        comp_ts = jnp.where(matched, cap_ts, pend.armed_ts)
+                        pending[pi - 1] = pend._replace(
+                            valid=pend.valid & ~consumed)
+                    else:
+                        # carry captured frames + the new arrival frame into
+                        # the advance; pend's own structure is untouched
+                        ins_frames = dict(pend.frames)
+                        ins_fvalid = dict(pend.frame_valid)
+                        ins_fts = dict(pend.frame_ts)
+                        ins_frames[leg.ref] = cap
+                        ins_fvalid[leg.ref] = matched
+                        ins_fts[leg.ref] = cap_ts
+                        adv_valid = matched
+                        comp_ts = cap_ts
+                        pending[pi - 1] = pend._replace(
+                            valid=pend.valid & ~matched)
+
+                    self._advance(
+                        pending, out_blocks, pi + 1,
+                        ins_frames, ins_fvalid, ins_fts,
+                        jnp.where(adv_valid, pend.start_ts, 0),
+                        jnp.where(adv_valid, arr_seq[b_star], pend.last_seq),
+                        comp_ts, adv_valid)
+
+            # ---- merge output blocks through the selector ----
+            new_sel, out = self._emit(state.sel_state, out_blocks, now)
+            new_state = PatternState(
+                pending=tuple(pending),
+                active0=active0,
+                seq=state.seq + n_valid,
+                sel_state=new_sel,
+            )
+            return new_state, out
+
+        return step
+
+    # ------------------------------------------------------- pending inserts
+
+    def _advance(self, pending: list, out_blocks: list, target_pos: int,
+                 frames, fvalid, fts, start_ts, last_seq, armed_ts,
+                 valid) -> None:
+        """Move completed entries to `target_pos` (insert into its waiting
+        table, or emit if past the last position). Optional count positions
+        add an epsilon edge: entries also advance past them immediately
+        (reference: CountPreStateProcessor forwards once min counts are met).
+        Note: the epsilon copy and the stay-behind copy are independent
+        entries; a documented round-1 divergence is that both may eventually
+        complete (the reference consumes the shared state event once)."""
+        S = len(self.plan.positions)
+        while True:
+            if target_pos >= S:
+                out_blocks.append((frames, fvalid, fts, armed_ts, valid))
+                return
+            pending[target_pos - 1] = self._insert_entries(
+                pending[target_pos - 1], frames, fvalid, fts,
+                start_ts, last_seq, armed_ts, valid)
+            if not self.plan.positions[target_pos].optional:
+                return
+            target_pos += 1
+
+    def _insert_entries(self, dst: PendingTable, frames, fvalid, fts,
+                        start_ts, last_seq, armed_ts, valid) -> PendingTable:
+        """Insert [P]-aligned candidate entries into dst's free slots."""
+        P = self.P
+        free_order = jnp.argsort(dst.valid, stable=True)
+        n_free = jnp.sum((~dst.valid).astype(jnp.int32))
+        rank = jnp.cumsum(valid.astype(jnp.int32)) - 1
+        fits = valid & (rank < n_free)
+        slot = jnp.where(fits, free_order[jnp.clip(rank, 0, P - 1)], P)
+
+        new_frames = {}
+        new_fvalid = {}
+        new_fts = {}
+        for ref in dst.frames:
+            src_cols = frames.get(ref)
+            if src_cols is None:
+                new_frames[ref] = dst.frames[ref]
+                new_fvalid[ref] = dst.frame_valid[ref]
+                new_fts[ref] = dst.frame_ts[ref]
+                continue
+            new_frames[ref] = {
+                n: dst.frames[ref][n].at[slot].set(src_cols[n], mode="drop")
+                for n in dst.frames[ref]}
+            new_fvalid[ref] = dst.frame_valid[ref].at[slot].set(
+                fvalid.get(ref, valid), mode="drop")
+            new_fts[ref] = dst.frame_ts[ref].at[slot].set(
+                fts.get(ref, jnp.zeros_like(dst.frame_ts[ref])), mode="drop")
+        return PendingTable(
+            frames=new_frames, frame_valid=new_fvalid, frame_ts=new_fts,
+            start_ts=dst.start_ts.at[slot].set(start_ts, mode="drop"),
+            last_seq=dst.last_seq.at[slot].set(last_seq, mode="drop"),
+            armed_ts=dst.armed_ts.at[slot].set(armed_ts, mode="drop"),
+            valid=dst.valid.at[slot].set(valid, mode="drop"),
+            leg_done=dst.leg_done.at[slot].set(
+                jnp.zeros((slot.shape[0], 2), bool), mode="drop"),
+        )
+
+    # ------------------------------------------------------------------ emit
+
+    def _emit(self, sel_state, out_blocks, now):
+        selector = self.selector
+        all_refs = []
+        for pos in self.plan.positions:
+            for leg in pos.legs:
+                all_refs.append(leg.ref)
+
+        if not out_blocks:
+            # empty output
+            W = 1
+            scope = Scope()
+            for ref in all_refs:
+                cols = {n: jnp.zeros((W,), dtypes.device_dtype(t))
+                        for n, t in self.ref_types[ref].items()}
+                scope.add_frame(ref, cols, jnp.zeros((W,), dtypes.TS_DTYPE),
+                                jnp.zeros((W,), bool),
+                                default=(ref == all_refs[0]))
+            self._alias_bare_streams(scope)
+            scope.extras["now"] = now
+            chunk = EventBatch(ts=jnp.zeros((W,), dtypes.TS_DTYPE), cols={},
+                               valid=jnp.zeros((W,), bool),
+                               types=jnp.zeros((W,), jnp.int8))
+            return selector.step(sel_state, chunk, scope)
+
+        # concatenate blocks lane-wise
+        scope = Scope()
+        tss = jnp.concatenate([b[3] for b in out_blocks])
+        valids = jnp.concatenate([b[4] for b in out_blocks])
+        for ref in all_refs:
+            cols_parts = []
+            valid_parts = []
+            ts_parts = []
+            for frames, fvalid, fts, ts, v in out_blocks:
+                W = ts.shape[0]
+                if ref in frames:
+                    cols_parts.append(frames[ref])
+                    valid_parts.append(fvalid[ref] & v)
+                    ts_parts.append(fts[ref])
+                else:
+                    cols_parts.append({
+                        n: jnp.zeros((W,), dtypes.device_dtype(t))
+                        for n, t in self.ref_types[ref].items()})
+                    valid_parts.append(jnp.zeros((W,), bool))
+                    ts_parts.append(jnp.zeros((W,), dtypes.TS_DTYPE))
+            cols = {n: jnp.concatenate([c[n] for c in cols_parts])
+                    for n in self.ref_types[ref]}
+            fv = jnp.concatenate(valid_parts)
+            # zero missing frames so projections emit nulls
+            cols = {n: jnp.where(fv, v, jnp.zeros((), v.dtype))
+                    for n, v in cols.items()}
+            scope.add_frame(ref, cols, jnp.concatenate(ts_parts), fv,
+                            default=(ref == all_refs[0]))
+        self._alias_bare_streams(scope)
+        scope.extras["now"] = now
+        chunk = EventBatch(ts=tss, cols={}, valid=valids,
+                           types=jnp.zeros((tss.shape[0],), jnp.int8))
+        return selector.step(sel_state, chunk, scope)
+
+    def _alias_bare_streams(self, scope: Scope) -> None:
+        """Let unambiguous bare stream names resolve to their position frame."""
+        sid_refs: dict[str, list[str]] = {}
+        for pos in self.plan.positions:
+            for leg in pos.legs:
+                sid_refs.setdefault(leg.stream_id, []).append(leg.ref)
+        for sid, refs in sid_refs.items():
+            if len(refs) == 1 and sid not in scope.frames:
+                ref = refs[0]
+                scope.frames[sid] = scope.frames[ref]
+                scope.valids[sid] = scope.valids[ref]
+                scope.ts[sid] = scope.ts[ref]
+
+    # ---------------------------------------------------------------- runtime
+
+    def on_junction_batch(self, sid: str, batch: EventBatch, now: int) -> None:
+        self.state, out = self._steps[sid](self.state, batch, jnp.int64(now))
+        self._distribute(out, now)
+
+    def heartbeat(self, now: int) -> None:
+        if not self.has_time_semantics:
+            return
+        any_j = next(iter(self.junctions.values()))
+        empty = EventBatch.empty(any_j.definition, any_j.batch_size)
+        self.state, out = self._heartbeat_step(self.state, empty, jnp.int64(now))
+        self._distribute(out, now)
+
+    def _distribute(self, out: EventBatch, now: int) -> None:
+        from .query_runtime import QueryRuntime
+        QueryRuntime._distribute(self, out, now)
+
+    def _select_event_type(self, out, etype):
+        from .query_runtime import QueryRuntime
+        return QueryRuntime._select_event_type(out, etype)
+
+    def add_callback(self, cb: QueryCallback) -> None:
+        self.callbacks.append(cb)
+
+
+class _PatternSideReceiver(Receiver):
+    def __init__(self, runtime: PatternQueryRuntime, sid: str):
+        self.runtime = runtime
+        self.sid = sid
+
+    def on_batch(self, batch: EventBatch, now: int) -> None:
+        self.runtime.on_junction_batch(self.sid, batch, now)

@@ -1,0 +1,218 @@
+"""Pattern & sequence NFA tests.
+
+Mirrors the reference suites (modules/siddhi-core/src/test/java/io/siddhi/core/
+query/pattern/ — PatternTestCase, EveryPatternTestCase, AbsentPatternTestCase,
+CountPatternTestCase, LogicalPatternTestCase — and query/sequence/).
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+TWO = ("define stream S1 (symbol string, price float);\n"
+       "define stream S2 (symbol string, price float);\n")
+
+
+def make(app, batch_size=8):
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(app, batch_size=batch_size)
+    got = []
+    rt.add_callback("OutStream", lambda evs: got.extend(e.data for e in evs))
+    rt.start()
+    return rt, got
+
+
+class TestBasicPattern:
+    def test_two_stream_pattern(self):
+        app = (TWO +
+               "from e1=S1[price > 20.0] -> e2=S2[price > 30.0] "
+               "select e1.symbol as s1, e2.symbol as s2 insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("IBM", 25.0))
+        rt.flush()
+        rt.get_input_handler("S2").send(("WSO2", 35.0))
+        rt.flush()
+        assert got == [("IBM", "WSO2")]
+
+    def test_non_every_matches_once(self):
+        app = (TWO +
+               "from e1=S1[price > 20.0] -> e2=S2[price > 30.0] "
+               "select e1.price as p1, e2.price as p2 insert into OutStream;")
+        rt, got = make(app)
+        s1, s2 = rt.get_input_handler("S1"), rt.get_input_handler("S2")
+        s1.send(("A", 25.0)); rt.flush()
+        s1.send(("B", 26.0)); rt.flush()  # start state consumed: ignored
+        s2.send(("C", 35.0)); rt.flush()
+        s2.send(("D", 36.0)); rt.flush()  # pattern done: ignored
+        assert got == [(25.0, 35.0)]
+
+    def test_every_rearms(self):
+        app = (TWO +
+               "from every e1=S1[price > 20.0] -> e2=S2[price > 30.0] "
+               "select e1.price as p1, e2.price as p2 insert into OutStream;")
+        rt, got = make(app)
+        s1, s2 = rt.get_input_handler("S1"), rt.get_input_handler("S2")
+        s1.send(("A", 25.0)); rt.flush()
+        s1.send(("B", 26.0)); rt.flush()
+        s2.send(("C", 35.0)); rt.flush()
+        # both pendings complete on the first qualifying S2
+        assert sorted(got) == [(25.0, 35.0), (26.0, 35.0)]
+        s1.send(("E", 27.0)); rt.flush()
+        s2.send(("F", 37.0)); rt.flush()
+        assert sorted(got) == [(25.0, 35.0), (26.0, 35.0), (27.0, 37.0)]
+
+    def test_condition_referencing_earlier_event(self):
+        app = (TWO +
+               "from every e1=S1 -> e2=S2[price > e1.price] "
+               "select e1.price as p1, e2.price as p2 insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("A", 50.0)); rt.flush()
+        rt.get_input_handler("S2").send(("B", 40.0)); rt.flush()  # not > 50
+        assert got == []
+        rt.get_input_handler("S2").send(("C", 60.0)); rt.flush()
+        assert got == [(50.0, 60.0)]
+
+    def test_intra_batch_chain(self):
+        app = ("define stream S (k string, v int);\n"
+               "from every e1=S[v == 1] -> e2=S[v == 2] "
+               "select e1.k as k1, e2.k as k2 insert into OutStream;")
+        rt, got = make(app, batch_size=8)
+        h = rt.get_input_handler("S")
+        h.send(("a", 1))
+        h.send(("b", 2))  # same micro-batch: chain must still complete
+        rt.flush()
+        assert got == [("a", "b")]
+
+    def test_three_stage(self):
+        app = ("define stream S (k string, v int);\n"
+               "from every e1=S[v == 1] -> e2=S[v == 2] -> e3=S[v == 3] "
+               "select e1.k as k1, e2.k as k2, e3.k as k3 insert into OutStream;")
+        rt, got = make(app)
+        h = rt.get_input_handler("S")
+        for row in [("a", 1), ("x", 9), ("b", 2), ("c", 3)]:
+            h.send(row)
+            rt.flush()
+        assert got == [("a", "b", "c")]
+
+
+class TestWithin:
+    def test_within_expires_partial(self):
+        # @app:playback: virtual clock driven by event timestamps (reference:
+        # PlaybackTestCase pattern for time-sensitive tests)
+        app = ("@app:playback\n" + TWO +
+               "from every e1=S1 -> e2=S2 within 1 sec "
+               "select e1.price as p1, e2.price as p2 insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("A", 1.0), timestamp=1000)
+        rt.flush()
+        rt.get_input_handler("S2").send(("B", 2.0), timestamp=5000)
+        rt.flush()
+        assert got == []  # partial expired (4s > 1s)
+
+    def test_within_allows_fast_match(self):
+        app = ("@app:playback\n" + TWO +
+               "from every e1=S1 -> e2=S2 within 10 sec "
+               "select e1.price as p1, e2.price as p2 insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("A", 1.0), timestamp=1000)
+        rt.flush()
+        rt.get_input_handler("S2").send(("B", 2.0), timestamp=5000)
+        rt.flush()
+        assert got == [(1.0, 2.0)]
+
+
+class TestLogical:
+    def test_and_pattern(self):
+        app = (TWO +
+               "define stream S3 (symbol string, price float);\n"
+               "from e1=S1 -> e2=S2 and e3=S3 "
+               "select e1.price as p1, e2.price as p2, e3.price as p3 "
+               "insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("A", 1.0)); rt.flush()
+        rt.get_input_handler("S3").send(("C", 3.0)); rt.flush()
+        assert got == []  # and needs both legs
+        rt.get_input_handler("S2").send(("B", 2.0)); rt.flush()
+        assert got == [(1.0, 2.0, 3.0)]
+
+    def test_or_pattern(self):
+        app = (TWO +
+               "define stream S3 (symbol string, price float);\n"
+               "from e1=S1 -> e2=S2 or e3=S3 "
+               "select e1.price as p1, e2.price as p2, e3.price as p3 "
+               "insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("A", 1.0)); rt.flush()
+        rt.get_input_handler("S3").send(("C", 3.0)); rt.flush()
+        # or completes on either leg; missing leg is null (numeric -> 0.0)
+        assert got == [(1.0, 0.0, 3.0)]
+
+    def test_or_is_null(self):
+        app = (TWO +
+               "define stream S3 (symbol string, price float);\n"
+               "from e1=S1 -> e2=S2 or e3=S3 "
+               "select e1.symbol as s, e2.symbol as s2 insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("A", 1.0)); rt.flush()
+        rt.get_input_handler("S3").send(("C", 3.0)); rt.flush()
+        assert got == [("A", None)]  # e2 leg missing -> null string
+
+
+class TestCount:
+    def test_exact_count(self):
+        app = ("define stream S (k string, v int);\n"
+               "from every e1=S[v > 0]<2> -> e2=S[v == 9] "
+               "select e1[0].k as k0, e1[1].k as k1, e2.k as k2 "
+               "insert into OutStream;")
+        rt, got = make(app)
+        h = rt.get_input_handler("S")
+        for row in [("a", 1), ("b", 2), ("x", 9)]:
+            h.send(row); rt.flush()
+        assert ("a", "b", "x") in got
+
+
+class TestAbsent:
+    def test_absent_detected(self):
+        app = ("@app:playback\n" + TWO +
+               "from every e1=S1 -> not S2 for 1 sec "
+               "select e1.price as p1 insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("A", 5.0), timestamp=1000)
+        rt.flush()
+        rt.heartbeat(now=2500)  # 1.5s later, no S2: absence fires
+        assert got == [(5.0,)]
+
+    def test_absent_killed_by_event(self):
+        app = ("@app:playback\n" + TWO +
+               "from every e1=S1 -> not S2 for 1 sec "
+               "select e1.price as p1 insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("A", 5.0), timestamp=1000)
+        rt.flush()
+        rt.get_input_handler("S2").send(("B", 9.0), timestamp=1500)
+        rt.flush()
+        rt.heartbeat(now=2500)
+        assert got == []
+
+
+class TestSequence:
+    def test_strict_sequence_match(self):
+        app = ("define stream S (k string, v int);\n"
+               "from every e1=S[v == 1], e2=S[v == 2] "
+               "select e1.k as k1, e2.k as k2 insert into OutStream;")
+        rt, got = make(app)
+        h = rt.get_input_handler("S")
+        h.send(("a", 1)); rt.flush()
+        h.send(("b", 2)); rt.flush()
+        assert got == [("a", "b")]
+
+    def test_strict_sequence_broken(self):
+        app = ("define stream S (k string, v int);\n"
+               "from every e1=S[v == 1], e2=S[v == 2] "
+               "select e1.k as k1, e2.k as k2 insert into OutStream;")
+        rt, got = make(app)
+        h = rt.get_input_handler("S")
+        h.send(("a", 1)); rt.flush()
+        h.send(("x", 7)); rt.flush()  # intervening event kills the partial
+        h.send(("b", 2)); rt.flush()
+        assert got == []
