@@ -221,6 +221,65 @@ class SiddhiAppRuntime:
                 seen.add(id(j))
                 j.heartbeat(t)
 
+    # ----------------------------------------------------- persist / restore
+
+    @property
+    def persistence_store(self):
+        return getattr(self, "_persistence_store", None)
+
+    @persistence_store.setter
+    def persistence_store(self, store) -> None:
+        self._persistence_store = store
+
+    def _snapshot_service(self):
+        from ..state.persistence import SnapshotService
+        if not hasattr(self, "_snap_service"):
+            self._snap_service = SnapshotService(self)
+        return self._snap_service
+
+    def snapshot(self) -> bytes:
+        """Full state snapshot as bytes (reference:
+        SiddhiAppRuntimeImpl.snapshot)."""
+        return self._snapshot_service().full_snapshot()
+
+    def restore(self, snapshot: bytes) -> None:
+        self._snapshot_service().restore(snapshot)
+
+    def persist(self) -> str:
+        """Snapshot to the configured PersistenceStore; returns the revision
+        (reference: SiddhiAppRuntimeImpl.persist:686)."""
+        from ..errors import NoPersistenceStoreError
+        store = self.persistence_store
+        if store is None:
+            raise NoPersistenceStoreError(
+                "no persistence store configured "
+                "(set manager.persistence_store)")
+        import time as _time
+        revision = f"{int(_time.time() * 1000)}_{self.app.name}"
+        store.save(self.app.name, revision, self.snapshot())
+        return revision
+
+    def restore_revision(self, revision: str) -> None:
+        from ..errors import CannotRestoreStateError, NoPersistenceStoreError
+        store = self.persistence_store
+        if store is None:
+            raise NoPersistenceStoreError("no persistence store configured")
+        blob = store.load(self.app.name, revision)
+        if blob is None:
+            raise CannotRestoreStateError(f"revision {revision!r} not found")
+        self.restore(blob)
+
+    def restore_last_revision(self) -> Optional[str]:
+        """Reference: SiddhiAppRuntimeImpl.restoreLastRevision."""
+        store = self.persistence_store
+        if store is None:
+            from ..errors import NoPersistenceStoreError
+            raise NoPersistenceStoreError("no persistence store configured")
+        rev = store.get_last_revision(self.app.name)
+        if rev is not None:
+            self.restore_revision(rev)
+        return rev
+
     # -------------------------------------------------------------- statistics
 
     @property

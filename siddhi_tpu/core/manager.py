@@ -21,6 +21,9 @@ class SiddhiManager:
         self.registry = GLOBAL.copy()
         self.runtimes: dict[str, SiddhiAppRuntime] = {}
         self._env_overrides: dict[str, str] = {}
+        #: shared store for all apps (reference:
+        #: SiddhiManager.setPersistenceStore)
+        self.persistence_store = None
 
     def create_siddhi_app_runtime(
         self, app: Union[str, SiddhiApp], *,
@@ -31,8 +34,24 @@ class SiddhiManager:
             app = compiler.parse(text)
         rt = SiddhiAppRuntime(app, self.registry, batch_size=batch_size,
                               group_capacity=group_capacity)
+        if self.persistence_store is not None:
+            rt.persistence_store = self.persistence_store
         self.runtimes[app.name] = rt
         return rt
+
+    def set_persistence_store(self, store) -> None:
+        """Reference: SiddhiManager.setPersistenceStore — shared by all apps."""
+        self.persistence_store = store
+        for rt in self.runtimes.values():
+            rt.persistence_store = store
+
+    def persist(self) -> dict:
+        """Persist every running app (reference: SiddhiManager.persist:291)."""
+        return {name: rt.persist() for name, rt in self.runtimes.items()}
+
+    def restore_last_state(self) -> None:
+        for rt in self.runtimes.values():
+            rt.restore_last_revision()
 
     def get_siddhi_app_runtime(self, name: str) -> Optional[SiddhiAppRuntime]:
         return self.runtimes.get(name)
