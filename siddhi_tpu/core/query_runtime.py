@@ -61,10 +61,13 @@ class FunctionQueryCallback(QueryCallback):
 
 
 def eval_constant(expr: Expression):
-    """Evaluate a compile-time-constant expression parameter (window sizes,
-    time periods...)."""
+    """Evaluate a compile-time-constant window/extension parameter (sizes,
+    periods). Variables pass through as AST nodes — some windows take
+    attribute references (externalTime's tsAttr, sort keys)."""
     if isinstance(expr, Constant):
         return expr.value
+    if isinstance(expr, Variable):
+        return expr
     raise SiddhiAppCreationError(f"expected a constant parameter, got {expr!r}")
 
 
@@ -177,13 +180,21 @@ class QueryRuntime(Receiver):
             attributes=self.output_attributes)
         self.output_codec = self._build_output_codec()
 
+        # --- output rate limiter ---
+        from ..ops.ratelimit import make_rate_limiter
+        out_layout = {n: dtypes.device_dtype(t)
+                      for n, t in self.selector.out_types.items()}
+        self.rate_limiter = make_rate_limiter(
+            query.output_rate, out_layout, self.window.chunk_width)
+
         # --- the jitted step ---
         self._step = jax.jit(self._make_step(), donate_argnums=(0,))
         self.state = self._init_state()
         #: time-driven windows need heartbeats to flush expirations
         self.has_time_semantics = (
             getattr(self.window, "time_ms", None) is not None
-            or type(self.window).__name__ == "TimeBatchWindow")
+            or type(self.window).__name__ == "TimeBatchWindow"
+            or self.rate_limiter.has_time_semantics)
 
     # ----------------------------------------------------------------- plan
 
@@ -193,7 +204,8 @@ class QueryRuntime(Receiver):
         return StreamCodec(self.output_definition, self.ctx.global_strings)
 
     def _init_state(self):
-        return (self.window.init_state(), self.selector.init_state())
+        return (self.window.init_state(), self.selector.init_state(),
+                self.rate_limiter.init_state())
 
     def _make_step(self):
         filters = self.filters
@@ -204,8 +216,10 @@ class QueryRuntime(Receiver):
         dep_tables = self.dep_tables
         probes = {tid: self.tables[tid].contains_probe for tid in dep_tables}
 
+        limiter = self.rate_limiter
+
         def step(state, batch: EventBatch, now, table_states=None):
-            wstate, sstate = state
+            wstate, sstate, rstate = state
 
             scope = Scope()
             scope.add_frame(frame_ref, batch.cols, batch.ts, batch.valid, default=True)
@@ -228,8 +242,9 @@ class QueryRuntime(Receiver):
                 chunk = chunk.where_valid(
                     f(cscope) | (chunk.types != EventType.CURRENT))
             sstate, out = selector.step(sstate, chunk, cscope)
+            rstate, out = limiter.step(rstate, out, now)
 
-            return (wstate, sstate), out
+            return (wstate, sstate, rstate), out
 
         return step
 

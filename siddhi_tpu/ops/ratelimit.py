@@ -1,0 +1,213 @@
+"""Output rate limiters (reference: core/query/output/ratelimit/ —
+OutputRateLimiter.java:43; event/ First/Last/All-PerEvent, time/ scheduler
+driven variants; `output [first|last|all] every N events / T sec`).
+
+Device redesign: a rate limiter is a pure `(state, out_batch, now) ->
+(state, emit_batch)` transform appended to the query's jitted step.
+
+- events-N first : emit lanes whose output ordinal % N == 0
+- events-N last  : emit lanes whose (ordinal+1) % N == 0
+- events-N all   : buffer into an [N] ring; emit complete groups only
+- time-T first   : emit the first lane of each T-bucket (immediate)
+- time-T last    : hold the latest lane per bucket; emit at bucket close
+                   (watermark/heartbeat driven, like the reference Scheduler)
+- time-T all     : buffer lanes; emit them all at bucket close
+
+Only CURRENT lanes are rate-limited; EXPIRED lanes pass with their CURRENT
+counterparts (the reference sends whole chunks per emission)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.event import EventBatch, EventType
+from ..errors import SiddhiAppCreationError
+from ..query_api.execution import OutputRate, OutputRateType
+
+
+class CounterState(NamedTuple):
+    count: jax.Array  # int64 emitted-ordinal counter
+
+
+class BufferState(NamedTuple):
+    ring: EventBatch  # [C] buffered lanes
+    appended: jax.Array  # int64
+    flushed: jax.Array  # int64
+    bucket: jax.Array  # int64 current time bucket (time mode)
+
+
+class RateLimiterOp:
+    has_time_semantics = False
+
+    def init_state(self):
+        raise NotImplementedError
+
+    def step(self, state, out: EventBatch, now):
+        raise NotImplementedError
+
+
+class PassThroughLimiter(RateLimiterOp):
+    def init_state(self):
+        return ()
+
+    def step(self, state, out, now):
+        return state, out
+
+
+class EventOrdinalLimiter(RateLimiterOp):
+    """first/last every N events: a pure mask on the output ordinal."""
+
+    def __init__(self, n: int, which: str):
+        self.n = n
+        self.which = which
+
+    def init_state(self):
+        return CounterState(jnp.int64(0))
+
+    def step(self, state, out: EventBatch, now):
+        live = out.valid & (out.types == EventType.CURRENT)
+        rank = jnp.cumsum(live.astype(jnp.int64)) - 1
+        ordinal = state.count + rank
+        N = jnp.int64(self.n)
+        if self.which == "first":
+            keep = live & (ordinal % N == 0)
+        else:
+            keep = live & ((ordinal + 1) % N == 0)
+        new_count = state.count + jnp.sum(live.astype(jnp.int64))
+        return CounterState(new_count), dataclasses.replace(
+            out, valid=out.valid & keep)
+
+
+class BufferedLimiter(RateLimiterOp):
+    """all-every-N-events and the time-driven variants: buffer lanes in a ring
+    and release them at group/bucket boundaries."""
+
+    def __init__(self, layout: dict, out_width: int, *,
+                 n_events: Optional[int] = None,
+                 time_ms: Optional[int] = None,
+                 which: str = "all"):
+        self.layout = layout
+        self.B = out_width
+        self.n_events = n_events
+        self.time_ms = time_ms
+        self.which = which
+        self.has_time_semantics = time_ms is not None
+        self.C = max(2 * out_width, (n_events or 1) * 2, 1024)
+
+    def init_state(self):
+        ring = EventBatch(
+            ts=jnp.zeros((self.C,), dtypes.TS_DTYPE),
+            cols={k: jnp.zeros((self.C,), dt) for k, dt in self.layout.items()},
+            valid=jnp.zeros((self.C,), bool),
+            types=jnp.zeros((self.C,), jnp.int8),
+        )
+        return BufferState(ring, jnp.int64(0), jnp.int64(0), jnp.int64(0))
+
+    def step(self, state: BufferState, out: EventBatch, now):
+        C = self.C
+        live = out.valid & (out.types == EventType.CURRENT)
+        order = jnp.argsort(~live, stable=True)
+        n_new = jnp.sum(live.astype(jnp.int64))
+        B = out.ts.shape[0]
+        p = jnp.arange(B, dtype=jnp.int64)
+        slot = jnp.where(p < n_new, (state.appended + p) % C, C)
+        ring = EventBatch(
+            ts=state.ring.ts.at[slot].set(out.ts[order], mode="drop"),
+            cols={k: state.ring.cols[k].at[slot].set(out.cols[k][order],
+                                                     mode="drop")
+                  for k in self.layout},
+            valid=state.ring.valid.at[slot].set(live[order], mode="drop"),
+            types=state.ring.types.at[slot].set(out.types[order], mode="drop"),
+        )
+        appended = state.appended + n_new
+
+        if self.time_ms is not None:
+            T = jnp.int64(self.time_ms)
+            cur_bucket = now // T
+            closing = cur_bucket > state.bucket
+            if self.which == "last":
+                # emit only the latest buffered lane when the bucket closes
+                flush_to = jnp.where(closing, appended, state.flushed)
+                emit_from = jnp.maximum(state.flushed, flush_to - 1)
+            else:
+                flush_to = jnp.where(closing, appended, state.flushed)
+                emit_from = state.flushed
+            new_bucket = jnp.maximum(state.bucket, cur_bucket)
+        else:
+            N = jnp.int64(self.n_events)
+            flush_to = (appended // N) * N
+            emit_from = state.flushed
+            new_bucket = state.bucket
+
+        # gather [emit_from, flush_to) into an output block of width C
+        o = emit_from + jnp.arange(C, dtype=jnp.int64)
+        sel = o < flush_to
+        oslot = jnp.clip(o, 0, None) % C
+        emitted = EventBatch(
+            ts=ring.ts[oslot],
+            cols={k: ring.cols[k][oslot] for k in self.layout},
+            valid=sel & ring.valid[oslot],
+            types=ring.types[oslot],
+        )
+        new_state = BufferState(ring, appended, flush_to, new_bucket)
+        return new_state, emitted
+
+
+class TimeFirstLimiter(RateLimiterOp):
+    """first every T: the first output lane of each bucket passes immediately."""
+
+    has_time_semantics = False  # emission is arrival-driven
+
+    def __init__(self, time_ms: int):
+        self.T = time_ms
+
+    def init_state(self):
+        return CounterState(jnp.int64(-1))  # last emitted bucket
+
+    def step(self, state, out: EventBatch, now):
+        T = jnp.int64(self.T)
+        live = out.valid & (out.types == EventType.CURRENT)
+        bucket = out.ts // T
+        # first live lane in a bucket newer than the last emitted one
+        newer = live & (bucket > state.count)
+        # first `newer` lane per bucket: sort by (bucket, lane) and mark run
+        # starts (O(B log B), no [B,B] mask)
+        L = out.ts.shape[0]
+        key = jnp.where(newer, bucket, jnp.int64(2**62))
+        order = jnp.argsort(key, stable=True)
+        sk = key[order]
+        first = jnp.concatenate([jnp.ones((1,), bool), sk[1:] != sk[:-1]])
+        keep_sorted = first & (sk != jnp.int64(2**62))
+        keep = jnp.zeros((L,), bool).at[order].set(keep_sorted)
+        top = jnp.max(jnp.where(keep, bucket, jnp.int64(-1)))
+        new_last = jnp.maximum(state.count, top)
+        return CounterState(new_last), dataclasses.replace(
+            out, valid=out.valid & keep)
+
+
+def make_rate_limiter(rate: Optional[OutputRate], layout: dict,
+                      out_width: int) -> RateLimiterOp:
+    if rate is None:
+        return PassThroughLimiter()
+    if rate.type == OutputRateType.SNAPSHOT:
+        raise SiddhiAppCreationError(
+            "`output snapshot every ...` is not yet supported")
+    if rate.event_count is not None:
+        n = rate.event_count
+        kind = rate.type.value  # all | first | last
+        if kind == "first":
+            return EventOrdinalLimiter(n, "first")
+        if kind == "last":
+            return EventOrdinalLimiter(n, "last")
+        return BufferedLimiter(layout, out_width, n_events=n)
+    # time-driven
+    t = rate.time_ms
+    kind = rate.type.value
+    if kind == "first":
+        return TimeFirstLimiter(t)
+    return BufferedLimiter(layout, out_width, time_ms=t, which=kind)

@@ -1,0 +1,76 @@
+"""Stream-function SPI — N-in/M-out batch transforms in FROM chains.
+
+Reference: core/query/processor/stream/StreamFunctionProcessor.java (extension
+SPI appending computed attributes to each event; e.g.
+Pol2CartStreamFunctionProcessor, LogStreamProcessor). TPU form: a stream
+function maps whole columnar batches — `fn(arg_arrays...) -> dict[new_attr ->
+array]` traced inside the query's jitted step, appending columns to the frame.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+
+from ..errors import SiddhiAppCreationError
+from ..extension.registry import GLOBAL, ExtensionKind
+from ..query_api.definition import AttributeType
+
+
+@dataclass
+class StreamFunctionSpec:
+    """Compiled stream function: `apply(*arg_cols) -> {name: col}`;
+    `new_attrs` extends the stream schema."""
+
+    apply: Callable
+    new_attrs: list  # [(name, AttributeType)]
+
+
+@dataclass
+class StreamFunctionFactory:
+    """SPI: make(arg_types: tuple[AttributeType]) -> StreamFunctionSpec."""
+
+    make: Callable
+
+
+def _make_pol2cart(arg_types):
+    """pol2Cart(theta, rho [, z]) -> x, y [, z] (reference:
+    Pol2CartStreamFunctionProcessor.java)."""
+    if len(arg_types) < 2:
+        raise SiddhiAppCreationError("pol2Cart needs (theta, rho)")
+
+    def apply(theta, rho, *z):
+        x = rho * jnp.cos(jnp.deg2rad(theta))
+        y = rho * jnp.sin(jnp.deg2rad(theta))
+        out = {"x": x, "y": y}
+        if z:
+            out["z"] = z[0]
+        return out
+
+    new = [("x", AttributeType.DOUBLE), ("y", AttributeType.DOUBLE)]
+    if len(arg_types) > 2:
+        new.append(("z", AttributeType.DOUBLE))
+    return StreamFunctionSpec(apply, new)
+
+
+def _make_log(arg_types):
+    """log(...) — the reference's LogStreamProcessor prints events; device
+    batches cannot print per event, so this is a pass-through marker (host
+    logging happens at callbacks)."""
+
+    def apply(*args):
+        return {}
+
+    return StreamFunctionSpec(apply, [])
+
+
+def register_all() -> None:
+    GLOBAL.register(ExtensionKind.STREAM_FUNCTION, "", "pol2Cart",
+                    StreamFunctionFactory(_make_pol2cart))
+    GLOBAL.register(ExtensionKind.STREAM_FUNCTION, "", "log",
+                    StreamFunctionFactory(_make_log))
+
+
+register_all()

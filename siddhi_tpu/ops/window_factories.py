@@ -13,7 +13,9 @@ from ..extension.registry import GLOBAL, ExtensionKind
 from .windows import (
     LengthBatchWindow,
     PassThroughWindow,
+    SessionWindow,
     SlidingWindow,
+    SortWindow,
     TimeBatchWindow,
     WindowOp,
 )
@@ -66,6 +68,56 @@ def _make_delay(layout, batch_cap, params, expired_on):
     return SlidingWindow(layout, batch_cap, time_ms=w, is_delay=True)
 
 
+def _make_external_time(layout, batch_cap, params, expired_on):
+    # externalTime(tsAttr, W) — first param is a Variable (attr ref)
+    from ..query_api.expression import Variable
+    if len(params) < 2 or not isinstance(params[0], Variable):
+        raise SiddhiAppCreationError(
+            "externalTime needs (timestampAttr, window.time)")
+    w = params[1]
+    return SlidingWindow(layout, batch_cap, time_ms=w,
+                         ts_attr=params[0].attribute)
+
+
+def _make_external_time_batch(layout, batch_cap, params, expired_on):
+    from ..query_api.expression import Variable
+    if len(params) < 2 or not isinstance(params[0], Variable):
+        raise SiddhiAppCreationError(
+            "externalTimeBatch needs (timestampAttr, window.time [, startTime])")
+    w = params[1]
+    start = params[2] if len(params) > 2 else None
+    return TimeBatchWindow(layout, batch_cap, w, expired_on=expired_on,
+                           start_time=start, ts_attr=params[0].attribute)
+
+
+def _make_session(layout, batch_cap, params, expired_on):
+    gap = _int_param(params, 0, "session")
+    if len(params) > 1:
+        raise SiddhiAppCreationError(
+            "keyed sessions (session(gap, key)) are not yet supported")
+    return SessionWindow(layout, batch_cap, gap)
+
+
+def _make_sort(layout, batch_cap, params, expired_on):
+    from ..query_api.expression import Variable
+    n = _int_param(params, 0, "sort")
+    keys = []
+    i = 1
+    while i < len(params):
+        v = params[i]
+        if not isinstance(v, Variable):
+            raise SiddhiAppCreationError("sort() keys must be attributes")
+        order = 1
+        if i + 1 < len(params) and isinstance(params[i + 1], str):
+            order = -1 if params[i + 1].lower() == "desc" else 1
+            i += 1
+        keys.append((v.attribute, order))
+        i += 1
+    if not keys:
+        raise SiddhiAppCreationError("sort() needs at least one key attribute")
+    return SortWindow(layout, batch_cap, n, keys)
+
+
 def register_all() -> None:
     reg = lambda name, make: GLOBAL.register(  # noqa: E731
         ExtensionKind.WINDOW, "", name, WindowFactory(make))
@@ -77,6 +129,10 @@ def register_all() -> None:
     reg("delay", _make_delay)
     reg("batch", lambda l, b, p, e: PassThroughWindow(l, b) if not p
         else LengthBatchWindow(l, b, p[0], expired_on=e))
+    reg("externalTime", _make_external_time)
+    reg("externalTimeBatch", _make_external_time_batch)
+    reg("session", _make_session)
+    reg("sort", _make_sort)
 
 
 register_all()

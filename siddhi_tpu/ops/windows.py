@@ -154,6 +154,7 @@ class SlidingState(NamedTuple):
     ring_ts: jax.Array
     appended: jax.Array  # int64 total valid arrivals ever
     expired: jax.Array  # int64 total expirations ever
+    wm: jax.Array  # int64 external-time watermark (externalTime mode only)
 
 
 class SlidingWindow(WindowOp):
@@ -172,12 +173,16 @@ class SlidingWindow(WindowOp):
                  time_ms: Optional[int] = None,
                  capacity: Optional[int] = None,
                  max_expired: Optional[int] = None,
-                 is_delay: bool = False):
+                 is_delay: bool = False,
+                 ts_attr: Optional[str] = None):
         self.layout = layout
         self.B = batch_cap
         self.length = length
         self.time_ms = time_ms
         self.is_delay = is_delay
+        #: externalTime(tsAttr, W): expiry driven by an event attribute clock
+        #: (reference: ExternalTimeWindowProcessor) instead of arrival time
+        self.ts_attr = ts_attr
         if length is not None and time_ms is None:
             self.C = max(length, 1)
         else:
@@ -192,11 +197,22 @@ class SlidingWindow(WindowOp):
             ring_ts=jnp.zeros((self.C,), dtypes.TS_DTYPE),
             appended=jnp.int64(0),
             expired=jnp.int64(0),
+            wm=jnp.int64(-(2**62)),
         )
 
     def step(self, state: SlidingState, batch: EventBatch, now: jax.Array):
         B, E, C = self.B, self.E, self.C
         comp_cols, comp_ts, n_valid, _ = compact(batch)
+
+        if self.ts_attr is not None:
+            # external clock: the time axis is an event attribute; the
+            # watermark advances to the max attribute value seen
+            comp_ts = comp_cols[self.ts_attr].astype(jnp.int64)
+            wm = jnp.maximum(state.wm, jnp.max(jnp.where(
+                jnp.arange(B) < n_valid, comp_ts, jnp.int64(-(2**62)))))
+            now = wm
+        else:
+            wm = state.wm
 
         appended1 = state.appended + n_valid
 
@@ -290,6 +306,7 @@ class SlidingWindow(WindowOp):
             ring_ts=new_ring_ts,
             appended=appended1,
             expired=state.expired + n_expired_new,
+            wm=wm,
         )
         return new_state, chunk
 
@@ -315,6 +332,7 @@ class BatchState(NamedTuple):
     prev_start: jax.Array  # int64 start overall idx of the previous flush
     epoch_base: jax.Array  # int64 ts base for time flushes (first-event ts)
     has_base: jax.Array  # bool
+    wm: jax.Array  # int64 external-time watermark (externalTimeBatch only)
 
 
 class LengthBatchWindow(WindowOp):
@@ -348,6 +366,7 @@ class LengthBatchWindow(WindowOp):
             prev_start=jnp.int64(-1),
             epoch_base=jnp.int64(0),
             has_base=jnp.bool_(False),
+            wm=jnp.int64(-(2**62)),
         )
 
     def step(self, state: BatchState, batch: EventBatch, now: jax.Array):
@@ -428,6 +447,7 @@ class LengthBatchWindow(WindowOp):
             prev_start=(f_now - 1) * Nl,
             epoch_base=state.epoch_base,
             has_base=state.has_base,
+            wm=state.wm,
         )
         return new_state, chunk
 
@@ -452,12 +472,16 @@ class TimeBatchWindow(WindowOp):
 
     def __init__(self, layout: dict, batch_cap: int, time_ms: int,
                  capacity: Optional[int] = None, expired_on: bool = True,
-                 start_time: Optional[int] = None):
+                 start_time: Optional[int] = None,
+                 ts_attr: Optional[str] = None):
         self.layout = layout
         self.B = batch_cap
         self.W = time_ms
         self.expired_on = expired_on
         self.start_time = start_time
+        #: externalTimeBatch(tsAttr, W): bucket clock from an event attribute
+        #: (reference: ExternalTimeBatchWindowProcessor)
+        self.ts_attr = ts_attr
         self.C = capacity or max(dtypes.config.default_window_capacity, 2 * batch_cap)
         self.E = max(batch_cap, 1024)  # max emitted current/expired lanes per step
         width = self.E + 1 + (self.E if expired_on else 0)
@@ -472,12 +496,20 @@ class TimeBatchWindow(WindowOp):
             prev_start=jnp.int64(0),
             epoch_base=jnp.int64(self.start_time if self.start_time is not None else 0),
             has_base=jnp.bool_(self.start_time is not None),
+            wm=jnp.int64(-(2**62)),
         )
 
     def step(self, state: BatchState, batch: EventBatch, now: jax.Array):
         B, E, C = self.B, self.E, self.C
         W = jnp.int64(self.W)
         comp_cols, comp_ts, n_valid, _ = compact(batch)
+        if self.ts_attr is not None:
+            comp_ts = comp_cols[self.ts_attr].astype(jnp.int64)
+            wm = jnp.maximum(state.wm, jnp.max(jnp.where(
+                jnp.arange(B) < n_valid, comp_ts, jnp.int64(-(2**62)))))
+            now = wm
+        else:
+            wm = state.wm
         appended1 = state.appended + n_valid
 
         # establish bucket base from the first-ever event
@@ -566,6 +598,7 @@ class TimeBatchWindow(WindowOp):
             prev_start=jnp.where(n_emitted > 0, state.flushed, state.prev_start),
             epoch_base=base,
             has_base=has_base,
+            wm=wm,
         )
         return new_state, chunk
 
@@ -599,3 +632,227 @@ class PassThroughWindow(WindowOp):
         join keeps a zero-length window — only the arriving event matches)."""
         cols = {k: jnp.zeros((1,), dtype=dt) for k, dt in self.layout.items()}
         return cols, jnp.zeros((1,), dtypes.TS_DTYPE), jnp.zeros((1,), bool)
+
+
+# --------------------------------------------------------------------------- #
+# session window
+# --------------------------------------------------------------------------- #
+
+
+class SessionState(NamedTuple):
+    ring_cols: dict
+    ring_ts: jax.Array
+    ring_session: jax.Array  # int64 session id per ring slot
+    appended: jax.Array
+    flushed: jax.Array
+    last_ts: jax.Array  # ts of latest arrival (gap detection)
+    session: jax.Array  # current session id
+    has_events: jax.Array  # bool
+
+
+class SessionWindow(WindowOp):
+    """session(gap): events pass through as CURRENT immediately; when a gap
+    larger than `gap` opens (next arrival or watermark), the closed session's
+    events are re-emitted as EXPIRED (reference: SessionWindowProcessor.java —
+    current chunk passes through:308, expired chunk of the previous session
+    prepended on rollover:303-307). Keyed sessions (`session(gap, key)`) are
+    not yet supported."""
+
+    def __init__(self, layout: dict, batch_cap: int, gap_ms: int,
+                 capacity: Optional[int] = None):
+        self.layout = layout
+        self.B = batch_cap
+        self.gap = gap_ms
+        self.C = capacity or max(dtypes.config.default_window_capacity,
+                                 2 * batch_cap)
+        self.E = max(batch_cap, 1024)
+        self.chunk_width = self.B + self.E
+
+    def init_state(self) -> SessionState:
+        return SessionState(
+            ring_cols=_empty_like_cols(self.layout, self.C),
+            ring_ts=jnp.zeros((self.C,), dtypes.TS_DTYPE),
+            ring_session=jnp.zeros((self.C,), jnp.int64),
+            appended=jnp.int64(0),
+            flushed=jnp.int64(0),
+            last_ts=jnp.int64(0),
+            session=jnp.int64(0),
+            has_events=jnp.bool_(False),
+        )
+
+    def step(self, state: SessionState, batch: EventBatch, now: jax.Array):
+        B, E, C = self.B, self.E, self.C
+        gap = jnp.int64(self.gap)
+        comp_cols, comp_ts, n_valid, _ = compact(batch)
+        p = jnp.arange(B, dtype=jnp.int64)
+        is_arr = p < n_valid
+
+        # gap break before arrival i (vs previous arrival / state.last_ts)
+        prev_ts = jnp.concatenate([state.last_ts[None], comp_ts[:-1]])
+        brk = is_arr & state.has_events & (comp_ts - prev_ts > gap)
+        # the very first arrival ever starts session 0 without a break
+        brk = brk & ~((p == 0) & ~state.has_events)
+        arr_session = state.session + jnp.cumsum(brk.astype(jnp.int64))
+        session_after = jnp.where(n_valid > 0, arr_session[jnp.clip(n_valid - 1, 0, B - 1)],
+                                  state.session)
+        # watermark close: gap elapsed since the last event of the batch
+        new_last = jnp.where(n_valid > 0, comp_ts[jnp.clip(n_valid - 1, 0, B - 1)],
+                             state.last_ts)
+        wm_close = state.has_events | (n_valid > 0)
+        wm_close = wm_close & (now - new_last > gap)
+        session_open = jnp.where(wm_close, session_after + 1, session_after)
+
+        # ---- currents pass through ----
+        keys_cur = p * 4 + KIND_CURRENT
+        # ---- expired: ring events whose session < session_open ----
+        o = state.flushed + jnp.arange(E, dtype=jnp.int64)
+        in_ring = o < state.appended
+        slot = jnp.clip(o, 0, None) % C
+        ring_sess = state.ring_session[slot]
+        exp_ring = in_ring & (ring_sess < session_open)
+        # batch arrivals whose session closed within this same step
+        exp_arr = is_arr & (arr_session < session_open)
+        # trigger position: first arrival of a later session (or end of batch)
+        arr_sess_padded = jnp.where(is_arr, arr_session, BIG)
+        trig_ring = jnp.searchsorted(arr_sess_padded, ring_sess + 1,
+                                     side="left").astype(jnp.int64)
+        trig_arr = jnp.searchsorted(arr_sess_padded, arr_session + 1,
+                                    side="left").astype(jnp.int64)
+        keys_exp_ring = jnp.clip(trig_ring, 0, jnp.int64(B)) * 4 + KIND_EXPIRED
+        keys_exp_arr = jnp.clip(trig_arr, 0, jnp.int64(B)) * 4 + KIND_EXPIRED
+
+        all_keys = jnp.concatenate([keys_exp_ring, keys_exp_arr, keys_cur])
+        all_cols = {k: jnp.concatenate([state.ring_cols[k][slot], comp_cols[k],
+                                        comp_cols[k]])
+                    for k in self.layout}
+        all_ts = jnp.concatenate([state.ring_ts[slot], comp_ts, comp_ts])
+        all_valid = jnp.concatenate([exp_ring, exp_arr, is_arr])
+        all_types = jnp.concatenate([
+            jnp.full((E,), EventType.EXPIRED, jnp.int8),
+            jnp.full((B,), EventType.EXPIRED, jnp.int8),
+            jnp.full((B,), EventType.CURRENT, jnp.int8),
+        ])
+        chunk = _sort_chunk(all_keys, all_cols, all_ts, all_valid, all_types,
+                            self.chunk_width)
+
+        # ---- ring update: append arrivals; account flushed ----
+        new_cols, new_ts = _scatter_append(
+            state.ring_cols, state.ring_ts, comp_cols, comp_ts,
+            state.appended, n_valid)
+        wslot = jnp.where(is_arr, (state.appended + p) % C, C)
+        new_sess = state.ring_session.at[wslot].set(arr_session, mode="drop")
+        n_flushed_ring = jnp.sum(exp_ring.astype(jnp.int64))
+        n_flushed_arr = jnp.sum(exp_arr.astype(jnp.int64))
+        new_state = SessionState(
+            ring_cols=new_cols, ring_ts=new_ts, ring_session=new_sess,
+            appended=state.appended + n_valid,
+            flushed=state.flushed + n_flushed_ring + n_flushed_arr,
+            last_ts=new_last,
+            session=session_open,
+            has_events=state.has_events | (n_valid > 0),
+        )
+        return new_state, chunk
+
+    def contents(self, state: SessionState, now: jax.Array):
+        live = _ring_live_mask(self.C, state.flushed, state.appended)
+        return state.ring_cols, state.ring_ts, live
+
+
+# --------------------------------------------------------------------------- #
+# sort window
+# --------------------------------------------------------------------------- #
+
+
+class SortState(NamedTuple):
+    cols: dict
+    ts: jax.Array
+    seq: jax.Array  # int64 arrival order (stable tiebreak)
+    valid: jax.Array
+    count: jax.Array  # int64 arrivals ever
+
+
+class SortWindow(WindowOp):
+    """sort(N, attr [,'asc'|'desc'], ...): keeps the N best events by sort
+    key; each arrival emits [current, evicted-worst as EXPIRED] (reference:
+    SortWindowProcessor.java:151-181). Batch form: merge buffer+batch, keep
+    the N best; evicted set matches the reference's per-event processing
+    (the kept set after any arrival order is the N best)."""
+
+    def __init__(self, layout: dict, batch_cap: int, n: int,
+                 sort_keys: list):  # [(attr, +1|-1)]
+        self.layout = layout
+        self.B = batch_cap
+        self.N = n
+        self.sort_keys = sort_keys
+        self.chunk_width = batch_cap + batch_cap + n  # currents + evictable
+        self.M = self.N + self.B  # merge width
+
+    def init_state(self) -> SortState:
+        N = self.N
+        return SortState(
+            cols=_empty_like_cols(self.layout, N),
+            ts=jnp.zeros((N,), dtypes.TS_DTYPE),
+            seq=jnp.zeros((N,), jnp.int64),
+            valid=jnp.zeros((N,), bool),
+            count=jnp.int64(0),
+        )
+
+    def _rank_key(self, cols: dict, valid: jax.Array):
+        """Composite sort rank via successive stable argsorts (last key first);
+        invalid lanes sort last."""
+        M = valid.shape[0]
+        perm = jnp.arange(M)
+        for attr, order in reversed(self.sort_keys):
+            k = cols[attr][perm].astype(jnp.float64)
+            k = jnp.where(order < 0, -k, k)
+            perm = perm[jnp.argsort(k, stable=True)]
+        k = jnp.where(valid[perm], 0, 1)
+        perm = perm[jnp.argsort(k, stable=True)]
+        return perm  # positions in best-to-worst order
+
+    def step(self, state: SortState, batch: EventBatch, now: jax.Array):
+        B, N = self.B, self.N
+        comp_cols, comp_ts, n_valid, _ = compact(batch)
+        p = jnp.arange(B, dtype=jnp.int64)
+        is_arr = p < n_valid
+
+        m_cols = {k: jnp.concatenate([state.cols[k], comp_cols[k]])
+                  for k in self.layout}
+        m_ts = jnp.concatenate([state.ts, comp_ts])
+        m_seq = jnp.concatenate([state.seq, state.count + p])
+        m_valid = jnp.concatenate([state.valid, is_arr])
+
+        perm = self._rank_key(m_cols, m_valid)
+        keep_rank = jnp.argsort(perm)  # rank of each lane
+        kept = m_valid & (keep_rank < N)
+        evicted = m_valid & ~kept
+
+        # chunk: currents (arrival order) then evicted as EXPIRED
+        keys_cur = p * 4 + KIND_CURRENT
+        M = self.N + B
+        keys_ev = jnp.full((M,), jnp.int64(B) * 4 + KIND_EXPIRED)
+        all_keys = jnp.concatenate([keys_cur, keys_ev])
+        all_cols = {k: jnp.concatenate([comp_cols[k], m_cols[k]])
+                    for k in self.layout}
+        all_ts = jnp.concatenate([comp_ts, jnp.full((M,), 0, dtypes.TS_DTYPE) + now])
+        all_valid = jnp.concatenate([is_arr, evicted])
+        all_types = jnp.concatenate([
+            jnp.full((B,), EventType.CURRENT, jnp.int8),
+            jnp.full((M,), EventType.EXPIRED, jnp.int8),
+        ])
+        chunk = _sort_chunk(all_keys, all_cols, all_ts, all_valid, all_types,
+                            self.chunk_width)
+
+        # new buffer: the N best lanes
+        sel = perm[:N]
+        new_state = SortState(
+            cols={k: m_cols[k][sel] for k in self.layout},
+            ts=m_ts[sel],
+            seq=m_seq[sel],
+            valid=m_valid[sel],
+            count=state.count + n_valid,
+        )
+        return new_state, chunk
+
+    def contents(self, state: SortState, now: jax.Array):
+        return state.cols, state.ts, state.valid
