@@ -53,6 +53,7 @@ class SiddhiAppRuntime:
         self.input_handlers: dict[str, InputHandler] = {}
         self.query_runtimes: dict[str, QueryRuntime] = {}
         self.tables: dict = {}
+        self.windows: dict = {}
         self._started = False
 
         self._build()
@@ -68,6 +69,10 @@ class SiddhiAppRuntime:
         from .table import InMemoryTable
         for td in app.table_definitions.values():
             self.tables[td.id] = InMemoryTable(td, ctx)
+
+        from .window import NamedWindow
+        for wd in app.window_definitions.values():
+            self.windows[wd.id] = NamedWindow(wd, ctx, self.ctx.registry)
 
         for i, query in enumerate(app.queries):
             self._add_query(query, f"query{i + 1}")
@@ -87,6 +92,14 @@ class SiddhiAppRuntime:
         elif isinstance(query.input_stream, SingleInputStream):
             sid = query.input_stream.stream_id
             junction = self.junctions.get(sid)
+            if junction is None and sid in self.windows:
+                # `from W ...` consumes the named window's emissions
+                # (reference: WindowWindowProcessor via core/window/Window.java)
+                if query.input_stream.handlers.window is not None:
+                    raise SiddhiAppCreationError(
+                        f"named window {sid!r} cannot take a further window "
+                        "in FROM (a window cannot be windowed)")
+                junction = self.windows[sid].output_junction
             if junction is None:
                 raise DefinitionNotExistError(f"stream {sid!r} is not defined")
             qr = QueryRuntime(query, self.ctx, junction, self.ctx.registry,
@@ -102,7 +115,7 @@ class SiddhiAppRuntime:
     def _add_join_query(self, query: Query, name: str):
         from .join_runtime import JoinQueryRuntime, _JoinSideReceiver
         qr = JoinQueryRuntime(query, self.ctx, self.junctions, self.tables,
-                              self.ctx.registry, name)
+                              self.ctx.registry, name, windows=self.windows)
         if not qr.left.is_table:
             qr.left.junction.subscribe(_JoinSideReceiver(qr, True))
         if not qr.right.is_table:
@@ -122,6 +135,11 @@ class SiddhiAppRuntime:
         if out.action == OutputAction.INSERT and out.target_id:
             if out.target_id in self.tables:
                 qr.output_junction = _TableJunctionAdapter(self.tables[out.target_id])
+            elif out.target_id in self.windows:
+                from .window import WindowJunctionAdapter
+                qr.output_junction = WindowJunctionAdapter(
+                    self.windows[out.target_id],
+                    out_types=qr.selector.out_types)
             else:
                 target = self.junctions.get(out.target_id)
                 if target is None:
@@ -190,6 +208,8 @@ class SiddhiAppRuntime:
             odq = compiler.parse_on_demand_query(on_demand_text)
             store = self.tables.get(odq.input_store_id)
             if store is None:
+                store = self.windows.get(odq.input_store_id)
+            if store is None:
                 raise DefinitionNotExistError(
                     f"store {odq.input_store_id!r} is not defined")
             rt = OnDemandQueryRuntime(odq, store, self.ctx, self.ctx.registry)
@@ -209,6 +229,9 @@ class SiddhiAppRuntime:
         with time-driven windows (the reference Scheduler's TIMER events)."""
         t = now if now is not None else self.ctx.timestamp_generator.current_time()
         self.flush(t)
+        for w in self.windows.values():
+            if w.has_time_semantics:
+                w.heartbeat(t)
         seen: set[int] = set()
         for qr in self.query_runtimes.values():
             if not qr.has_time_semantics:

@@ -45,13 +45,17 @@ from .stream import Receiver, StreamJunction
 
 
 class _Side:
-    """One join side: a stream (junction + window) or a table."""
+    """One join side: a stream (junction + window), a table, or a named
+    window (probed via its shared contents; its emissions also trigger)."""
 
-    def __init__(self, ins: SingleInputStream, ctx, registry, junctions, tables):
+    def __init__(self, ins: SingleInputStream, ctx, registry, junctions, tables,
+                 windows=None):
         self.ref = ins.reference_id  # alias or stream id
         self.stream_id = ins.stream_id
         self.is_table = ins.stream_id in tables
         self.table = tables.get(ins.stream_id)
+        self.named_window = (windows or {}).get(ins.stream_id)
+        self.is_named_window = self.named_window is not None and not self.is_table
         self.junction: Optional[StreamJunction] = None
         self.window: Optional[WindowOp] = None
         self.filters = []
@@ -60,6 +64,14 @@ class _Side:
                 raise SiddhiAppCreationError("tables cannot take windows in joins")
             self.attr_types = dict(self.table.attr_types)
             self.codec = self.table.codec
+        elif self.is_named_window:
+            if ins.handlers.window is not None:
+                raise SiddhiAppCreationError(
+                    "named windows cannot take further windows in joins")
+            self.attr_types = dict(self.named_window.attr_types)
+            self.codec = self.named_window.codec
+            # the window's emission stream triggers this side
+            self.junction = self.named_window.output_junction
         else:
             self.junction = junctions.get(ins.stream_id)
             if self.junction is None:
@@ -85,7 +97,7 @@ class _Side:
 class JoinQueryRuntime:
     def __init__(self, query: Query, ctx: SiddhiAppContext,
                  junctions: dict, tables: dict, registry: Registry,
-                 name: str) -> None:
+                 name: str, windows: Optional[dict] = None) -> None:
         assert isinstance(query.input_stream, JoinInputStream)
         jis: JoinInputStream = query.input_stream
         self.query = query
@@ -97,8 +109,8 @@ class JoinQueryRuntime:
         self.table_executor = None
         self.k_max = dtypes.config.join_max_matches
 
-        self.left = _Side(jis.left, ctx, registry, junctions, tables)
-        self.right = _Side(jis.right, ctx, registry, junctions, tables)
+        self.left = _Side(jis.left, ctx, registry, junctions, tables, windows)
+        self.right = _Side(jis.right, ctx, registry, junctions, tables, windows)
         if self.left.is_table and self.right.is_table:
             raise SiddhiAppCreationError("cannot join two tables in a stream query")
         if self.left.ref == self.right.ref:
@@ -141,9 +153,12 @@ class JoinQueryRuntime:
             attributes=self.output_attributes)
         self.output_codec = StreamCodec(self.output_definition, ctx.global_strings)
 
+        def _side_state(s):
+            return () if (s.is_table or s.is_named_window) else s.window.init_state()
+
         self.state = (
-            self.left.window.init_state() if not self.left.is_table else (),
-            self.right.window.init_state() if not self.right.is_table else (),
+            _side_state(self.left),
+            _side_state(self.right),
             self.selector.init_state(),
         )
         self._step_left = jax.jit(self._make_step(from_left=True),
@@ -185,12 +200,15 @@ class JoinQueryRuntime:
                              default=True)
             pscope.extras["now"] = now
             mask = batch.valid
+            if probe_side.is_named_window:
+                # window emissions carry CURRENT + EXPIRED; only arrivals probe
+                mask = mask & (batch.types == EventType.CURRENT)
             for f in filters:
                 mask = mask & f(pscope)
             batch = dataclasses.replace(batch, valid=mask)
             pscope.valids[probe_side.ref] = mask
 
-            if not probe_side.is_table:
+            if not probe_side.is_table and not probe_side.is_named_window:
                 w_probe, _chunk = probe_side.window.step(w_probe, batch, now)
 
             # --- build-side contents ---
@@ -198,8 +216,21 @@ class JoinQueryRuntime:
                 b_cols = build_tstate.cols
                 b_ts = build_tstate.ts
                 b_valid = build_tstate.valid
+            elif build_side.is_named_window:
+                b_cols, b_ts, b_valid = build_side.named_window.contents(
+                    build_tstate, now)
             else:
                 b_cols, b_ts, b_valid = build_side.window.contents(w_build, now)
+            if build_side.filters and (build_side.is_table
+                                       or build_side.is_named_window):
+                # stream sides are filtered before their ring append; probed
+                # contents (tables / named windows) are filtered here
+                bscope = Scope()
+                bscope.add_frame(build_side.ref, b_cols, b_ts, b_valid,
+                                 default=True)
+                bscope.extras["now"] = now
+                for f in build_side.filters:
+                    b_valid = b_valid & f(bscope)
 
             # --- candidate pairs ---
             if plan.probe_keys:
@@ -289,10 +320,15 @@ class JoinQueryRuntime:
                     or (self.trigger == EventTrigger.LEFT and from_left)
                     or (self.trigger == EventTrigger.RIGHT and not from_left))
         step = self._step_left if from_left else self._step_right
-        tstate = build.table.state if build.is_table else None
+        if build.is_table:
+            tstate = build.table.state
+        elif build.is_named_window:
+            tstate = build.named_window.state
+        else:
+            tstate = None
         if not triggers:
             # non-triggering side still feeds its window
-            if side.is_table:
+            if side.is_table or side.is_named_window:
                 return
             wl, wr, sel = self.state
             w = wl if from_left else wr

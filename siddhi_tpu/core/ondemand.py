@@ -26,12 +26,13 @@ from .table import InMemoryTable, TableState
 
 
 class OnDemandQueryRuntime:
-    """Compiled pull query over one table store."""
+    """Compiled pull query over one store (table or named window)."""
 
-    def __init__(self, odq: OnDemandQuery, table: InMemoryTable, ctx,
+    def __init__(self, odq: OnDemandQuery, table, ctx,
                  registry) -> None:
         self.odq = odq
         self.table = table
+        self.is_window = not isinstance(table, InMemoryTable)
         tid = table.definition.id
 
         frames = {tid: dict(table.attr_types)}
@@ -67,8 +68,13 @@ class OnDemandQueryRuntime:
         tid = self.table.definition.id
         cond = self.cond
         selector = self.selector
+        is_window = self.is_window
+        window = self.table if is_window else None
 
-        def run(tstate: TableState, now):
+        def run(tstate, now):
+            if is_window:
+                cols, ts, valid = window.contents(tstate, now)
+                tstate = TableState(cols=cols, ts=ts, valid=valid)
             C = tstate.ts.shape[0]
             scope = Scope()
             scope.add_frame(tid, tstate.cols, tstate.ts, tstate.valid, default=True)

@@ -191,9 +191,9 @@ class QueryRuntime(Receiver):
         self._step = jax.jit(self._make_step(), donate_argnums=(0,))
         self.state = self._init_state()
         #: time-driven windows need heartbeats to flush expirations
+        from ..ops.windows import window_has_time_semantics
         self.has_time_semantics = (
-            getattr(self.window, "time_ms", None) is not None
-            or type(self.window).__name__ == "TimeBatchWindow"
+            window_has_time_semantics(self.window)
             or self.rate_limiter.has_time_semantics)
 
     # ----------------------------------------------------------------- plan

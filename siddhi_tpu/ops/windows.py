@@ -114,6 +114,15 @@ def _empty_like_cols(layout: dict, n: int) -> dict:
     return {k: jnp.zeros((n,), dtype=dt) for k, dt in layout.items()}
 
 
+def window_has_time_semantics(window: "WindowOp") -> bool:
+    """True if the window needs heartbeats (empty timer batches) to emit
+    expirations when no data arrives — the TPU analogue of the reference's
+    Scheduler TIMER wiring (core/util/Scheduler.java:48)."""
+    if getattr(window, "time_ms", None) is not None:
+        return True
+    return isinstance(window, (TimeBatchWindow, SessionWindow))
+
+
 class WindowOp:
     """Base window operator. Subclasses define init_state/step; both must be
     traceable (called inside the query's jitted step)."""

@@ -1,0 +1,185 @@
+"""Named window (`define window`) behavioral tests.
+
+Mirrors the reference's window-definition suites
+(modules/siddhi-core/src/test/java/io/siddhi/core/query/window/ —
+WindowDefinitionTestCase-style: define window, insert via one query, consume
+via `from W` in another, join against it, pull-query it).
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+STOCK = "define stream StockStream (symbol string, price float, volume long);\n"
+
+
+def build(app_text, batch_size=8):
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(app_text, batch_size=batch_size)
+    rt.start()
+    return rt
+
+
+def collect_callback(rt, query_name):
+    got = []
+
+    def cb(ts, in_events, remove_events):
+        got.append((in_events, remove_events))
+
+    rt.add_query_callback(query_name, cb)
+    return got
+
+
+class TestNamedWindowBasics:
+    def test_insert_and_consume(self):
+        rt = build(
+            STOCK
+            + "define window StockWindow (symbol string, price float, volume long) lengthBatch(2);\n"
+            "from StockStream insert into StockWindow;\n"
+            "@info(name='q2') from StockWindow select symbol, sum(price) as total "
+            "insert into OutputStream;")
+        got = collect_callback(rt, "q2")
+        h = rt.get_input_handler("StockStream")
+        for row in [("IBM", 10.0, 1), ("IBM", 20.0, 1)]:
+            h.send(row)
+        rt.flush()
+        ins = [e for ins_, _ in got if ins_ for e in ins_]
+        # lengthBatch(2) emits on the second arrival; running sum over emissions
+        assert ins[-1].data[1] == pytest.approx(30.0)
+
+    def test_length_window_expired_events(self):
+        rt = build(
+            STOCK
+            + "define window W (symbol string, price float, volume long) length(2) output all events;\n"
+            "from StockStream insert into W;\n"
+            "@info(name='q2') from W select symbol, sum(price) as total "
+            "insert into OutputStream;")
+        got = collect_callback(rt, "q2")
+        h = rt.get_input_handler("StockStream")
+        for i, row in enumerate([("A", 10.0, 1), ("B", 20.0, 1), ("C", 40.0, 1)]):
+            h.send(row)
+            rt.flush()
+        # after C arrives, A expires: running sum = 10+20+40-10 = 60
+        ins = [e for ins_, _ in got if ins_ for e in ins_]
+        assert ins[-1].data[1] == pytest.approx(60.0)
+
+    def test_output_current_events_only(self):
+        rt = build(
+            STOCK
+            + "define window W (symbol string, price float, volume long) length(1) output current events;\n"
+            "from StockStream insert into W;\n"
+            "@info(name='q2') from W select symbol, price insert into OutputStream;")
+        got = collect_callback(rt, "q2")
+        h = rt.get_input_handler("StockStream")
+        for row in [("A", 10.0, 1), ("B", 20.0, 1)]:
+            h.send(row)
+            rt.flush()
+        removes = [e for _, rem in got if rem for e in rem]
+        assert removes == []  # expired emissions suppressed
+        ins = [e for ins_, _ in got if ins_ for e in ins_]
+        assert [e.data[0] for e in ins] == ["A", "B"]
+
+    def test_positional_rename_on_insert(self):
+        # query emits different attr names; insert matches positionally
+        rt = build(
+            STOCK
+            + "define window W (sym string, p float) length(5);\n"
+            "from StockStream select symbol, price insert into W;\n"
+            "@info(name='q2') from W select sym, max(p) as maxP insert into Out;")
+        got = collect_callback(rt, "q2")
+        h = rt.get_input_handler("StockStream")
+        h.send(("IBM", 12.5, 3))
+        rt.flush()
+        ins = [e for ins_, _ in got if ins_ for e in ins_]
+        assert ins[0].data == ("IBM", pytest.approx(12.5))
+
+
+class TestNamedWindowJoin:
+    def test_stream_join_named_window(self):
+        rt = build(
+            STOCK
+            + "define stream CheckStream (symbol string);\n"
+            "define window StockWindow (symbol string, price float, volume long) length(10);\n"
+            "from StockStream insert into StockWindow;\n"
+            "@info(name='j') from CheckStream join StockWindow "
+            "on CheckStream.symbol == StockWindow.symbol "
+            "select CheckStream.symbol as symbol, StockWindow.price as price "
+            "insert into OutStream;")
+        got = collect_callback(rt, "j")
+        rt.get_input_handler("StockStream").send(("IBM", 75.0, 100))
+        rt.get_input_handler("StockStream").send(("WSO2", 55.0, 100))
+        rt.flush()
+        rt.get_input_handler("CheckStream").send(("IBM",))
+        rt.flush()
+        ins = [e for ins_, _ in got if ins_ for e in ins_]
+        assert len(ins) == 1
+        assert ins[0].data == ("IBM", pytest.approx(75.0))
+
+
+class TestNamedWindowJoinFilter:
+    def test_window_side_filter_applies_to_contents(self):
+        rt = build(
+            STOCK
+            + "define stream CheckStream (symbol string);\n"
+            "define window W (symbol string, price float, volume long) length(10);\n"
+            "from StockStream insert into W;\n"
+            "@info(name='j') from CheckStream join W[price > 60.0] "
+            "on CheckStream.symbol == W.symbol "
+            "select CheckStream.symbol as symbol, W.price as price "
+            "insert into OutStream;")
+        got = collect_callback(rt, "j")
+        rt.get_input_handler("StockStream").send(("WSO2", 55.0, 10))
+        rt.get_input_handler("StockStream").send(("WSO2", 75.0, 10))
+        rt.flush()
+        rt.get_input_handler("CheckStream").send(("WSO2",))
+        rt.flush()
+        ins = [e for ins_, _ in got if ins_ for e in ins_]
+        assert [tuple(e.data) for e in ins] == [("WSO2", pytest.approx(75.0))]
+
+
+class TestNamedWindowOnDemand:
+    def test_pull_query_window_contents(self):
+        rt = build(
+            STOCK
+            + "define window W (symbol string, price float, volume long) length(3);\n"
+            "from StockStream insert into W;")
+        h = rt.get_input_handler("StockStream")
+        for row in [("A", 10.0, 1), ("B", 20.0, 2), ("C", 30.0, 3), ("D", 40.0, 4)]:
+            h.send(row)
+        rt.flush()
+        events = rt.query("from W select symbol, price")
+        rows = sorted(tuple(e.data) for e in events)
+        # length(3): A has expired
+        assert rows == [("B", pytest.approx(20.0)), ("C", pytest.approx(30.0)),
+                        ("D", pytest.approx(40.0))]
+
+    def test_pull_query_window_aggregate(self):
+        rt = build(
+            STOCK
+            + "define window W (symbol string, price float, volume long) length(10);\n"
+            "from StockStream insert into W;")
+        h = rt.get_input_handler("StockStream")
+        for row in [("A", 10.0, 1), ("A", 30.0, 2), ("B", 5.0, 3)]:
+            h.send(row)
+        rt.flush()
+        events = rt.query("from W select symbol, sum(price) as total group by symbol")
+        rows = sorted(tuple(e.data) for e in events)
+        assert rows == [("A", pytest.approx(40.0)), ("B", pytest.approx(5.0))]
+
+
+class TestNamedWindowPersistence:
+    def test_snapshot_restore_window_state(self):
+        app = (STOCK
+               + "define window W (symbol string, price float, volume long) length(5);\n"
+               "from StockStream insert into W;")
+        rt = build(app)
+        h = rt.get_input_handler("StockStream")
+        h.send(("A", 1.0, 1))
+        h.send(("B", 2.0, 2))
+        rt.flush()
+        blob = rt.snapshot()
+
+        rt2 = build(app)
+        rt2.restore(blob)
+        events = rt2.query("from W select symbol, price")
+        assert sorted(e.data[0] for e in events) == ["A", "B"]
