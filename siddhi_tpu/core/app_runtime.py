@@ -54,6 +54,7 @@ class SiddhiAppRuntime:
         self.query_runtimes: dict[str, QueryRuntime] = {}
         self.tables: dict = {}
         self.windows: dict = {}
+        self.triggers: dict = {}
         self._started = False
 
         self._build()
@@ -73,6 +74,12 @@ class SiddhiAppRuntime:
         from .window import NamedWindow
         for wd in app.window_definitions.values():
             self.windows[wd.id] = NamedWindow(wd, ctx, self.ctx.registry)
+
+        from .trigger import TriggerRuntime, trigger_stream_definition
+        for td in app.trigger_definitions.values():
+            sd = trigger_stream_definition(td)
+            self.junctions[sd.id] = StreamJunction(sd, ctx)
+            self.triggers[td.id] = TriggerRuntime(td, self.junctions[sd.id], ctx)
 
         for i, query in enumerate(app.queries):
             self._add_query(query, f"query{i + 1}")
@@ -165,9 +172,16 @@ class SiddhiAppRuntime:
 
     def start(self) -> None:
         self._started = True
+        if self.triggers:
+            now = self.ctx.timestamp_generator.current_time()
+            for tr in self.triggers.values():
+                tr.start(now)
+            self.flush(now)
 
     def shutdown(self) -> None:
         self._started = False
+        for tr in self.triggers.values():
+            tr.shutdown()
 
     # ------------------------------------------------------------------- I/O
 
@@ -221,6 +235,10 @@ class SiddhiAppRuntime:
     def flush(self, now: Optional[int] = None) -> None:
         """Drive every staged batch through the pipeline (source junctions
         first; device-to-device chaining cascades synchronously)."""
+        if self.triggers:
+            t = now if now is not None else self.ctx.timestamp_generator.current_time()
+            for tr in self.triggers.values():
+                tr.poll(t)
         for j in self.junctions.values():
             j.flush(now)
 

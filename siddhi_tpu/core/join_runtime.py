@@ -165,9 +165,10 @@ class JoinQueryRuntime:
                                   donate_argnums=(0,))
         self._step_right = jax.jit(self._make_step(from_left=False),
                                    donate_argnums=(0,))
+        from ..ops.windows import window_has_time_semantics
         self.has_time_semantics = any(
-            getattr(s.window, "time_ms", None) is not None
-            for s in (self.left, self.right) if not s.is_table)
+            s.window is not None and window_has_time_semantics(s.window)
+            for s in (self.left, self.right))
 
     # ------------------------------------------------------------------- plan
 

@@ -1,0 +1,182 @@
+"""Triggers — `define trigger T at ('start' | every <interval> | '<cron>')`.
+
+Reference: core/trigger/ — PeriodicTrigger.java:36,74 (ScheduledExecutorService),
+CronTrigger.java:46,109 (quartz), StartTrigger. A trigger defines a stream named
+after itself with one attribute `triggered_time long` and injects events into
+its junction at fire times.
+
+TPU design: the engine is synchronous single-controller (no background timer
+threads racing the jitted pipeline), so trigger firing is **watermark-driven**:
+`poll(now)` computes every due fire time <= now and stages one event per fire
+into the trigger's junction. The app runtime polls triggers on every flush() /
+heartbeat(), which is also how time windows receive their timer batches — one
+clock, one ordering. `start` triggers fire once inside SiddhiAppRuntime.start().
+Cron expressions use quartz's 6/7-field layout (sec min hour dom mon dow
+[year]), evaluated by the pure-Python matcher below.
+"""
+
+from __future__ import annotations
+
+import calendar
+from datetime import datetime, timedelta
+from typing import Optional
+
+from ..errors import SiddhiAppCreationError
+from ..query_api.definition import Attribute, AttributeType, StreamDefinition, TriggerDefinition
+
+
+# --------------------------------------------------------------------------- #
+# quartz-style cron (sec min hour dom mon dow [year]); minute-level wildcards
+# like the reference's common "0 * * * * ?" patterns
+# --------------------------------------------------------------------------- #
+
+
+def _parse_field(spec: str, lo: int, hi: int, names: Optional[dict] = None) -> Optional[frozenset]:
+    """One cron field → allowed-value set, or None for 'any' (* or ?)."""
+    spec = spec.strip()
+    if spec in ("*", "?"):
+        return None
+    allowed: set[int] = set()
+    for part in spec.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            step = int(step_s)
+            if part in ("*", ""):
+                part = f"{lo}-{hi}"
+        if names:
+            for nm, v in names.items():
+                part = part.upper().replace(nm, str(v))
+        if "-" in part:
+            a, b = int(part.split("-", 1)[0]), int(part.split("-", 1)[1])
+            if a <= b:
+                rng = list(range(a, b + 1, step))
+            else:  # quartz wrap-around range, e.g. hours 22-2 or SAT-SUN
+                rng = list(range(a, hi + 1, step)) + list(range(lo, b + 1, step))
+        else:
+            start = int(part)
+            rng = range(start, hi + 1, step) if step > 1 else (start,)
+        for v in rng:
+            if not (lo <= v <= hi):
+                raise SiddhiAppCreationError(
+                    f"cron field value {v} outside [{lo},{hi}]")
+            allowed.add(v)
+    if not allowed:
+        raise SiddhiAppCreationError(f"cron field {spec!r} matches no values")
+    return frozenset(allowed)
+
+
+_MONTHS = {m.upper(): i for i, m in enumerate(calendar.month_abbr) if m}
+_DOWS = {"SUN": 1, "MON": 2, "TUE": 3, "WED": 4, "THU": 5, "FRI": 6, "SAT": 7}
+
+
+class CronSchedule:
+    """Quartz layout: sec min hour day-of-month month day-of-week [year].
+    Reference: CronTrigger.java:46 delegates to quartz; this is a direct
+    next-fire evaluator over the same field semantics."""
+
+    def __init__(self, expr: str) -> None:
+        fields = expr.split()
+        if len(fields) not in (6, 7):
+            raise SiddhiAppCreationError(
+                f"cron expression needs 6 or 7 fields (quartz), got {expr!r}")
+        self.sec = _parse_field(fields[0], 0, 59)
+        self.minute = _parse_field(fields[1], 0, 59)
+        self.hour = _parse_field(fields[2], 0, 23)
+        self.dom = _parse_field(fields[3], 1, 31)
+        self.mon = _parse_field(fields[4], 1, 12, _MONTHS)
+        self.dow = _parse_field(fields[5], 1, 7, _DOWS)  # 1 = SUN (quartz)
+        self.year = _parse_field(fields[6], 1970, 2199) if len(fields) == 7 else None
+
+    def _matches(self, dt: datetime) -> bool:
+        quartz_dow = (dt.isoweekday() % 7) + 1  # Mon=1..Sun=7 → SUN=1..SAT=7
+        return ((self.sec is None or dt.second in self.sec)
+                and (self.minute is None or dt.minute in self.minute)
+                and (self.hour is None or dt.hour in self.hour)
+                and (self.dom is None or dt.day in self.dom)
+                and (self.mon is None or dt.month in self.mon)
+                and (self.dow is None or quartz_dow in self.dow)
+                and (self.year is None or dt.year in self.year))
+
+    def next_fire_ms(self, after_ms: int) -> Optional[int]:
+        """First fire time strictly after `after_ms` (epoch millis), scanning
+        second-by-second with day-level skips for non-matching dates."""
+        dt = datetime.fromtimestamp(after_ms / 1000.0).replace(microsecond=0)
+        dt += timedelta(seconds=1)
+        limit = dt + timedelta(days=366 * 4)
+        while dt < limit:
+            if ((self.mon is not None and dt.month not in self.mon)
+                    or (self.dom is not None and dt.day not in self.dom)
+                    or (self.dow is not None
+                        and (dt.isoweekday() % 7) + 1 not in self.dow)
+                    or (self.year is not None and dt.year not in self.year)):
+                dt = (dt + timedelta(days=1)).replace(hour=0, minute=0, second=0)
+                continue
+            if self._matches(dt):
+                return int(dt.timestamp() * 1000)
+            dt += timedelta(seconds=1)
+        return None
+
+
+# --------------------------------------------------------------------------- #
+# trigger runtime
+# --------------------------------------------------------------------------- #
+
+TRIGGER_ATTR = "triggered_time"
+
+
+def trigger_stream_definition(td: TriggerDefinition) -> StreamDefinition:
+    """A trigger IS a stream of (triggered_time long) (reference:
+    DefinitionParserHelper — trigger streams)."""
+    return StreamDefinition(
+        id=td.id,
+        attributes=(Attribute(TRIGGER_ATTR, AttributeType.LONG),),
+        annotations=td.annotations)
+
+
+class TriggerRuntime:
+    """Watermark-driven fire computation for one trigger."""
+
+    def __init__(self, definition: TriggerDefinition, junction, ctx) -> None:
+        self.definition = definition
+        self.junction = junction
+        self.ctx = ctx
+        self.cron: Optional[CronSchedule] = (
+            CronSchedule(definition.at_cron) if definition.at_cron else None)
+        #: next due fire (epoch ms); None until started / for start-only triggers
+        self.next_fire_ms: Optional[int] = None
+        self._started = False
+
+    def start(self, now_ms: int) -> None:
+        self._started = True
+        td = self.definition
+        if td.at_start:
+            self._fire(now_ms)
+        if td.at_every_ms is not None:
+            self.next_fire_ms = now_ms + td.at_every_ms
+        elif self.cron is not None:
+            self.next_fire_ms = self.cron.next_fire_ms(now_ms)
+
+    def poll(self, now_ms: int, max_fires: int = 10_000) -> int:
+        """Fire every due time <= now; returns number of fires staged."""
+        if not self._started or self.next_fire_ms is None:
+            return 0
+        fired = 0
+        td = self.definition
+        while self.next_fire_ms is not None and self.next_fire_ms <= now_ms:
+            self._fire(self.next_fire_ms)
+            fired += 1
+            if td.at_every_ms is not None:
+                self.next_fire_ms += td.at_every_ms
+            else:
+                self.next_fire_ms = self.cron.next_fire_ms(self.next_fire_ms)
+            if fired >= max_fires:  # clock jumped far forward; don't spin
+                break
+        return fired
+
+    def _fire(self, ts_ms: int) -> None:
+        self.junction.send_row(ts_ms, (ts_ms,))
+
+    def shutdown(self) -> None:
+        self._started = False
+        self.next_fire_ms = None

@@ -1,0 +1,107 @@
+"""Trigger behavioral tests (reference: core/trigger/ — PeriodicTrigger,
+CronTrigger, StartTrigger; trigger streams carry one `triggered_time long`).
+
+Uses @app:playback so the clock is event/heartbeat-driven and deterministic.
+"""
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.trigger import CronSchedule
+
+
+def build(app_text, batch_size=8):
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(app_text, batch_size=batch_size)
+    return rt
+
+
+class TestStartTrigger:
+    def test_fires_once_on_start(self):
+        rt = build(
+            "@app:playback\n"
+            "define trigger InitTrigger at 'start';\n"
+            "from InitTrigger select triggered_time insert into Out;")
+        got = []
+        rt.add_callback("Out", lambda events: got.extend(events))
+        rt.start()
+        rt.flush()
+        assert len(got) == 1
+        rt.heartbeat(10_000)
+        assert len(got) == 1  # start trigger fires exactly once
+
+
+class TestPeriodicTrigger:
+    def test_every_interval_fires(self):
+        rt = build(
+            "@app:playback\n"
+            "define trigger T at every 1 sec;\n"
+            "from T select triggered_time insert into Out;")
+        got = []
+        rt.add_callback("Out", lambda events: got.extend(events))
+        rt.start()  # playback clock starts at 0 → next fire at 1000
+        rt.heartbeat(5_000)
+        assert [e.data[0] for e in got] == [1000, 2000, 3000, 4000, 5000]
+        rt.heartbeat(6_500)
+        assert [e.data[0] for e in got][-1] == 6000
+
+    def test_trigger_feeds_time_window_query(self):
+        rt = build(
+            "@app:playback\n"
+            "define trigger T at every 500 milliseconds;\n"
+            "from T select count() as fires insert into Out;")
+        got = []
+        rt.add_callback("Out", lambda events: got.extend(events))
+        rt.start()
+        rt.heartbeat(2_000)
+        assert got[-1].data[0] == 4  # fires at 500,1000,1500,2000
+
+
+class TestCronSchedule:
+    def test_every_minute(self):
+        cs = CronSchedule("0 * * * * ?")
+        # after 00:00:30 the next fire is 00:01:00
+        import datetime
+        base = int(datetime.datetime(2026, 1, 5, 0, 0, 30).timestamp() * 1000)
+        nxt = cs.next_fire_ms(base)
+        assert nxt == int(datetime.datetime(2026, 1, 5, 0, 1, 0).timestamp() * 1000)
+
+    def test_specific_hour_range(self):
+        cs = CronSchedule("0 0 9-17 * * MON-FRI")
+        import datetime
+        # Friday 17:30 → next fire Monday 09:00
+        base = int(datetime.datetime(2026, 1, 9, 17, 30, 0).timestamp() * 1000)
+        nxt = cs.next_fire_ms(base)
+        assert nxt == int(datetime.datetime(2026, 1, 12, 9, 0, 0).timestamp() * 1000)
+
+    def test_step_seconds(self):
+        cs = CronSchedule("*/15 * * * * ?")
+        import datetime
+        base = int(datetime.datetime(2026, 1, 5, 10, 0, 1).timestamp() * 1000)
+        nxt = cs.next_fire_ms(base)
+        assert nxt == int(datetime.datetime(2026, 1, 5, 10, 0, 15).timestamp() * 1000)
+
+    def test_wrap_around_range(self):
+        cs = CronSchedule("0 0 22-2 * * ?")
+        import datetime
+        base = int(datetime.datetime(2026, 1, 5, 20, 0, 0).timestamp() * 1000)
+        nxt = cs.next_fire_ms(base)
+        assert nxt == int(datetime.datetime(2026, 1, 5, 22, 0, 0).timestamp() * 1000)
+        base2 = int(datetime.datetime(2026, 1, 5, 23, 30, 0).timestamp() * 1000)
+        nxt2 = cs.next_fire_ms(base2)
+        assert nxt2 == int(datetime.datetime(2026, 1, 6, 0, 0, 0).timestamp() * 1000)
+
+    def test_unsatisfiable_field_rejected(self):
+        import pytest
+        from siddhi_tpu.errors import SiddhiAppCreationError
+        with pytest.raises(SiddhiAppCreationError):
+            CronSchedule("0 61 * * * ?")  # minute out of range
+
+    def test_cron_trigger_runtime(self):
+        rt = build(
+            "@app:playback\n"
+            "define trigger T at '*/2 * * * * ?';\n"
+            "from T select triggered_time insert into Out;")
+        got = []
+        rt.add_callback("Out", lambda events: got.extend(events))
+        rt.start()  # playback time 0 = epoch; every-2-seconds cron
+        rt.heartbeat(10_000)
+        assert [e.data[0] for e in got] == [2000, 4000, 6000, 8000, 10000]
