@@ -757,10 +757,12 @@ class AstTransformer(Transformer):
             elif isinstance(r, Token) and r.type in ("NAME", "INNER_STREAM_ID", "FAULT_STREAM_ID"):
                 target = str(r)
         is_fault = target.startswith("!")
+        is_inner = target.startswith("#")
         if target.startswith(("#", "!")):
             target = target[1:]
         return OutputStream(OutputAction.INSERT, target_id=target,
-                            event_type=etype, is_fault=is_fault)
+                            event_type=etype, is_fault=is_fault,
+                            is_inner=is_inner)
 
     def set_item(self, var, expr):
         return UpdateSetAttribute(var, expr)

@@ -55,6 +55,8 @@ class SiddhiAppRuntime:
         self.tables: dict = {}
         self.windows: dict = {}
         self.triggers: dict = {}
+        self.aggregations: dict = {}
+        self.partitions: dict = {}
         self._started = False
 
         self._build()
@@ -81,11 +83,23 @@ class SiddhiAppRuntime:
             self.junctions[sd.id] = StreamJunction(sd, ctx)
             self.triggers[td.id] = TriggerRuntime(td, self.junctions[sd.id], ctx)
 
+        from .aggregation import AggregationRuntime
+        for ad in app.aggregation_definitions.values():
+            junction = self.junctions.get(ad.input_stream_id)
+            if junction is None:
+                raise DefinitionNotExistError(
+                    f"aggregation {ad.id!r}: stream {ad.input_stream_id!r} "
+                    "is not defined")
+            self.aggregations[ad.id] = AggregationRuntime(
+                ad, ctx, junction, self.ctx.registry)
+
         for i, query in enumerate(app.queries):
             self._add_query(query, f"query{i + 1}")
 
-        if app.partitions:
-            raise SiddhiAppCreationError("partitions are not yet supported")
+        from .partition import PartitionRuntime
+        for i, p in enumerate(app.partitions):
+            pr = PartitionRuntime(p, self, i + 1)
+            self.partitions[pr.name] = pr
 
     def _add_query(self, query: Query, default_name: str) -> None:
         from ..query_api.execution import JoinInputStream
@@ -122,10 +136,11 @@ class SiddhiAppRuntime:
     def _add_join_query(self, query: Query, name: str):
         from .join_runtime import JoinQueryRuntime, _JoinSideReceiver
         qr = JoinQueryRuntime(query, self.ctx, self.junctions, self.tables,
-                              self.ctx.registry, name, windows=self.windows)
-        if not qr.left.is_table:
+                              self.ctx.registry, name, windows=self.windows,
+                              aggregations=self.aggregations)
+        if qr.left.junction is not None:
             qr.left.junction.subscribe(_JoinSideReceiver(qr, True))
-        if not qr.right.is_table:
+        if qr.right.junction is not None:
             qr.right.junction.subscribe(_JoinSideReceiver(qr, False))
         return qr
 
@@ -223,6 +238,17 @@ class SiddhiAppRuntime:
             store = self.tables.get(odq.input_store_id)
             if store is None:
                 store = self.windows.get(odq.input_store_id)
+            if store is None and odq.input_store_id in self.aggregations:
+                # aggregation store query: bind `per`/`within` into a view
+                # (reference: AggregationRuntime.find, within/per clauses)
+                import dataclasses as dc
+                agg = self.aggregations[odq.input_store_id]
+                if odq.per is None:
+                    raise SiddhiAppCreationError(
+                        f"aggregation {odq.input_store_id!r} queries need "
+                        "`per '<duration>'`")
+                store = agg.view(odq.per, odq.within_range)
+                odq = dc.replace(odq, per=None, within_range=None)
             if store is None:
                 raise DefinitionNotExistError(
                     f"store {odq.input_store_id!r} is not defined")
@@ -250,9 +276,14 @@ class SiddhiAppRuntime:
         for w in self.windows.values():
             if w.has_time_semantics:
                 w.heartbeat(t)
+        for a in self.aggregations.values():
+            a._maybe_evict(t)  # retention purge rides the heartbeat clock
+        for pr in self.partitions.values():
+            if pr.has_time_semantics or pr._purge_idle_ms is not None:
+                pr.heartbeat(t)
         seen: set[int] = set()
         for qr in self.query_runtimes.values():
-            if not qr.has_time_semantics:
+            if not qr.has_time_semantics or getattr(qr, "_partitioned", False):
                 continue
             if hasattr(qr, "heartbeat"):  # pattern runtimes drive themselves
                 qr.heartbeat(t)

@@ -49,17 +49,35 @@ class _Side:
     window (probed via its shared contents; its emissions also trigger)."""
 
     def __init__(self, ins: SingleInputStream, ctx, registry, junctions, tables,
-                 windows=None):
+                 windows=None, aggregations=None, per=None):
         self.ref = ins.reference_id  # alias or stream id
         self.stream_id = ins.stream_id
         self.is_table = ins.stream_id in tables
         self.table = tables.get(ins.stream_id)
         self.named_window = (windows or {}).get(ins.stream_id)
         self.is_named_window = self.named_window is not None and not self.is_table
+        self.aggregation = (aggregations or {}).get(ins.stream_id)
+        self.is_aggregation = (self.aggregation is not None and not self.is_table
+                               and not self.is_named_window)
+        self.agg_view = None
         self.junction: Optional[StreamJunction] = None
         self.window: Optional[WindowOp] = None
         self.filters = []
-        if self.is_table:
+        if self.is_aggregation:
+            # `from S join Agg per "duration" on ...` (reference:
+            # AggregationRuntime.compileExpression:384+ / JoinInputStreamParser).
+            # Divergence, documented: `within start, end` bucket-range bounds on
+            # joins are not supported — use the ON condition over AGG_TIMESTAMP.
+            if per is None:
+                raise SiddhiAppCreationError(
+                    f"joining aggregation {ins.stream_id!r} needs `per '<duration>'`")
+            if ins.handlers.window is not None:
+                raise SiddhiAppCreationError(
+                    "aggregations cannot take windows in joins")
+            self.agg_view = self.aggregation.view(per)
+            self.attr_types = dict(self.aggregation.output_attr_types)
+            self.codec = self.aggregation.output_codec
+        elif self.is_table:
             if ins.handlers.window is not None:
                 raise SiddhiAppCreationError("tables cannot take windows in joins")
             self.attr_types = dict(self.table.attr_types)
@@ -97,7 +115,8 @@ class _Side:
 class JoinQueryRuntime:
     def __init__(self, query: Query, ctx: SiddhiAppContext,
                  junctions: dict, tables: dict, registry: Registry,
-                 name: str, windows: Optional[dict] = None) -> None:
+                 name: str, windows: Optional[dict] = None,
+                 aggregations: Optional[dict] = None) -> None:
         assert isinstance(query.input_stream, JoinInputStream)
         jis: JoinInputStream = query.input_stream
         self.query = query
@@ -109,10 +128,14 @@ class JoinQueryRuntime:
         self.table_executor = None
         self.k_max = dtypes.config.join_max_matches
 
-        self.left = _Side(jis.left, ctx, registry, junctions, tables, windows)
-        self.right = _Side(jis.right, ctx, registry, junctions, tables, windows)
+        self.left = _Side(jis.left, ctx, registry, junctions, tables, windows,
+                          aggregations, jis.per)
+        self.right = _Side(jis.right, ctx, registry, junctions, tables, windows,
+                           aggregations, jis.per)
         if self.left.is_table and self.right.is_table:
             raise SiddhiAppCreationError("cannot join two tables in a stream query")
+        if self.left.is_aggregation and self.right.is_aggregation:
+            raise SiddhiAppCreationError("cannot join two aggregations")
         if self.left.ref == self.right.ref:
             raise SiddhiAppCreationError(
                 "self-joins need an alias: `from S as a join S as b ...`")
@@ -154,7 +177,9 @@ class JoinQueryRuntime:
         self.output_codec = StreamCodec(self.output_definition, ctx.global_strings)
 
         def _side_state(s):
-            return () if (s.is_table or s.is_named_window) else s.window.init_state()
+            if s.is_table or s.is_named_window or s.is_aggregation:
+                return ()
+            return s.window.init_state()
 
         self.state = (
             _side_state(self.left),
@@ -209,7 +234,8 @@ class JoinQueryRuntime:
             batch = dataclasses.replace(batch, valid=mask)
             pscope.valids[probe_side.ref] = mask
 
-            if not probe_side.is_table and not probe_side.is_named_window:
+            if not (probe_side.is_table or probe_side.is_named_window
+                    or probe_side.is_aggregation):
                 w_probe, _chunk = probe_side.window.step(w_probe, batch, now)
 
             # --- build-side contents ---
@@ -220,10 +246,14 @@ class JoinQueryRuntime:
             elif build_side.is_named_window:
                 b_cols, b_ts, b_valid = build_side.named_window.contents(
                     build_tstate, now)
+            elif build_side.is_aggregation:
+                b_cols, b_ts, b_valid = build_side.agg_view.contents(
+                    build_tstate, now)
             else:
                 b_cols, b_ts, b_valid = build_side.window.contents(w_build, now)
             if build_side.filters and (build_side.is_table
-                                       or build_side.is_named_window):
+                                       or build_side.is_named_window
+                                       or build_side.is_aggregation):
                 # stream sides are filtered before their ring append; probed
                 # contents (tables / named windows) are filtered here
                 bscope = Scope()
@@ -325,11 +355,13 @@ class JoinQueryRuntime:
             tstate = build.table.state
         elif build.is_named_window:
             tstate = build.named_window.state
+        elif build.is_aggregation:
+            tstate = build.agg_view.state
         else:
             tstate = None
         if not triggers:
             # non-triggering side still feeds its window
-            if side.is_table or side.is_named_window:
+            if side.is_table or side.is_named_window or side.is_aggregation:
                 return
             wl, wr, sel = self.state
             w = wl if from_left else wr

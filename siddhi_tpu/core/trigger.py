@@ -87,20 +87,27 @@ class CronSchedule:
         self.mon = _parse_field(fields[4], 1, 12, _MONTHS)
         self.dow = _parse_field(fields[5], 1, 7, _DOWS)  # 1 = SUN (quartz)
         self.year = _parse_field(fields[6], 1970, 2199) if len(fields) == 7 else None
+        if self.dom is not None and self.dow is not None:
+            # quartz rejects restricting both; accepting would silently AND
+            # them (classic cron ORs) — surprising either way
+            raise SiddhiAppCreationError(
+                "cron: specify day-of-month or day-of-week, not both "
+                f"(use '?' for one): {expr!r}")
 
-    def _matches(self, dt: datetime) -> bool:
-        quartz_dow = (dt.isoweekday() % 7) + 1  # Mon=1..Sun=7 → SUN=1..SAT=7
-        return ((self.sec is None or dt.second in self.sec)
-                and (self.minute is None or dt.minute in self.minute)
-                and (self.hour is None or dt.hour in self.hour)
-                and (self.dom is None or dt.day in self.dom)
-                and (self.mon is None or dt.month in self.mon)
-                and (self.dow is None or quartz_dow in self.dow)
-                and (self.year is None or dt.year in self.year))
+    @staticmethod
+    def _next_in(allowed: Optional[frozenset], v: int, lo: int, hi: int):
+        """Smallest allowed value >= v, or (lo-of-allowed, carry=True)."""
+        if allowed is None:
+            return v, False
+        geq = [a for a in allowed if a >= v]
+        if geq:
+            return min(geq), False
+        return min(allowed), True
 
     def next_fire_ms(self, after_ms: int) -> Optional[int]:
-        """First fire time strictly after `after_ms` (epoch millis), scanning
-        second-by-second with day-level skips for non-matching dates."""
+        """First fire time strictly after `after_ms` (epoch millis). Field-carry
+        evaluation: jumps straight to the next allowed second/minute/hour/day
+        instead of scanning second-by-second."""
         dt = datetime.fromtimestamp(after_ms / 1000.0).replace(microsecond=0)
         dt += timedelta(seconds=1)
         limit = dt + timedelta(days=366 * 4)
@@ -112,9 +119,23 @@ class CronSchedule:
                     or (self.year is not None and dt.year not in self.year)):
                 dt = (dt + timedelta(days=1)).replace(hour=0, minute=0, second=0)
                 continue
-            if self._matches(dt):
-                return int(dt.timestamp() * 1000)
-            dt += timedelta(seconds=1)
+            h, carry = self._next_in(self.hour, dt.hour, 0, 23)
+            if carry:
+                dt = (dt + timedelta(days=1)).replace(hour=0, minute=0, second=0)
+                continue
+            if h != dt.hour:
+                dt = dt.replace(hour=h, minute=0, second=0)
+            m, carry = self._next_in(self.minute, dt.minute, 0, 59)
+            if carry:
+                dt = (dt.replace(minute=0, second=0) + timedelta(hours=1))
+                continue
+            if m != dt.minute:
+                dt = dt.replace(minute=m, second=0)
+            s, carry = self._next_in(self.sec, dt.second, 0, 59)
+            if carry:
+                dt = (dt.replace(second=0) + timedelta(minutes=1))
+                continue
+            return int(dt.replace(second=s).timestamp() * 1000)
         return None
 
 
@@ -141,6 +162,9 @@ class TriggerRuntime:
         self.definition = definition
         self.junction = junction
         self.ctx = ctx
+        if definition.at_every_ms is not None and definition.at_every_ms <= 0:
+            raise SiddhiAppCreationError(
+                f"trigger {definition.id!r}: interval must be positive")
         self.cron: Optional[CronSchedule] = (
             CronSchedule(definition.at_cron) if definition.at_cron else None)
         #: next due fire (epoch ms); None until started / for start-only triggers

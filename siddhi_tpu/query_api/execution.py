@@ -236,6 +236,7 @@ class OutputStream:
     on_condition: Optional[Expression] = None  # delete/update ... on <cond>
     set_attributes: tuple[UpdateSetAttribute, ...] = ()
     is_fault: bool = False  # `insert into !Stream`
+    is_inner: bool = False  # `insert into #Inner` (partition-scoped stream)
 
 
 class OutputRateType(enum.Enum):

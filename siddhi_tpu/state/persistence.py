@@ -135,10 +135,15 @@ class SnapshotService:
         snap = {
             "app": rt.app.name,
             "queries": {name: _to_host(qr.state)
-                        for name, qr in rt.query_runtimes.items()},
+                        for name, qr in rt.query_runtimes.items()
+                        if not getattr(qr, "_partitioned", False)},
             "tables": {tid: _to_host(t.state) for tid, t in rt.tables.items()},
             "windows": {wid: _to_host(w.state)
                         for wid, w in getattr(rt, "windows", {}).items()},
+            "aggregations": {aid: _to_host(a.state)
+                             for aid, a in getattr(rt, "aggregations", {}).items()},
+            "partitions": {pname: p.snapshot_states()
+                           for pname, p in getattr(rt, "partitions", {}).items()},
             "strings": rt.ctx.global_strings.snapshot(),
             "last_event_ts": rt.ctx.timestamp_generator._last_event_ts,
         }
@@ -156,7 +161,7 @@ class SnapshotService:
                 f"not {rt.app.name!r}")
         try:
             for name, qr in rt.query_runtimes.items():
-                if name in snap["queries"]:
+                if name in snap["queries"] and not getattr(qr, "_partitioned", False):
                     qr.state = _to_device(snap["queries"][name], qr.state)
             for tid, t in rt.tables.items():
                 if tid in snap["tables"]:
@@ -164,7 +169,13 @@ class SnapshotService:
             for wid, w in getattr(rt, "windows", {}).items():
                 if wid in snap.get("windows", {}):
                     w.state = _to_device(snap["windows"][wid], w.state)
-        except ValueError as e:
+            for aid, a in getattr(rt, "aggregations", {}).items():
+                if aid in snap.get("aggregations", {}):
+                    a.state = _to_device(snap["aggregations"][aid], a.state)
+            for pname, p in getattr(rt, "partitions", {}).items():
+                if pname in snap.get("partitions", {}):
+                    p.restore_states(snap["partitions"][pname])
+        except (ValueError, KeyError) as e:
             raise CannotRestoreStateError(
                 f"snapshot structure mismatch (app definition changed?): {e}"
             ) from e

@@ -1,0 +1,253 @@
+"""Incremental aggregation tests (reference suites:
+modules/siddhi-core/src/test/java/io/siddhi/core/aggregation/ —
+Aggregation1TestCase, Aggregation2TestCase: define aggregation, send events
+with explicit timestamps, pull-query `within ... per ...`).
+
+Uses `aggregate by <ts attr>` with explicit epoch-ms timestamps so bucket
+boundaries are deterministic.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.aggregation import bucket_start, parse_time_constant
+from siddhi_tpu.query_api.definition import Duration
+
+APP = """
+define stream TradeStream (symbol string, price double, volume long, ts long);
+define aggregation TradeAgg
+from TradeStream
+select symbol, avg(price) as avgPrice, sum(price) as total, count() as n
+group by symbol
+aggregate by ts every sec, min, hours, days;
+"""
+
+HOUR = 3_600_000
+DAY = 86_400_000
+
+
+def build(app=APP):
+    rt = SiddhiManager().create_siddhi_app_runtime(app)
+    rt.start()
+    return rt
+
+
+def send_trades(rt, rows):
+    h = rt.get_input_handler("TradeStream")
+    for row in rows:
+        h.send(row)
+    rt.flush()
+
+
+class TestBucketStart:
+    def test_fixed_widths(self):
+        import jax.numpy as jnp
+        ts = jnp.array([1_234_567, 59_999, 60_000], dtype=jnp.int64)
+        assert bucket_start(Duration.SECONDS, ts).tolist() == [1_234_000, 59_000, 60_000]
+        assert bucket_start(Duration.MINUTES, ts).tolist() == [1_200_000, 0, 60_000]
+
+    def test_month_year_civil(self):
+        import datetime
+        import jax.numpy as jnp
+        # 2026-07-15 12:30:00 UTC → month bucket 2026-07-01, year 2026-01-01
+        t = int(datetime.datetime(2026, 7, 15, 12, 30,
+                                  tzinfo=datetime.timezone.utc).timestamp() * 1000)
+        ts = jnp.array([t], dtype=jnp.int64)
+        m = bucket_start(Duration.MONTHS, ts).tolist()[0]
+        y = bucket_start(Duration.YEARS, ts).tolist()[0]
+        assert m == int(datetime.datetime(2026, 7, 1,
+                                          tzinfo=datetime.timezone.utc).timestamp() * 1000)
+        assert y == int(datetime.datetime(2026, 1, 1,
+                                          tzinfo=datetime.timezone.utc).timestamp() * 1000)
+
+    def test_parse_time_constant(self):
+        assert parse_time_constant(1000) == 1000
+        assert parse_time_constant("1970-01-01 00:00:10") == 10_000
+        assert parse_time_constant("1970-01-01 01:00:00 +01:00") == 0
+
+
+class TestAggregationFind:
+    def test_per_sec_group_by(self):
+        rt = build()
+        send_trades(rt, [
+            ("IBM", 10.0, 1, 1_000), ("IBM", 20.0, 2, 1_500),  # same second
+            ("IBM", 40.0, 3, 2_200),                            # next second
+            ("WSO2", 5.0, 1, 1_100),
+        ])
+        events = rt.query(
+            "from TradeAgg within 0, 10000 per 'sec' "
+            "select symbol, avgPrice, total, n")
+        rows = sorted(tuple(e.data) for e in events)
+        assert rows == [
+            ("IBM", pytest.approx(15.0), pytest.approx(30.0), 2),
+            ("IBM", pytest.approx(40.0), pytest.approx(40.0), 1),
+            ("WSO2", pytest.approx(5.0), pytest.approx(5.0), 1),
+        ]
+
+    def test_per_hour_rollup(self):
+        rt = build()
+        send_trades(rt, [
+            ("IBM", 10.0, 1, 10 * HOUR + 5),
+            ("IBM", 30.0, 1, 10 * HOUR + 70_000),   # same hour, later minute
+            ("IBM", 100.0, 1, 11 * HOUR + 1),       # next hour
+        ])
+        events = rt.query(
+            "from TradeAgg within 0, 86400000 per 'hours' "
+            "select symbol, total, n")
+        rows = sorted(tuple(e.data) for e in events)
+        assert rows == [("IBM", pytest.approx(40.0), 2),
+                        ("IBM", pytest.approx(100.0), 1)]
+
+    def test_within_filters_buckets(self):
+        rt = build()
+        send_trades(rt, [
+            ("A", 1.0, 1, 1 * DAY + 10),
+            ("A", 2.0, 1, 2 * DAY + 10),
+            ("A", 4.0, 1, 3 * DAY + 10),
+        ])
+        events = rt.query(
+            f"from TradeAgg within {2 * DAY}, {3 * DAY} per 'days' "
+            "select symbol, total")
+        assert [tuple(e.data) for e in events] == [("A", pytest.approx(2.0))]
+
+    def test_out_of_order_events_merge(self):
+        rt = build()
+        send_trades(rt, [("A", 10.0, 1, 5_000)])
+        send_trades(rt, [("A", 30.0, 1, 1_000)])   # late event, older bucket
+        send_trades(rt, [("A", 2.0, 1, 5_500)])    # back to the newer second
+        events = rt.query(
+            "from TradeAgg within 0, 10000 per 'sec' select symbol, total, n")
+        rows = sorted((e.data[1], e.data[2]) for e in events)
+        assert rows == [(pytest.approx(12.0), 2), (pytest.approx(30.0), 1)]
+
+    def test_further_aggregation_in_pull_query(self):
+        rt = build()
+        send_trades(rt, [
+            ("A", 10.0, 1, 1_000), ("A", 20.0, 1, 2_000), ("B", 5.0, 1, 3_000)])
+        events = rt.query(
+            "from TradeAgg within 0, 100000 per 'sec' "
+            "select symbol, sum(total) as grand group by symbol")
+        rows = sorted(tuple(e.data) for e in events)
+        assert rows == [("A", pytest.approx(30.0)), ("B", pytest.approx(5.0))]
+
+    def test_agg_timestamp_exposed(self):
+        rt = build()
+        send_trades(rt, [("A", 10.0, 1, 61_000)])
+        events = rt.query(
+            "from TradeAgg within 0, 600000 per 'min' select AGG_TIMESTAMP, total")
+        assert [tuple(e.data) for e in events] == [(60_000, pytest.approx(10.0))]
+
+    def test_missing_per_rejected(self):
+        rt = build()
+        from siddhi_tpu.errors import SiddhiAppCreationError
+        with pytest.raises(SiddhiAppCreationError):
+            rt.query("from TradeAgg select symbol")
+
+    def test_unknown_duration_rejected(self):
+        rt = build()
+        from siddhi_tpu.errors import SiddhiAppCreationError
+        with pytest.raises(SiddhiAppCreationError):
+            rt.query("from TradeAgg within 0, 10 per 'months' select symbol")
+
+
+class TestAggregationMinMax:
+    def test_min_max_buckets(self):
+        app = """
+        define stream S (k string, v double, ts long);
+        define aggregation MM
+        from S select k, min(v) as lo, max(v) as hi
+        group by k aggregate by ts every sec, min;
+        """
+        rt = build(app)
+        h = rt.get_input_handler("S")
+        for row in [("a", 5.0, 1_000), ("a", 2.0, 1_200), ("a", 9.0, 1_900),
+                    ("a", 7.0, 2_500)]:
+            h.send(row)
+        rt.flush()
+        events = rt.query("from MM within 0, 2000 per 'sec' select k, lo, hi")
+        assert [tuple(e.data) for e in events] == [
+            ("a", pytest.approx(2.0), pytest.approx(9.0))]
+
+
+class TestAggregationEviction:
+    def test_capacity_pressure_evicts_oldest_buckets(self):
+        import warnings as _warnings
+        app = """
+        define stream S (k string, v double, ts long);
+        define aggregation A
+        from S select k, sum(v) as total
+        group by k aggregate by ts every sec;
+        """
+        rt = SiddhiManager().create_siddhi_app_runtime(app, group_capacity=4096)
+        rt.start()
+        h = rt.get_input_handler("S")
+        # > 0.85 * 4096 distinct (bucket, key) slots, then trigger the check
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")
+            for i in range(3600):
+                h.send(("x", 1.0, 1_000 * i))
+            rt.flush()
+            agg = rt.aggregations["A"]
+            agg._batches_since_check = 32
+            h.send(("x", 1.0, 1_000 * 3600))
+            rt.flush()
+        count = int(agg.state[0].key_table.count)
+        assert count <= 4096 // 2 + 64  # compacted to ~newest half
+        # newest buckets survive
+        events = rt.query(
+            f"from A within {3_599_000}, {3_601_000} per 'sec' select total")
+        assert len(events) == 2
+
+    def test_retention_purge(self):
+        app = """
+        define stream S (k string, v double, ts long);
+        @purge(enable='true', @retentionPeriod(sec='10 sec'))
+        define aggregation A
+        from S select k, sum(v) as total
+        group by k aggregate by ts every sec, min;
+        """
+        rt = SiddhiManager().create_siddhi_app_runtime(app)
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(("x", 1.0, 1_000))
+        h.send(("x", 2.0, 50_000))
+        rt.flush()
+        rt.heartbeat(60_000)  # retention: sec buckets older than 10s drop
+        events = rt.query("from A within 0, 100000 per 'sec' select total")
+        assert [e.data[0] for e in events] == [pytest.approx(2.0)]
+        # the min duration has no retention configured → its (single) bucket
+        # keeps both events' contribution
+        events = rt.query("from A within 0, 100000 per 'min' select total")
+        assert [e.data[0] for e in events] == [pytest.approx(3.0)]
+
+
+class TestAggregationJoin:
+    def test_stream_join_aggregation(self):
+        app = APP + """
+        define stream QueryStream (symbol string, qts long);
+        @info(name='j')
+        from QueryStream join TradeAgg
+        on QueryStream.symbol == TradeAgg.symbol
+        per 'sec'
+        select QueryStream.symbol as symbol, TradeAgg.total as total
+        insert into Out;
+        """
+        rt = build(app)
+        send_trades(rt, [("IBM", 10.0, 1, 1_000), ("IBM", 20.0, 2, 1_500),
+                         ("WSO2", 5.0, 1, 1_100)])
+        got = []
+        rt.add_query_callback("j", lambda ts, i, r: got.extend(i or []))
+        rt.get_input_handler("QueryStream").send(("IBM", 0))
+        rt.flush()
+        assert [tuple(e.data) for e in got] == [("IBM", pytest.approx(30.0))]
+
+
+class TestAggregationPersistence:
+    def test_snapshot_restore(self):
+        rt = build()
+        send_trades(rt, [("A", 10.0, 1, 1_000)])
+        blob = rt.snapshot()
+        rt2 = build()
+        rt2.restore(blob)
+        events = rt2.query("from TradeAgg within 0, 10000 per 'sec' select total")
+        assert [e.data[0] for e in events] == [pytest.approx(10.0)]
