@@ -104,6 +104,16 @@ def bucket_start(duration: Duration, ts):
     raise SiddhiAppCreationError(f"unsupported duration {duration}")
 
 
+def bucket_bounds(duration: Duration, t: int) -> tuple[int, int]:
+    """[start, end) of the bucket containing instant `t` (host scalars)."""
+    lo = int(bucket_start(duration, jnp.array([t], jnp.int64))[0])
+    if duration in _MS_WIDTH:
+        return lo, lo + _MS_WIDTH[duration]
+    probe = lo + (40 if duration == Duration.MONTHS else 370) * _DAY_MS
+    hi = int(bucket_start(duration, jnp.array([probe], jnp.int64))[0])
+    return lo, hi
+
+
 def parse_time_constant(value) -> int:
     """`within` bound → epoch ms. Accepts epoch millis (int) or the
     reference's datetime string formats `yyyy-MM-dd HH:mm:ss` (GMT) with
@@ -372,11 +382,30 @@ class AggregationRuntime(Receiver):
         state[d_idx] = store
         self.state = tuple(state)
 
+    def _grow(self) -> None:
+        """Double every duration store's capacity (one retrace + rehash each).
+        Taken when eviction cannot free slots — high *group* cardinality
+        rather than bucket age (the reference grows its HashMaps the same way,
+        implicitly)."""
+        import warnings
+        self.capacity *= 2
+        warnings.warn(
+            f"aggregation {self.definition.id!r}: growing bucket stores to "
+            f"{self.capacity} slots (set group_capacity higher to avoid the "
+            "rehash)", stacklevel=2)
+        self._ingest = jax.jit(self._make_ingest(), donate_argnums=(0,))
+        self._evict = jax.jit(self._make_evict())
+        # rehash every store into the new capacity (cutoff far in the past
+        # keeps everything)
+        self.state = tuple(
+            self._evict(store, jnp.int64(-(1 << 62))) for store in self.state)
+
     def _maybe_evict(self, now: int) -> None:
-        """Retention purge + capacity-pressure eviction (oldest buckets drop
-        when a duration store nears its slot capacity, keeping results exact
-        over the retained horizon instead of silently dropping NEW buckets)."""
+        """Retention purge + capacity-pressure handling: evict buckets older
+        than the newest half when age explains the pressure, grow the store
+        when group cardinality does — never silently drop or corrupt."""
         import numpy as np
+        grow = False
         for d_idx, dur in enumerate(self.durations):
             store = self.state[d_idx]
             cutoff = None
@@ -384,10 +413,14 @@ class AggregationRuntime(Receiver):
             if retention is not None:
                 cutoff = now - retention
             if int(store.key_table.count) > int(0.85 * self.capacity):
-                bts = np.asarray(store.bucket_ts)[np.asarray(store.alive)]
-                if bts.size:
-                    newest_half = np.sort(bts)[::-1][:self.capacity // 2]
-                    pressure_cutoff = int(newest_half[-1])
+                alive = np.asarray(store.alive)
+                bts = np.asarray(store.bucket_ts)[alive]
+                newest_half = np.sort(bts)[::-1][:self.capacity // 2]
+                pressure_cutoff = int(newest_half[-1])
+                would_keep = int((bts >= max(cutoff or 0, pressure_cutoff)).sum())
+                if would_keep > int(0.7 * self.capacity):
+                    grow = True  # eviction can't help: too many live groups
+                else:
                     cutoff = max(cutoff or 0, pressure_cutoff)
                     import warnings
                     warnings.warn(
@@ -401,6 +434,8 @@ class AggregationRuntime(Receiver):
                 if (alive & (bts < cutoff)).any():
                     self._replace_store(
                         d_idx, self._evict(store, jnp.int64(cutoff)))
+        if grow:
+            self._grow()
 
     # ---------------------------------------------------------------- runtime
 
@@ -454,9 +489,9 @@ class AggregationRuntime(Receiver):
         if within_range is not None:
             lo = parse_time_constant(_const_value(within_range[0]))
             if within_range[1] is None:
-                # single-value within: one bucket of the per duration — the
-                # reference's `within <point>` form
-                hi = lo + 1
+                # single-value within: the whole bucket containing the instant
+                # (reference's `within <point>` form)
+                lo, hi = bucket_bounds(self.durations[d_idx], lo)
             else:
                 hi = parse_time_constant(_const_value(within_range[1]))
             within = (lo, hi)

@@ -31,7 +31,8 @@ from .stream import (
 
 class SiddhiAppRuntime:
     def __init__(self, app: SiddhiApp, registry: Registry,
-                 batch_size: int = 0, group_capacity: int = 0) -> None:
+                 batch_size: int = 0, group_capacity: int = 0,
+                 error_store=None) -> None:
         self.app = app
         playback_ann = app.annotation("app:playback")
         self.ctx = SiddhiAppContext(
@@ -43,6 +44,7 @@ class SiddhiAppRuntime:
             playback=playback_ann is not None,
         )
         self.ctx.runtime = self
+        self.ctx.error_store = error_store
         from .event import StringTable
         self.ctx.global_strings = StringTable()
         stats_ann = app.annotation("app:statistics")
@@ -57,6 +59,9 @@ class SiddhiAppRuntime:
         self.triggers: dict = {}
         self.aggregations: dict = {}
         self.partitions: dict = {}
+        self.sources: list = []
+        self.sinks: list = []
+        self.fault_junctions: dict[str, StreamJunction] = {}
         self._started = False
 
         self._build()
@@ -66,8 +71,25 @@ class SiddhiAppRuntime:
     def _build(self) -> None:
         app, ctx = self.app, self.ctx
 
+        from ..io.wiring import build_sink, build_source
+        from ..query_api.definition import Attribute, AttributeType
         for sd in app.stream_definitions.values():
-            self.junctions[sd.id] = StreamJunction(sd, ctx)
+            junction = StreamJunction(sd, ctx)
+            self.junctions[sd.id] = junction
+            if junction.on_error_action == "stream":
+                # `!stream` fault junction: original attrs + _error message
+                # (reference: StreamJunction fault streams :371-463)
+                fd = StreamDefinition(
+                    id=f"!{sd.id}",
+                    attributes=tuple(sd.attributes)
+                    + (Attribute("_error", AttributeType.STRING),))
+                junction.fault_junction = StreamJunction(fd, ctx)
+                self.fault_junctions[sd.id] = junction.fault_junction
+            for ann in sd.annotations or ():
+                if ann.name.lower() == "source":
+                    self.sources.append(build_source(ann, junction, ctx))
+                elif ann.name.lower() == "sink":
+                    self.sinks.append(build_sink(ann, junction, ctx))
 
         from .table import InMemoryTable
         for td in app.table_definitions.values():
@@ -112,6 +134,18 @@ class SiddhiAppRuntime:
             qr = self._add_pattern_query(query, name)
         elif isinstance(query.input_stream, SingleInputStream):
             sid = query.input_stream.stream_id
+            if query.input_stream.is_fault:
+                junction = self.fault_junctions.get(sid)
+                if junction is None:
+                    raise DefinitionNotExistError(
+                        f"stream {sid!r} has no fault stream (add "
+                        "@OnError(action='STREAM'))")
+                qr = QueryRuntime(query, self.ctx, junction, self.ctx.registry,
+                                  name=name, tables=self.tables)
+                junction.subscribe(qr)
+                self.query_runtimes[name] = qr
+                self._wire_output(qr, query)
+                return
             junction = self.junctions.get(sid)
             if junction is None and sid in self.windows:
                 # `from W ...` consumes the named window's emissions
@@ -154,6 +188,14 @@ class SiddhiAppRuntime:
 
     def _wire_output(self, qr, query: Query) -> None:
         out = query.output_stream
+        if out.action == OutputAction.INSERT and out.is_fault and out.target_id:
+            target = self.fault_junctions.get(out.target_id)
+            if target is None:
+                raise DefinitionNotExistError(
+                    f"stream {out.target_id!r} has no fault stream (add "
+                    "@OnError(action='STREAM'))")
+            qr.output_junction = target
+            return
         if out.action == OutputAction.INSERT and out.target_id:
             if out.target_id in self.tables:
                 qr.output_junction = _TableJunctionAdapter(self.tables[out.target_id])
@@ -187,6 +229,10 @@ class SiddhiAppRuntime:
 
     def start(self) -> None:
         self._started = True
+        for sink in self.sinks:
+            sink.connect()
+        for source in self.sources:
+            source.connect_with_retry()
         if self.triggers:
             now = self.ctx.timestamp_generator.current_time()
             for tr in self.triggers.values():
@@ -197,6 +243,10 @@ class SiddhiAppRuntime:
         self._started = False
         for tr in self.triggers.values():
             tr.shutdown()
+        for source in self.sources:
+            source.disconnect()
+        for sink in self.sinks:
+            sink.disconnect()
 
     # ------------------------------------------------------------------- I/O
 
@@ -209,7 +259,10 @@ class SiddhiAppRuntime:
         return self.input_handlers[stream_id]
 
     def add_callback(self, stream_id: str, callback) -> None:
-        junction = self.junctions.get(stream_id)
+        if stream_id.startswith("!"):
+            junction = self.fault_junctions.get(stream_id[1:])
+        else:
+            junction = self.junctions.get(stream_id)
         if junction is None:
             raise DefinitionNotExistError(f"stream {stream_id!r} is not defined")
         if not isinstance(callback, StreamCallback):

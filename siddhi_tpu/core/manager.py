@@ -24,6 +24,8 @@ class SiddhiManager:
         #: shared store for all apps (reference:
         #: SiddhiManager.setPersistenceStore)
         self.persistence_store = None
+        #: shared error store (reference: SiddhiManager.setErrorStore)
+        self.error_store = None
 
     def create_siddhi_app_runtime(
         self, app: Union[str, SiddhiApp], *,
@@ -33,7 +35,8 @@ class SiddhiManager:
             text = compiler.update_variables(app) if "${" in app else app
             app = compiler.parse(text)
         rt = SiddhiAppRuntime(app, self.registry, batch_size=batch_size,
-                              group_capacity=group_capacity)
+                              group_capacity=group_capacity,
+                              error_store=self.error_store)
         if self.persistence_store is not None:
             rt.persistence_store = self.persistence_store
         self.runtimes[app.name] = rt
@@ -44,6 +47,12 @@ class SiddhiManager:
         self.persistence_store = store
         for rt in self.runtimes.values():
             rt.persistence_store = store
+
+    def set_error_store(self, store) -> None:
+        """Reference: SiddhiManager.setErrorStore — shared by all apps."""
+        self.error_store = store
+        for rt in self.runtimes.values():
+            rt.ctx.error_store = store
 
     def persist(self) -> dict:
         """Persist every running app (reference: SiddhiManager.persist:291)."""

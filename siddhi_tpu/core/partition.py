@@ -206,8 +206,11 @@ class PartitionRuntime:
             if outer is None:
                 raise DefinitionNotExistError(
                     f"stream {sid!r} (used in partition) is not defined")
-            self.proxies[sid] = StreamJunction(
-                outer.definition, self.ctx, codec=outer.codec)
+            proxy = StreamJunction(outer.definition, self.ctx, codec=outer.codec)
+            # @OnError(action='STREAM') failures inside the partition route to
+            # the same !stream as outside it
+            proxy.fault_junction = outer.fault_junction
+            self.proxies[sid] = proxy
         return self.proxies[sid]
 
     def _resolve_input(self, sid: str, is_inner: bool) -> StreamJunction:

@@ -16,6 +16,7 @@ so multi-query pipelines stay on device until a host callback needs decoding.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Callable, Optional, Sequence
 
 import numpy as np
@@ -79,6 +80,16 @@ class StreamJunction:
         self._staged_ts: list[int] = []
         self.on_error: Optional[Callable] = None
         self._flushing = False
+        # @OnError(action=LOG|STREAM|STORE) (reference:
+        # StreamJunction.java:371-463, OnErrorAction); None = propagate
+        on_error_ann = (definition.annotation("OnError")
+                        if definition.annotations else None)
+        self.on_error_action: Optional[str] = (
+            (on_error_ann.element("action") or "log").lower()
+            if on_error_ann is not None else None)
+        #: fault junction (`!stream`), created by the app runtime for
+        #: action=STREAM; schema = this stream's attrs + _error string
+        self.fault_junction: Optional["StreamJunction"] = None
 
     # ------------------------------------------------------------- subscribe
 
@@ -130,6 +141,29 @@ class StreamJunction:
             self._deliver(batch, now if now is not None else
                           self.ctx.timestamp_generator.current_time())
 
+    def _handle_error(self, e: Exception, batch: EventBatch, now: int) -> None:
+        """@OnError dispatch (reference: StreamJunction.java:371-463)."""
+        action = self.on_error_action
+        if action == "stream" and self.fault_junction is not None:
+            # route failed events + error message into `!stream`
+            for ev in batch.to_host_events(self.codec):
+                self.fault_junction.send_row(ev.timestamp,
+                                             tuple(ev.data) + (str(e),))
+            self.fault_junction.flush(now)
+            return
+        if action == "store":
+            store = getattr(self.ctx, "error_store", None)
+            if store is not None:
+                events = [(ev.timestamp, tuple(ev.data))
+                          for ev in batch.to_host_events(self.codec)]
+                store.save(self.ctx.name, self.definition.id, events, str(e))
+                return
+            logging.getLogger("siddhi_tpu").error(
+                "@OnError(action='STORE') on %r but no error store configured; "
+                "logging instead", self.definition.id)
+        logging.getLogger("siddhi_tpu").exception(
+            "error processing %r events: %s", self.definition.id, e)
+
     def heartbeat(self, now: int) -> None:
         """Advance time with no data: flush staged rows then deliver an empty
         batch so time-window expirations fire (the watermark analogue of the
@@ -150,6 +184,8 @@ class StreamJunction:
                 except Exception as e:  # noqa: BLE001
                     if self.on_error is not None:
                         self.on_error(e, batch)
+                    elif self.on_error_action is not None:
+                        self._handle_error(e, batch, now)
                     else:
                         raise
         finally:

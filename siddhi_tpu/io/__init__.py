@@ -1,0 +1,52 @@
+"""IO layer: sources, sinks, mappers, in-memory broker, distributed transports
+(reference: core/stream/input/source/, core/stream/output/sink/,
+core/util/transport/)."""
+
+from .broker import InMemoryBroker, Subscriber
+from .sink import (
+    BroadcastStrategy,
+    DistributedSink,
+    DistributionStrategy,
+    InMemorySink,
+    JsonSinkMapper,
+    LogSink,
+    PartitionedStrategy,
+    PassThroughSinkMapper,
+    RoundRobinStrategy,
+    Sink,
+    SinkMapper,
+    TextSinkMapper,
+)
+from .source import (
+    BackoffRetryCounter,
+    ConnectionUnavailableException,
+    InMemorySource,
+    JsonSourceMapper,
+    PassThroughSourceMapper,
+    Source,
+    SourceMapper,
+)
+
+__all__ = [
+    "BackoffRetryCounter",
+    "BroadcastStrategy",
+    "ConnectionUnavailableException",
+    "DistributedSink",
+    "DistributionStrategy",
+    "InMemoryBroker",
+    "InMemorySink",
+    "InMemorySource",
+    "JsonSinkMapper",
+    "JsonSourceMapper",
+    "LogSink",
+    "PartitionedStrategy",
+    "PassThroughSinkMapper",
+    "PassThroughSourceMapper",
+    "RoundRobinStrategy",
+    "Sink",
+    "SinkMapper",
+    "Source",
+    "SourceMapper",
+    "Subscriber",
+    "TextSinkMapper",
+]

@@ -1,0 +1,70 @@
+"""In-memory topic broker — the zero-I/O transport fabric used by the inMemory
+source/sink pair and the behavioral test harness.
+
+Reference: core/util/transport/InMemoryBroker.java:29 — a static topic →
+subscribers map with publish/subscribe. Kept process-global exactly like the
+reference so separate SiddhiManager instances exchange messages in tests.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+
+class Subscriber:
+    """Reference: InMemoryBroker.Subscriber — onMessage + topic."""
+
+    def on_message(self, msg) -> None:
+        raise NotImplementedError
+
+    def get_topic(self) -> str:
+        raise NotImplementedError
+
+
+class _FnSubscriber(Subscriber):
+    def __init__(self, topic: str, fn: Callable):
+        self._topic = topic
+        self._fn = fn
+
+    def on_message(self, msg) -> None:
+        self._fn(msg)
+
+    def get_topic(self) -> str:
+        return self._topic
+
+
+class InMemoryBroker:
+    """Static pub/sub hub (all methods class-level, like the reference)."""
+
+    _topics: dict[str, list[Subscriber]] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def subscribe(cls, subscriber: Subscriber) -> None:
+        with cls._lock:
+            cls._topics.setdefault(subscriber.get_topic(), []).append(subscriber)
+
+    @classmethod
+    def subscribe_fn(cls, topic: str, fn: Callable) -> Subscriber:
+        sub = _FnSubscriber(topic, fn)
+        cls.subscribe(sub)
+        return sub
+
+    @classmethod
+    def unsubscribe(cls, subscriber: Subscriber) -> None:
+        with cls._lock:
+            subs = cls._topics.get(subscriber.get_topic(), [])
+            if subscriber in subs:
+                subs.remove(subscriber)
+
+    @classmethod
+    def publish(cls, topic: str, msg) -> None:
+        for sub in list(cls._topics.get(topic, [])):
+            sub.on_message(msg)
+
+    @classmethod
+    def clear(cls) -> None:
+        """Test helper: drop all subscriptions."""
+        with cls._lock:
+            cls._topics.clear()

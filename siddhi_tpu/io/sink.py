@@ -1,0 +1,205 @@
+"""Sinks, sink mappers & distributed transports — stream events out.
+
+Reference: core/stream/output/sink/Sink.java:62 (publish + retry),
+SinkMapper.java:44 (event → payload with {{attr}} templating —
+core/util/transport/TemplateBuilder.java), InMemorySink.java:64, LogSink.java,
+distributed/DistributedTransport.java + RoundRobin/Partitioned/Broadcast
+DistributionStrategy (core/util/transport/), SinkHandler SPI.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import logging
+import re
+from typing import Optional
+
+from ..errors import SiddhiAppCreationError
+from ..extension.registry import GLOBAL, ExtensionKind
+from .broker import InMemoryBroker
+from .source import BackoffRetryCounter, ConnectionUnavailableException
+
+log = logging.getLogger("siddhi_tpu")
+
+
+class SinkMapper:
+    """Row → payload SPI (reference: SinkMapper.java:44)."""
+
+    def init(self, stream_definition, options: dict,
+             payload_template: Optional[str]) -> None:
+        self.definition = stream_definition
+        self.options = options
+        self.payload_template = payload_template
+
+    def map(self, row: tuple) -> object:
+        raise NotImplementedError
+
+
+class PassThroughSinkMapper(SinkMapper):
+    def map(self, row: tuple) -> object:
+        return row
+
+
+class JsonSinkMapper(SinkMapper):
+    """@map(type='json') — {"event": {attr: value}}."""
+
+    def map(self, row: tuple) -> object:
+        ev = {a.name: v for a, v in zip(self.definition.attributes, row)}
+        return _json.dumps({"event": ev})
+
+
+_TEMPLATE_RE = re.compile(r"\{\{(\w+)\}\}")
+
+
+class TextSinkMapper(SinkMapper):
+    """@map(type='text', @payload('price is {{price}}')) — the reference's
+    TemplateBuilder {{attr}} substitution (core/util/transport/TemplateBuilder.java)."""
+
+    def map(self, row: tuple) -> object:
+        values = {a.name: v for a, v in zip(self.definition.attributes, row)}
+        if self.payload_template is None:
+            return ", ".join(f"{k}:{v}" for k, v in values.items())
+        return _TEMPLATE_RE.sub(lambda m: str(values[m.group(1)]),
+                                self.payload_template)
+
+
+class Sink:
+    """Transport SPI (reference: Sink.java:62)."""
+
+    def init(self, stream_definition, options: dict, mapper: SinkMapper, ctx) -> None:
+        self.definition = stream_definition
+        self.options = options
+        self.mapper = mapper
+        self.ctx = ctx
+
+    def connect(self) -> None:
+        pass
+
+    def disconnect(self) -> None:
+        pass
+
+    def publish(self, payload) -> None:
+        raise NotImplementedError
+
+    def publish_rows(self, rows: list[tuple]) -> None:
+        for row in rows:
+            self.publish(self.mapper.map(row))
+
+
+class InMemorySink(Sink):
+    """@sink(type='inMemory', topic='x') (reference: InMemorySink.java:64)."""
+
+    def init(self, stream_definition, options, mapper, ctx) -> None:
+        super().init(stream_definition, options, mapper, ctx)
+        self.topic = options.get("topic")
+        if not self.topic:
+            raise SiddhiAppCreationError("inMemory sink needs topic=")
+
+    def publish(self, payload) -> None:
+        InMemoryBroker.publish(self.topic, payload)
+
+
+class LogSink(Sink):
+    """@sink(type='log') (reference: LogSink.java) — logs each event."""
+
+    def init(self, stream_definition, options, mapper, ctx) -> None:
+        super().init(stream_definition, options, mapper, ctx)
+        self.prefix = options.get("prefix", f"{ctx.name}:{stream_definition.id}")
+
+    def publish(self, payload) -> None:
+        log.info("%s : %s", self.prefix, payload)
+
+
+# --------------------------------------------------------------------------- #
+# distributed transports
+# --------------------------------------------------------------------------- #
+
+
+class DistributionStrategy:
+    """Reference: core/stream/output/sink/distributed/DistributionStrategy.java —
+    picks destination indices per event."""
+
+    def init(self, n_destinations: int, options: dict, stream_definition) -> None:
+        self.n = n_destinations
+
+    def destinations(self, row: tuple) -> list[int]:
+        raise NotImplementedError
+
+
+class RoundRobinStrategy(DistributionStrategy):
+    def init(self, n, options, stream_definition) -> None:
+        super().init(n, options, stream_definition)
+        self._i = 0
+
+    def destinations(self, row):
+        d = self._i % self.n
+        self._i += 1
+        return [d]
+
+
+class PartitionedStrategy(DistributionStrategy):
+    """@distribution(strategy='partitioned', partitionKey='attr')."""
+
+    def init(self, n, options, stream_definition) -> None:
+        super().init(n, options, stream_definition)
+        key = options.get("partitionKey") or options.get("partition.key")
+        if not key:
+            raise SiddhiAppCreationError(
+                "partitioned distribution needs partitionKey=")
+        names = [a.name for a in stream_definition.attributes]
+        if key not in names:
+            raise SiddhiAppCreationError(f"partitionKey {key!r} not an attribute")
+        self._idx = names.index(key)
+
+    def destinations(self, row):
+        return [hash(row[self._idx]) % self.n]
+
+
+class BroadcastStrategy(DistributionStrategy):
+    def destinations(self, row):
+        return list(range(self.n))
+
+
+class DistributedSink(Sink):
+    """Fans one logical sink out across N destination sinks (reference:
+    MultiClientDistributedSink / SingleClientDistributedSink +
+    DistributedTransport)."""
+
+    def init_distributed(self, destinations: list[Sink],
+                         strategy: DistributionStrategy) -> None:
+        self.destinations = destinations
+        self.strategy = strategy
+
+    def publish_rows(self, rows: list[tuple]) -> None:
+        for row in rows:
+            payload, payload_mapper = None, None
+            for d in self.strategy.destinations(row):
+                sink = self.destinations[d]
+                if sink.mapper is not payload_mapper:
+                    payload, payload_mapper = sink.mapper.map(row), sink.mapper
+                sink.publish(payload)
+
+    def connect(self) -> None:
+        for d in self.destinations:
+            d.connect()
+
+    def disconnect(self) -> None:
+        for d in self.destinations:
+            d.disconnect()
+
+
+def register_all() -> None:
+    GLOBAL.register(ExtensionKind.SINK, "", "inMemory", InMemorySink)
+    GLOBAL.register(ExtensionKind.SINK, "", "log", LogSink)
+    GLOBAL.register(ExtensionKind.SINK_MAPPER, "", "passThrough", PassThroughSinkMapper)
+    GLOBAL.register(ExtensionKind.SINK_MAPPER, "", "json", JsonSinkMapper)
+    GLOBAL.register(ExtensionKind.SINK_MAPPER, "", "text", TextSinkMapper)
+    GLOBAL.register(ExtensionKind.DISTRIBUTION_STRATEGY, "", "roundRobin",
+                    RoundRobinStrategy)
+    GLOBAL.register(ExtensionKind.DISTRIBUTION_STRATEGY, "", "partitioned",
+                    PartitionedStrategy)
+    GLOBAL.register(ExtensionKind.DISTRIBUTION_STRATEGY, "", "broadcast",
+                    BroadcastStrategy)
+
+
+register_all()

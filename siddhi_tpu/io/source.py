@@ -1,0 +1,198 @@
+"""Sources & source mappers — external payloads into stream junctions.
+
+Reference: core/stream/input/source/Source.java:50 (abstract
+init/connect/disconnect/pause/resume:113-153, connectWithRetry with exponential
+BackoffRetryCounter:155-177), SourceMapper.java:49 (payload → events with
+@attributes mappings), InMemorySource.java:63 (@Extension name="inMemory"),
+SourceHandler (interception SPI).
+
+TPU note: sources are host-side by definition; their job here is to land
+payloads in the junction's staging buffers, where the micro-batcher takes over.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import time
+from typing import Callable, Optional
+
+from ..errors import SiddhiAppCreationError
+from ..extension.registry import GLOBAL, ExtensionKind
+from .broker import InMemoryBroker, Subscriber
+
+
+class BackoffRetryCounter:
+    """Reference: core/util/transport/BackoffRetryCounter.java — 5ms→1hr
+    exponential schedule (the reference's literal table)."""
+
+    _INTERVALS_MS = [5, 50, 500, 5_000, 10_000, 30_000, 60_000, 300_000,
+                     1_800_000, 3_600_000]
+
+    def __init__(self) -> None:
+        self._i = 0
+
+    def get_time_interval_ms(self) -> int:
+        return self._INTERVALS_MS[self._i]
+
+    def increment(self) -> None:
+        if self._i < len(self._INTERVALS_MS) - 1:
+            self._i += 1
+
+    def reset(self) -> None:
+        self._i = 0
+
+
+class ConnectionUnavailableException(Exception):
+    """Reference: core/exception/ConnectionUnavailableException.java."""
+
+
+class SourceMapper:
+    """Payload → rows SPI (reference: SourceMapper.java:49). Subclasses parse
+    one transport message into row tuples ordered per the stream schema."""
+
+    def init(self, stream_definition, options: dict, attribute_mappings) -> None:
+        self.definition = stream_definition
+        self.options = options
+        self.attribute_mappings = attribute_mappings  # list[(attr, path)] or None
+
+    def map(self, payload) -> list[tuple]:
+        raise NotImplementedError
+
+
+class PassThroughSourceMapper(SourceMapper):
+    """@map(type='passThrough') — payload already is a row (tuple/list) or a
+    list of rows (reference: PassThroughSourceMapper.java)."""
+
+    def map(self, payload) -> list[tuple]:
+        if isinstance(payload, (list,)) and payload and isinstance(payload[0], (list, tuple)):
+            return [tuple(r) for r in payload]
+        if isinstance(payload, (list, tuple)):
+            return [tuple(payload)]
+        raise SiddhiAppCreationError(
+            f"passThrough mapper expects row tuples, got {type(payload).__name__}")
+
+
+class JsonSourceMapper(SourceMapper):
+    """@map(type='json') — parses {"event": {attr: value}} | [events] | a bare
+    attr dict, with optional @attributes(attr='json.path') dotted-path
+    mappings (the core behavior of the siddhi-map-json extension)."""
+
+    def map(self, payload) -> list[tuple]:
+        data = _json.loads(payload) if isinstance(payload, (str, bytes)) else payload
+        events = data if isinstance(data, list) else [data]
+        rows = []
+        for ev in events:
+            if isinstance(ev, dict) and "event" in ev:
+                ev = ev["event"]
+            rows.append(self._row(ev))
+        return rows
+
+    def _row(self, ev: dict) -> tuple:
+        if self.attribute_mappings:
+            return tuple(self._path(ev, path)
+                         for _attr, path in self.attribute_mappings)
+        return tuple(ev[a.name] for a in self.definition.attributes)
+
+    @staticmethod
+    def _path(obj, path: str):
+        cur = obj
+        for part in path.replace("$.", "").split("."):
+            cur = cur[part]
+        return cur
+
+
+class Source:
+    """Transport SPI (reference: Source.java:50). Lifecycle:
+    init → connect_with_retry → [pause/resume] → disconnect."""
+
+    def init(self, stream_definition, options: dict, mapper: SourceMapper,
+             handler: Callable[[list[tuple]], None], ctx) -> None:
+        self.definition = stream_definition
+        self.options = options
+        self.mapper = mapper
+        self._handler = handler
+        self.ctx = ctx
+        self._paused = False
+        self._pending: list = []
+
+    # -- transport hooks -----------------------------------------------------
+
+    def connect(self) -> None:
+        raise NotImplementedError
+
+    def disconnect(self) -> None:
+        raise NotImplementedError
+
+    def pause(self) -> None:
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
+        pending, self._pending = self._pending, []
+        for payload in pending:
+            self.on_payload(payload)
+
+    # -- runtime -------------------------------------------------------------
+
+    def on_payload(self, payload) -> None:
+        """Transport callback: map + hand rows to the junction."""
+        if self._paused:
+            self._pending.append(payload)
+            return
+        self._handler(self.mapper.map(payload))
+
+    def connect_with_retry(self, max_attempts: int = 3,
+                           sleep: Callable[[float], None] = time.sleep) -> None:
+        """Reference: Source.connectWithRetry:155-177 — exponential backoff on
+        ConnectionUnavailableException. max_attempts bounds the synchronous
+        build (the reference retries forever on a scheduler thread)."""
+        counter = BackoffRetryCounter()
+        attempt = 0
+        while True:
+            try:
+                self.connect()
+                counter.reset()
+                return
+            except ConnectionUnavailableException:
+                attempt += 1
+                if attempt >= max_attempts:
+                    raise
+                sleep(counter.get_time_interval_ms() / 1000.0)
+                counter.increment()
+
+
+class InMemorySource(Source):
+    """@source(type='inMemory', topic='x') (reference: InMemorySource.java:63)."""
+
+    def connect(self) -> None:
+        topic = self.options.get("topic")
+        if not topic:
+            raise SiddhiAppCreationError("inMemory source needs topic=")
+        self._sub = InMemoryBroker.subscribe_fn(topic, self.on_payload)
+
+    def disconnect(self) -> None:
+        if getattr(self, "_sub", None) is not None:
+            InMemoryBroker.unsubscribe(self._sub)
+            self._sub = None
+
+
+class TimerSource(Source):
+    """@source(type='timer', interval='1000') — poll-driven synthetic source
+    for tests/benchmarks; fires one empty-keyed row per poll tick."""
+
+    def connect(self) -> None:
+        self._connected = True
+
+    def disconnect(self) -> None:
+        self._connected = False
+
+
+def register_all() -> None:
+    GLOBAL.register(ExtensionKind.SOURCE, "", "inMemory", InMemorySource)
+    GLOBAL.register(ExtensionKind.SOURCE, "", "timer", TimerSource)
+    GLOBAL.register(ExtensionKind.SOURCE_MAPPER, "", "passThrough",
+                    PassThroughSourceMapper)
+    GLOBAL.register(ExtensionKind.SOURCE_MAPPER, "", "json", JsonSourceMapper)
+
+
+register_all()

@@ -1,0 +1,353 @@
+"""Additional window operators: cron, hopping, frequent, lossyFrequent.
+
+Reference: core/query/processor/stream/window/ —
+CronWindowProcessor.java (quartz-driven tumble), HoppingWindowProcessor.java
+(emit every hop covering the last windowTime), FrequentWindowProcessor.java
+(Misra-Gries counter map, evicted keys emit EXPIRED),
+LossyFrequentWindowProcessor.java (lossy counting with support/error bounds).
+
+Batched divergences (documented per class): counter updates happen at
+micro-batch granularity instead of per event, and multiple simultaneous
+boundary crossings collapse into the latest one.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.event import EventBatch, EventType
+from ..errors import SiddhiAppCreationError
+from .windows import (
+    BIG,
+    WindowOp,
+    _empty_like_cols,
+    _gather_overall,
+    _ring_live_mask,
+    _scatter_append,
+    compact,
+)
+
+
+class CronState(NamedTuple):
+    ring_cols: dict
+    ring_ts: jax.Array
+    appended: jax.Array  # int64 total arrivals
+    flushed: jax.Array  # int64 arrivals already emitted
+    prev_start: jax.Array  # int64 start of the previous flush
+    next_fire: jax.Array  # int64 epoch ms of the next cron fire
+
+
+class CronWindow(WindowOp):
+    """cron('0 0/5 * * * ?'): tumble on cron fire times. The next-fire instant
+    lives IN the state; crossing it flushes the buffer. The cron expression is
+    evaluated host-side through jax.pure_callback — one scalar callback per
+    fire, zero per quiet step (reference: CronWindowProcessor.java delegates
+    to quartz the same way)."""
+
+    def __init__(self, layout: dict, batch_cap: int, expr: str,
+                 expired_on: bool = True):
+        from ..core.trigger import CronSchedule
+        self.layout = layout
+        self.B = batch_cap
+        self.expired_on = expired_on
+        self.schedule = CronSchedule(expr)
+        self.C = max(4 * batch_cap, 1024)
+        self.chunk_width = 2 * self.C + 1
+
+    def init_state(self) -> CronState:
+        return CronState(
+            ring_cols=_empty_like_cols(self.layout, self.C),
+            ring_ts=jnp.zeros((self.C,), dtypes.TS_DTYPE),
+            appended=jnp.int64(0),
+            flushed=jnp.int64(0),
+            prev_start=jnp.int64(0),
+            next_fire=jnp.int64(-1),  # -1 = not yet scheduled
+        )
+
+    def _host_next_fire(self, after_ms):
+        def fn(t):
+            import numpy as np
+            nxt = self.schedule.next_fire_ms(int(t))
+            return np.int64(nxt if nxt is not None else 2**62)
+
+        return jax.pure_callback(
+            fn, jax.ShapeDtypeStruct((), jnp.int64), after_ms)
+
+    def step(self, state: CronState, batch: EventBatch, now: jax.Array):
+        C = self.C
+        comp_cols, comp_ts, n_valid, _ = compact(batch)
+        appended1 = state.appended + n_valid
+        ring_cols, ring_ts = _scatter_append(
+            state.ring_cols, state.ring_ts, comp_cols, comp_ts,
+            state.appended, n_valid)
+
+        next_fire = jnp.where(state.next_fire < 0,
+                              self._host_next_fire(now), state.next_fire)
+        fire = next_fire <= now
+
+        # currents: overall [flushed, appended1); expired: [prev_start, flushed)
+        o = jnp.arange(C, dtype=jnp.int64)
+        o_cur = state.flushed + o
+        cur_valid = fire & (o_cur < appended1)
+        cur_cols, cur_ts = _gather_overall(
+            ring_cols, ring_ts, comp_cols, comp_ts, appended1, o_cur)
+        o_exp = state.prev_start + o
+        exp_valid = (fire & self.expired_on & (o_exp < state.flushed)
+                     & (state.flushed - o_exp <= C))
+        exp_cols, exp_ts = _gather_overall(
+            ring_cols, ring_ts, comp_cols, comp_ts, appended1, o_exp)
+
+        cols = {k: jnp.concatenate(
+            [exp_cols[k], jnp.zeros((1,), v.dtype), cur_cols[k]])
+            for k, v in ring_cols.items()}
+        ts = jnp.concatenate([exp_ts, now[None], cur_ts])
+        valid = jnp.concatenate(
+            [exp_valid, fire[None] & (state.flushed > state.prev_start), cur_valid])
+        types = jnp.concatenate([
+            jnp.full((C,), EventType.EXPIRED, jnp.int8),
+            jnp.full((1,), EventType.RESET, jnp.int8),
+            jnp.full((C,), EventType.CURRENT, jnp.int8)])
+        chunk = EventBatch(ts=ts, cols=cols, valid=valid, types=types)
+
+        new_next = jnp.where(fire, self._host_next_fire(now), next_fire)
+        new_state = CronState(
+            ring_cols=ring_cols, ring_ts=ring_ts,
+            appended=appended1,
+            flushed=jnp.where(fire, appended1, state.flushed),
+            prev_start=jnp.where(fire, state.flushed, state.prev_start),
+            next_fire=new_next,
+        )
+        return new_state, chunk
+
+    def contents(self, state: CronState, now: jax.Array):
+        live = _ring_live_mask(self.C, state.flushed, state.appended)
+        return state.ring_cols, state.ring_ts, live
+
+
+class HopState(NamedTuple):
+    ring_cols: dict
+    ring_ts: jax.Array
+    appended: jax.Array  # int64 total arrivals
+    last_hop: jax.Array  # int64 index of the last emitted hop boundary
+
+
+class HoppingWindow(WindowOp):
+    """hopping(windowTime, hopTime): every hopTime emit the events of the last
+    windowTime (overlapping when window > hop; reference:
+    HoppingWindowProcessor.java). Batched divergence: multiple hop boundaries
+    crossed inside one micro-batch collapse into the latest boundary's
+    emission."""
+
+    def __init__(self, layout: dict, batch_cap: int, window_ms: int,
+                 hop_ms: int):
+        if hop_ms <= 0 or window_ms <= 0:
+            raise SiddhiAppCreationError("hopping needs positive window and hop")
+        self.layout = layout
+        self.B = batch_cap
+        self.W = window_ms
+        self.H = hop_ms
+        self.C = max(2 * batch_cap, 1024)
+        self.chunk_width = self.C + 1  # RESET + window contents
+
+    def init_state(self) -> HopState:
+        return HopState(
+            ring_cols=_empty_like_cols(self.layout, self.C),
+            ring_ts=jnp.zeros((self.C,), dtypes.TS_DTYPE),
+            appended=jnp.int64(0),
+            last_hop=jnp.int64(0),
+        )
+
+    def step(self, state: HopState, batch: EventBatch, now: jax.Array):
+        C = self.C
+        comp_cols, comp_ts, n_valid, _ = compact(batch)
+        appended1 = state.appended + n_valid
+        ring_cols, ring_ts = _scatter_append(
+            state.ring_cols, state.ring_ts, comp_cols, comp_ts,
+            state.appended, n_valid)
+
+        hop_idx = now // jnp.int64(self.H)
+        fire = hop_idx > state.last_hop
+        boundary = hop_idx * jnp.int64(self.H)
+
+        live = _ring_live_mask(C, jnp.maximum(appended1 - C, 0), appended1)
+        in_window = live & (ring_ts > boundary - jnp.int64(self.W)) \
+            & (ring_ts <= boundary)
+        valid = jnp.concatenate([fire[None], fire & in_window])
+        cols = {k: jnp.concatenate([jnp.zeros((1,), v.dtype), v])
+                for k, v in ring_cols.items()}
+        ts = jnp.concatenate([now[None], ring_ts])
+        types = jnp.concatenate([
+            jnp.full((1,), EventType.RESET, jnp.int8),
+            jnp.full((C,), EventType.CURRENT, jnp.int8)])
+        chunk = EventBatch(ts=ts, cols=cols, valid=valid, types=types)
+
+        new_state = HopState(
+            ring_cols=ring_cols, ring_ts=ring_ts, appended=appended1,
+            last_hop=jnp.where(fire, hop_idx, state.last_hop))
+        return new_state, chunk
+
+    def contents(self, state: HopState, now: jax.Array):
+        live = _ring_live_mask(self.C, jnp.maximum(state.appended - self.C, 0),
+                               state.appended)
+        in_window = live & (state.ring_ts > now - jnp.int64(self.W))
+        return state.ring_cols, state.ring_ts, in_window
+
+
+class FrequentState(NamedTuple):
+    slot_keys: jax.Array  # int64[N], PAD when empty
+    slot_counts: jax.Array  # int64[N]
+    slot_cols: dict  # latest event per slot
+    slot_ts: jax.Array  # int64[N]
+    total: jax.Array  # int64 total arrivals (lossyFrequent)
+
+
+_PAD = jnp.iinfo(jnp.int64).max
+
+
+class FrequentWindow(WindowOp):
+    """frequent(N[, attrs...]): keep events whose attribute combination is one
+    of the N most frequent — Misra-Gries counters (reference:
+    FrequentWindowProcessor.java). Evicted keys emit their remembered latest
+    event as EXPIRED. Batched divergence: counter decrements are applied per
+    micro-batch, so within-batch admit/evict interleavings collapse."""
+
+    def __init__(self, layout: dict, batch_cap: int, n_slots: int,
+                 key_attrs: Optional[list] = None, support: float = 0.0,
+                 error: float = 0.0, lossy: bool = False):
+        self.layout = layout
+        self.B = batch_cap
+        self.N = n_slots
+        self.key_attrs = key_attrs or list(layout.keys())
+        for a in self.key_attrs:
+            if a not in layout:
+                raise SiddhiAppCreationError(f"frequent: no attribute {a!r}")
+        self.support = support
+        self.error = error
+        self.lossy = lossy
+        self.chunk_width = batch_cap + n_slots  # currents + evict-expireds
+
+    def init_state(self) -> FrequentState:
+        N = self.N
+        return FrequentState(
+            slot_keys=jnp.full((N,), _PAD, jnp.int64),
+            slot_counts=jnp.zeros((N,), jnp.int64),
+            slot_cols=_empty_like_cols(self.layout, N),
+            slot_ts=jnp.zeros((N,), dtypes.TS_DTYPE),
+            total=jnp.int64(0),
+        )
+
+    _SCALE = 1_000_000  # fixed-point for support/error thresholds
+
+    def step(self, state: FrequentState, batch: EventBatch, now: jax.Array):
+        from .groupby import hash_columns
+        N, B = self.N, self.B
+        comp_cols, comp_ts, n_valid, _ = compact(batch)
+        lane_live = jnp.arange(B) < n_valid
+        keys = hash_columns([comp_cols[a] for a in self.key_attrs])
+        keys = jnp.where(keys == _PAD, _PAD - 1, keys)
+
+        # batch-unique keys (as runs of the sorted key array) with counts
+        sk = jnp.where(lane_live, keys, _PAD)
+        order = jnp.argsort(sk, stable=True)
+        s = sk[order]
+        first = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+        uniq_rank = jnp.cumsum(first.astype(jnp.int32)) - 1  # run id per lane
+        run_count = jax.ops.segment_sum(
+            (s != _PAD).astype(jnp.int64), uniq_rank, num_segments=B)
+        idx = jnp.arange(B)
+        run_first = jax.ops.segment_min(
+            jnp.where(s != _PAD, idx, B - 1), uniq_rank, num_segments=B)
+        run_key = jnp.where(run_count > 0, s[jnp.clip(run_first, 0, B - 1)], _PAD)
+        uniq_live = run_count > 0
+
+        # match batch-unique keys against tracked slots
+        slot_of = _match(state.slot_keys, run_key)  # [B] slot idx or N
+        tracked = slot_of < N
+
+        # 1) tracked keys: counts += batch count
+        counts1 = state.slot_counts.at[
+            jnp.where(tracked & uniq_live, slot_of, N)].add(
+            run_count, mode="drop")
+
+        # 2) untracked keys fill free slots (j-th new key → j-th free slot)
+        free = state.slot_keys == _PAD
+        sorted_free = jnp.sort(jnp.where(free, jnp.arange(N), N))  # [N]
+        new_need = uniq_live & ~tracked
+        new_rank = jnp.cumsum(new_need.astype(jnp.int32)) - 1
+        n_free = jnp.sum(free.astype(jnp.int32))
+        placed = new_need & (new_rank < n_free)
+        place_slot = jnp.where(
+            placed, sorted_free[jnp.clip(new_rank, 0, N - 1)], N)
+        keys1 = state.slot_keys.at[place_slot].set(run_key, mode="drop")
+        counts2 = counts1.at[place_slot].set(run_count, mode="drop")
+
+        # 3) Misra-Gries decrement: arrivals that found no slot decrement all
+        unplaced_arrivals = jnp.sum(jnp.where(new_need & ~placed, run_count, 0))
+        occupied = keys1 != _PAD
+        counts3 = jnp.where(occupied,
+                            jnp.maximum(counts2 - unplaced_arrivals, 0), 0)
+        evicted = occupied & (counts3 == 0)
+        keys2 = jnp.where(evicted, _PAD, keys1)
+
+        total1 = state.total + n_valid
+        if self.lossy:
+            # lossy-counting prune: drop keys below the error floor
+            # (reference: LossyFrequentWindowProcessor). Fixed-point int math.
+            err = jnp.int64(int(self.error * self._SCALE))
+            lossy_evict = (keys2 != _PAD) & (
+                counts3 * self._SCALE < err * total1)
+            evicted = evicted | lossy_evict
+            keys2 = jnp.where(lossy_evict, _PAD, keys2)
+
+        # remembered latest event per tracked slot — last lane per slot via a
+        # commutative scatter-max (duplicate-index .set order is undefined)
+        lane_slot_of = _match(keys2, keys)  # per original lane
+        lane_tracked = lane_live & (lane_slot_of < N)
+        scat_slot = jnp.where(lane_tracked, lane_slot_of, N)
+        last_lane = jnp.full((N + 1,), -1, jnp.int32).at[scat_slot].max(
+            idx.astype(jnp.int32), mode="drop")[:N]
+        has_new = last_lane >= 0
+        g = jnp.clip(last_lane, 0, B - 1)
+        cols1 = {k: jnp.where(has_new, comp_cols[k][g], state.slot_cols[k])
+                 for k in self.layout}
+        ts1 = jnp.where(has_new, comp_ts[g], state.slot_ts)
+
+        # chunk: CURRENT lanes whose key is tracked post-update (lossy adds a
+        # support threshold), EXPIRED = evicted slots' remembered events
+        cur_valid = lane_tracked
+        if self.lossy:
+            thr = jnp.int64(int((self.support - self.error) * self._SCALE))
+            lane_count = counts3[jnp.clip(lane_slot_of, 0, N - 1)]
+            cur_valid = cur_valid & (lane_count * self._SCALE >= thr * total1)
+        ev_cols = {k: jnp.concatenate([comp_cols[k], state.slot_cols[k]])
+                   for k in self.layout}
+        ev_ts = jnp.concatenate([comp_ts, state.slot_ts])
+        chunk = EventBatch(
+            ts=ev_ts, cols=ev_cols,
+            valid=jnp.concatenate([cur_valid, evicted]),
+            types=jnp.concatenate([
+                jnp.full((B,), EventType.CURRENT, jnp.int8),
+                jnp.full((N,), EventType.EXPIRED, jnp.int8)]))
+
+        new_state = FrequentState(
+            slot_keys=keys2, slot_counts=counts3, slot_cols=cols1,
+            slot_ts=ts1, total=total1)
+        return new_state, chunk
+
+    def contents(self, state: FrequentState, now: jax.Array):
+        return state.slot_cols, state.slot_ts, state.slot_keys != _PAD
+
+
+def _match(table_keys: jax.Array, query_keys: jax.Array) -> jax.Array:
+    """Index of each query key in table_keys, or len(table) when absent."""
+    N = table_keys.shape[0]
+    order = jnp.argsort(table_keys, stable=True)
+    sorted_keys = table_keys[order]
+    pos = jnp.searchsorted(sorted_keys, query_keys)
+    pos_c = jnp.clip(pos, 0, N - 1)
+    found = sorted_keys[pos_c] == query_keys
+    return jnp.where(found, order[pos_c], N).astype(jnp.int32)

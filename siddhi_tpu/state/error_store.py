@@ -1,0 +1,72 @@
+"""Error store — persist-and-replay for events whose processing failed.
+
+Reference: core/util/error/handler/ — ErrorStore SPI:46, ErroneousEvent /
+ErrorEntry model, ErrorStoreHelper; wired from the junction's @OnError STORE
+action (StreamJunction.java:371-463) and replayed by the user via
+SiddhiManager's error store accessors.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class ErrorEntry:
+    """Reference: core/util/error/handler/ErrorEntry.java."""
+
+    id: int
+    timestamp: int
+    app_name: str
+    stream_name: str
+    events: list  # original (event_timestamp, row) pairs
+    cause: str
+
+
+class ErrorStore:
+    """SPI (reference: ErrorStore.java:46)."""
+
+    def save(self, app_name: str, stream_name: str, events: list,
+             cause: str) -> ErrorEntry:
+        """`events` is a list of (event_timestamp, row) pairs."""
+        raise NotImplementedError
+
+    def load(self, app_name: str, stream_name: Optional[str] = None) -> list:
+        raise NotImplementedError
+
+    def discard(self, entry_id: int) -> None:
+        raise NotImplementedError
+
+
+class InMemoryErrorStore(ErrorStore):
+    def __init__(self) -> None:
+        self._entries: dict[int, ErrorEntry] = {}
+        self._ids = itertools.count(1)
+
+    def save(self, app_name, stream_name, events, cause) -> ErrorEntry:
+        entry = ErrorEntry(
+            id=next(self._ids), timestamp=int(time.time() * 1000),
+            app_name=app_name, stream_name=stream_name,
+            events=list(events), cause=cause)
+        self._entries[entry.id] = entry
+        return entry
+
+    def load(self, app_name, stream_name=None) -> list:
+        return [e for e in self._entries.values()
+                if e.app_name == app_name
+                and (stream_name is None or e.stream_name == stream_name)]
+
+    def discard(self, entry_id) -> None:
+        self._entries.pop(entry_id, None)
+
+    def replay(self, entry: ErrorEntry, app_runtime) -> None:
+        """Re-send a stored entry's rows into its original stream — with their
+        ORIGINAL timestamps, so windows/aggregations bucket them correctly —
+        and drop it (reference: replay via ReplayableTableRecord)."""
+        handler = app_runtime.get_input_handler(entry.stream_name)
+        for ts, row in entry.events:
+            handler.send(row, timestamp=ts)
+        self.discard(entry.id)

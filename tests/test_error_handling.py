@@ -1,0 +1,103 @@
+"""Error-handling tests (reference:
+modules/siddhi-core/src/test/java/io/siddhi/core/stream/FaultStreamTestCase,
+ExceptionHandlerTestCase — @OnError LOG/STREAM/STORE, `!stream` fault streams,
+error store save/replay)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.state.error_store import InMemoryErrorStore
+
+APP_BASE = (
+    "define stream S (symbol string, price float);\n"
+    "@OnError(action='{action}')\n"
+    "define stream Out (symbol string, price float);\n"
+    "from S select symbol, price insert into Out;\n")
+
+
+class _Boom(Exception):
+    pass
+
+
+def _raising_callback(events):
+    raise _Boom("downstream exploded")
+
+
+class TestFaultStream:
+    def test_on_error_stream_routes_to_fault_stream(self):
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            APP_BASE.format(action="STREAM")
+            + "@info(name='fq') from !Out select symbol, _error insert into FOut;")
+        rt.start()
+        rt.add_callback("Out", _raising_callback)
+        got = []
+        rt.add_query_callback("fq", lambda ts, i, r: got.extend(i or []))
+        rt.get_input_handler("S").send(("IBM", 75.0))
+        rt.flush()
+        assert len(got) == 1
+        assert got[0].data[0] == "IBM"
+        assert "downstream exploded" in got[0].data[1]
+
+    def test_fault_callback_via_bang_name(self):
+        rt = SiddhiManager().create_siddhi_app_runtime(APP_BASE.format(action="STREAM"))
+        rt.start()
+        rt.add_callback("Out", _raising_callback)
+        got = []
+        rt.add_callback("!Out", lambda events: got.extend(events))
+        rt.get_input_handler("S").send(("IBM", 75.0))
+        rt.flush()
+        assert len(got) == 1
+
+    def test_no_on_error_propagates(self):
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            "define stream S (v long);\n"
+            "from S select v insert into Out;")
+        rt.start()
+        rt.add_callback("Out", _raising_callback)
+        rt.get_input_handler("S").send((1,))
+        with pytest.raises(_Boom):
+            rt.flush()
+
+
+class TestOnErrorLog:
+    def test_log_swallows_and_continues(self, caplog):
+        import logging
+        rt = SiddhiManager().create_siddhi_app_runtime(APP_BASE.format(action="LOG"))
+        rt.start()
+        rt.add_callback("Out", _raising_callback)
+        with caplog.at_level(logging.ERROR, logger="siddhi_tpu"):
+            rt.get_input_handler("S").send(("IBM", 75.0))
+            rt.flush()  # no raise
+        assert any("error processing" in r.message for r in caplog.records)
+
+
+class TestErrorStore:
+    def test_store_and_replay(self):
+        manager = SiddhiManager()
+        store = InMemoryErrorStore()
+        manager.set_error_store(store)
+        rt = manager.create_siddhi_app_runtime(
+            "@app:name('errapp')\n" + APP_BASE.format(action="STORE"))
+        rt.start()
+        boom = {"on": True}
+
+        def flaky(events):
+            if boom["on"]:
+                raise _Boom("transient")
+
+        rt.add_callback("Out", flaky)
+        rt.get_input_handler("S").send(("IBM", 75.0))
+        rt.flush()
+        entries = store.load("errapp")
+        assert len(entries) == 1
+        assert entries[0].stream_name == "Out"
+        assert [row for _ts, row in entries[0].events] == [("IBM", 75.0)]
+
+        # replay after the fault clears: events flow again, entry discarded
+        boom["on"] = False
+        got = []
+        rt.add_callback("Out", lambda events: got.extend(events))
+        store.replay(entries[0], rt)
+        rt.flush()
+        assert [tuple(e.data) for e in got] == [("IBM", pytest.approx(75.0))]
+        assert store.load("errapp") == []

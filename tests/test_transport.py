@@ -1,0 +1,166 @@
+"""Transport fabric tests (reference:
+modules/siddhi-core/src/test/java/io/siddhi/core/transport/ —
+InMemoryTransportTestCase, MultiClientDistributedSinkTestCase,
+SingleClientDistributedTransportTestCases; plus mapper behavior)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.io import InMemoryBroker
+
+
+@pytest.fixture(autouse=True)
+def clean_broker():
+    InMemoryBroker.clear()
+    yield
+    InMemoryBroker.clear()
+
+
+def build(app_text, batch_size=8):
+    rt = SiddhiManager().create_siddhi_app_runtime(app_text, batch_size=batch_size)
+    rt.start()
+    return rt
+
+
+class TestInMemorySourceSink:
+    def test_source_to_sink_roundtrip(self):
+        rt = build(
+            "@source(type='inMemory', topic='in', @map(type='passThrough'))\n"
+            "define stream S (symbol string, price float);\n"
+            "@sink(type='inMemory', topic='out', @map(type='passThrough'))\n"
+            "define stream Out (symbol string, price float);\n"
+            "from S[price > 50.0] select symbol, price insert into Out;")
+        got = []
+        InMemoryBroker.subscribe_fn("out", got.append)
+        InMemoryBroker.publish("in", ("IBM", 75.0))
+        InMemoryBroker.publish("in", ("WSO2", 45.0))
+        InMemoryBroker.publish("in", ("MSFT", 88.0))
+        assert [g[0] for g in got] == ["IBM", "MSFT"]
+
+    def test_json_mapper_roundtrip(self):
+        rt = build(
+            "@source(type='inMemory', topic='jin', @map(type='json'))\n"
+            "define stream S (symbol string, price double);\n"
+            "@sink(type='inMemory', topic='jout', @map(type='json'))\n"
+            "define stream Out (symbol string, price double);\n"
+            "from S select symbol, price insert into Out;")
+        got = []
+        InMemoryBroker.subscribe_fn("jout", got.append)
+        InMemoryBroker.publish("jin", '{"event": {"symbol": "IBM", "price": 75.5}}')
+        import json
+        assert json.loads(got[0]) == {"event": {"symbol": "IBM", "price": 75.5}}
+
+    def test_json_attribute_paths(self):
+        rt = build(
+            "@source(type='inMemory', topic='pin', @map(type='json', "
+            "@attributes(symbol='$.stock.name', price='$.stock.value')))\n"
+            "define stream S (symbol string, price double);\n"
+            "@sink(type='inMemory', topic='pout', @map(type='passThrough'))\n"
+            "define stream Out (symbol string, price double);\n"
+            "from S select symbol, price insert into Out;")
+        got = []
+        InMemoryBroker.subscribe_fn("pout", got.append)
+        InMemoryBroker.publish(
+            "pin", '{"stock": {"name": "IBM", "value": 12.5}}')
+        assert got == [("IBM", 12.5)]
+
+    def test_text_template_sink(self):
+        rt = build(
+            "@source(type='inMemory', topic='tin', @map(type='passThrough'))\n"
+            "define stream S (symbol string, price double);\n"
+            "@sink(type='inMemory', topic='tout', @map(type='text', "
+            "@payload('{{symbol}} costs {{price}}')))\n"
+            "define stream Out (symbol string, price double);\n"
+            "from S select symbol, price insert into Out;")
+        got = []
+        InMemoryBroker.subscribe_fn("tout", got.append)
+        InMemoryBroker.publish("tin", ("IBM", 75.5))
+        assert got == ["IBM costs 75.5"]
+
+
+class TestDistributedSink:
+    APP = (
+        "@source(type='inMemory', topic='din', @map(type='passThrough'))\n"
+        "define stream S (symbol string, price double);\n"
+        "@sink(type='inMemory', @map(type='passThrough'), "
+        "@distribution(strategy='{strategy}'{extra}, "
+        "@destination(topic='d1'), @destination(topic='d2')))\n"
+        "define stream Out (symbol string, price double);\n"
+        "from S select symbol, price insert into Out;")
+
+    def _run(self, strategy, extra="", events=4):
+        rt = build(self.APP.format(strategy=strategy, extra=extra))
+        d1, d2 = [], []
+        InMemoryBroker.subscribe_fn("d1", d1.append)
+        InMemoryBroker.subscribe_fn("d2", d2.append)
+        for i in range(events):
+            InMemoryBroker.publish("din", (f"S{i % 2}", float(i)))
+        return d1, d2
+
+    def test_round_robin(self):
+        d1, d2 = self._run("roundRobin")
+        assert len(d1) == 2 and len(d2) == 2
+
+    def test_broadcast(self):
+        d1, d2 = self._run("broadcast")
+        assert len(d1) == 4 and len(d2) == 4
+
+    def test_partitioned(self):
+        d1, d2 = self._run("partitioned", extra=", partitionKey='symbol'")
+        # same key always lands on the same destination
+        keys1 = {r[0] for r in d1}
+        keys2 = {r[0] for r in d2}
+        assert not (keys1 & keys2)
+        assert len(d1) + len(d2) == 4
+
+
+class TestSourceLifecycle:
+    def test_pause_resume(self):
+        rt = build(
+            "@source(type='inMemory', topic='lin', @map(type='passThrough'))\n"
+            "define stream S (v long);\n"
+            "@sink(type='inMemory', topic='lout', @map(type='passThrough'))\n"
+            "define stream Out (v long);\n"
+            "from S select v insert into Out;")
+        got = []
+        InMemoryBroker.subscribe_fn("lout", got.append)
+        src = rt.sources[0]
+        src.pause()
+        InMemoryBroker.publish("lin", (1,))
+        assert got == []
+        src.resume()
+        rt.flush()
+        assert got == [(1,)]
+
+    def test_connect_retry_backoff(self):
+        from siddhi_tpu.io import ConnectionUnavailableException, Source
+
+        class FlakySource(Source):
+            attempts = 0
+
+            def connect(self):
+                FlakySource.attempts += 1
+                if FlakySource.attempts < 3:
+                    raise ConnectionUnavailableException("nope")
+
+            def disconnect(self):
+                pass
+
+        src = FlakySource()
+        src.init(None, {}, None, lambda rows: None, None)
+        sleeps = []
+        src.connect_with_retry(max_attempts=5, sleep=sleeps.append)
+        assert FlakySource.attempts == 3
+        assert sleeps == [0.005, 0.05]  # reference backoff schedule
+
+    def test_shutdown_disconnects(self):
+        rt = build(
+            "@source(type='inMemory', topic='sin', @map(type='passThrough'))\n"
+            "define stream S (v long);\n"
+            "from S select v insert into Out;")
+        rt.shutdown()
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(evs))
+        InMemoryBroker.publish("sin", (1,))  # no subscriber anymore
+        rt.flush()
+        assert got == []
