@@ -1,0 +1,211 @@
+/* _siddhi_native — C hot path for host-side event marshalling.
+ *
+ * Role in the framework: the TPU compute path is JAX/XLA; the host runtime
+ * around it (ingestion marshalling, string interning) is native, mirroring
+ * how the reference's performance-critical event plumbing is engineered
+ * (reference: core/event/stream/converter/ — ZeroStreamEventConverter etc.,
+ * and the Disruptor ring's event translation, StreamJunction.java:149-182).
+ *
+ * encode_rows() converts a Python list of row tuples into pre-allocated
+ * columnar numpy buffers (via the buffer protocol — no numpy C-API
+ * dependency), interning strings through the SAME dict/list pair that backs
+ * the Python StringTable, so native and Python encode paths share one code
+ * space and snapshot/restore stays unchanged.
+ *
+ * Type codes (one byte per attribute):
+ *   'b' bool -> int8 buffer      'i' int -> int32
+ *   'l' long -> int64            'f' float -> float32
+ *   'd' double -> float64        's' string -> int32 (interned code)
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+
+/* Intern one string through (to_code: dict, to_str: list); returns code or -1
+ * on error. None encodes as 0 (null). */
+static int32_t
+intern_string(PyObject *value, PyObject *to_code, PyObject *to_str)
+{
+    if (value == Py_None)
+        return 0;
+    PyObject *existing = PyDict_GetItemWithError(to_code, value);
+    if (existing != NULL)
+        return (int32_t)PyLong_AsLong(existing);
+    if (PyErr_Occurred())
+        return -1;
+    Py_ssize_t code = PyList_GET_SIZE(to_str);
+    PyObject *code_obj = PyLong_FromSsize_t(code);
+    if (code_obj == NULL)
+        return -1;
+    if (PyDict_SetItem(to_code, value, code_obj) < 0 ||
+        PyList_Append(to_str, value) < 0) {
+        Py_DECREF(code_obj);
+        return -1;
+    }
+    Py_DECREF(code_obj);
+    return (int32_t)code;
+}
+
+/* encode_rows(rows, typecodes: bytes, columns: tuple[memoryview-able],
+ *             tables: tuple[(dict, list) | None], nulls: tuple[float|int]) */
+static PyObject *
+encode_rows(PyObject *self, PyObject *args)
+{
+    PyObject *rows, *typecodes_obj, *columns, *tables, *nulls;
+    if (!PyArg_ParseTuple(args, "OSOOO", &rows, &typecodes_obj, &columns,
+                          &tables, &nulls))
+        return NULL;
+
+    const char *typecodes = PyBytes_AS_STRING(typecodes_obj);
+    Py_ssize_t n_cols = PyBytes_GET_SIZE(typecodes_obj);
+
+    PyObject *rows_fast = PySequence_Fast(rows, "rows must be a sequence");
+    if (rows_fast == NULL)
+        return NULL;
+    Py_ssize_t n_rows = PySequence_Fast_GET_SIZE(rows_fast);
+
+    /* acquire writable buffers for every column */
+    Py_buffer *bufs = PyMem_Calloc((size_t)n_cols, sizeof(Py_buffer));
+    if (bufs == NULL) {
+        Py_DECREF(rows_fast);
+        return PyErr_NoMemory();
+    }
+    Py_ssize_t acquired = 0;
+    PyObject *result = NULL;
+    for (; acquired < n_cols; acquired++) {
+        PyObject *col = PyTuple_GET_ITEM(columns, acquired);
+        if (PyObject_GetBuffer(col, &bufs[acquired],
+                               PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0)
+            goto done;
+    }
+
+    for (Py_ssize_t r = 0; r < n_rows; r++) {
+        PyObject *row = PySequence_Fast_GET_ITEM(rows_fast, r);
+        PyObject *row_fast = PySequence_Fast(row, "row must be a sequence");
+        if (row_fast == NULL)
+            goto done;
+        if (PySequence_Fast_GET_SIZE(row_fast) < n_cols) {
+            Py_DECREF(row_fast);
+            PyErr_Format(PyExc_ValueError,
+                         "row %zd has fewer than %zd values", r, n_cols);
+            goto done;
+        }
+        for (Py_ssize_t c = 0; c < n_cols; c++) {
+            PyObject *v = PySequence_Fast_GET_ITEM(row_fast, c);
+            void *data = bufs[c].buf;
+            char tc = typecodes[c];
+            if (tc == 's') {
+                PyObject *pair = PyTuple_GET_ITEM(tables, c);
+                int32_t code = intern_string(
+                    v, PyTuple_GET_ITEM(pair, 0), PyTuple_GET_ITEM(pair, 1));
+                if (code < 0 && PyErr_Occurred()) {
+                    Py_DECREF(row_fast);
+                    goto done;
+                }
+                ((int32_t *)data)[r] = code;
+                continue;
+            }
+            int is_null = (v == Py_None);
+            if (is_null)
+                v = PyTuple_GET_ITEM(nulls, c);
+            switch (tc) {
+            case 'b':
+                ((int8_t *)data)[r] = (int8_t)PyObject_IsTrue(v);
+                break;
+            case 'i': {
+                long x = PyLong_AsLong(v);
+                if (x == -1 && PyErr_Occurred()) { Py_DECREF(row_fast); goto done; }
+                ((int32_t *)data)[r] = (int32_t)x;
+                break;
+            }
+            case 'l': {
+                long long x = PyLong_AsLongLong(v);
+                if (x == -1 && PyErr_Occurred()) { Py_DECREF(row_fast); goto done; }
+                ((int64_t *)data)[r] = (int64_t)x;
+                break;
+            }
+            case 'f': {
+                double x = PyFloat_AsDouble(v);
+                if (x == -1.0 && PyErr_Occurred()) { Py_DECREF(row_fast); goto done; }
+                ((float *)data)[r] = (float)x;
+                break;
+            }
+            case 'd': {
+                double x = PyFloat_AsDouble(v);
+                if (x == -1.0 && PyErr_Occurred()) { Py_DECREF(row_fast); goto done; }
+                ((double *)data)[r] = x;
+                break;
+            }
+            default:
+                Py_DECREF(row_fast);
+                PyErr_Format(PyExc_ValueError, "bad type code %c", tc);
+                goto done;
+            }
+        }
+        Py_DECREF(row_fast);
+    }
+    result = Py_NewRef(Py_None);
+
+done:
+    for (Py_ssize_t i = 0; i < acquired; i++)
+        PyBuffer_Release(&bufs[i]);
+    PyMem_Free(bufs);
+    Py_DECREF(rows_fast);
+    return result;
+}
+
+/* fill_ts(ts_list, out: int64 buffer, n_pad) — timestamps + monotone pad */
+static PyObject *
+fill_ts(PyObject *self, PyObject *args)
+{
+    PyObject *ts_list, *out;
+    Py_ssize_t n_pad;
+    if (!PyArg_ParseTuple(args, "OOn", &ts_list, &out, &n_pad))
+        return NULL;
+    PyObject *fast = PySequence_Fast(ts_list, "ts must be a sequence");
+    if (fast == NULL)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    Py_buffer buf;
+    if (PyObject_GetBuffer(out, &buf, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0) {
+        Py_DECREF(fast);
+        return NULL;
+    }
+    int64_t *data = (int64_t *)buf.buf;
+    int64_t last = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        long long x = PyLong_AsLongLong(PySequence_Fast_GET_ITEM(fast, i));
+        if (x == -1 && PyErr_Occurred()) {
+            PyBuffer_Release(&buf);
+            Py_DECREF(fast);
+            return NULL;
+        }
+        data[i] = (int64_t)x;
+        last = (int64_t)x;
+    }
+    for (Py_ssize_t i = n; i < n_pad; i++)
+        data[i] = last; /* monotone pad keeps searchsorted correct */
+    PyBuffer_Release(&buf);
+    Py_DECREF(fast);
+    return Py_NewRef(Py_None);
+}
+
+static PyMethodDef methods[] = {
+    {"encode_rows", encode_rows, METH_VARARGS,
+     "Encode row tuples into columnar buffers with string interning."},
+    {"fill_ts", fill_ts, METH_VARARGS,
+     "Fill an int64 timestamp buffer with monotone padding."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef module = {
+    PyModuleDef_HEAD_INIT, "_siddhi_native",
+    "Native host-path marshalling for siddhi_tpu.", -1, methods,
+};
+
+PyMODINIT_FUNC
+PyInit__siddhi_native(void)
+{
+    return PyModule_Create(&module);
+}

@@ -1,0 +1,20 @@
+"""Build the native host-path extension:
+
+    python native/setup.py build_ext --build-lib <dir>
+
+siddhi_tpu.native builds this lazily on first import (cached under
+siddhi_tpu/_native_build/) and falls back to the pure-Python encoder when no
+compiler is available."""
+
+from setuptools import Extension, setup
+
+setup(
+    name="siddhi-tpu-native",
+    ext_modules=[
+        Extension(
+            "_siddhi_native",
+            sources=["columnar.c"],
+            extra_compile_args=["-O3"],
+        )
+    ],
+)

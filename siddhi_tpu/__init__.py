@@ -29,6 +29,8 @@ import jax as _jax
 _jax.config.update("jax_enable_x64", True)
 
 from . import compiler  # noqa: E402
+from . import io  # noqa: E402,F401  (registers source/sink/mapper extensions)
+from .core import function as _function  # noqa: E402,F401  (script engines)
 from .core.dtypes import config  # noqa: E402
 from .core.event import Event  # noqa: E402
 from .core.manager import SiddhiManager  # noqa: E402

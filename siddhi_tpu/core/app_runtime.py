@@ -71,6 +71,12 @@ class SiddhiAppRuntime:
     def _build(self) -> None:
         app, ctx = self.app, self.ctx
 
+        if app.function_definitions:
+            # app-scoped registry: `define function` must not leak across apps
+            ctx.registry = ctx.registry.copy()
+            from .function import bind_app_functions
+            bind_app_functions(app, ctx.registry)
+
         from ..io.wiring import build_sink, build_source
         from ..query_api.definition import Attribute, AttributeType
         for sd in app.stream_definitions.values():

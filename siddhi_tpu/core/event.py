@@ -92,8 +92,11 @@ class StringTable:
         return list(self._to_str)
 
     def restore(self, strings: list) -> None:
-        self._to_str = list(strings)
-        self._to_code = {s: i for i, s in enumerate(strings) if s is not None}
+        # mutate in place: native encode plans hold references to these
+        self._to_str[:] = list(strings)
+        self._to_code.clear()
+        self._to_code.update(
+            {s: i for i, s in enumerate(strings) if s is not None})
 
 
 class StreamCodec:
@@ -126,6 +129,33 @@ class StreamCodec:
         self.object_attrs = tuple(
             a.name for a in definition.attributes if a.type == AttributeType.OBJECT
         )
+        self._native_plan = self._build_native_plan()
+
+    def _build_native_plan(self):
+        """Precompute the arguments the native encoder needs; None when the
+        schema can't use it (OBJECT attrs or extension unavailable)."""
+        from .. import native as native_mod
+        if native_mod.native is None or self.object_attrs:
+            return None
+        codes, tables, nulls = [], [], []
+        np_code = {"bool": "b", "int8": "b", "int32": "i", "int64": "l",
+                   "float32": "f", "float64": "d"}
+        for a in self.definition.attributes:
+            if a.type == AttributeType.STRING:
+                tbl = self.string_tables[a.name]
+                codes.append("s")
+                tables.append((tbl._to_code, tbl._to_str))
+                nulls.append(0)
+            else:
+                c = np_code.get(self.np_dtypes[a.name].name)
+                if c is None:
+                    return None
+                codes.append(c)
+                tables.append(None)
+                nv = dtypes.null_value(a.type)
+                nulls.append(float(nv) if c in "fd" else int(nv))
+        return ("".join(codes).encode("ascii"), tuple(tables), tuple(nulls),
+                native_mod.native)
 
     def encode_value(self, attr_name: str, attr_type: AttributeType, value):
         if attr_type == AttributeType.STRING:
@@ -138,9 +168,18 @@ class StreamCodec:
         self, rows: Sequence[Sequence], n_pad: Optional[int] = None
     ) -> dict[str, np.ndarray]:
         """Encode host rows (tuples in attribute order) into numpy columns,
-        zero-padded to n_pad lanes."""
+        zero-padded to n_pad lanes. Uses the native C marshaller when built
+        (siddhi_tpu.native); Python fallback below is semantically identical."""
         n = len(rows)
         cap = n_pad if n_pad is not None else n
+        if self._native_plan is not None:
+            codes, tables, nulls, native = self._native_plan
+            out = tuple(
+                np.zeros(cap, dtype=self.np_dtypes[a.name])
+                for a in self.definition.attributes)
+            native.encode_rows(rows, codes, out, tables, nulls)
+            return {a.name: arr
+                    for a, arr in zip(self.definition.attributes, out)}
         cols: dict[str, np.ndarray] = {}
         for i, attr in enumerate(self.definition.attributes):
             if attr.type == AttributeType.OBJECT:

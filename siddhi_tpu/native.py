@@ -1,0 +1,61 @@
+"""Loader for the native host-path extension (_siddhi_native).
+
+Builds native/columnar.c on first import (g++/cc via setuptools), caches the
+shared object under siddhi_tpu/_native_build/, and degrades to the pure-Python
+encoder when no toolchain is available. Set SIDDHI_TPU_NO_NATIVE=1 to force
+the Python path (useful for A/B benchmarking the marshalling hot loop)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import sysconfig
+
+_log = logging.getLogger("siddhi_tpu")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_native_build")
+_SRC_DIR = os.path.join(_REPO_ROOT, "native")
+
+native = None
+
+
+def _try_import():
+    global native
+    if _BUILD_DIR not in sys.path:
+        sys.path.insert(0, _BUILD_DIR)
+    import _siddhi_native
+    native = _siddhi_native
+
+
+def _build() -> bool:
+    src = os.path.join(_SRC_DIR, "columnar.c")
+    if not os.path.exists(src):
+        return False
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    try:
+        subprocess.run(
+            [sys.executable, "setup.py", "build_ext", "--build-lib", _BUILD_DIR],
+            cwd=_SRC_DIR, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, OSError) as e:
+        _log.info("native extension build failed, using Python encoder: %s", e)
+        return False
+
+
+if not os.environ.get("SIDDHI_TPU_NO_NATIVE"):
+    try:
+        _try_import()
+    except ImportError:
+        if _build():
+            try:
+                _try_import()
+            except ImportError as e:  # pragma: no cover
+                _log.info("native extension import failed after build: %s", e)
+
+
+def available() -> bool:
+    return native is not None

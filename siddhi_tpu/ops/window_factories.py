@@ -118,6 +118,57 @@ def _make_sort(layout, batch_cap, params, expired_on):
     return SortWindow(layout, batch_cap, n, keys)
 
 
+def _make_cron(layout, batch_cap, params, expired_on):
+    from .windows_extra import CronWindow
+    if len(params) != 1 or not isinstance(params[0], str):
+        raise SiddhiAppCreationError("cron window needs ('<cron expression>')")
+    return CronWindow(layout, batch_cap, params[0], expired_on=expired_on)
+
+
+def _make_hopping(layout, batch_cap, params, expired_on):
+    from .windows_extra import HoppingWindow
+    w = _int_param(params, 0, "hopping")
+    h = _int_param(params, 1, "hopping")
+    return HoppingWindow(layout, batch_cap, w, h)
+
+
+def _frequent_keys(params, start):
+    from ..query_api.expression import Variable
+    keys = []
+    for p in params[start:]:
+        if not isinstance(p, Variable):
+            raise SiddhiAppCreationError("frequent key parameters must be attributes")
+        keys.append(p.attribute)
+    return keys or None
+
+
+def _make_frequent(layout, batch_cap, params, expired_on):
+    from .windows_extra import FrequentWindow
+    n = _int_param(params, 0, "frequent")
+    return FrequentWindow(layout, batch_cap, n,
+                          key_attrs=_frequent_keys(params, 1))
+
+
+def _make_lossy_frequent(layout, batch_cap, params, expired_on):
+    from .windows_extra import FrequentWindow
+    if not params or not isinstance(params[0], float):
+        raise SiddhiAppCreationError(
+            "lossyFrequent needs (supportThreshold [, errorBound] [, attrs...])")
+    support = params[0]
+    error = params[1] if len(params) > 1 and isinstance(params[1], float) else support / 10.0
+    start = 2 if len(params) > 1 and isinstance(params[1], float) else 1
+    if not 0.0 < support < 1.0:
+        raise SiddhiAppCreationError(
+            f"lossyFrequent supportThreshold must be in (0, 1), got {support}")
+    if not 0.0 < error < support:
+        raise SiddhiAppCreationError(
+            f"lossyFrequent errorBound must be in (0, supportThreshold), got {error}")
+    n_slots = max(int(1.0 / error), 16)
+    return FrequentWindow(layout, batch_cap, n_slots,
+                          key_attrs=_frequent_keys(params, start),
+                          support=support, error=error, lossy=True)
+
+
 def register_all() -> None:
     reg = lambda name, make: GLOBAL.register(  # noqa: E731
         ExtensionKind.WINDOW, "", name, WindowFactory(make))
@@ -133,6 +184,10 @@ def register_all() -> None:
     reg("externalTimeBatch", _make_external_time_batch)
     reg("session", _make_session)
     reg("sort", _make_sort)
+    reg("cron", _make_cron)
+    reg("hopping", _make_hopping)
+    reg("frequent", _make_frequent)
+    reg("lossyFrequent", _make_lossy_frequent)
 
 
 register_all()

@@ -120,6 +120,8 @@ def window_has_time_semantics(window: "WindowOp") -> bool:
     Scheduler TIMER wiring (core/util/Scheduler.java:48)."""
     if getattr(window, "time_ms", None) is not None:
         return True
+    if getattr(window, "needs_heartbeat", False):  # cron/hopping etc.
+        return True
     return isinstance(window, (TimeBatchWindow, SessionWindow))
 
 

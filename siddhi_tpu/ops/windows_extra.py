@@ -48,6 +48,8 @@ class CronWindow(WindowOp):
     fire, zero per quiet step (reference: CronWindowProcessor.java delegates
     to quartz the same way)."""
 
+    needs_heartbeat = True
+
     def __init__(self, layout: dict, batch_cap: int, expr: str,
                  expired_on: bool = True):
         from ..core.trigger import CronSchedule
@@ -55,7 +57,7 @@ class CronWindow(WindowOp):
         self.B = batch_cap
         self.expired_on = expired_on
         self.schedule = CronSchedule(expr)
-        self.C = max(4 * batch_cap, 1024)
+        self.C = max(dtypes.config.default_window_capacity, 4 * batch_cap)
         self.chunk_width = 2 * self.C + 1
 
     def init_state(self) -> CronState:
@@ -85,14 +87,25 @@ class CronWindow(WindowOp):
             state.ring_cols, state.ring_ts, comp_cols, comp_ts,
             state.appended, n_valid)
 
-        next_fire = jnp.where(state.next_fire < 0,
-                              self._host_next_fire(now), state.next_fire)
+        # lazy initial schedule: from the earliest unprocessed event (so a
+        # boundary between that event and the first watermark still fires)
+        idx_b = jnp.arange(self.B, dtype=jnp.int64)
+        min_ts = jnp.min(jnp.where(idx_b < n_valid, comp_ts, BIG))
+        base = jnp.where(n_valid > 0, jnp.minimum(min_ts, now), now)
+        # lax.cond so the host callback runs only when actually unscheduled
+        next_fire = jax.lax.cond(
+            state.next_fire < 0,
+            lambda: self._host_next_fire(base - 1),
+            lambda: state.next_fire)
         fire = next_fire <= now
 
         # currents: overall [flushed, appended1); expired: [prev_start, flushed)
         o = jnp.arange(C, dtype=jnp.int64)
         o_cur = state.flushed + o
-        cur_valid = fire & (o_cur < appended1)
+        # ring guard: only the most recent C arrivals survive between fires
+        # (same truncation rule as _scatter_append); older slots were
+        # overwritten and must not emit stale duplicates
+        cur_valid = fire & (o_cur < appended1) & (appended1 - o_cur <= C)
         cur_cols, cur_ts = _gather_overall(
             ring_cols, ring_ts, comp_cols, comp_ts, appended1, o_cur)
         o_exp = state.prev_start + o
@@ -113,7 +126,8 @@ class CronWindow(WindowOp):
             jnp.full((C,), EventType.CURRENT, jnp.int8)])
         chunk = EventBatch(ts=ts, cols=cols, valid=valid, types=types)
 
-        new_next = jnp.where(fire, self._host_next_fire(now), next_fire)
+        new_next = jax.lax.cond(
+            fire, lambda: self._host_next_fire(now), lambda: next_fire)
         new_state = CronState(
             ring_cols=ring_cols, ring_ts=ring_ts,
             appended=appended1,
@@ -142,6 +156,8 @@ class HoppingWindow(WindowOp):
     crossed inside one micro-batch collapse into the latest boundary's
     emission."""
 
+    needs_heartbeat = True
+
     def __init__(self, layout: dict, batch_cap: int, window_ms: int,
                  hop_ms: int):
         if hop_ms <= 0 or window_ms <= 0:
@@ -150,7 +166,7 @@ class HoppingWindow(WindowOp):
         self.B = batch_cap
         self.W = window_ms
         self.H = hop_ms
-        self.C = max(2 * batch_cap, 1024)
+        self.C = max(dtypes.config.default_window_capacity, 2 * batch_cap)
         self.chunk_width = self.C + 1  # RESET + window contents
 
     def init_state(self) -> HopState:
@@ -317,7 +333,11 @@ class FrequentWindow(WindowOp):
         ts1 = jnp.where(has_new, comp_ts[g], state.slot_ts)
 
         # chunk: CURRENT lanes whose key is tracked post-update (lossy adds a
-        # support threshold), EXPIRED = evicted slots' remembered events
+        # support threshold), EXPIRED = evicted slots' remembered events.
+        # Only slots occupied BEFORE this batch may emit expired — a key
+        # admitted and decremented away within one batch has no remembered
+        # event (its slot_cols still hold the previous occupant / zeros)
+        evicted_emit = evicted & (state.slot_keys != _PAD)
         cur_valid = lane_tracked
         if self.lossy:
             thr = jnp.int64(int((self.support - self.error) * self._SCALE))
@@ -328,7 +348,7 @@ class FrequentWindow(WindowOp):
         ev_ts = jnp.concatenate([comp_ts, state.slot_ts])
         chunk = EventBatch(
             ts=ev_ts, cols=ev_cols,
-            valid=jnp.concatenate([cur_valid, evicted]),
+            valid=jnp.concatenate([cur_valid, evicted_emit]),
             types=jnp.concatenate([
                 jnp.full((B,), EventType.CURRENT, jnp.int8),
                 jnp.full((N,), EventType.EXPIRED, jnp.int8)]))

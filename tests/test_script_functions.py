@@ -1,0 +1,101 @@
+"""`define function` script tests (reference: core/function/Script.java,
+query/extension/ script-function test cases — here with the python/jax engine)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+S = "define stream S (symbol string, price double, volume long);\n"
+
+
+def build(app, batch_size=8):
+    rt = SiddhiManager().create_siddhi_app_runtime(app, batch_size=batch_size)
+    rt.start()
+    return rt
+
+
+class TestPythonScriptFunctions:
+    def test_expression_body(self):
+        rt = build(
+            "define function sq[python] return double { args[0] * args[0] };\n"
+            + S +
+            "@info(name='q') from S select symbol, sq(price) as p2 insert into Out;")
+        got = []
+        rt.add_query_callback("q", lambda ts, i, r: got.extend(i or []))
+        rt.get_input_handler("S").send(("A", 3.0, 1))
+        rt.flush()
+        assert got[0].data == ("A", pytest.approx(9.0))
+
+    def test_statement_body_with_jnp(self):
+        rt = build(
+            "define function clip10[jax] return double {\n"
+            "  x = jnp.minimum(args[0], 10.0)\n"
+            "  return jnp.maximum(x, 0.0)\n"
+            "};\n" + S +
+            "@info(name='q') from S select clip10(price) as c insert into Out;")
+        got = []
+        rt.add_query_callback("q", lambda ts, i, r: got.extend(i or []))
+        h = rt.get_input_handler("S")
+        h.send(("A", 25.0, 1))
+        h.send(("B", -5.0, 1))
+        h.send(("C", 7.5, 1))
+        rt.flush()
+        assert [e.data[0] for e in got] == [
+            pytest.approx(10.0), pytest.approx(0.0), pytest.approx(7.5)]
+
+    def test_two_args_and_filter_use(self):
+        rt = build(
+            "define function addmul[python] return double { (args[0] + args[1]) * 2.0 };\n"
+            + S +
+            "@info(name='q') from S[addmul(price, volume) > 20.0] "
+            "select symbol insert into Out;")
+        got = []
+        rt.add_query_callback("q", lambda ts, i, r: got.extend(i or []))
+        h = rt.get_input_handler("S")
+        h.send(("A", 9.0, 2))   # (9+2)*2 = 22 > 20
+        h.send(("B", 1.0, 2))   # 6 < 20
+        rt.flush()
+        assert [e.data[0] for e in got] == ["A"]
+
+    def test_unknown_language_rejected(self):
+        from siddhi_tpu.errors import SiddhiAppCreationError
+        with pytest.raises(SiddhiAppCreationError, match="script engine"):
+            build("define function f[ruby] return double { args[0] };\n" + S
+                  + "from S select f(price) as p insert into Out;")
+
+    def test_function_scoped_per_app(self):
+        manager = SiddhiManager()
+        rt1 = manager.create_siddhi_app_runtime(
+            "@app:name('a1')\n"
+            "define function g[python] return double { args[0] + 1.0 };\n"
+            + S + "from S select g(price) as p insert into Out;")
+        # second app on the SAME manager must not see g
+        from siddhi_tpu.errors import SiddhiAppCreationError
+        with pytest.raises(SiddhiAppCreationError, match="no function extension"):
+            manager.create_siddhi_app_runtime(
+                "@app:name('a2')\n" + S
+                + "from S select g(price) as p insert into Out;")
+
+
+class TestCustomExtensionRegistration:
+    def test_set_extension_scalar_function(self):
+        import jax.numpy as jnp
+
+        from siddhi_tpu.extension.registry import ExtensionKind
+        from siddhi_tpu.ops.expr_compile import ScalarFunction
+        from siddhi_tpu.query_api.definition import AttributeType
+
+        manager = SiddhiManager()
+        manager.set_extension(
+            "custom:double", ScalarFunction(
+                make=lambda arg_types: (lambda x: x * 2, AttributeType.DOUBLE)),
+            kind=ExtensionKind.FUNCTION)
+        rt = manager.create_siddhi_app_runtime(
+            S + "@info(name='q') from S select custom:double(price) as d "
+            "insert into Out;")
+        rt.start()
+        got = []
+        rt.add_query_callback("q", lambda ts, i, r: got.extend(i or []))
+        rt.get_input_handler("S").send(("A", 4.0, 1))
+        rt.flush()
+        assert got[0].data[0] == pytest.approx(8.0)

@@ -1,0 +1,134 @@
+"""cron / hopping / frequent / lossyFrequent window tests (reference:
+query/window/CronWindowTestCase, HoppingWindowTestCase,
+FrequentWindowTestCase, LossyFrequentWindowTestCase)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+S = "define stream S (symbol string, price float, volume long);\n"
+
+
+def build(app, batch_size=8):
+    rt = SiddhiManager().create_siddhi_app_runtime(
+        "@app:playback\n" + app, batch_size=batch_size)
+    rt.start()
+    return rt
+
+
+def q_callback(rt, name):
+    got = []
+    rt.add_query_callback(
+        name, lambda ts, i, r: got.append((i or [], r or [])))
+    return got
+
+
+class TestCronWindow:
+    def test_cron_flush(self):
+        rt = build(
+            S + "@info(name='q') from S#window.cron('*/2 * * * * ?') "
+            "select symbol, sum(price) as total insert into Out;")
+        got = q_callback(rt, "q")
+        h = rt.get_input_handler("S")
+        h.send(("A", 10.0, 1), timestamp=100)
+        h.send(("B", 20.0, 1), timestamp=300)
+        rt.flush(now=500)
+        assert got == []  # nothing until the cron fires
+        rt.heartbeat(2_100)  # cron boundary at 2000 crossed
+        ins = [e for i, _ in got for e in i]
+        assert [e.data[0] for e in ins] == ["A", "B"]
+        assert ins[-1].data[1] == pytest.approx(30.0)
+
+    def test_cron_expired_on_next_fire(self):
+        rt = build(
+            S + "@info(name='q') from S#window.cron('*/2 * * * * ?') "
+            "select symbol insert into Out;")
+        got = q_callback(rt, "q")
+        h = rt.get_input_handler("S")
+        h.send(("A", 1.0, 1), timestamp=100)
+        rt.heartbeat(2_100)
+        h.send(("B", 2.0, 1), timestamp=2_500)
+        rt.heartbeat(4_100)
+        removes = [e for _, r in got for e in r]
+        assert [e.data[0] for e in removes] == ["A"]
+
+
+class TestHoppingWindow:
+    def test_hop_emissions_overlap(self):
+        rt = build(
+            S + "@info(name='q') from S#window.hopping(2 sec, 1 sec) "
+            "select symbol, count() as n insert into Out;")
+        got = q_callback(rt, "q")
+        h = rt.get_input_handler("S")
+        h.send(("A", 1.0, 1), timestamp=200)
+        h.send(("B", 1.0, 1), timestamp=700)
+        rt.heartbeat(1_050)  # hop at 1000: both in window
+        h.send(("C", 1.0, 1), timestamp=1_500)
+        rt.heartbeat(2_050)  # hop at 2000: window (0,2000] → A,B,C
+        rt.heartbeat(3_050)  # hop at 3000: window (1000,3000] → C only
+        counts = [i[-1].data[1] for i, _ in got if i]
+        assert counts == [2, 3, 1]
+
+
+class TestFrequentWindow:
+    def test_keeps_top_keys(self):
+        rt = build(
+            S + "@info(name='q') from S#window.frequent(2, symbol) "
+            "select symbol, price insert into Out;", batch_size=4)
+        got = q_callback(rt, "q")
+        h = rt.get_input_handler("S")
+        # 2 slots: A and B occupy them; C decrements both instead of entering
+        for row in [("A", 1.0, 1), ("B", 2.0, 1), ("A", 3.0, 1)]:
+            h.send(row)
+        rt.flush()
+        for row in [("C", 9.0, 1)]:
+            h.send(row)
+        rt.flush()
+        ins = [e for i, _ in got for e in i]
+        assert [e.data[0] for e in ins] == ["A", "B", "A"]  # C swallowed
+
+    def test_eviction_emits_expired(self):
+        rt = build(
+            S + "@info(name='q') from S#window.frequent(1, symbol) "
+            "select symbol insert into Out;", batch_size=4)
+        got = q_callback(rt, "q")
+        h = rt.get_input_handler("S")
+        h.send(("A", 1.0, 1))
+        rt.flush()
+        # B decrements A to 0 → A evicted (expired); next B takes the slot
+        h.send(("B", 1.0, 1))
+        rt.flush()
+        removes = [e for _, r in got for e in r]
+        assert [e.data[0] for e in removes] == ["A"]
+
+
+class TestFrequentSameBatchAdmitEvict:
+    def test_no_phantom_expired(self):
+        # A admitted and decremented away within ONE batch: nothing was ever
+        # remembered for that slot, so no EXPIRED event may emit
+        rt = build(
+            S + "@info(name='q') from S#window.frequent(1, symbol) "
+            "select symbol insert into Out;", batch_size=4)
+        got = q_callback(rt, "q")
+        h = rt.get_input_handler("S")
+        for row in [("A", 1.0, 1), ("B", 1.0, 1), ("B", 2.0, 1)]:
+            h.send(row)
+        rt.flush()
+        removes = [e for _, r in got for e in r]
+        assert removes == []
+
+
+class TestLossyFrequentWindow:
+    def test_support_threshold(self):
+        rt = build(
+            S + "@info(name='q') from S#window.lossyFrequent(0.5, 0.1, symbol) "
+            "select symbol insert into Out;", batch_size=4)
+        got = q_callback(rt, "q")
+        h = rt.get_input_handler("S")
+        rows = [("A", 1.0, 1)] * 6 + [("B", 1.0, 1)]
+        for row in rows:
+            h.send(row)
+        rt.flush()
+        ins = [e for i, _ in got for e in i]
+        # A is above 50% support throughout; the lone B (1/7 < 0.4) is not
+        assert set(e.data[0] for e in ins) == {"A"}
