@@ -31,6 +31,7 @@ _jax.config.update("jax_enable_x64", True)
 from . import compiler  # noqa: E402
 from . import io  # noqa: E402,F401  (registers source/sink/mapper extensions)
 from .core import function as _function  # noqa: E402,F401  (script engines)
+from .ops import stream_functions as _stream_functions  # noqa: E402,F401
 from .core.dtypes import config  # noqa: E402
 from .core.event import Event  # noqa: E402
 from .core.manager import SiddhiManager  # noqa: E402

@@ -35,10 +35,22 @@ class SiddhiAppRuntime:
                  error_store=None) -> None:
         self.app = app
         playback_ann = app.annotation("app:playback")
+        idle_ms = increment_ms = None
+        if playback_ann is not None:
+            from .partition import _parse_annotation_time
+            idle = playback_ann.element("idle.time")
+            inc = playback_ann.element("increment")
+            idle_ms = _parse_annotation_time(idle) if idle else None
+            increment_ms = _parse_annotation_time(inc) if inc else None
+            if increment_ms is None and idle_ms is not None:
+                increment_ms = idle_ms  # idle.time alone: bump by itself
         self.ctx = SiddhiAppContext(
             name=app.name,
             registry=registry,
-            timestamp_generator=TimestampGenerator(playback=playback_ann is not None),
+            timestamp_generator=TimestampGenerator(
+                playback=playback_ann is not None,
+                playback_increment_ms=increment_ms or 0,
+                idle_time_ms=idle_ms),
             batch_size=batch_size,
             group_capacity=group_capacity,
             playback=playback_ann is not None,
@@ -49,7 +61,15 @@ class SiddhiAppRuntime:
         self.ctx.global_strings = StringTable()
         stats_ann = app.annotation("app:statistics")
         if stats_ann is not None:
-            self.ctx.statistics = Statistics(enabled=True, level="BASIC")
+            # @app:statistics('true'|'BASIC'|'DETAIL') (reference:
+            # SiddhiAppParser.java:113-148, metrics/Level.java)
+            val = (stats_ann.element() or "BASIC").upper()
+            level = {"TRUE": "BASIC", "FALSE": "OFF"}.get(val, val)
+            self.ctx.statistics = Statistics()
+            try:
+                self.ctx.statistics.set_level(level)
+            except ValueError as e:
+                raise SiddhiAppCreationError(str(e)) from e
 
         self.junctions: dict[str, StreamJunction] = {}
         self.input_handlers: dict[str, InputHandler] = {}
@@ -329,8 +349,15 @@ class SiddhiAppRuntime:
 
     def heartbeat(self, now: Optional[int] = None) -> None:
         """Advance watermarks: flush + deliver empty timer batches to queries
-        with time-driven windows (the reference Scheduler's TIMER events)."""
-        t = now if now is not None else self.ctx.timestamp_generator.current_time()
+        with time-driven windows (the reference Scheduler's TIMER events).
+        In playback mode a bare heartbeat() bumps the virtual clock by the
+        @app:playback increment (idle-time heartbeat,
+        TimestampGeneratorImpl.java:92-131)."""
+        tg = self.ctx.timestamp_generator
+        if now is None and tg.playback and tg.playback_increment_ms:
+            t = tg.advance_idle()
+        else:
+            t = now if now is not None else tg.current_time()
         self.flush(t)
         for w in self.windows.values():
             if w.has_time_semantics:
@@ -416,6 +443,26 @@ class SiddhiAppRuntime:
     @property
     def statistics(self) -> Statistics:
         return self.ctx.statistics
+
+    def set_statistics_level(self, level: str) -> None:
+        """Runtime-switchable metric level (reference:
+        SiddhiAppRuntimeImpl.setStatisticsLevel:868)."""
+        self.ctx.statistics.set_level(level)
+
+    def statistics_report(self) -> dict:
+        return self.ctx.statistics.report(runtime=self)
+
+    # ---------------------------------------------------------------- debugger
+
+    def debug(self):
+        """Attach a debugger (reference: SiddhiAppRuntimeImpl.debug():666 →
+        core/debugger/SiddhiDebugger.java:36)."""
+        from .debugger import SiddhiDebugger
+        if getattr(self.ctx, "debugger", None) is None:
+            self.ctx.debugger = SiddhiDebugger(self)
+        if not self._started:
+            self.start()
+        return self.ctx.debugger
 
 
 class _TableJunctionAdapter:

@@ -19,30 +19,51 @@ from . import dtypes
 
 class TimestampGenerator:
     """Wall-clock by default; in playback mode (@app:playback) time is driven
-    by event timestamps (reference TimestampGeneratorImpl.java:78-131)."""
+    by event timestamps (reference TimestampGeneratorImpl.java:78-131).
+
+    @app:playback(idle.time='100 millisecond', increment='2 sec'): when the
+    stream goes idle, `advance_idle()` bumps the virtual clock by `increment`
+    (the reference runs this on a scheduled thread every idle.time; here the
+    single-controller calls it from SiddhiAppRuntime.heartbeat)."""
 
     def __init__(self, playback: bool = False,
-                 playback_increment_ms: int = 0) -> None:
+                 playback_increment_ms: int = 0,
+                 idle_time_ms: Optional[int] = None) -> None:
         self.playback = playback
         self.playback_increment_ms = playback_increment_ms
+        self.idle_time_ms = idle_time_ms
         self._last_event_ts: Optional[int] = None
 
     def current_time(self) -> int:
         if self.playback:
             if self._last_event_ts is None:
                 return 0
-            return self._last_event_ts + self.playback_increment_ms
+            return self._last_event_ts
         return int(time.time() * 1000)
 
     def observe_event_time(self, ts: int) -> None:
         if self._last_event_ts is None or ts > self._last_event_ts:
             self._last_event_ts = ts
 
+    def advance_idle(self) -> int:
+        """Playback idle bump: virtual clock += increment. Returns new time."""
+        if self.playback and self._last_event_ts is not None:
+            self._last_event_ts += self.playback_increment_ms
+        return self.current_time()
+
 
 @dataclass
 class Statistics:
-    """Per-app counters (reference: core/util/statistics/ — codahale registry;
-    here simple host counters; per-query latency tracked in QueryRuntime)."""
+    """Per-app metrics (reference: core/util/statistics/ —
+    SiddhiStatisticsManager.java:35-55 codahale registry, ThroughputTracker,
+    LatencyTracker markIn/markOut, MemoryUsageTracker with deep object sizing,
+    BufferedEventsTracker; levels OFF/BASIC/DETAIL, metrics/Level.java).
+
+    BASIC: per-stream throughput + batch counts. DETAIL adds per-query latency
+    and on-demand device-state memory (pytree nbytes replaces the reference's
+    ObjectSizeCalculator walk) + staged-buffer depth (the Disruptor backlog
+    analogue). Runtime-switchable via SiddhiAppRuntime.set_statistics_level
+    (reference: SiddhiAppRuntimeImpl.setStatisticsLevel:868)."""
 
     enabled: bool = False
     level: str = "OFF"  # OFF | BASIC | DETAIL
@@ -50,6 +71,18 @@ class Statistics:
     events_out: dict = field(default_factory=dict)
     batches: dict = field(default_factory=dict)
     query_latency_ns: dict = field(default_factory=dict)  # query -> (total, count)
+    started_at: float = field(default_factory=time.time)
+
+    @property
+    def detail(self) -> bool:
+        return self.enabled and self.level == "DETAIL"
+
+    def set_level(self, level: str) -> None:
+        level = level.upper()
+        if level not in ("OFF", "BASIC", "DETAIL"):
+            raise ValueError(f"bad statistics level {level!r}")
+        self.level = level
+        self.enabled = level != "OFF"
 
     def track_in(self, stream_id: str, n: int) -> None:
         if self.enabled:
@@ -60,16 +93,47 @@ class Statistics:
             self.batches[stream_id] = self.batches.get(stream_id, 0) + 1
 
     def track_latency(self, query: str, ns: int) -> None:
-        if self.enabled:
+        if self.detail:
             t, c = self.query_latency_ns.get(query, (0, 0))
             self.query_latency_ns[query] = (t + ns, c + 1)
 
-    def report(self) -> dict:
-        out = {"events_in": dict(self.events_in), "batches": dict(self.batches)}
-        out["query_latency_ms"] = {
-            q: (t / c / 1e6 if c else 0.0)
-            for q, (t, c) in self.query_latency_ns.items()}
+    def reset(self) -> None:
+        self.events_in.clear()
+        self.events_out.clear()
+        self.batches.clear()
+        self.query_latency_ns.clear()
+        self.started_at = time.time()
+
+    def report(self, runtime=None) -> dict:
+        elapsed = max(time.time() - self.started_at, 1e-9)
+        out = {
+            "level": self.level,
+            "events_in": dict(self.events_in),
+            "batches": dict(self.batches),
+            "throughput_eps": {s: n / elapsed for s, n in self.events_in.items()},
+        }
+        if self.detail:
+            out["query_latency_ms"] = {
+                q: (t / c / 1e6 if c else 0.0)
+                for q, (t, c) in self.query_latency_ns.items()}
+            if runtime is not None:
+                out["state_memory_bytes"] = {
+                    name: _pytree_nbytes(qr.state)
+                    for name, qr in runtime.query_runtimes.items()}
+                out["buffered_events"] = {
+                    sid: len(j._staged_rows)
+                    for sid, j in runtime.junctions.items()}
         return out
+
+
+def _pytree_nbytes(tree) -> int:
+    """Deep device-state size — replaces the reference's
+    ObjectSizeCalculator (core/util/statistics/memory/)."""
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += getattr(leaf, "nbytes", 0) or 0
+    return total
 
 
 @dataclass

@@ -1,0 +1,80 @@
+"""SiddhiDebugger — breakpoint inspection at query terminals.
+
+Reference: core/debugger/SiddhiDebugger.java:36 — breakpoints at query IN/OUT
+terminals (:249), acquireBreakPoint:95, blocking checkBreakPoint:133 driven
+from ProcessStreamReceiver:101-175, next()/play() stepping, and a
+SiddhiDebuggerCallback receiving each held event.
+
+TPU adaptation: execution is synchronous single-controller, so a breakpoint
+does not suspend a thread — the debugger callback runs INLINE at the terminal
+with the decoded events (batch-level capture of the masked lanes, per SURVEY
+§7 "mask-level event capture"). The callback's return value steers stepping:
+SiddhiDebugger.PLAY keeps flowing, SiddhiDebugger.NEXT keeps the breakpoint
+armed (the default). Returning STOP releases all breakpoints.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Optional
+
+
+class QueryTerminal(enum.Enum):
+    IN = "in"
+    OUT = "out"
+
+
+class SiddhiDebugger:
+    PLAY = "play"
+    NEXT = "next"
+    STOP = "stop"
+
+    def __init__(self, runtime) -> None:
+        self.runtime = runtime
+        self._breakpoints: set[tuple[str, QueryTerminal]] = set()
+        self._callback: Optional[Callable] = None
+
+    def acquire_break_point(self, query_name: str,
+                            terminal: QueryTerminal | str) -> None:
+        """Reference: SiddhiDebugger.acquireBreakPoint:95."""
+        if query_name not in self.runtime.query_runtimes:
+            raise KeyError(f"query {query_name!r} is not defined")
+        self._breakpoints.add((query_name, QueryTerminal(terminal)))
+
+    def release_break_point(self, query_name: str,
+                            terminal: QueryTerminal | str) -> None:
+        self._breakpoints.discard((query_name, QueryTerminal(terminal)))
+
+    def release_all_break_points(self) -> None:
+        self._breakpoints.clear()
+
+    def set_debugger_callback(self, callback: Callable) -> None:
+        """callback(events, query_name, terminal, debugger) -> PLAY|NEXT|STOP
+        (reference: SiddhiDebuggerCallback.debugEvent)."""
+        self._callback = callback
+
+    def detach(self) -> None:
+        """Remove the debugger from the runtime's hot path entirely."""
+        self.release_all_break_points()
+        self._callback = None
+        self.runtime.ctx.debugger = None
+
+    # ------------------------------------------------------------------ hooks
+
+    def wants(self, query_name: str, terminal: QueryTerminal) -> bool:
+        """Cheap hot-path guard: the runtime only decodes a batch to host
+        events when a callback AND a matching breakpoint exist."""
+        return (self._callback is not None
+                and (query_name, terminal) in self._breakpoints)
+
+    def check_break_point(self, query_name: str, terminal: QueryTerminal,
+                          events: list) -> None:
+        """Called from the query runtime at each terminal (the batch analogue
+        of ProcessStreamReceiver's per-event checkBreakPoint:133)."""
+        if not events or not self.wants(query_name, terminal):
+            return
+        action = self._callback(events, query_name, terminal, self)
+        if action == self.PLAY:
+            self.release_break_point(query_name, terminal)
+        elif action == self.STOP:
+            self.release_all_break_points()

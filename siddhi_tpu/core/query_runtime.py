@@ -132,16 +132,40 @@ class QueryRuntime(Receiver):
         for f in self.filters:
             if f.type != AttributeType.BOOL:
                 raise SiddhiAppCreationError("filter must be boolean")
-        if in_stream.handlers.pre_window_functions or in_stream.handlers.post_window_functions:
-            raise SiddhiAppCreationError(
-                "stream functions in FROM chains are not yet supported")
+
+        # --- stream functions (reference: StreamFunctionProcessor SPI) ---
+        # each appends computed columns to the frame; later handlers and the
+        # selector see the extended schema. attr_types is the same dict the
+        # resolver reads, so extending it here extends name resolution too.
+        def _compile_stream_fns(handlers):
+            from ..ops.stream_functions import StreamFunctionFactory
+            out = []
+            for h in handlers:
+                factory = registry.require(
+                    ExtensionKind.STREAM_FUNCTION, h.namespace, h.name)
+                assert isinstance(factory, StreamFunctionFactory)
+                arg_ex = [compile_expression(p, self.resolver, registry)
+                          for p in h.parameters]
+                spec = factory.make(tuple(a.type for a in arg_ex))
+                for n, t in spec.new_attrs:
+                    attr_types[n] = t
+                out.append((spec, arg_ex))
+            return out
+
+        self.pre_window_fns = _compile_stream_fns(
+            in_stream.handlers.pre_window_functions)
+        self.post_window_fns = _compile_stream_fns(
+            in_stream.handlers.post_window_functions)
         self.post_filters = [compile_expression(f, self.resolver, registry)
                              for f in in_stream.handlers.post_window_filters]
 
-        # --- window ---
+        # --- window (layout includes stream-function columns) ---
         batch_cap = input_junction.batch_size
         layout = {a.name: dtypes.device_dtype(a.type)
                   for a in definition.attributes if a.type != AttributeType.OBJECT}
+        for spec, _ in self.pre_window_fns:
+            for n, t in spec.new_attrs:
+                layout[n] = dtypes.device_dtype(t)
         # query callbacks always see removeEvents (reference wires
         # outputExpectsExpiredEvents from the callback/output type); keep
         # expired lanes on unless profiling shows it matters.
@@ -159,6 +183,10 @@ class QueryRuntime(Receiver):
         # --- selector ---
         select_all = [(a.name, a.type) for a in definition.attributes
                       if a.type != AttributeType.OBJECT]
+        for spec, _ in (*self.pre_window_fns, *self.post_window_fns):
+            for n, t in spec.new_attrs:
+                if n not in dict(select_all):
+                    select_all.append((n, t))
         self.selector = CompiledSelector(
             query.selector, self.resolver, registry,
             ctx.effective_group_capacity, self.frame_ref,
@@ -190,6 +218,10 @@ class QueryRuntime(Receiver):
         # --- the jitted step ---
         self._step = jax.jit(self._make_step(), donate_argnums=(0,))
         self.state = self._init_state()
+        self._has_custom_aggs = any(
+            spec.custom_scan is not None for _, spec, _ in self.selector.agg_specs)
+        self._batches_seen = 0
+        self._capacity_warned = False
         #: time-driven windows need heartbeats to flush expirations
         from ..ops.windows import window_has_time_semantics
         self.has_time_semantics = (
@@ -208,8 +240,12 @@ class QueryRuntime(Receiver):
                 self.rate_limiter.init_state())
 
     def _make_step(self):
+        import dataclasses as dc
+
         filters = self.filters
         post_filters = self.post_filters
+        pre_fns = self.pre_window_fns
+        post_fns = self.post_window_fns
         window = self.window
         selector = self.selector
         frame_ref = self.frame_ref
@@ -217,6 +253,19 @@ class QueryRuntime(Receiver):
         probes = {tid: self.tables[tid].contains_probe for tid in dep_tables}
 
         limiter = self.rate_limiter
+
+        def apply_fns(fns, batch, scope):
+            for spec, arg_ex in fns:
+                args = [a(scope) for a in arg_ex]
+                new_cols = spec.apply(*args)
+                declared = dict(spec.new_attrs)
+                cast_cols = {
+                    n: jnp.asarray(c).astype(dtypes.device_dtype(declared[n]))
+                    for n, c in new_cols.items()}
+                batch = dc.replace(batch, cols={**batch.cols, **cast_cols})
+                scope.add_frame(frame_ref, batch.cols, batch.ts, batch.valid,
+                                default=True)
+            return batch
 
         def step(state, batch: EventBatch, now, table_states=None):
             wstate, sstate, rstate = state
@@ -232,12 +281,16 @@ class QueryRuntime(Receiver):
             for f in filters:
                 mask = mask & f(scope)
             batch = batch.where_valid(mask)
+            scope.add_frame(frame_ref, batch.cols, batch.ts, batch.valid,
+                            default=True)
+            batch = apply_fns(pre_fns, batch, scope)
 
             wstate, chunk = window.step(wstate, batch, now)
 
             cscope = Scope()
             cscope.add_frame(frame_ref, chunk.cols, chunk.ts, chunk.valid, default=True)
             cscope.extras = dict(scope.extras)
+            chunk = apply_fns(post_fns, chunk, cscope)
             for f in post_filters:
                 chunk = chunk.where_valid(
                     f(cscope) | (chunk.types != EventType.CURRENT))
@@ -252,14 +305,51 @@ class QueryRuntime(Receiver):
 
     def on_batch(self, batch: EventBatch, now: int) -> None:
         t0 = time.perf_counter_ns()
+        debugger = getattr(self.ctx, "debugger", None)
+        if debugger is not None:
+            from .debugger import QueryTerminal
+            if debugger.wants(self.name, QueryTerminal.IN):
+                debugger.check_break_point(
+                    self.name, QueryTerminal.IN,
+                    batch.to_host_events(self.codec))
         tstates = {tid: self.tables[tid].state for tid in self.dep_tables}
         self.state, out = self._step(self.state, batch, jnp.int64(now), tstates)
         self._distribute(out, now)
         self.ctx.statistics.track_latency(self.name, time.perf_counter_ns() - t0)
+        self._batches_seen += 1
+        if (self._has_custom_aggs and not self._capacity_warned
+                and self._batches_seen % 256 == 0):
+            self._check_custom_agg_capacity()
+
+    def _check_custom_agg_capacity(self) -> None:
+        """distinctCount's (group,value) pair table is append-only (zeroed
+        pairs keep their slot, unlike the reference's HashMap entry removal);
+        warn before lifetime-unique pairs overflow and alias slot 0."""
+        from ..ops.groupby import KeyTable
+        for g in self.state[1].groups:
+            if isinstance(g, tuple) and g and isinstance(g[0], KeyTable):
+                kt = g[0]
+                cap = kt.sorted_keys.shape[0]
+                if int(kt.count) > int(0.85 * cap):
+                    import warnings
+                    warnings.warn(
+                        f"query {self.name!r}: distinctCount pair table at "
+                        f"{int(kt.count)}/{cap} lifetime-unique (group,value) "
+                        "pairs; counts will corrupt past capacity — raise "
+                        "group_capacity", stacklevel=2)
+                    self._capacity_warned = True
 
     def _distribute(self, out: EventBatch, now: int) -> None:
         action = self.query.output_stream.action
         etype = self.query.output_stream.event_type
+
+        debugger = getattr(self.ctx, "debugger", None)
+        if debugger is not None:
+            from .debugger import QueryTerminal
+            if debugger.wants(self.name, QueryTerminal.OUT):
+                debugger.check_break_point(
+                    self.name, QueryTerminal.OUT,
+                    out.to_host_events(self.output_codec))
 
         if self.callbacks:
             events = out.to_host_events(self.output_codec)

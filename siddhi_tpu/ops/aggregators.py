@@ -50,6 +50,12 @@ class AggregatorSpec:
     return_type: AttributeType
     #: needs removal support (sliding windows); min/max set False
     supports_removal: bool = True
+    #: stateful aggregators that don't decompose into scan components
+    #: (distinctCount): init_custom(group_capacity) -> state pytree;
+    #: custom_scan(state, slots, arg_vals, sign, lane_valid, resets, epoch)
+    #: -> (state', per-lane values)
+    init_custom: Optional[Callable] = None
+    custom_scan: Optional[Callable] = None
 
 
 class AggregatorFactory:
@@ -161,9 +167,49 @@ def _make_bool_or(arg_types):
 
 
 def _make_distinct_count(arg_types):
-    raise SiddhiAppCreationError(
-        "distinctCount over arbitrary windows is not yet device-supported; "
-        "use it over batch windows via hll:distinctCount (sketch) once available")
+    """distinctCount(attr) — EXACT distinct values per group with full
+    add/remove support (reference: DistinctCountAttributeAggregatorExecutor
+    keeps a value→count HashMap per group key).
+
+    TPU design: one device hash table over (group, value) PAIRS shared by all
+    groups + a per-group distinct counter. Two chained grouped scans per
+    batch: (1) per-pair signed counts — a CURRENT lane whose post-update pair
+    count == 1 is a 0→1 transition (+1 distinct), an EXPIRED lane reaching 0
+    is a 1→0 transition (-1); (2) those ±1 deltas scanned per group give the
+    per-lane running distinct count, preserving the reference's event-at-a-time
+    emission semantics inside a batch."""
+    from .groupby import (
+        grouped_scan,
+        hash_columns,
+        init_group_state,
+        init_key_table,
+        key_lookup_or_insert,
+    )
+
+    dt = dtypes.device_dtype(_T.LONG)
+
+    def init_custom(group_capacity: int):
+        P = group_capacity  # (group, value) pair capacity
+        return (init_key_table(P), init_group_state(P, dt),
+                init_group_state(group_capacity, dt))
+
+    def custom_scan(state, slots, arg_vals, sign, lane_valid, resets, epoch):
+        kt, pair_counts, distinct = state
+        pk = hash_columns([slots.astype(jnp.int64), arg_vals[0]])
+        kt2, pair_slots = key_lookup_or_insert(kt, pk, lane_valid)
+        deltas = sign.astype(dt)
+        pair_counts2, pair_post = grouped_scan(
+            pair_counts, pair_slots, deltas, lane_valid, resets, epoch,
+            op="sum")
+        dd = jnp.where(sign > 0,
+                       (pair_post == 1).astype(dt),
+                       -(pair_post == 0).astype(dt))
+        distinct2, out = grouped_scan(
+            distinct, slots, dd, lane_valid, resets, epoch, op="sum")
+        return (kt2, pair_counts2, distinct2), out
+
+    return AggregatorSpec((), lambda cs: cs[0], _T.LONG,
+                          init_custom=init_custom, custom_scan=custom_scan)
 
 
 def register_all() -> None:

@@ -252,10 +252,17 @@ def key_lookup_or_insert(
 
 def hash_columns(cols: list[jax.Array]) -> jax.Array:
     """Combine multiple key columns into one int64 key (fxhash-style mix).
-    Collision probability over 64 bits is negligible for CEP key cardinalities."""
+    Collision probability over 64 bits is negligible for CEP key cardinalities.
+    Float columns hash by BIT PATTERN (like Java's Double.hashCode), not by
+    int truncation — 1.2 and 1.9 are distinct keys."""
     h = jnp.uint64(0xCBF29CE484222325)
     for c in cols:
-        x = c.astype(jnp.int64).astype(jnp.uint64)
+        if jnp.issubdtype(c.dtype, jnp.floating):
+            bits = jax.lax.bitcast_convert_type(
+                c, jnp.int32 if c.dtype == jnp.float32 else jnp.int64)
+            x = bits.astype(jnp.int64).astype(jnp.uint64)
+        else:
+            x = c.astype(jnp.int64).astype(jnp.uint64)
         h = (h ^ x) * jnp.uint64(0x100000001B3)
         h = h ^ (h >> 29)
     return h.astype(jnp.int64)

@@ -182,6 +182,9 @@ class CompiledSelector:
         groups = []
         K = self.group_capacity if self.group_vars else 1
         for _, spec, _ in self.agg_specs:
+            if spec.custom_scan is not None:
+                groups.append(spec.init_custom(self.group_capacity))
+                continue
             for comp in spec.components:
                 groups.append(init_group_state(K, comp.dtype))
         return SelectorState(
@@ -225,6 +228,14 @@ class CompiledSelector:
         no_reset = jnp.zeros((L,), bool)
         for slot_name, spec, args in self.agg_specs:
             arg_vals = [a(scope) for a in args] if args else [None]
+            if spec.custom_scan is not None:
+                g, out_vals = spec.custom_scan(
+                    state.groups[gi], slots.astype(jnp.int32), arg_vals,
+                    sign, data_valid, any_reset, state.epoch)
+                new_groups.append(g)
+                agg_values[slot_name] = out_vals
+                gi += 1
+                continue
             comp_outs = []
             for comp in spec.components:
                 deltas = comp.delta(arg_vals[0], sign)

@@ -1,0 +1,113 @@
+"""Statistics / debugger / playback idle-time tests (reference:
+managment/StatisticsTestCase, debugger/TestDebugger, managment/PlaybackTestCase)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+S = "define stream S (symbol string, price float);\n"
+
+
+def build(app, batch_size=8):
+    rt = SiddhiManager().create_siddhi_app_runtime(app, batch_size=batch_size)
+    rt.start()
+    return rt
+
+
+class TestStatistics:
+    def test_basic_level_counts(self):
+        rt = build("@app:statistics('true')\n" + S
+                   + "@info(name='q') from S select symbol insert into Out;")
+        h = rt.get_input_handler("S")
+        for i in range(5):
+            h.send(("A", float(i)))
+        rt.flush()
+        rep = rt.statistics_report()
+        assert rep["level"] == "BASIC"
+        assert rep["events_in"]["S"] == 5
+        assert "query_latency_ms" not in rep  # DETAIL only
+
+    def test_detail_level_memory_and_latency(self):
+        rt = build("@app:statistics('DETAIL')\n" + S
+                   + "@info(name='q') from S#window.length(4) "
+                   "select symbol, sum(price) as t insert into Out;")
+        h = rt.get_input_handler("S")
+        for i in range(3):
+            h.send(("A", float(i)))
+        rt.flush()
+        rep = rt.statistics_report()
+        assert rep["query_latency_ms"]["q"] > 0
+        assert rep["state_memory_bytes"]["q"] > 0
+        assert rep["buffered_events"]["S"] == 0
+
+    def test_runtime_switchable(self):
+        rt = build(S + "from S select symbol insert into Out;")
+        assert not rt.statistics.enabled
+        rt.set_statistics_level("BASIC")
+        rt.get_input_handler("S").send(("A", 1.0))
+        rt.flush()
+        assert rt.statistics_report()["events_in"]["S"] == 1
+        rt.set_statistics_level("OFF")
+        assert not rt.statistics.enabled
+
+
+class TestDebugger:
+    def test_in_terminal_capture_and_play(self):
+        from siddhi_tpu.core.debugger import QueryTerminal, SiddhiDebugger
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            S + "@info(name='q') from S[price > 1.0] select symbol insert into Out;")
+        dbg = rt.debug()
+        seen = []
+
+        def cb(events, qname, terminal, debugger):
+            seen.append((qname, terminal, [tuple(e.data) for e in events]))
+            return SiddhiDebugger.NEXT
+
+        dbg.set_debugger_callback(cb)
+        dbg.acquire_break_point("q", QueryTerminal.IN)
+        h = rt.get_input_handler("S")
+        h.send(("A", 2.0))
+        rt.flush()
+        h.send(("B", 3.0))
+        rt.flush()
+        # NEXT keeps the breakpoint armed: both batches captured at IN
+        assert [s[0] for s in seen] == ["q", "q"]
+        assert seen[0][1] == QueryTerminal.IN
+
+    def test_out_terminal_sees_filtered_output(self):
+        from siddhi_tpu.core.debugger import QueryTerminal, SiddhiDebugger
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            S + "@info(name='q') from S[price > 1.0] select symbol insert into Out;")
+        dbg = rt.debug()
+        seen = []
+        dbg.set_debugger_callback(
+            lambda evs, q, t, d: seen.extend(tuple(e.data) for e in evs)
+            or SiddhiDebugger.PLAY)
+        dbg.acquire_break_point("q", QueryTerminal.OUT)
+        h = rt.get_input_handler("S")
+        h.send(("A", 2.0))
+        h.send(("B", 0.5))  # filtered out
+        rt.flush()
+        assert seen == [("A",)]
+        # PLAY released the breakpoint
+        h.send(("C", 5.0))
+        rt.flush()
+        assert seen == [("A",)]
+
+
+class TestPlaybackIdle:
+    def test_idle_heartbeat_advances_virtual_clock(self):
+        rt = build(
+            "@app:playback(idle.time='100 millisecond', increment='2 sec')\n"
+            + S +
+            "@info(name='q') from S#window.timeBatch(2 sec) "
+            "select symbol, count() as n insert into Out;")
+        got = []
+        rt.add_query_callback("q", lambda ts, i, r: got.extend(i or []))
+        h = rt.get_input_handler("S")
+        h.send(("A", 1.0), timestamp=100)
+        h.send(("B", 1.0), timestamp=200)
+        rt.flush()
+        assert got == []  # bucket not closed yet
+        rt.heartbeat()  # idle bump: +2 sec virtual → bucket closes
+        assert [e.data[1] for e in got] == [1, 2]  # per-event running counts

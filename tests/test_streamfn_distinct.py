@@ -1,0 +1,120 @@
+"""Stream-function FROM chains + distinctCount aggregator tests (reference:
+query/streamfunction/Pol2CartTestCase, query/aggregator/DistinctCountTestCase
+— incl. the BASELINE config-3 shape: sliding distinctCount)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+S = "define stream S (symbol string, theta double, rho double, v long);\n"
+
+
+def build(app, batch_size=8):
+    rt = SiddhiManager().create_siddhi_app_runtime(app, batch_size=batch_size)
+    rt.start()
+    return rt
+
+
+def q_callback(rt, name):
+    got = []
+    rt.add_query_callback(name, lambda ts, i, r: got.extend(i or []))
+    return got
+
+
+class TestStreamFunctions:
+    def test_pol2cart_adds_columns(self):
+        rt = build(
+            S + "@info(name='q') from S#pol2Cart(theta, rho) "
+            "select symbol, x, y insert into Out;")
+        got = q_callback(rt, "q")
+        rt.get_input_handler("S").send(("A", 0.0, 5.0, 1))
+        rt.get_input_handler("S").send(("B", 90.0, 2.0, 1))
+        rt.flush()
+        assert got[0].data == ("A", pytest.approx(5.0), pytest.approx(0.0, abs=1e-6))
+        assert got[1].data == ("B", pytest.approx(0.0, abs=1e-6), pytest.approx(2.0))
+
+    def test_stream_fn_feeds_window_aggregate(self):
+        rt = build(
+            S + "@info(name='q') from S#pol2Cart(theta, rho)#window.lengthBatch(2) "
+            "select symbol, sum(x) as sx insert into Out;")
+        got = q_callback(rt, "q")
+        h = rt.get_input_handler("S")
+        h.send(("A", 0.0, 3.0, 1))   # x=3
+        h.send(("A", 0.0, 4.0, 1))   # x=4
+        rt.flush()
+        assert got[-1].data[1] == pytest.approx(7.0)
+
+    def test_select_star_includes_new_attrs(self):
+        rt = build(
+            S + "@info(name='q') from S#pol2Cart(theta, rho) "
+            "select * insert into Out;")
+        got = q_callback(rt, "q")
+        rt.get_input_handler("S").send(("A", 0.0, 5.0, 9))
+        rt.flush()
+        # original attrs + x, y
+        assert len(got[0].data) == 6
+
+
+class TestDistinctCount:
+    APP = ("define stream T (user string, page string, v long);\n"
+           "@info(name='q') from T{window} "
+           "select user, distinctCount(page) as pages "
+           "group by user insert into Out;")
+
+    def test_plain_distinct_count(self):
+        rt = build(self.APP.format(window=""))
+        got = q_callback(rt, "q")
+        h = rt.get_input_handler("T")
+        for row in [("u1", "a", 1), ("u1", "b", 1), ("u1", "a", 1),
+                    ("u2", "a", 1), ("u1", "c", 1)]:
+            h.send(row)
+        rt.flush()
+        per_lane = [(e.data[0], e.data[1]) for e in got]
+        assert per_lane == [("u1", 1), ("u1", 2), ("u1", 2), ("u2", 1), ("u1", 3)]
+
+    def test_sliding_window_removal(self):
+        # BASELINE config 3 shape: sliding length window — values leaving the
+        # window decrement the distinct count exactly
+        rt = build(self.APP.format(window="#window.length(2)"))
+        got = q_callback(rt, "q")
+        h = rt.get_input_handler("T")
+        for row in [("u1", "a", 1), ("u1", "b", 1), ("u1", "c", 1)]:
+            h.send(row)
+            rt.flush()
+        # after c arrives, a expired: distinct = {b, c} = 2
+        currents = [e.data[1] for e in got if not e.is_expired]
+        assert currents[-1] == 2
+
+    def test_duplicate_survives_partial_expiry(self):
+        rt = build(self.APP.format(window="#window.length(2)"))
+        got = q_callback(rt, "q")
+        h = rt.get_input_handler("T")
+        for row in [("u1", "a", 1), ("u1", "a", 1), ("u1", "b", 1)]:
+            h.send(row)
+            rt.flush()
+        # window holds [a, b] after first a expired — 'a' still present once
+        currents = [e.data[1] for e in got if not e.is_expired]
+        assert currents == [1, 1, 2]
+
+    def test_float_values_distinct_by_bits(self):
+        app = ("define stream T (user string, price double, v long);\n"
+               "@info(name='q') from T select user, distinctCount(price) as n "
+               "group by user insert into Out;")
+        rt = build(app)
+        got = q_callback(rt, "q")
+        h = rt.get_input_handler("T")
+        for p in [1.2, 1.9, 2.5, 1.2]:
+            h.send(("u", p, 1))
+        rt.flush()
+        assert [e.data[1] for e in got] == [1, 2, 3, 3]
+
+    def test_batch_window_reset(self):
+        rt = build(self.APP.format(window="#window.lengthBatch(2)"))
+        got = q_callback(rt, "q")
+        h = rt.get_input_handler("T")
+        for row in [("u1", "a", 1), ("u1", "b", 1), ("u1", "b", 1), ("u1", "b", 1)]:
+            h.send(row)
+            rt.flush()
+        currents = [e.data[1] for e in got if not e.is_expired]
+        # batch 1: a,b → 1,2 ; batch 2 (after reset): b,b → 1,1
+        assert currents == [1, 2, 1, 1]
