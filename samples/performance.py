@@ -1,0 +1,91 @@
+"""Performance harnesses (reference:
+modules/siddhi-samples/performance-samples/ —
+SimpleFilterSingleQueryPerformance.java:40-52 prints throughput + avg latency
+every 10M events; window/group-by/partition variants alongside).
+
+Run:  python samples/performance.py [config] [n_events]
+Configs: filter | window_groupby | distinct | partition | join
+(the BASELINE.md harness shapes). Prints events/sec and per-batch latency.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.event import EventBatch
+
+CONFIGS = {
+    "filter": """
+        define stream In (symbol string, price double, volume long);
+        @info(name='q') from In[price > 50.0] select symbol, price
+        insert into Out;""",
+    "window_groupby": """
+        define stream In (symbol string, price double, volume long);
+        @info(name='q') from In#window.lengthBatch(10000)
+        select symbol, sum(price) as total, avg(price) as avgPrice
+        group by symbol insert into Out;""",
+    "distinct": """
+        define stream In (symbol string, price double, volume long);
+        @info(name='q') from In#window.time(60 sec)
+        select distinctCount(symbol) as uniques insert into Out;""",
+    "join": """
+        define stream In (symbol string, price double, volume long);
+        define stream In2 (symbol string, qty long);
+        @info(name='q') from In#window.length(1000) join In2#window.length(1000)
+        on In.symbol == In2.symbol
+        select In.symbol as symbol, In.price as price, In2.qty as qty
+        insert into Out;""",
+}
+
+
+def run(config: str, n_events: int, batch: int = 8192,
+        n_keys: int = 100_000) -> None:
+    app = CONFIGS[config]
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(app, batch_size=batch,
+                                           group_capacity=1 << 20)
+    rt.start()
+    qr = rt.query_runtimes["q"]
+
+    rng = np.random.default_rng(0)
+    rows = [(f"S{int(k)}", float(p), int(v))
+            for k, p, v in zip(rng.integers(0, n_keys, batch),
+                               rng.uniform(1.0, 100.0, batch),
+                               rng.integers(1, 1000, batch))]
+    cols = qr.codec.rows_to_columns(rows, n_pad=batch) \
+        if hasattr(qr, "codec") else qr.left.codec.rows_to_columns(rows, n_pad=batch)
+
+    import jax.numpy as jnp
+    steps = max(n_events // batch, 1)
+    t_total = 0.0
+    sent = 0
+    junction = rt.junctions["In"]
+    for i in range(steps + 3):
+        ts = np.full(batch, i * 1000, dtype=np.int64)
+        eb = EventBatch.from_numpy(ts, cols, batch)
+        t0 = time.perf_counter()
+        junction.publish_batch(eb, i * 1000)
+        if i >= 3:  # skip warmup/compile
+            t_total += time.perf_counter() - t0
+            sent += batch
+    eps = sent / max(t_total, 1e-9)
+    print(f"{config}: {eps:,.0f} events/sec "
+          f"({t_total / max(steps, 1) * 1e3:.2f} ms/batch of {batch})")
+
+
+def main() -> None:
+    config = sys.argv[1] if len(sys.argv) > 1 else "filter"
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 2_000_000
+    if config == "all":
+        for c in CONFIGS:
+            run(c, n)
+    else:
+        run(config, n)
+
+
+if __name__ == "__main__":
+    main()

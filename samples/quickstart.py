@@ -1,0 +1,36 @@
+"""Quick-start sample (reference:
+modules/siddhi-samples/quick-start-samples/ — SimpleFilterQuery etc.).
+
+Run:  python samples/quickstart.py
+"""
+
+from siddhi_tpu import SiddhiManager
+
+APP = """
+define stream StockStream (symbol string, price float, volume long);
+
+@info(name = 'filterQuery')
+from StockStream[price > 50.0]
+select symbol, price
+insert into HighPriceStream;
+"""
+
+
+def main() -> None:
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime(APP)
+    runtime.add_callback(
+        "HighPriceStream",
+        lambda events: [print(f"  -> {e.data}") for e in events])
+    runtime.start()
+
+    handler = runtime.get_input_handler("StockStream")
+    print("sending events...")
+    for row in [("IBM", 75.6, 100), ("WSO2", 45.6, 10), ("GOOG", 120.0, 50)]:
+        handler.send(row)
+    runtime.flush()
+    runtime.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -146,7 +146,12 @@ event_type: CURRENT | EXPIRED | ALL
 
 // on-demand (store) query — reference grammar rule store_query; executed via
 // SiddhiAppRuntime.query() against tables/windows/aggregations
-on_demand_query: FROM NAME od_on? od_within? od_per? select_clause? group_by_clause? having_clause? order_by_clause? limit_clause? offset_clause?
+on_demand_query: od_from | od_delete_q | od_update_q | od_update_or_insert_q
+od_from: FROM NAME od_on? od_within? od_per? select_clause? group_by_clause? having_clause? order_by_clause? limit_clause? offset_clause? od_insert?
+od_insert: INSERT INTO NAME
+od_delete_q: DELETE NAME od_on
+od_update_q: UPDATE NAME set_clause od_on?
+od_update_or_insert_q: select_clause UPDATE OR INSERT INTO NAME set_clause? od_on
 od_on: ON expression
 od_within: WITHIN expression ("," expression)?
 od_per: PER expression

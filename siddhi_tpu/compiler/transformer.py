@@ -847,7 +847,43 @@ class AstTransformer(Transformer):
     def od_per(self, _per, e):
         return ("od_per", e)
 
-    def on_demand_query(self, _from, name, *clauses):
+    def on_demand_query(self, q):
+        return q
+
+    def od_insert(self, _insert, _into, name):
+        return ("od_insert", str(name))
+
+    def od_delete_q(self, _delete, name, on):
+        # `delete T on <cond>` (reference: DeleteOnDemandQueryRuntime)
+        return OnDemandQuery(
+            input_store_id=str(name), action=OutputAction.DELETE,
+            target_id=str(name), on_condition=on[1])
+
+    def od_update_q(self, _update, name, set_c, *rest):
+        # `update T set T.a = ... [on <cond>]` (UpdateOnDemandQueryRuntime)
+        on_cond = rest[0][1] if rest else None
+        return OnDemandQuery(
+            input_store_id=str(name), action=OutputAction.UPDATE,
+            target_id=str(name), on_condition=on_cond,
+            set_attributes=set_c[1])
+
+    def od_update_or_insert_q(self, selector, _update, _or, _insert, _into,
+                              name, *rest):
+        # `select ... update or insert into T [set ...] on <cond>`
+        # (UpdateOrInsertOnDemandQueryRuntime)
+        sets = ()
+        on_cond = None
+        for r in rest:
+            if isinstance(r, tuple) and r and r[0] == "set":
+                sets = r[1]
+            elif isinstance(r, tuple) and r and r[0] == "od_on":
+                on_cond = r[1]
+        return OnDemandQuery(
+            input_store_id=str(name), action=OutputAction.UPDATE_OR_INSERT,
+            target_id=str(name), on_condition=on_cond,
+            set_attributes=sets, selector=selector)
+
+    def od_from(self, _from, name, *clauses):
         parts = {"selector": Selector(), "group_by": (), "having": None,
                  "order_by": (), "limit": None, "offset": None}
         on_cond = None
@@ -873,6 +909,10 @@ class AstTransformer(Transformer):
                 within = (w[0], w[1] if len(w) > 1 else None)
             elif isinstance(c, tuple) and c and c[0] == "od_per":
                 per = c[1]
+        insert_target = None
+        for c in clauses:
+            if isinstance(c, tuple) and c and c[0] == "od_insert":
+                insert_target = c[1]
         base = parts["selector"]
         selector = Selector(
             attributes=base.attributes, group_by=parts["group_by"],
@@ -880,7 +920,9 @@ class AstTransformer(Transformer):
             limit=parts["limit"], offset=parts["offset"])
         return OnDemandQuery(
             input_store_id=str(name), on_condition=on_cond,
-            within_range=within, per=per, selector=selector)
+            within_range=within, per=per, selector=selector,
+            action=(OutputAction.INSERT if insert_target else OutputAction.RETURN),
+            target_id=insert_target)
 
     # ---------------- partition ----------------
 

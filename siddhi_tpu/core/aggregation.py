@@ -220,6 +220,13 @@ class AggregationRuntime(Receiver):
                     args = [compile_expression(p, self.resolver, registry)
                             for p in expr.parameters]
                     spec = factory.make([a.type for a in args])
+                    if spec.custom_scan is not None:
+                        # distinctCount et al. don't decompose into additive
+                        # bucket components (reference gets per-bucket distinct
+                        # sets via its incremental aggregator SPI — not built)
+                        raise SiddhiAppCreationError(
+                            f"aggregation {definition.id!r}: {expr.name!r} is "
+                            "not supported in incremental aggregations")
                     off = len(self._comp_meta)
                     for comp in spec.components:
                         self._comp_meta.append(comp)

@@ -32,7 +32,7 @@ from .stream import (
 class SiddhiAppRuntime:
     def __init__(self, app: SiddhiApp, registry: Registry,
                  batch_size: int = 0, group_capacity: int = 0,
-                 error_store=None) -> None:
+                 error_store=None, config_manager=None) -> None:
         self.app = app
         playback_ann = app.annotation("app:playback")
         idle_ms = increment_ms = None
@@ -57,6 +57,7 @@ class SiddhiAppRuntime:
         )
         self.ctx.runtime = self
         self.ctx.error_store = error_store
+        self.ctx.config_manager = config_manager
         from .event import StringTable
         self.ctx.global_strings = StringTable()
         stats_ann = app.annotation("app:statistics")
@@ -314,6 +315,14 @@ class SiddhiAppRuntime:
         rt = self._ondemand_cache.get(on_demand_text)
         if rt is None:
             odq = compiler.parse_on_demand_query(on_demand_text)
+            from ..query_api.execution import OutputAction as _OA
+            if odq.action != _OA.RETURN:
+                rt = self._build_crud_runtime(odq)
+                self._ondemand_cache[on_demand_text] = rt
+                self.flush()
+                t = (now if now is not None
+                     else self.ctx.timestamp_generator.current_time())
+                return rt.execute(t)
             store = self.tables.get(odq.input_store_id)
             if store is None:
                 store = self.windows.get(odq.input_store_id)
@@ -336,6 +345,30 @@ class SiddhiAppRuntime:
         self.flush()
         t = now if now is not None else self.ctx.timestamp_generator.current_time()
         return rt.execute(t)
+
+    def _build_crud_runtime(self, odq):
+        """Write-form on-demand queries (delete/update/update-or-insert/
+        select-insert) — reference: OnDemandQueryParser non-find runtimes."""
+        from ..query_api.execution import OutputAction as _OA
+        from .ondemand import OnDemandCrudRuntime
+        target = self.tables.get(odq.target_id)
+        if target is None:
+            raise DefinitionNotExistError(
+                f"table {odq.target_id!r} is not defined")
+        source = None
+        if odq.action == _OA.INSERT:
+            source = self.tables.get(odq.input_store_id)
+            if source is None:  # NOT `or`: an empty table is falsy (__len__)
+                source = self.windows.get(odq.input_store_id)
+            if source is None and odq.input_store_id in self.aggregations:
+                raise SiddhiAppCreationError(
+                    "insert-into from aggregations: query the aggregation "
+                    "and insert host-side instead")
+            if source is None:
+                raise DefinitionNotExistError(
+                    f"store {odq.input_store_id!r} is not defined")
+        return OnDemandCrudRuntime(odq, target, self.ctx, self.ctx.registry,
+                                   source_store=source)
 
     def flush(self, now: Optional[int] = None) -> None:
         """Drive every staged batch through the pipeline (source junctions

@@ -26,6 +26,8 @@ class SiddhiManager:
         self.persistence_store = None
         #: shared error store (reference: SiddhiManager.setErrorStore)
         self.error_store = None
+        #: deployment config (reference: SiddhiManager.setConfigManager)
+        self.config_manager = None
 
     def create_siddhi_app_runtime(
         self, app: Union[str, SiddhiApp], *,
@@ -36,7 +38,8 @@ class SiddhiManager:
             app = compiler.parse(text)
         rt = SiddhiAppRuntime(app, self.registry, batch_size=batch_size,
                               group_capacity=group_capacity,
-                              error_store=self.error_store)
+                              error_store=self.error_store,
+                              config_manager=self.config_manager)
         if self.persistence_store is not None:
             rt.persistence_store = self.persistence_store
         self.runtimes[app.name] = rt
@@ -53,6 +56,11 @@ class SiddhiManager:
         self.error_store = store
         for rt in self.runtimes.values():
             rt.ctx.error_store = store
+
+    def set_config_manager(self, config_manager) -> None:
+        """Reference: SiddhiManager.setConfigManager — deployment config for
+        extension ConfigReaders (applies to apps created afterwards)."""
+        self.config_manager = config_manager
 
     def persist(self) -> dict:
         """Persist every running app (reference: SiddhiManager.persist:291)."""

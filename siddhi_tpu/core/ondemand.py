@@ -93,3 +93,89 @@ class OnDemandQueryRuntime:
     def execute(self, now: int = 0) -> list[Event]:
         out = self._fn(self.table.state, jnp.int64(now))
         return out.to_host_events(self.output_codec)
+
+
+class OnDemandCrudRuntime:
+    """Write-form on-demand queries (reference: Insert/Delete/Update/
+    UpdateOrInsert OnDemandQueryRuntime under core/query/):
+
+      delete T on <cond>
+      update T set T.a = <expr>, ... [on <cond>]
+      select <consts> update or insert into T [set ...] on <cond>
+      from Store ... select ... insert into T
+
+    Reuses the query-output TableOutputExecutor (one jitted device op); the
+    standalone forms evaluate against a single dummy lane since their
+    conditions/sets reference only the table frame and constants."""
+
+    def __init__(self, odq: OnDemandQuery, target: InMemoryTable, ctx,
+                 registry, source_store=None) -> None:
+        from ..query_api.execution import OutputAction, OutputStream
+        from .table import TableOutputExecutor
+
+        self.odq = odq
+        self.target = target
+        self.ctx = ctx
+        self.action = odq.action
+        self.select_runtime = None
+        self._out_batch = None
+
+        if self.action == OutputAction.INSERT:
+            # select over the source store, insert results into the target
+            import dataclasses as dc
+            sel_odq = dc.replace(odq, action=OutputAction.RETURN, target_id=None)
+            self.select_runtime = OnDemandQueryRuntime(
+                sel_odq, source_store, ctx, registry)
+            self.executor = None
+            return
+
+        out_types: dict = {}
+        out_cols: dict = {}
+        if self.action == OutputAction.UPDATE_OR_INSERT:
+            # the SELECT list supplies the row to insert on no-match:
+            # constant expressions evaluated once into a 1-lane out frame
+            empty = TypeResolver({"__out__": {}}, "__out__",
+                                 {"__out__": None})
+            scope = Scope()
+            scope.add_frame("__out__", {}, jnp.zeros((1,), jnp.int64),
+                            jnp.ones((1,), bool), default=True)
+            for oa in odq.selector.attributes:
+                ce = compile_expression(oa.expression, empty, registry)
+                name = oa.rename or getattr(oa.expression, "attribute", None)
+                if name is None:
+                    raise SiddhiAppCreationError(
+                        "update-or-insert select items need `as` names")
+                out_types[name] = ce.type
+                val = ce(scope)
+                if isinstance(val, str):  # bare string constant → intern
+                    val = ctx.global_strings.encode(val)
+                    out_cols[name] = jnp.full((1,), val, jnp.int32)
+                else:
+                    out_cols[name] = jnp.broadcast_to(jnp.asarray(val), (1,))
+
+        out_def = StreamDefinition(
+            id="__out__", attributes=tuple(
+                Attribute(n, t) for n, t in out_types.items()))
+        out_codec = StreamCodec(out_def, ctx.global_strings)
+        from ..query_api.expression import Constant
+        out_stream = OutputStream(
+            action=self.action, target_id=target.definition.id,
+            # bare `update T set ...` applies to every row
+            on_condition=odq.on_condition or Constant(True, "bool"),
+            set_attributes=odq.set_attributes)
+        self.executor = TableOutputExecutor(
+            target, out_stream, out_types, out_codec, registry)
+        self._out_batch = EventBatch(
+            ts=jnp.zeros((1,), jnp.int64),
+            cols=out_cols,
+            valid=jnp.ones((1,), bool),
+            types=jnp.zeros((1,), jnp.int8))
+
+    def execute(self, now: int = 0) -> list[Event]:
+        if self.select_runtime is not None:
+            events = self.select_runtime.execute(now)
+            rows = [tuple(e.data) for e in events]
+            self.target.insert_rows(rows, timestamp=now)
+            return events
+        self.executor.apply(self._out_batch)
+        return []

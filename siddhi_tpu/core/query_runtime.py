@@ -318,7 +318,8 @@ class QueryRuntime(Receiver):
         self.ctx.statistics.track_latency(self.name, time.perf_counter_ns() - t0)
         self._batches_seen += 1
         if (self._has_custom_aggs and not self._capacity_warned
-                and self._batches_seen % 256 == 0):
+                and (self._batches_seen in (1, 16, 64)
+                     or self._batches_seen % 256 == 0)):
             self._check_custom_agg_capacity()
 
     def _check_custom_agg_capacity(self) -> None:

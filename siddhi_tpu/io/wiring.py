@@ -73,6 +73,15 @@ def _make_sink_mapper(map_ann: Optional[Annotation], definition,
     return mapper
 
 
+def _config_defaults(ctx, namespace: str, name: str) -> dict:
+    """Deployment-config properties for one extension (annotation options
+    override them — reference: per-extension ConfigReader precedence)."""
+    cm = getattr(ctx, "config_manager", None)
+    if cm is None:
+        return {}
+    return cm.generate_config_reader(namespace, name).get_all_configs()
+
+
 def build_source(ann: Annotation, junction, ctx) -> Source:
     """One @source(...) annotation → connected-on-start Source bound to the
     stream's junction staging buffers."""
@@ -80,6 +89,7 @@ def build_source(ann: Annotation, junction, ctx) -> Source:
     stype = options.pop("type", None)
     if not stype:
         raise SiddhiAppCreationError("@source needs type=")
+    options = {**_config_defaults(ctx, "source", stype), **options}
     definition = junction.definition
     registry = ctx.registry
     mapper = _make_source_mapper(ann.nested_annotation("map"), definition,
@@ -105,6 +115,7 @@ def build_sink(ann: Annotation, junction, ctx) -> Sink:
     stype = options.pop("type", None)
     if not stype:
         raise SiddhiAppCreationError("@sink needs type=")
+    options = {**_config_defaults(ctx, "sink", stype), **options}
     definition = junction.definition
     registry = ctx.registry
     mapper = _make_sink_mapper(ann.nested_annotation("map"), definition, registry)

@@ -1,0 +1,165 @@
+"""REST deployment microservice.
+
+Reference: modules/siddhi-service/ — an MSF4J/Swagger service exposing deploy/
+undeploy/list of SiddhiQL apps (SiddhiApiServiceImpl.java:24). Here: a
+stdlib ThreadingHTTPServer over one SiddhiManager.
+
+Endpoints (JSON):
+  POST   /siddhi-apps                 body = SiddhiQL text  → deploy + start
+  GET    /siddhi-apps                 → list of app names
+  DELETE /siddhi-apps/<name>          → shutdown + undeploy
+  POST   /siddhi-apps/<name>/streams/<stream>  body = {"events": [[...], ...]}
+  POST   /siddhi-apps/<name>/query    body = {"query": "from T select ..."}
+  GET    /siddhi-apps/<name>/statistics
+
+Usage:  python -m siddhi_tpu.service [port]
+
+Concurrency note: requests serialize through one lock — the engine is a
+single-controller runtime by design (SURVEY §7); the service is a deployment
+surface, not a data-plane load balancer.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .core.manager import SiddhiManager
+from .errors import SiddhiError
+
+
+class SiddhiService:
+    def __init__(self, manager: SiddhiManager | None = None) -> None:
+        self.manager = manager or SiddhiManager()
+        self.lock = threading.Lock()
+
+    # ------------------------------------------------------------- operations
+
+    def deploy(self, siddhi_ql: str) -> str:
+        with self.lock:
+            from . import compiler
+            text = (compiler.update_variables(siddhi_ql)
+                    if "${" in siddhi_ql else siddhi_ql)
+            app = compiler.parse(text)
+            if app.name in self.manager.runtimes:
+                # reference service rejects duplicate deployment
+                raise SiddhiError(f"app {app.name!r} is already deployed")
+            rt = self.manager.create_siddhi_app_runtime(app)
+            rt.start()
+            return rt.app.name
+
+    def undeploy(self, name: str) -> bool:
+        with self.lock:
+            rt = self.manager.runtimes.pop(name, None)
+            if rt is None:
+                return False
+            rt.shutdown()
+            return True
+
+    def list_apps(self) -> list[str]:
+        with self.lock:
+            return sorted(self.manager.runtimes)
+
+    def send(self, app: str, stream: str, events: list) -> int:
+        with self.lock:
+            rt = self.manager.runtimes[app]
+            handler = rt.get_input_handler(stream)
+            for row in events:
+                handler.send(tuple(row))
+            rt.flush()
+            return len(events)
+
+    def query(self, app: str, text: str) -> list:
+        with self.lock:
+            rt = self.manager.runtimes[app]
+            return [list(e.data) for e in rt.query(text)]
+
+    def statistics(self, app: str) -> dict:
+        with self.lock:
+            return self.manager.runtimes[app].statistics_report()
+
+    # ---------------------------------------------------------------- server
+
+    def make_server(self, port: int = 9090,
+                    host: str = "127.0.0.1") -> ThreadingHTTPServer:
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _reply(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n).decode()
+
+            def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                try:
+                    if parts == ["siddhi-apps"]:
+                        self._reply(200, {"apps": service.list_apps()})
+                    elif (len(parts) == 3 and parts[0] == "siddhi-apps"
+                          and parts[2] == "statistics"):
+                        self._reply(200, service.statistics(parts[1]))
+                    else:
+                        self._reply(404, {"error": "not found"})
+                except KeyError:
+                    self._reply(404, {"error": "unknown app"})
+
+            def do_POST(self):
+                parts = self.path.strip("/").split("/")
+                try:
+                    if parts == ["siddhi-apps"]:
+                        name = service.deploy(self._body())
+                        self._reply(201, {"app": name})
+                    elif (len(parts) == 4 and parts[0] == "siddhi-apps"
+                          and parts[2] == "streams"):
+                        data = json.loads(self._body())
+                        n = service.send(parts[1], parts[3],
+                                         data.get("events", []))
+                        self._reply(200, {"accepted": n})
+                    elif (len(parts) == 3 and parts[0] == "siddhi-apps"
+                          and parts[2] == "query"):
+                        data = json.loads(self._body())
+                        rows = service.query(parts[1], data["query"])
+                        self._reply(200, {"records": rows})
+                    else:
+                        self._reply(404, {"error": "not found"})
+                except KeyError as e:
+                    self._reply(404, {"error": f"unknown: {e}"})
+                except json.JSONDecodeError as e:
+                    self._reply(400, {"error": f"bad JSON body: {e}"})
+                except SiddhiError as e:
+                    self._reply(400, {"error": str(e)})
+
+            def do_DELETE(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 2 and parts[0] == "siddhi-apps":
+                    ok = service.undeploy(parts[1])
+                    self._reply(200 if ok else 404,
+                                {"undeployed": ok})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+        return ThreadingHTTPServer((host, port), Handler)
+
+
+def main(argv=None) -> None:
+    import sys
+    argv = argv if argv is not None else sys.argv[1:]
+    port = int(argv[0]) if argv else 9090
+    server = SiddhiService().make_server(port)
+    print(f"siddhi_tpu service on :{port}")
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

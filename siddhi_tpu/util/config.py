@@ -1,0 +1,117 @@
+"""Deployment configuration — ConfigManager / ConfigReader SPI.
+
+Reference: core/util/config/ — ConfigManager + ConfigReader SPI,
+InMemoryConfigManager, YAMLConfigManager.java:40 (parses the deployment YAML's
+`extensions:` list into per-(namespace,name) property maps, plus `refs:` and
+root-level system configs). Extensions receive a ConfigReader at init; here
+the IO wiring layers config properties UNDER annotation options (annotation
+wins), matching the reference's configReader precedence.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ConfigReader:
+    """Per-extension property view (reference: ConfigReader SPI)."""
+
+    def __init__(self, properties: Optional[dict] = None) -> None:
+        self._props = dict(properties or {})
+
+    def read_config(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self._props.get(key, default)
+
+    def get_all_configs(self) -> dict:
+        return dict(self._props)
+
+
+class ConfigManager:
+    """SPI (reference: ConfigManager)."""
+
+    def generate_config_reader(self, namespace: str, name: str) -> ConfigReader:
+        raise NotImplementedError
+
+    def extract_system_configs(self, name: str) -> dict:
+        raise NotImplementedError
+
+    def extract_property(self, name: str) -> Optional[str]:
+        raise NotImplementedError
+
+
+class InMemoryConfigManager(ConfigManager):
+    """Reference: InMemoryConfigManager — configs keyed 'namespace.name.key'."""
+
+    def __init__(self, configs: Optional[dict] = None,
+                 system_configs: Optional[dict] = None) -> None:
+        self._configs = dict(configs or {})
+        self._system = dict(system_configs or {})
+
+    def generate_config_reader(self, namespace: str, name: str) -> ConfigReader:
+        prefix = f"{namespace}.{name}."
+        return ConfigReader({
+            k[len(prefix):]: v for k, v in self._configs.items()
+            if k.startswith(prefix)})
+
+    def extract_system_configs(self, name: str) -> dict:
+        return dict(self._system.get(name, {}))
+
+    def extract_property(self, name: str) -> Optional[str]:
+        return self._configs.get(name)
+
+
+class YAMLConfigManager(ConfigManager):
+    """Reference: YAMLConfigManager.java:40. YAML layout::
+
+        extensions:
+          - extension:
+              name: inMemory
+              namespace: source
+              properties:
+                topic: defaultTopic
+        refs:
+          - ref:
+              name: store1
+              type: rdbms
+              properties: {...}
+        properties:
+          some.system.property: value
+    """
+
+    def __init__(self, yaml_text: Optional[str] = None,
+                 yaml_path: Optional[str] = None) -> None:
+        import yaml
+        if yaml_text is None:
+            if yaml_path is None:
+                raise ValueError("need yaml_text or yaml_path")
+            with open(yaml_path) as f:
+                yaml_text = f.read()
+        data = yaml.safe_load(yaml_text) or {}
+        self._extensions: dict[tuple[str, str], dict] = {}
+        for item in data.get("extensions", []) or []:
+            ext = item.get("extension", item)
+            key = (str(ext.get("namespace", "")).lower(),
+                   str(ext.get("name", "")).lower())
+            self._extensions[key] = dict(ext.get("properties", {}) or {})
+        self._refs: dict[str, dict] = {}
+        for item in data.get("refs", []) or []:
+            ref = item.get("ref", item)
+            self._refs[str(ref.get("name"))] = {
+                "type": ref.get("type"),
+                "properties": dict(ref.get("properties", {}) or {})}
+        self._properties = dict(data.get("properties", {}) or {})
+
+    def generate_config_reader(self, namespace: str, name: str) -> ConfigReader:
+        return ConfigReader(
+            self._extensions.get((namespace.lower(), name.lower()), {}))
+
+    def extract_system_configs(self, name: str) -> dict:
+        ref = self._refs.get(name)
+        if ref is None:
+            return {}
+        out = dict(ref["properties"])
+        out["type"] = ref["type"]
+        return out
+
+    def extract_property(self, name: str) -> Optional[str]:
+        return self._properties.get(name)

@@ -1,0 +1,79 @@
+"""Extension documentation generator.
+
+Reference: modules/siddhi-doc-gen/ — a Maven mojo that renders markdown for
+every @Extension's metadata. Here: walk the extension registry and render one
+markdown document grouped by kind, with each extension's docstring.
+
+Usage:  python -m siddhi_tpu.util.docgen [output.md]
+"""
+
+from __future__ import annotations
+
+import inspect
+
+from ..extension.registry import GLOBAL, ExtensionKind, Registry
+
+_KIND_TITLES = {
+    ExtensionKind.WINDOW: "Windows",
+    ExtensionKind.AGGREGATOR: "Aggregators",
+    ExtensionKind.FUNCTION: "Functions",
+    ExtensionKind.STREAM_FUNCTION: "Stream functions",
+    ExtensionKind.STREAM_PROCESSOR: "Stream processors",
+    ExtensionKind.SOURCE: "Sources",
+    ExtensionKind.SINK: "Sinks",
+    ExtensionKind.SOURCE_MAPPER: "Source mappers",
+    ExtensionKind.SINK_MAPPER: "Sink mappers",
+    ExtensionKind.DISTRIBUTION_STRATEGY: "Sink distribution strategies",
+    ExtensionKind.SCRIPT: "Script engines",
+    ExtensionKind.TABLE: "Tables",
+    ExtensionKind.STORE: "Stores",
+    ExtensionKind.INCREMENTAL_AGGREGATOR: "Incremental aggregators",
+}
+
+
+def _describe(impl) -> str:
+    doc = inspect.getdoc(impl)
+    auto = f"{type(impl).__name__}(" if not inspect.isclass(impl) else None
+    if not doc or (auto and doc.startswith(auto)):
+        # dataclass-generated repr docstring: describe the factory instead
+        make = getattr(impl, "make", None)
+        doc = inspect.getdoc(make) if make is not None else None
+    if not doc:
+        return "_(no documentation)_"
+    return doc.split("\n\n")[0].replace("\n", " ")
+
+
+def generate_markdown(registry: Registry = GLOBAL) -> str:
+    lines = ["# siddhi_tpu extension reference", "",
+             "Generated from the extension registry "
+             "(the analogue of the reference's siddhi-doc-gen mojo over "
+             "@Extension metadata).", ""]
+    by_kind: dict[ExtensionKind, list] = {}
+    for (kind, key), impl in sorted(registry._entries.items(),
+                                    key=lambda kv: (kv[0][0].value, kv[0][1])):
+        by_kind.setdefault(kind, []).append((key, impl))
+    for kind, entries in by_kind.items():
+        lines.append(f"## {_KIND_TITLES.get(kind, kind.value)}")
+        lines.append("")
+        for key, impl in entries:
+            lines.append(f"### `{key}`")
+            lines.append("")
+            lines.append(_describe(impl))
+            lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> None:
+    import sys
+    argv = argv if argv is not None else sys.argv[1:]
+    out = argv[0] if argv else "docs/extensions.md"
+    import os
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    import siddhi_tpu  # noqa: F401 — trigger all built-in registrations
+    with open(out, "w") as f:
+        f.write(generate_markdown())
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

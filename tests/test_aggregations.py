@@ -150,6 +150,18 @@ class TestAggregationFind:
             rt.query("from TradeAgg within 0, 10 per 'months' select symbol")
 
 
+class TestAggregationUnsupportedAggregator:
+    def test_distinct_count_rejected_clearly(self):
+        from siddhi_tpu.errors import SiddhiAppCreationError
+        with pytest.raises(SiddhiAppCreationError, match="not supported"):
+            SiddhiManager().create_siddhi_app_runtime("""
+            define stream S (k string, v double, ts long);
+            define aggregation A from S
+            select k, distinctCount(v) as n
+            group by k aggregate by ts every sec;
+            """)
+
+
 class TestAggregationMinMax:
     def test_min_max_buckets(self):
         app = """

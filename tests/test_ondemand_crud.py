@@ -1,0 +1,99 @@
+"""Write-form on-demand query tests (reference:
+store/OnDemandQueryTableTestCase — delete/update/update-or-insert/insert)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+APP = (
+    "define stream S (symbol string, price float, volume long);\n"
+    "define table T (symbol string, price float, volume long);\n"
+    "from S insert into T;\n")
+
+
+def build():
+    rt = SiddhiManager().create_siddhi_app_runtime(APP)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for row in [("IBM", 75.0, 100), ("WSO2", 57.0, 10), ("GOOG", 120.0, 5)]:
+        h.send(row)
+    rt.flush()
+    return rt
+
+
+class TestOnDemandDelete:
+    def test_delete_on_condition(self):
+        rt = build()
+        rt.query("delete T on T.symbol == 'IBM'")
+        rows = sorted(r[0] for r in rt.tables["T"].all_rows())
+        assert rows == ["GOOG", "WSO2"]
+
+    def test_delete_numeric_condition(self):
+        rt = build()
+        rt.query("delete T on T.price < 100.0")
+        assert [r[0] for r in rt.tables["T"].all_rows()] == ["GOOG"]
+
+
+class TestOnDemandUpdate:
+    def test_update_with_condition(self):
+        rt = build()
+        rt.query("update T set T.price = 99.5 on T.symbol == 'WSO2'")
+        rows = {r[0]: r[1] for r in rt.tables["T"].all_rows()}
+        assert rows["WSO2"] == pytest.approx(99.5)
+        assert rows["IBM"] == pytest.approx(75.0)
+
+    def test_update_all_rows(self):
+        rt = build()
+        rt.query("update T set T.volume = 0l")
+        assert all(r[2] == 0 for r in rt.tables["T"].all_rows())
+
+    def test_update_expression_of_table_attr(self):
+        rt = build()
+        rt.query("update T set T.price = T.price * 2.0 on T.symbol == 'IBM'")
+        rows = {r[0]: r[1] for r in rt.tables["T"].all_rows()}
+        assert rows["IBM"] == pytest.approx(150.0)
+
+
+class TestOnDemandUpdateOrInsert:
+    def test_updates_existing(self):
+        rt = build()
+        rt.query("select 'IBM' as symbol, 11.0 as price, 1l as volume "
+                 "update or insert into T set T.price = 11.0 "
+                 "on T.symbol == 'IBM'")
+        rows = {r[0]: r[1] for r in rt.tables["T"].all_rows()}
+        assert rows["IBM"] == pytest.approx(11.0)
+        assert len(rows) == 3
+
+    def test_inserts_when_missing(self):
+        rt = build()
+        rt.query("select 'MSFT' as symbol, 300.0 as price, 7l as volume "
+                 "update or insert into T set T.price = 300.0 "
+                 "on T.symbol == 'MSFT'")
+        rows = {r[0]: (r[1], r[2]) for r in rt.tables["T"].all_rows()}
+        assert rows["MSFT"] == (pytest.approx(300.0), 7)
+        assert len(rows) == 4
+
+
+class TestEmptySourceInsert:
+    def test_insert_from_empty_table(self):
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            "define table Src (a long);\n"
+            "define table T (a long);\n")
+        rt.start()
+        events = rt.query("from Src select a insert into T")
+        assert events == []
+        assert rt.tables["T"].all_rows() == []
+
+
+class TestOnDemandInsertFromSelect:
+    def test_select_insert_into(self):
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            APP + "define table Archive (symbol string, price float, volume long);\n")
+        rt.start()
+        h = rt.get_input_handler("S")
+        for row in [("IBM", 75.0, 100), ("WSO2", 57.0, 10)]:
+            h.send(row)
+        rt.flush()
+        events = rt.query("from T select symbol, price, volume insert into Archive")
+        assert len(events) == 2
+        assert sorted(r[0] for r in rt.tables["Archive"].all_rows()) == ["IBM", "WSO2"]
