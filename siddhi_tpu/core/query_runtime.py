@@ -166,10 +166,12 @@ class QueryRuntime(Receiver):
         for spec, _ in self.pre_window_fns:
             for n, t in spec.new_attrs:
                 layout[n] = dtypes.device_dtype(t)
-        # query callbacks always see removeEvents (reference wires
-        # outputExpectsExpiredEvents from the callback/output type); keep
-        # expired lanes on unless profiling shows it matters.
-        expired_on = True
+        # expired-lane emission (reference: outputExpectsExpiredEvents wiring,
+        # QueryParser): batch windows only materialize EXPIRED lanes when the
+        # query output wants them (`insert all/expired events`) — a CURRENT
+        # insert halves the emission chunk the selector sorts. Sliding windows
+        # ignore this flag: their expired lanes drive aggregator removal.
+        expired_on = query.output_stream.event_type != OutputEventType.CURRENT
         wh = in_stream.handlers.window
         if wh is not None:
             factory = registry.require(ExtensionKind.WINDOW, wh.namespace, wh.name)
@@ -353,9 +355,15 @@ class QueryRuntime(Receiver):
                     out.to_host_events(self.output_codec))
 
         if self.callbacks:
+            # callbacks see exactly what the query emits (reference:
+            # outputExpectsExpiredEvents): CURRENT-only queries get no
+            # removeEvents regardless of window kind
             events = out.to_host_events(self.output_codec)
             in_events = [e for e in events if not e.is_expired] or None
-            remove_events = [e for e in events if e.is_expired] or None
+            remove_events = ([e for e in events if e.is_expired] or None
+                             if etype != OutputEventType.CURRENT else None)
+            if etype == OutputEventType.EXPIRED:
+                in_events = None
             if in_events or remove_events:
                 for cb in self.callbacks:
                     cb.receive(now, in_events, remove_events)

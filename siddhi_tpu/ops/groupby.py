@@ -30,6 +30,14 @@ import jax
 import jax.numpy as jnp
 
 
+def invert_permutation(perm: jax.Array) -> jax.Array:
+    """Inverse of a permutation via scatter — O(n), vs the O(n log n) second
+    sort of the argsort(argsort(x)) idiom (slow on TPU)."""
+    n = perm.shape[0]
+    return jnp.zeros((n,), perm.dtype).at[perm].set(
+        jnp.arange(n, dtype=perm.dtype))
+
+
 class GroupState(NamedTuple):
     """Persistent per-key accumulator table (one per aggregator component).
 
@@ -84,7 +92,7 @@ def grouped_scan(
 
     # stable sort by (slot, lane) — lane order inside a slot is preserved
     order = jnp.argsort(slots_v, stable=True)
-    inv = jnp.argsort(order, stable=True)
+    inv = invert_permutation(order)
     s_slots = slots_v[order]
     s_deltas = jnp.where(valid, deltas, jnp.full_like(deltas, identity))[order]
     s_epochs = lane_epoch[order]
@@ -223,7 +231,7 @@ def key_lookup_or_insert(
     first = jnp.concatenate([jnp.ones((1,), bool), snk[1:] != snk[:-1]]) & (snk != _KEY_PAD)
     # rank new unique keys by first-appearance lane index for deterministic ids
     first_lane = jnp.where(first, order, L)
-    lane_rank = jnp.argsort(jnp.argsort(first_lane))  # position after sorting by lane
+    lane_rank = invert_permutation(jnp.argsort(first_lane, stable=True))
     new_id_sorted = table.count + lane_rank.astype(jnp.int32)
 
     # each lane's id: for new keys, find their unique-key id via the sorted run

@@ -833,8 +833,9 @@ class SortWindow(WindowOp):
         m_seq = jnp.concatenate([state.seq, state.count + p])
         m_valid = jnp.concatenate([state.valid, is_arr])
 
+        from .groupby import invert_permutation
         perm = self._rank_key(m_cols, m_valid)
-        keep_rank = jnp.argsort(perm)  # rank of each lane
+        keep_rank = invert_permutation(perm)
         kept = m_valid & (keep_rank < N)
         evicted = m_valid & ~kept
 

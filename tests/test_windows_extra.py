@@ -40,9 +40,11 @@ class TestCronWindow:
         assert ins[-1].data[1] == pytest.approx(30.0)
 
     def test_cron_expired_on_next_fire(self):
+        # `insert all events` opts into EXPIRED emission (reference:
+        # outputExpectsExpiredEvents — CURRENT-only inserts skip expired lanes)
         rt = build(
             S + "@info(name='q') from S#window.cron('*/2 * * * * ?') "
-            "select symbol insert into Out;")
+            "select symbol insert all events into Out;")
         got = q_callback(rt, "q")
         h = rt.get_input_handler("S")
         h.send(("A", 1.0, 1), timestamp=100)
@@ -90,7 +92,7 @@ class TestFrequentWindow:
     def test_eviction_emits_expired(self):
         rt = build(
             S + "@info(name='q') from S#window.frequent(1, symbol) "
-            "select symbol insert into Out;", batch_size=4)
+            "select symbol insert all events into Out;", batch_size=4)
         got = q_callback(rt, "q")
         h = rt.get_input_handler("S")
         h.send(("A", 1.0, 1))
