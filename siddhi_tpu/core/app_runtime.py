@@ -446,7 +446,13 @@ class SiddhiAppRuntime:
                 "no persistence store configured "
                 "(set manager.persistence_store)")
         import time as _time
-        revision = f"{int(_time.time() * 1000)}_{self.app.name}"
+        ms = int(_time.time() * 1000)
+        # strictly increasing: two persists in one millisecond must not
+        # collide (delta persistence chains rely on revision uniqueness/order)
+        last = getattr(self, "_last_rev_ms", 0)
+        ms = max(ms, last + 1)
+        self._last_rev_ms = ms
+        revision = f"{ms}_{self.app.name}"
         store.save(self.app.name, revision, self.snapshot())
         return revision
 

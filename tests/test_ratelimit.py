@@ -1,0 +1,103 @@
+"""Output rate-limiter behavioral tests (reference:
+modules/siddhi-core/src/test/java/io/siddhi/core/query/ratelimit/ —
+EventOutputRateLimitTestCase, TimeOutputRateLimitTestCase)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+S = "define stream S (symbol string, price float);\n"
+
+
+def build(app, batch_size=4, playback=True):
+    text = ("@app:playback\n" if playback else "") + app
+    rt = SiddhiManager().create_siddhi_app_runtime(text, batch_size=batch_size)
+    rt.start()
+    return rt
+
+
+def q_callback(rt, name="q"):
+    got = []
+    rt.add_query_callback(name, lambda ts, i, r: got.extend(i or []))
+    return got
+
+
+class TestEventRateLimits:
+    def test_output_last_every_3_events(self):
+        rt = build(S + "@info(name='q') from S select symbol, price "
+                   "output last every 3 events insert into Out;")
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        for i, sym in enumerate("abcdef"):
+            h.send((sym, float(i)))
+        rt.flush()
+        # every 3rd event emits, carrying the LAST of its group
+        assert [e.data[0] for e in got] == ["c", "f"]
+
+    def test_output_first_every_3_events(self):
+        rt = build(S + "@info(name='q') from S select symbol, price "
+                   "output first every 3 events insert into Out;")
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        for i, sym in enumerate("abcdef"):
+            h.send((sym, float(i)))
+        rt.flush()
+        assert [e.data[0] for e in got] == ["a", "d"]
+
+    def test_output_all_every_2_events(self):
+        rt = build(S + "@info(name='q') from S select symbol, price "
+                   "output all every 2 events insert into Out;")
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        for i, sym in enumerate("abcde"):
+            h.send((sym, float(i)))
+        rt.flush()
+        # batches of 2 release buffered events; 'e' stays buffered
+        assert [e.data[0] for e in got] == ["a", "b", "c", "d"]
+
+    def test_carry_across_batches(self):
+        rt = build(S + "@info(name='q') from S select symbol, price "
+                   "output last every 3 events insert into Out;", batch_size=2)
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        for i, sym in enumerate("abcd"):
+            h.send((sym, float(i)))
+            rt.flush()
+        assert [e.data[0] for e in got] == ["c"]
+
+
+class TestTimeRateLimits:
+    def test_output_first_every_second(self):
+        rt = build(S + "@info(name='q') from S select symbol, price "
+                   "output first every 1 sec insert into Out;")
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0), timestamp=100)
+        h.send(("b", 2.0), timestamp=200)   # same window: suppressed
+        rt.flush()
+        h.send(("c", 3.0), timestamp=1_300)  # new window
+        rt.flush()
+        assert [e.data[0] for e in got] == ["a", "c"]
+
+    def test_output_all_every_second_buffers(self):
+        rt = build(S + "@info(name='q') from S select symbol, price "
+                   "output all every 1 sec insert into Out;")
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0), timestamp=100)
+        h.send(("b", 2.0), timestamp=200)
+        rt.flush()
+        assert got == []  # buffered until the period elapses
+        rt.heartbeat(1_500)
+        assert [e.data[0] for e in got] == ["a", "b"]
+
+    def test_output_last_every_second(self):
+        rt = build(S + "@info(name='q') from S select symbol, price "
+                   "output last every 1 sec insert into Out;")
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0), timestamp=100)
+        h.send(("b", 2.0), timestamp=200)
+        rt.flush()
+        rt.heartbeat(1_500)
+        assert [e.data[0] for e in got] == ["b"]
