@@ -76,12 +76,31 @@ def grouped_scan(
     planner forbids min/max over sliding windows until the segment-tree ring
     lands); identity is +/-inf (or dtype extremes for ints).
     """
-    L = slots.shape[0]
     K = state.values.shape[0]
+    plan = _segment_plan(slots, valid, resets, current_epoch, K)
+    new_values, s_out = _scan_component(
+        state.values, state.epoch, deltas, valid, plan, op)
+    new_epoch = state.epoch.at[plan.write_slot].set(
+        plan.s_epochs.astype(state.epoch.dtype), mode="drop")
+    return GroupState(new_values, new_epoch), s_out[plan.inv]
+
+
+class _SegmentPlan(NamedTuple):
+    """Shared per-batch segment structure: one sort + boundary computation
+    reused by every component scanned over the same (slots, valid, resets)."""
+
+    order: jax.Array
+    inv: jax.Array
+    s_slots: jax.Array
+    s_epochs: jax.Array
+    seg_start: jax.Array
+    safe_slots: jax.Array
+    epoch_ok_slots: jax.Array  # s_slots < K (validity of gathers)
+    write_slot: jax.Array
+
+
+def _segment_plan(slots, valid, resets, current_epoch, K) -> _SegmentPlan:
     sentinel = jnp.int32(K)
-
-    combine, identity = _OPS[op](deltas.dtype)
-
     slots_v = jnp.where(valid, slots, sentinel)
 
     # epoch id per lane: lanes after the r-th reset belong to epoch
@@ -94,43 +113,78 @@ def grouped_scan(
     order = jnp.argsort(slots_v, stable=True)
     inv = invert_permutation(order)
     s_slots = slots_v[order]
-    s_deltas = jnp.where(valid, deltas, jnp.full_like(deltas, identity))[order]
     s_epochs = lane_epoch[order]
 
-    # within-segment, within-epoch scan:
     # a new segment starts when slot changes OR lane epoch changes
     prev_slot = jnp.concatenate([jnp.full((1,), -1, s_slots.dtype), s_slots[:-1]])
     prev_epoch = jnp.concatenate([jnp.full((1,), -1, s_epochs.dtype), s_epochs[:-1]])
     seg_start = (s_slots != prev_slot) | (s_epochs != prev_epoch)
 
-    within = _segmented_scan(s_deltas, seg_start, combine, identity)
-
-    # carry-in: only the segment whose epoch matches the state's stored epoch
-    # for that slot gets the stored value; stale epochs read the identity.
     safe_slots = jnp.minimum(s_slots, K - 1)
-    stored_vals = state.values[safe_slots]
-    stored_epoch = state.epoch[safe_slots]
-    carry = jnp.where(
-        (s_slots < K) & (stored_epoch == s_epochs), stored_vals,
-        jnp.full_like(stored_vals, identity))
-    # carry applies uniformly within a segment; take it from the segment start
-    carry_at_start = jnp.where(seg_start, carry, jnp.full_like(carry, identity))
-    carry_seg = _segment_broadcast_op(carry_at_start, seg_start, identity)
 
-    s_out = combine(carry_seg, within)
-    out = s_out[inv]
-
-    # new state: written from the last lane of each *slot* run (unique per slot,
-    # so the scatter has no duplicate indices; the last epoch's value wins).
+    # state writes come from the last lane of each *slot* run (unique per
+    # slot, so the scatter has no duplicate indices; last epoch's value wins)
     next_slot = jnp.concatenate([s_slots[1:], jnp.full((1,), -1, s_slots.dtype)])
     is_slot_end = s_slots != next_slot
     write_slot = jnp.where((s_slots < K) & is_slot_end, s_slots, sentinel)
-    new_values = state.values.at[write_slot].set(
-        s_out.astype(state.values.dtype), mode="drop")
-    new_epoch = state.epoch.at[write_slot].set(
-        s_epochs.astype(state.epoch.dtype), mode="drop")
 
-    return GroupState(new_values, new_epoch), out
+    return _SegmentPlan(order, inv, s_slots, s_epochs, seg_start, safe_slots,
+                        s_slots < K, write_slot)
+
+
+def _scan_component(values, epoch_table, deltas, valid, plan: _SegmentPlan,
+                    op: str):
+    """One component's segmented scan + carry + state write over a shared
+    plan. Returns (new_values, sorted-order outputs)."""
+    combine, identity = _OPS[op](deltas.dtype)
+    s_deltas = jnp.where(valid, deltas,
+                         jnp.full_like(deltas, identity))[plan.order]
+    within = _segmented_scan(s_deltas, plan.seg_start, combine, identity)
+
+    # carry-in: only the segment whose epoch matches the state's stored epoch
+    # for that slot gets the stored value; stale epochs read the identity.
+    stored_vals = values[plan.safe_slots]
+    stored_epoch = epoch_table[plan.safe_slots]
+    carry = jnp.where(
+        plan.epoch_ok_slots & (stored_epoch == plan.s_epochs), stored_vals,
+        jnp.full_like(stored_vals, identity))
+    carry_at_start = jnp.where(plan.seg_start, carry,
+                               jnp.full_like(carry, identity))
+    carry_seg = _segment_broadcast_op(carry_at_start, plan.seg_start, identity)
+
+    s_out = combine(carry_seg, within)
+    new_values = values.at[plan.write_slot].set(
+        s_out.astype(values.dtype), mode="drop")
+    return new_values, s_out
+
+
+def grouped_scan_fused(
+    values_list: list,  # per component: [K] accumulator array
+    shared_epoch: jax.Array,  # int32[K] — ONE epoch table for all components
+    slots: jax.Array,
+    deltas_list: list,  # per component: [L] signed deltas
+    valid: jax.Array,
+    resets: jax.Array,
+    current_epoch: jax.Array,
+) -> tuple[list, jax.Array, list]:
+    """grouped_scan for N sum-op components sharing (slots, valid, resets):
+    ONE sort, ONE segment structure, ONE epoch gather/scatter — instead of N
+    of each. The dominant per-step HBM traffic for multi-aggregate queries
+    (sum+avg = 3 components) drops accordingly. Semantics identical to N
+    grouped_scan(op='sum') calls.
+
+    Returns (new_values_list, new_shared_epoch, per-lane outputs list)."""
+    K = shared_epoch.shape[0]
+    plan = _segment_plan(slots, valid, resets, current_epoch, K)
+    new_values, outs = [], []
+    for values, deltas in zip(values_list, deltas_list):
+        nv, s_out = _scan_component(values, shared_epoch, deltas, valid, plan,
+                                    "sum")
+        new_values.append(nv)
+        outs.append(s_out[plan.inv])
+    new_epoch = shared_epoch.at[plan.write_slot].set(
+        plan.s_epochs.astype(shared_epoch.dtype), mode="drop")
+    return new_values, new_epoch, outs
 
 
 def _op_sum(dtype):

@@ -190,13 +190,68 @@ class TimeFirstLimiter(RateLimiterOp):
             out, valid=out.valid & keep)
 
 
+class SnapshotState(NamedTuple):
+    last_cols: dict  # [1] retained last output row
+    has: jax.Array  # bool
+    bucket: jax.Array  # int64 last observed time bucket
+
+
+class SnapshotLimiter(RateLimiterOp):
+    """`output snapshot every <t>` — periodically re-emits the latest output
+    row (reference: snapshot/ SnapshotOutputRateLimiter; the per-group and
+    windowed variants — 8 further classes — retain per-key rows and are not
+    yet built). Emission rides the watermark like every timer here."""
+
+    has_time_semantics = True
+
+    def __init__(self, layout: dict, time_ms: int):
+        self.layout = layout
+        self.T = time_ms
+
+    def init_state(self) -> SnapshotState:
+        return SnapshotState(
+            last_cols={k: jnp.zeros((1,), dt) for k, dt in self.layout.items()},
+            has=jnp.bool_(False),
+            bucket=jnp.int64(-1),
+        )
+
+    def step(self, state: SnapshotState, out: EventBatch, now):
+        B = out.ts.shape[0]
+        live = out.valid & (out.types == EventType.CURRENT)
+        idx = jnp.arange(B)
+        last_i = jnp.max(jnp.where(live, idx, -1))
+        any_live = last_i >= 0
+        g = jnp.clip(last_i, 0, B - 1)
+        new_cols = {k: jnp.where(any_live, v[g][None], state.last_cols[k])
+                    for k, v in out.cols.items()}
+        has = state.has | any_live
+
+        bucket = now // jnp.int64(self.T)
+        first = state.bucket < 0
+        fire = has & ~first & (bucket > state.bucket)
+        emit = EventBatch(
+            ts=jnp.broadcast_to(now[None] if now.ndim == 0 else now, (1,)),
+            cols=new_cols,
+            valid=jnp.broadcast_to(fire, (1,)),
+            types=jnp.zeros((1,), jnp.int8))
+        # bucket advances on EVERY crossing (idle heartbeats included) so a
+        # post-idle event waits for the next boundary instead of firing early
+        new_state = SnapshotState(
+            last_cols=new_cols, has=has,
+            bucket=jnp.where(first, bucket,
+                             jnp.maximum(state.bucket, bucket)))
+        return new_state, emit
+
+
 def make_rate_limiter(rate: Optional[OutputRate], layout: dict,
                       out_width: int) -> RateLimiterOp:
     if rate is None:
         return PassThroughLimiter()
     if rate.type == OutputRateType.SNAPSHOT:
-        raise SiddhiAppCreationError(
-            "`output snapshot every ...` is not yet supported")
+        if rate.time_ms is None:
+            raise SiddhiAppCreationError(
+                "`output snapshot every ...` needs a time period")
+        return SnapshotLimiter(layout, rate.time_ms)
     if rate.event_count is not None:
         n = rate.event_count
         kind = rate.type.value  # all | first | last

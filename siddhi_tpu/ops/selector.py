@@ -47,6 +47,7 @@ from .groupby import (
     GroupState,
     KeyTable,
     grouped_scan,
+    grouped_scan_fused,
     hash_columns,
     init_group_state,
     init_key_table,
@@ -92,11 +93,16 @@ def _rewrite_aggregators(expr: Expression, registry: Registry, found: list):
 
 @dataclass
 class SelectorState:
-    """Pytree of selector persistent state."""
+    """Pytree of selector persistent state.
 
-    groups: list  # list[GroupState], one per aggregator component
+    `groups` holds, in agg-spec order: bare [K] value arrays for FUSED
+    components (plain sum-op — they share `shared_epoch`), GroupState for
+    monotone/forever components, and custom pytrees for custom scans."""
+
+    groups: list
     key_table: Optional[KeyTable]
     epoch: jax.Array  # int32
+    shared_epoch: Optional[jax.Array] = None  # int32[K] for fused components
 
 
 jax.tree_util.register_dataclass(SelectorState)
@@ -181,16 +187,24 @@ class CompiledSelector:
     def init_state(self) -> SelectorState:
         groups = []
         K = self.group_capacity if self.group_vars else 1
+        any_fused = False
         for _, spec, _ in self.agg_specs:
             if spec.custom_scan is not None:
                 groups.append(spec.init_custom(self.group_capacity))
                 continue
             for comp in spec.components:
-                groups.append(init_group_state(K, comp.dtype))
+                if (comp.op == "sum" and not comp.ignore_removal
+                        and not comp.ignore_reset):
+                    # fused components: bare values array, shared epoch table
+                    groups.append(jnp.zeros((K,), dtype=comp.dtype))
+                    any_fused = True
+                else:
+                    groups.append(init_group_state(K, comp.dtype))
         return SelectorState(
             groups=groups,
             key_table=init_key_table(K) if self.needs_key_table else None,
             epoch=jnp.int32(0),
+            shared_epoch=jnp.zeros((K,), jnp.int32) if any_fused else None,
         )
 
     # ------------------------------------------------------------------- step
@@ -221,9 +235,15 @@ class CompiledSelector:
         sign = jnp.where(is_expired, -1, 1).astype(jnp.int32)
 
         # --- run aggregator components ---
-        new_groups = []
+        # plain sum-op components (sum/count/avg/stdDev parts) fuse into ONE
+        # scan sharing one epoch table; monotone/forever/custom run separately
+        new_groups = list(state.groups)
         gi = 0
-        agg_values: dict[str, jax.Array] = {}
+        results: dict[int, jax.Array] = {}
+        pending: list[tuple[str, AggregatorSpec, list[int]]] = []
+        fused_idx: list[int] = []
+        fused_vals: list = []
+        fused_deltas: list = []
         any_reset = is_reset
         no_reset = jnp.zeros((L,), bool)
         for slot_name, spec, args in self.agg_specs:
@@ -232,23 +252,49 @@ class CompiledSelector:
                 g, out_vals = spec.custom_scan(
                     state.groups[gi], slots.astype(jnp.int32), arg_vals,
                     sign, data_valid, any_reset, state.epoch)
-                new_groups.append(g)
-                agg_values[slot_name] = out_vals
+                new_groups[gi] = g
+                results[gi] = out_vals
+                pending.append((slot_name, spec, [gi]))
                 gi += 1
                 continue
-            comp_outs = []
+            comp_gis = []
             for comp in spec.components:
                 deltas = comp.delta(arg_vals[0], sign)
-                lane_valid = data_valid if not comp.ignore_removal else (
-                    valid & is_current)
-                resets = no_reset if comp.ignore_reset else any_reset
-                g, out_vals = grouped_scan(
-                    state.groups[gi], slots.astype(jnp.int32), deltas,
-                    lane_valid, resets, state.epoch, op=comp.op)
-                new_groups.append(g)
-                comp_outs.append(out_vals)
+                if (comp.op == "sum" and not comp.ignore_removal
+                        and not comp.ignore_reset):
+                    fused_idx.append(gi)
+                    fused_vals.append(state.groups[gi])
+                    fused_deltas.append(deltas)
+                else:
+                    lane_valid = data_valid if not comp.ignore_removal else (
+                        valid & is_current)
+                    resets = no_reset if comp.ignore_reset else any_reset
+                    g, out_vals = grouped_scan(
+                        state.groups[gi], slots.astype(jnp.int32), deltas,
+                        lane_valid, resets, state.epoch, op=comp.op)
+                    new_groups[gi] = g
+                    results[gi] = out_vals
+                comp_gis.append(gi)
                 gi += 1
-            agg_values[slot_name] = spec.finalize(comp_outs)
+            pending.append((slot_name, spec, comp_gis))
+
+        shared_epoch = state.shared_epoch
+        if fused_idx:
+            f_vals, shared_epoch, f_outs = grouped_scan_fused(
+                fused_vals, state.shared_epoch, slots.astype(jnp.int32),
+                fused_deltas, data_valid, any_reset, state.epoch)
+            for i, g in zip(fused_idx, f_vals):
+                new_groups[i] = g
+            for i, o in zip(fused_idx, f_outs):
+                results[i] = o
+
+        agg_values: dict[str, jax.Array] = {}
+        for slot_name, spec, comp_gis in pending:
+            if spec.custom_scan is not None:
+                agg_values[slot_name] = results[comp_gis[0]]
+            else:
+                agg_values[slot_name] = spec.finalize(
+                    [results[i] for i in comp_gis])
 
         new_epoch = state.epoch + jnp.sum(is_reset.astype(jnp.int32))
 
@@ -288,7 +334,8 @@ class CompiledSelector:
         if self.offset is not None or self.limit is not None:
             out = self._limit_chunk(out)
 
-        return SelectorState(new_groups, new_key_table, new_epoch), out
+        return SelectorState(new_groups, new_key_table, new_epoch,
+                             shared_epoch), out
 
     def _order_chunk(self, out: EventBatch) -> EventBatch:
         keys = []

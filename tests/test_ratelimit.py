@@ -66,6 +66,21 @@ class TestEventRateLimits:
         assert [e.data[0] for e in got] == ["c"]
 
 
+class TestSnapshotRateLimit:
+    def test_snapshot_reemits_latest(self):
+        rt = build(S + "@info(name='q') from S select symbol, price "
+                   "output snapshot every 1 sec insert into Out;")
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0), timestamp=100)
+        h.send(("b", 2.0), timestamp=200)
+        rt.flush()
+        rt.heartbeat(1_500)  # period boundary: snapshot of the latest row
+        assert [e.data[0] for e in got] == ["b"]
+        rt.heartbeat(2_500)  # no new events: the same snapshot re-emits
+        assert [e.data[0] for e in got] == ["b", "b"]
+
+
 class TestTimeRateLimits:
     def test_output_first_every_second(self):
         rt = build(S + "@info(name='q') from S select symbol, price "
