@@ -215,7 +215,8 @@ class QueryRuntime(Receiver):
         out_layout = {n: dtypes.device_dtype(t)
                       for n, t in self.selector.out_types.items()}
         self.rate_limiter = make_rate_limiter(
-            query.output_rate, out_layout, self.window.chunk_width)
+            query.output_rate, out_layout, self.window.chunk_width,
+            grouped=bool(query.selector.group_by))
 
         # --- the jitted step ---
         self._step = jax.jit(self._make_step(), donate_argnums=(0,))

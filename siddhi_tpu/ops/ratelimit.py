@@ -224,33 +224,41 @@ class SnapshotLimiter(RateLimiterOp):
         g = jnp.clip(last_i, 0, B - 1)
         new_cols = {k: jnp.where(any_live, v[g][None], state.last_cols[k])
                     for k, v in out.cols.items()}
-        has = state.has | any_live
 
         bucket = now // jnp.int64(self.T)
         first = state.bucket < 0
-        fire = has & ~first & (bucket > state.bucket)
+        # fire on a boundary crossing with the PRE-batch retained row: the
+        # snapshot shows state as of the boundary, not rows that arrived with
+        # the batch that revealed the crossing (batch-granularity watermark)
+        fire = state.has & ~first & (bucket > state.bucket)
         emit = EventBatch(
             ts=jnp.broadcast_to(now[None] if now.ndim == 0 else now, (1,)),
-            cols=new_cols,
+            cols=state.last_cols,
             valid=jnp.broadcast_to(fire, (1,)),
             types=jnp.zeros((1,), jnp.int8))
         # bucket advances on EVERY crossing (idle heartbeats included) so a
         # post-idle event waits for the next boundary instead of firing early
         new_state = SnapshotState(
-            last_cols=new_cols, has=has,
+            last_cols=new_cols, has=state.has | any_live,
             bucket=jnp.where(first, bucket,
                              jnp.maximum(state.bucket, bucket)))
         return new_state, emit
 
 
 def make_rate_limiter(rate: Optional[OutputRate], layout: dict,
-                      out_width: int) -> RateLimiterOp:
+                      out_width: int, grouped: bool = False) -> RateLimiterOp:
     if rate is None:
         return PassThroughLimiter()
     if rate.type == OutputRateType.SNAPSHOT:
         if rate.time_ms is None:
             raise SiddhiAppCreationError(
                 "`output snapshot every ...` needs a time period")
+        if grouped:
+            # the reference's Grouped/Windowed PerSnapshot limiters retain one
+            # row per group; emitting only the global last row would be
+            # silently wrong — fail fast until those land
+            raise SiddhiAppCreationError(
+                "`output snapshot` with GROUP BY is not yet supported")
         return SnapshotLimiter(layout, rate.time_ms)
     if rate.event_count is not None:
         n = rate.event_count

@@ -80,6 +80,25 @@ class TestSnapshotRateLimit:
         rt.heartbeat(2_500)  # no new events: the same snapshot re-emits
         assert [e.data[0] for e in got] == ["b", "b"]
 
+    def test_snapshot_boundary_uses_pre_batch_row(self):
+        rt = build(S + "@info(name='q') from S select symbol, price "
+                   "output snapshot every 1 sec insert into Out;")
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0), timestamp=900)
+        rt.flush()
+        # batch crossing the 1000ms boundary: snapshot shows 'a' (as of the
+        # boundary), not the newly arrived 'b'
+        h.send(("b", 2.0), timestamp=1_100)
+        rt.flush()
+        assert [e.data[0] for e in got] == ["a"]
+
+    def test_snapshot_with_group_by_rejected(self):
+        from siddhi_tpu.errors import SiddhiAppCreationError
+        with pytest.raises(SiddhiAppCreationError, match="GROUP BY"):
+            build(S + "from S select symbol, sum(price) as t group by symbol "
+                  "output snapshot every 1 sec insert into Out;")
+
 
 class TestTimeRateLimits:
     def test_output_first_every_second(self):
