@@ -1,0 +1,55 @@
+"""Force JAX onto a virtual multi-device CPU platform.
+
+Env-var overrides alone are not enough in this image — the axon TPU plugin
+registers itself regardless of ``JAX_PLATFORMS`` — so the platform is also
+forced through ``jax.config``, and an already-initialised backend on the
+wrong platform (or with too few devices) is cleared so it re-initialises
+under the new settings.
+
+Used by ``tests/conftest.py`` and ``__graft_entry__.dryrun_multichip`` (the
+driver calls the latter directly, possibly after jax has already been
+touched on the real TPU).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_cpu_platform(n_devices: int) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flag = "--xla_force_host_platform_device_count"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if flag in flags:
+        # Replace an inherited count rather than trusting it: it may be
+        # smaller than what we need (older jax has no jax_num_cpu_devices
+        # config, so XLA_FLAGS must carry the right value by itself).
+        flags = re.sub(rf"{flag}=\S+", f"{flag}={n_devices}", flags)
+    else:
+        flags = (flags + f" {flag}={n_devices}").strip()
+    os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax.extend.backend import clear_backends
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        pass  # older jax: XLA_FLAGS alone must suffice
+    except RuntimeError:
+        # Backends were already initialised; reset them and set the count
+        # before they re-initialise.
+        clear_backends()
+        jax.config.update("jax_num_cpu_devices", n_devices)
+
+    try:
+        devices = jax.devices()
+        ok = len(devices) >= n_devices and all(
+            d.platform == "cpu" for d in devices)
+    except Exception:
+        ok = False
+    if not ok:
+        clear_backends()

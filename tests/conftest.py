@@ -1,18 +1,11 @@
 """Test configuration: run everything on a virtual 8-device CPU mesh so sharding
 tests work without TPU hardware (the driver separately dry-runs multi-chip).
 
-Note: env-var overrides are not enough here — the axon TPU plugin registers
-itself regardless of JAX_PLATFORMS in some images — so we also force the
-platform through jax.config before any device is initialised.
+The shared helper also forces the platform through jax.config, because env-var
+overrides are not enough here — the axon TPU plugin registers itself regardless
+of JAX_PLATFORMS in some images.
 """
 
-import os
+from siddhi_tpu.util.platform import force_cpu_platform
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu_platform(8)
