@@ -32,7 +32,8 @@ from .stream import (
 class SiddhiAppRuntime:
     def __init__(self, app: SiddhiApp, registry: Registry,
                  batch_size: int = 0, group_capacity: int = 0,
-                 error_store=None, config_manager=None) -> None:
+                 error_store=None, config_manager=None,
+                 mesh=None, partition_capacity: int = 0) -> None:
         self.app = app
         playback_ann = app.annotation("app:playback")
         idle_ms = increment_ms = None
@@ -53,6 +54,8 @@ class SiddhiAppRuntime:
                 idle_time_ms=idle_ms),
             batch_size=batch_size,
             group_capacity=group_capacity,
+            mesh=mesh,
+            partition_capacity=partition_capacity,
             playback=playback_ann is not None,
         )
         self.ctx.runtime = self

@@ -143,6 +143,9 @@ class SiddhiAppContext:
     timestamp_generator: TimestampGenerator
     batch_size: int = 0  # 0 = dtypes.config.default_batch_size
     group_capacity: int = 0
+    #: jax.sharding.Mesh for SPMD partition execution (None = host routing)
+    mesh: object = None
+    partition_capacity: int = 0  # key slots for mesh partitions; 0 = default
     statistics: Statistics = field(default_factory=Statistics)
     playback: bool = False
     #: root runtime back-reference (set by SiddhiAppRuntime)
@@ -158,3 +161,7 @@ class SiddhiAppContext:
     @property
     def effective_group_capacity(self) -> int:
         return self.group_capacity or dtypes.config.default_group_capacity
+
+    @property
+    def effective_partition_capacity(self) -> int:
+        return self.partition_capacity or dtypes.config.default_partition_capacity

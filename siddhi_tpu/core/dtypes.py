@@ -40,6 +40,9 @@ class _Config:
     default_window_capacity = 1 << 16
     #: default max distinct group-by keys tracked on device per query.
     default_group_capacity = 1 << 20
+    #: key slots for mesh-sharded partitions (per-key state is preallocated
+    #: for every slot, so this is deliberately small; raise per app)
+    default_partition_capacity = 64
     #: default table row capacity (rows are capacity-padded device arrays).
     default_table_capacity = 1 << 16
     #: max matched build rows per probe event in joins (static join fan-out).

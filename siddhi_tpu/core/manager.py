@@ -32,6 +32,7 @@ class SiddhiManager:
     def create_siddhi_app_runtime(
         self, app: Union[str, SiddhiApp], *,
         batch_size: int = 0, group_capacity: int = 0,
+        mesh=None, partition_capacity: int = 0,
     ) -> SiddhiAppRuntime:
         if isinstance(app, str):
             text = compiler.update_variables(app) if "${" in app else app
@@ -39,7 +40,8 @@ class SiddhiManager:
         rt = SiddhiAppRuntime(app, self.registry, batch_size=batch_size,
                               group_capacity=group_capacity,
                               error_store=self.error_store,
-                              config_manager=self.config_manager)
+                              config_manager=self.config_manager,
+                              mesh=mesh, partition_capacity=partition_capacity)
         if self.persistence_store is not None:
             rt.persistence_store = self.persistence_store
         self.runtimes[app.name] = rt

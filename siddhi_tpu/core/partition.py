@@ -134,16 +134,23 @@ class _KeySpec:
                 self.ranges.append((rp.partition_key, self._jit(cond, sid)))
         else:
             executor = compile_expression(ptype.expression, resolver, registry)
-            self.value_fn = self._jit(executor, sid)
+            #: un-jitted batch→key-values closure, traceable inside larger
+            #: jits (the mesh partition step); value_fn is its jitted form
+            self.value_raw = self._wrap(executor, sid)
+            self.value_fn = jax.jit(self.value_raw)
 
     @staticmethod
-    def _jit(executor, sid):
+    def _wrap(executor, sid):
         def fn(batch: EventBatch):
             scope = Scope()
             scope.add_frame(sid, batch.cols, batch.ts, batch.valid, default=True)
             return executor(scope)
 
-        return jax.jit(fn)
+        return fn
+
+    @classmethod
+    def _jit(cls, executor, sid):
+        return jax.jit(cls._wrap(executor, sid))
 
 
 class PartitionRuntime:
@@ -190,6 +197,10 @@ class PartitionRuntime:
             if idle:
                 self._purge_idle_ms = _parse_annotation_time(idle)
 
+        # --- mesh-sharded execution (key-slot axis), when eligible ---
+        self._mesh_step = None
+        self._init_mesh_path()
+
         # --- routing subscriptions ---
         for sid, proxy in self.proxies.items():
             outer = app_runtime.junctions[sid]
@@ -197,6 +208,103 @@ class PartitionRuntime:
                 outer.subscribe(_PartitionStreamReceiver(self, sid))
             else:
                 outer.subscribe(_GlobalStreamReceiver(self, sid))
+
+    # ------------------------------------------------------------------- mesh
+
+    def _init_mesh_path(self) -> None:
+        """Swap the per-key host loop for one SPMD step over a key-slot axis
+        (parallel/sharded.PartitionedQueryStep) when a mesh is configured and
+        the partition shape supports it: a single value-partitioned stream
+        feeding a single plain query. Range partitions, joins/patterns,
+        inner `#streams`, `in Table` deps, and `@purge` (slot states are
+        permanent) stay on the host loop."""
+        mesh = getattr(self.ctx, "mesh", None)
+        if mesh is None or self.stateless:
+            return
+        if self._purge_idle_ms is not None:
+            return
+        if len(self.key_specs) != 1 or len(self.runtimes) != 1:
+            return
+        if self.inner_junctions or set(self.proxies) != set(self.key_specs):
+            return
+        from .query_runtime import QueryRuntime
+
+        ((sid, spec),) = self.key_specs.items()
+        ((_, qr),) = self.runtimes.items()
+        if spec.is_range or not isinstance(qr, QueryRuntime) or qr.dep_tables:
+            return
+
+        from ..ops.groupby import hash_columns
+        from ..parallel.sharded import PartitionedQueryStep
+
+        axis = mesh.axis_names[0]
+        n_slots = self.ctx.effective_partition_capacity
+
+        def key_fn(batch: EventBatch):
+            return hash_columns([spec.value_raw(batch)])
+
+        self._mesh_step = PartitionedQueryStep(
+            qr._make_step(), mesh, axis, n_slots, key_fn)
+        self._mesh_states, self._mesh_keys = self._mesh_step.init_state(
+            qr._init_state())
+        self._mesh_qr = qr
+        self._mesh_sid = sid
+        self._mesh_batches = 0
+        self._mesh_key_warned = False
+
+    def _mesh_route(self, batch: EventBatch, now: int) -> None:
+        import time as _time
+
+        qr = self._mesh_qr
+        t0 = _time.perf_counter_ns()
+        debugger = getattr(self.ctx, "debugger", None)
+        if debugger is not None:
+            from .debugger import QueryTerminal
+            if debugger.wants(qr.name, QueryTerminal.IN):
+                debugger.check_break_point(
+                    qr.name, QueryTerminal.IN, batch.to_host_events(qr.codec))
+        self._mesh_states, self._mesh_keys, out = self._mesh_step(
+            self._mesh_states, self._mesh_keys, batch, now)
+        qr._distribute(out, now)
+        self.ctx.statistics.track_latency(qr.name, _time.perf_counter_ns() - t0)
+        self._mesh_batches += 1
+        # key-slot occupancy: checked every batch (the _distribute host fetch
+        # already synced the device, so reading count is cheap). Keys that
+        # arrive past capacity get slot ids >= n_slots, matching no device
+        # slot — their events are DROPPED, and a later small-hash key can
+        # evict a live key's table entry (ops/groupby.py sorted merge).
+        if not self._mesh_key_warned:
+            used = int(self._mesh_keys.count)
+            cap = self._mesh_step.n_slots
+            if used >= cap:
+                import warnings
+                warnings.warn(
+                    f"partition {self.name!r}: all {cap} key slots used — "
+                    "events for any further partition keys are dropped; "
+                    "raise partition_capacity", stacklevel=2)
+                self._mesh_key_warned = True
+        if (self._mesh_qr._has_custom_aggs
+                and (self._mesh_batches in (1, 16, 64)
+                     or self._mesh_batches % 256 == 0)):
+            self._check_mesh_agg_capacity()
+
+    def _check_mesh_agg_capacity(self) -> None:
+        """Per-slot distinctCount pair tables overflow independently; warn on
+        the fullest slot (mirrors QueryRuntime._check_custom_agg_capacity)."""
+        import warnings
+
+        from ..ops.groupby import KeyTable
+        for g in self._mesh_states[1].groups:
+            if isinstance(g, tuple) and g and isinstance(g[0], KeyTable):
+                kt = g[0]
+                cap = kt.sorted_keys.shape[-1]
+                worst = int(np.max(np.asarray(kt.count)))
+                if worst > int(0.85 * cap):
+                    warnings.warn(
+                        f"partition {self.name!r}: a key slot's distinctCount "
+                        f"pair table is at {worst}/{cap} lifetime-unique "
+                        "pairs; counts will corrupt past capacity — raise "
+                        "group_capacity", stacklevel=2)
 
     # ------------------------------------------------------------------ build
 
@@ -316,6 +424,9 @@ class PartitionRuntime:
         return inst
 
     def route(self, sid: str, batch: EventBatch, now: int) -> None:
+        if self._mesh_step is not None:
+            self._mesh_route(batch, now)
+            return
         proxy = self.proxies[sid]
         spec = self.key_specs[sid]
         if self.stateless and not spec.is_range:
@@ -380,6 +491,12 @@ class PartitionRuntime:
     # ----------------------------------------------------------------- timers
 
     def heartbeat(self, now: int) -> None:
+        if self._mesh_step is not None:
+            # one all-invalid batch heartbeats every key slot on device
+            proxy = self.proxies[self._mesh_sid]
+            empty = EventBatch.empty(proxy.definition, proxy.batch_size)
+            self._mesh_route(empty, now)
+            return
         if self._purge_idle_ms is not None:
             cutoff = now - self._purge_idle_ms
             for key in [k for k, ts in self.last_seen.items() if ts < cutoff]:
@@ -400,6 +517,9 @@ class PartitionRuntime:
 
     def snapshot_states(self):
         from ..state.persistence import _to_host
+        if self._mesh_step is not None:
+            return {"__mesh_states__": _to_host(self._mesh_states),
+                    "__mesh_keys__": _to_host(self._mesh_keys)}
         return {repr(k): {n: _to_host(s) for n, s in inst.items()}
                 for k, inst in self.instances.items()}
 
@@ -408,6 +528,15 @@ class PartitionRuntime:
 
         from ..errors import CannotRestoreStateError
         from ..state.persistence import _to_device
+        if self._mesh_step is not None:
+            if set(snap) != {"__mesh_states__", "__mesh_keys__"}:
+                raise CannotRestoreStateError(
+                    "snapshot was taken without a mesh; cannot restore into a "
+                    "mesh-sharded partition (or vice versa)")
+            self._mesh_states = _to_device(
+                snap["__mesh_states__"], self._mesh_states)
+            self._mesh_keys = _to_device(snap["__mesh_keys__"], self._mesh_keys)
+            return
         self.instances = {}
         now = self.ctx.timestamp_generator.current_time()
         for k_repr, inst in snap.items():

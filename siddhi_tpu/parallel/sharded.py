@@ -14,9 +14,21 @@ queues. Output lanes are disjoint across shards, so the merged output is an
 `psum` over the mesh axis of zero-masked columns (one XLA collective riding
 ICI, not host gather).
 
-This module is used by the driver's `dryrun_multichip` and by the partition
-runtime when a mesh is configured; the same code path compiles for a virtual
-CPU mesh (tests) and a real TPU slice.
+`ShardedQueryStep` below shards ONE query's state by key hash (each shard runs
+the ordinary step on the lanes it owns — keys co-located on a shard share that
+shard's state, matching unpartitioned GROUP BY semantics at scale).
+
+`PartitionedQueryStep` is the `partition with (key of Stream)` runtime over a
+mesh: state carries a leading KEY-SLOT axis (`[n_slots, ...]` pytree), sharded
+over the mesh axis with `shard_map` and vmapped over the local slots — every
+key gets its own fully isolated window/selector/limiter state, exactly the
+reference's per-key runtime clones, but as one SPMD step (SURVEY §7 "a key
+axis in state arrays"). Keys map to slots through a replicated device
+KeyTable in first-appearance order.
+
+This module is used by the driver's `dryrun_multichip`, by
+`core/partition.py` when a mesh is configured, and by tests on a virtual
+CPU mesh; the same code compiles unchanged for a real TPU slice.
 """
 
 from __future__ import annotations
@@ -26,11 +38,16 @@ from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+try:
+    from jax import shard_map
+    _SHARD_KW = {"check_vma": False}
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+    _SHARD_KW = {"check_rep": False}
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.event import EventBatch
-from ..ops.groupby import hash_columns
+from ..ops.groupby import KeyTable, hash_columns, init_key_table, key_lookup_or_insert
 
 
 def _zero_masked(batch: EventBatch) -> EventBatch:
@@ -97,7 +114,7 @@ class ShardedQueryStep:
                 shard_step, mesh=mesh,
                 in_specs=(state_spec, repl, repl),
                 out_specs=(state_spec, repl),
-                check_rep=False,
+                **_SHARD_KW,
             ),
             donate_argnums=(0,),
         )
@@ -111,3 +128,80 @@ class ShardedQueryStep:
 
     def __call__(self, state, batch: EventBatch, now):
         return self._step(state, batch, now)
+
+
+class PartitionedQueryStep:
+    """`partition with (key of Stream)` over a mesh: a key-slot axis in state.
+
+    Wraps a pure per-query step `(state, batch, now) -> (state', out)` so that
+    `n_slots` independent copies of its state live stacked on a leading axis,
+    sharded over `mesh[axis_name]`; each step vmaps the query over the local
+    slots with per-slot lane masks. A lane belongs to exactly one slot (dense
+    id from a replicated KeyTable, assigned in first-appearance order), so
+    every partition key has fully isolated window/selector/limiter state —
+    the reference's per-key QueryRuntime clones
+    (PartitionStreamReceiver.java:82-141) as one SPMD step.
+
+    An all-invalid batch acts as a timer heartbeat: every slot's step runs
+    with `now`, so per-key time windows flush without a host loop over keys.
+
+    The merged output is the per-slot outputs flattened to one
+    `[n_slots * chunk_width]` batch, ordered by slot id (key first-appearance
+    order) — the host loop it replaces orders by sorted key value, both are
+    batched reorderings of the reference's arrival-order interleave.
+    """
+
+    def __init__(self, step_fn: Callable, mesh: Mesh, axis_name: str,
+                 n_slots: int, key_fn: Callable[[EventBatch], jax.Array]):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.n_shards = mesh.shape[axis_name]
+        if n_slots % self.n_shards != 0:
+            raise ValueError(
+                f"partition capacity {n_slots} must be divisible by the mesh "
+                f"axis size {self.n_shards}")
+        self.n_slots = n_slots
+        slots_local = n_slots // self.n_shards
+
+        def shard_step(states, batch: EventBatch, slots, now):
+            base = jax.lax.axis_index(axis_name).astype(jnp.int32) * slots_local
+
+            def per_slot(state, j):
+                owned = batch.valid & (slots == base + j)
+                return step_fn(state, batch.where_valid(owned), now)
+
+            return jax.vmap(per_slot)(
+                states, jnp.arange(slots_local, dtype=jnp.int32))
+
+        spec, repl = P(axis_name), P()
+        sharded = shard_map(
+            shard_step, mesh=mesh,
+            in_specs=(spec, repl, repl, repl),
+            out_specs=(spec, spec),
+            **_SHARD_KW,
+        )
+
+        def full_step(states, key_table: KeyTable, batch: EventBatch, now):
+            keys = key_fn(batch)
+            key_table, slots = key_lookup_or_insert(
+                key_table, keys, batch.valid)
+            states, outs = sharded(states, batch, slots, now)
+            # flatten [n_slots, C] per-slot outputs into one wide batch
+            flat = jax.tree_util.tree_map(
+                lambda x: x.reshape((-1,) + x.shape[2:]), outs)
+            return states, key_table, flat
+
+        self._step = jax.jit(full_step, donate_argnums=(0, 1))
+
+    def init_state(self, single_state):
+        """Stack the per-key template state onto the sharded slot axis."""
+        stacked = stack_states(single_state, self.n_slots)
+        sharding = NamedSharding(self.mesh, P(self.axis_name))
+        return (
+            jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sharding), stacked),
+            init_key_table(self.n_slots),
+        )
+
+    def __call__(self, states, key_table, batch: EventBatch, now):
+        return self._step(states, key_table, batch, now)

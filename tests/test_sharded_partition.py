@@ -1,0 +1,265 @@
+"""Mesh-sharded partition execution (parallel/sharded.PartitionedQueryStep).
+
+Runs `partition with (key of Stream)` apps on the virtual 8-device CPU mesh
+(conftest forces it) and asserts output parity with the host-loop path, which
+itself mirrors the reference's per-key runtime clones
+(core/partition/PartitionStreamReceiver.java:82-141).
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from siddhi_tpu import SiddhiManager
+
+
+def _mesh(n=8):
+    devs = jax.devices()[:n]
+    assert len(devs) == n
+    return Mesh(np.asarray(devs), ("part",))
+
+
+def _run(app, sends, *, mesh=None, out_stream="Out", **kw):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app, batch_size=32, group_capacity=64,
+                                     mesh=mesh, partition_capacity=16, **kw)
+    got = []
+    rt.add_callback(out_stream, lambda evs: got.extend(
+        tuple(e) for e in evs))
+    rt.start()
+    for stream, rows in sends:
+        h = rt.get_input_handler(stream)
+        for row in rows:
+            h.send(row)
+        rt.flush()
+    rt.shutdown()
+    return got
+
+
+PARTITIONED_LENGTH_BATCH = """
+define stream S (sym string, price double, vol long);
+partition with (sym of S)
+begin
+  @info(name='q')
+  from S#window.lengthBatch(3)
+  select sym, sum(price) as total, count() as n
+  group by sym
+  insert into Out;
+end;
+"""
+
+
+def _trades(n, n_keys, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(f"K{int(k)}", float(round(p, 2)), int(v)) for k, p, v in zip(
+        rng.integers(0, n_keys, n), rng.uniform(1, 100, n),
+        rng.integers(1, 50, n))]
+
+
+def test_partitioned_lengthbatch_parity():
+    rows = _trades(60, 5)
+    sends = [("S", rows[:20]), ("S", rows[20:40]), ("S", rows[40:])]
+    host = _run(PARTITIONED_LENGTH_BATCH, sends)
+    sharded = _run(PARTITIONED_LENGTH_BATCH, sends, mesh=_mesh())
+    assert len(host) == len(sharded) > 0
+    # emission order differs (host: sorted key value; mesh: slot id) — compare
+    # as multisets of rounded rows
+    canon = lambda rs: sorted((s, round(t, 4), n) for s, t, n in rs)
+    assert canon(host) == canon(sharded)
+
+
+def test_partitioned_sliding_window_parity():
+    app = """
+    define stream S (sym string, price double, vol long);
+    partition with (sym of S)
+    begin
+      @info(name='q')
+      from S#window.length(4)
+      select sym, sum(price) as total
+      group by sym
+      insert into Out;
+    end;
+    """
+    rows = _trades(50, 4, seed=1)
+    sends = [("S", rows[:25]), ("S", rows[25:])]
+    host = _run(app, sends)
+    sharded = _run(app, sends, mesh=_mesh())
+    canon = lambda rs: sorted((s, round(t, 4)) for s, t in rs)
+    assert len(host) == len(sharded) > 0
+    assert canon(host) == canon(sharded)
+
+
+def test_partitioned_per_key_isolation():
+    # every key's lengthBatch window is isolated: with batches of 3, a key
+    # flushes only after ITS OWN 3rd event, never because of other keys
+    rows = [("A", 1.0, 1), ("B", 10.0, 1), ("A", 2.0, 1),
+            ("B", 20.0, 1), ("A", 3.0, 1)]
+    got = _run(PARTITIONED_LENGTH_BATCH, [("S", rows)], mesh=_mesh())
+    # only A reached 3 events; the flush emits per-event running aggregates
+    # (QuerySelector.processGroupBy semantics); B's window holds 2, no output
+    assert [(s, t, n) for s, t, n in got] == [
+        ("A", 1.0, 1), ("A", 3.0, 2), ("A", 6.0, 3)]
+
+
+def test_partitioned_filter_inside_partition():
+    app = """
+    define stream S (sym string, price double, vol long);
+    partition with (sym of S)
+    begin
+      @info(name='q')
+      from S[vol > 5]#window.lengthBatch(2)
+      select sym, sum(price) as total
+      group by sym
+      insert into Out;
+    end;
+    """
+    rows = [("A", 1.0, 10), ("A", 2.0, 1), ("A", 3.0, 10),
+            ("B", 5.0, 7), ("B", 6.0, 9)]
+    host = _run(app, [("S", rows)])
+    sharded = _run(app, [("S", rows)], mesh=_mesh())
+    canon = lambda rs: sorted((s, round(t, 4)) for s, t in rs)
+    # per-event running aggregates; the vol<=5 event never enters A's window
+    assert canon(host) == canon(sharded) == [
+        ("A", 1.0), ("A", 4.0), ("B", 5.0), ("B", 11.0)]
+
+
+def test_partitioned_time_window_heartbeat_parity():
+    app = """
+    define stream S (sym string, price double, vol long);
+    partition with (sym of S)
+    begin
+      @info(name='q')
+      from S#window.timeBatch(1 sec)
+      select sym, sum(price) as total, count() as n
+      group by sym
+      insert into Out;
+    end;
+    """
+
+    def run(mesh):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(
+            app, batch_size=16, group_capacity=64,
+            mesh=mesh, partition_capacity=16)
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(tuple(e) for e in evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i, row in enumerate(_trades(12, 3, seed=2)):
+            h.send(row, timestamp=10 + i * 50)
+        rt.flush()
+        rt.heartbeat(2000)  # expire the 1s bucket
+        rt.shutdown()
+        return got
+
+    host, sharded = run(None), run(_mesh())
+    canon = lambda rs: sorted((s, round(t, 4), n) for s, t, n in rs)
+    assert len(host) == len(sharded) > 0
+    assert canon(host) == canon(sharded)
+
+
+def test_partitioned_int_key_and_many_batches():
+    app = """
+    define stream S (k long, v double);
+    partition with (k of S)
+    begin
+      @info(name='q')
+      from S#window.lengthBatch(5)
+      select k, sum(v) as total, count() as n
+      group by k
+      insert into Out;
+    end;
+    """
+    rng = np.random.default_rng(3)
+    rows = [(int(k), float(v)) for k, v in zip(
+        rng.integers(0, 10, 200), rng.uniform(0, 10, 200))]
+    sends = [("S", rows[i:i + 40]) for i in range(0, 200, 40)]
+    host = _run(app, sends)
+    sharded = _run(app, sends, mesh=_mesh())
+    canon = lambda rs: sorted((k, round(t, 3), n) for k, t, n in rs)
+    assert len(host) == len(sharded) > 0
+    assert canon(host) == canon(sharded)
+
+
+def test_mesh_falls_back_for_range_partitions():
+    app = """
+    define stream S (sym string, price double);
+    partition with (price < 50 as 'low' or price >= 50 as 'high' of S)
+    begin
+      @info(name='q')
+      from S#window.lengthBatch(2)
+      select sym, sum(price) as total
+      group by sym
+      insert into Out;
+    end;
+    """
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app, batch_size=8, group_capacity=16,
+                                     mesh=_mesh(), partition_capacity=16)
+    pr = next(iter(rt.partitions.values()))
+    assert pr._mesh_step is None  # host loop retained
+    got = []
+    rt.add_callback("Out", lambda evs: got.extend(tuple(e) for e in evs))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for row in [("A", 10.0), ("A", 20.0), ("B", 60.0), ("B", 70.0)]:
+        h.send(row)
+    rt.flush()
+    assert sorted(got) == [("A", 10.0), ("A", 30.0),
+                           ("B", 60.0), ("B", 130.0)]
+    rt.shutdown()
+
+
+def test_mesh_partition_uses_sharded_step():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        PARTITIONED_LENGTH_BATCH, batch_size=8, group_capacity=16,
+        mesh=_mesh(), partition_capacity=16)
+    pr = next(iter(rt.partitions.values()))
+    assert pr._mesh_step is not None
+    assert pr._mesh_step.n_shards == 8
+
+
+def test_mesh_partition_key_overflow_warns():
+    # keys past partition_capacity are DROPPED (slot id >= n_slots matches no
+    # device slot); the runtime must warn the first time the table fills
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        PARTITIONED_LENGTH_BATCH, batch_size=16, group_capacity=16,
+        mesh=_mesh(), partition_capacity=8)
+    rt.start()
+    h = rt.get_input_handler("S")
+    with pytest.warns(UserWarning, match="key slots"):
+        for i in range(12):  # 12 distinct keys > 8 slots
+            h.send((f"K{i}", 1.0, 1))
+        rt.flush()
+    rt.shutdown()
+
+
+def test_mesh_partition_persist_restore():
+    m = SiddhiManager()
+    from siddhi_tpu.state.persistence import InMemoryPersistenceStore
+    m.set_persistence_store(InMemoryPersistenceStore())
+    rt = m.create_siddhi_app_runtime(
+        PARTITIONED_LENGTH_BATCH, batch_size=8, group_capacity=16,
+        mesh=_mesh(), partition_capacity=16)
+    got = []
+    rt.add_callback("Out", lambda evs: got.extend(tuple(e) for e in evs))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(("A", 1.0, 1)); h.send(("A", 2.0, 1))
+    rt.flush()
+    assert got == []  # window of 3 holds 2, no flush yet
+    rt.persist()
+    h.send(("A", 100.0, 1))  # post-snapshot event, lost on restore
+    rt.flush()
+    assert [(s, round(t, 4), n) for s, t, n in got] == [
+        ("A", 1.0, 1), ("A", 3.0, 2), ("A", 103.0, 3)]
+    got.clear()
+    rt.restore_last_revision()
+    h.send(("A", 3.0, 1))  # completes the pre-snapshot window of 2
+    rt.flush()
+    assert [(s, round(t, 4), n) for s, t, n in got] == [
+        ("A", 1.0, 1), ("A", 3.0, 2), ("A", 6.0, 3)]
+    rt.shutdown()
