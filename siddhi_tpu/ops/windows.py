@@ -68,7 +68,11 @@ def _gather_overall(
     o_idx: jax.Array,
 ):
     """Fetch events by overall arrival index: from the ring for pre-batch
-    events, from the compacted batch for this batch's arrivals."""
+    events, from the compacted batch for this batch's arrivals.
+
+    NOTE: vectorized int64 `%`/`-` here is software-emulated on TPU (no
+    native s64 ALU) — hot windows use `_gather_rel` instead, which keeps the
+    per-lane math in int32 and only the scalar base in int64."""
     C = ring_ts.shape[0]
     B = comp_ts.shape[0]
     from_batch = o_idx >= appended0
@@ -82,15 +86,43 @@ def _gather_overall(
     return cols, ts
 
 
+def _gather_rel(ring_cols, ring_ts, comp_cols, comp_ts, appended0, base, offs):
+    """`_gather_overall` for o_idx = base + offs, with ALL per-lane arithmetic
+    in int32: `base` is an int64 scalar (folded into two scalar reductions),
+    `offs` an int32 vector. TPU v5e has no native s64 ALU — per-lane s64
+    div/mod lowers to thousands of emulated ops — so the hot windows keep
+    lane math 32-bit and reserve int64 for scalars and timestamp payloads."""
+    C = ring_ts.shape[0]
+    B = comp_ts.shape[0]
+    # offset of the first batch arrival relative to base (|value| <= C+B)
+    rel0 = (appended0 - base).astype(jnp.int32)
+    from_batch = offs >= rel0
+    batch_slot = jnp.clip(offs - rel0, 0, B - 1)
+    # floored modulo wraps a negative base correctly (callers mask lanes
+    # whose overall index is negative, but lanes at base+offs >= 0 with a
+    # negative base are real ring rows and must hit their true slot)
+    ring_base = (base % C).astype(jnp.int32)
+    ring_slot = (ring_base + offs) % C
+    cols = {
+        k: jnp.where(from_batch, comp_cols[k][batch_slot], ring_cols[k][ring_slot])
+        for k in ring_cols
+    }
+    ts = jnp.where(from_batch, comp_ts[batch_slot], ring_ts[ring_slot])
+    return cols, ts
+
+
 def _scatter_append(ring_cols, ring_ts, comp_cols, comp_ts, appended0, n_valid):
     """Write the batch's valid events into the ring at slot (appended0+p)%C.
     When more than C events arrive in one batch only the last C survive —
-    earlier lanes are masked out so the scatter has no duplicate slots."""
+    earlier lanes are masked out so the scatter has no duplicate slots.
+    Per-lane math is int32 (see `_gather_rel`)."""
     C = ring_ts.shape[0]
     B = comp_ts.shape[0]
-    p = jnp.arange(B)
+    p = jnp.arange(B, dtype=jnp.int32)
+    n_valid = n_valid.astype(jnp.int32)
     keep = (p < n_valid) & (p >= n_valid - C)
-    slot = jnp.where(keep, (appended0 + p) % C, C)  # C = drop sentinel
+    base = (appended0 % C).astype(jnp.int32)
+    slot = jnp.where(keep, (base + p) % C, C)  # C = drop sentinel
     new_cols = {k: ring_cols[k].at[slot].set(comp_cols[k], mode="drop")
                 for k in ring_cols}
     new_ts = ring_ts.at[slot].set(comp_ts, mode="drop")
@@ -99,9 +131,20 @@ def _scatter_append(ring_cols, ring_ts, comp_cols, comp_ts, appended0, n_valid):
 
 def _sort_chunk(keys, cols, ts, valid, types, width):
     """Order lanes by emission key (invalid lanes pushed to the end) and trim
-    to `width` lanes."""
-    k = jnp.where(valid, keys, BIG)
-    order = jnp.argsort(k, stable=True)[:width]
+    to `width` lanes.
+
+    `keys` is either an int32 (hi, lo) pair — the fast path, sorted with a
+    native two-key 32-bit comparator — or a single legacy array (extra
+    windows; s64 keys sort via emulated two-word compares there)."""
+    if isinstance(keys, tuple):
+        hi, lo = keys
+        hi = jnp.where(valid, hi, jnp.iinfo(jnp.int32).max)
+        iota = jnp.arange(hi.shape[0], dtype=jnp.int32)
+        _, _, order = jax.lax.sort((hi, lo, iota), num_keys=2, is_stable=True)
+        order = order[:width]
+    else:
+        k = jnp.where(valid, keys, BIG)
+        order = jnp.argsort(k, stable=True)[:width]
     return EventBatch(
         ts=ts[order],
         cols={n: v[order] for n, v in cols.items()},
@@ -228,10 +271,15 @@ class SlidingWindow(WindowOp):
         appended1 = state.appended + n_valid
 
         # ---- expiry candidates: the E oldest in-window events ----
-        e_idx = state.expired + jnp.arange(E, dtype=jnp.int64)
-        cand_exists = e_idx < appended1
-        cand_cols, cand_ts = _gather_overall(
-            state.ring_cols, state.ring_ts, comp_cols, comp_ts, state.appended, e_idx)
+        # Per-lane index math is int32 relative to state.expired (see
+        # _gather_rel — vectorized s64 arithmetic is emulated on TPU).
+        pe = jnp.arange(E, dtype=jnp.int32)
+        win_len1 = (appended1 - state.expired).astype(jnp.int32)
+        cand_exists = pe < win_len1
+        cand_cols, cand_ts = _gather_rel(
+            state.ring_cols, state.ring_ts, comp_cols, comp_ts,
+            state.appended, state.expired, pe)
+        n_valid32 = n_valid.astype(jnp.int32)
 
         if self.time_ms is not None and self.length is None:
             # time(W): candidate expires once now >= cand_ts + W; the trigger
@@ -241,30 +289,32 @@ class SlidingWindow(WindowOp):
             deadline = cand_ts + jnp.int64(self.time_ms)
             trig = jnp.searchsorted(
                 jnp.where(jnp.arange(B) < n_valid, comp_ts, BIG), deadline,
-                side="left").astype(jnp.int64)
+                side="left").astype(jnp.int32)
             expires = cand_exists & (deadline <= now)
             emit_ts = deadline
         elif self.time_ms is None:
             # length(N): candidate o is evicted by arrival with overall index
             # o + N (the N+1'th event); trigger position within this batch:
-            N = jnp.int64(self.length)
-            trig_overall = e_idx + N
-            trig = trig_overall - state.appended
-            expires = cand_exists & (trig_overall < appended1)
+            # trig = (expired + pe + N) - appended, all relative → int32.
+            rel = (state.expired + jnp.int64(self.length)
+                   - state.appended).astype(jnp.int32)
+            trig = pe + rel
+            expires = cand_exists & (trig < n_valid32)
             # reference stamps evicted events with current time
             # (LengthWindowProcessor.java:121)
             safe_trig = jnp.clip(trig, 0, B - 1)
             emit_ts = comp_ts[safe_trig]
         else:
             # timeLength(W, N): expire on whichever rule fires first.
-            N = jnp.int64(self.length)
             deadline = cand_ts + jnp.int64(self.time_ms)
             trig_time = jnp.searchsorted(
                 jnp.where(jnp.arange(B) < n_valid, comp_ts, BIG), deadline,
-                side="left").astype(jnp.int64)
-            trig_len = e_idx + N - state.appended
+                side="left").astype(jnp.int32)
+            rel = (state.expired + jnp.int64(self.length)
+                   - state.appended).astype(jnp.int32)
+            trig_len = pe + rel
             time_fires = deadline <= now
-            len_fires = (e_idx + N) < appended1
+            len_fires = trig_len < n_valid32
             trig = jnp.where(
                 time_fires & len_fires, jnp.minimum(trig_time, trig_len),
                 jnp.where(time_fires, trig_time, trig_len))
@@ -279,13 +329,14 @@ class SlidingWindow(WindowOp):
         # it is also a prefix. (Non-prefix would indicate ts disorder.)
 
         # ---- assemble chunk: E expired lanes + B current lanes ----
-        p = jnp.arange(B, dtype=jnp.int64)
-        cur_valid = p < n_valid
+        p = jnp.arange(B, dtype=jnp.int32)
+        cur_valid = p < n_valid32
 
-        keys_exp = jnp.clip(trig, 0, jnp.int64(B)) * 4 + KIND_EXPIRED
+        keys_exp = jnp.clip(trig, 0, B) * 4 + KIND_EXPIRED
         keys_cur = p * 4 + KIND_CURRENT
 
-        all_keys = jnp.concatenate([keys_exp, keys_cur])
+        all_keys = (jnp.concatenate([keys_exp, keys_cur]),
+                    jnp.concatenate([pe, p]))
         all_cols = {k: jnp.concatenate([cand_cols[k], comp_cols[k]])
                     for k in self.layout}
         all_ts = jnp.concatenate([emit_ts, comp_ts])
@@ -388,25 +439,34 @@ class LengthBatchWindow(WindowOp):
 
         f_done = state.flushed // Nl  # flushes completed before this batch
         f_now = appended1 // Nl  # flushes completed after this batch
+        # All per-lane index math below is int32 RELATIVE to state.flushed
+        # (int64 scalars only feed scalar subtractions) — vectorized s64
+        # div/mod is software-emulated on TPU and was the step's hot spot.
+        # Invariant: state.flushed == f_done*N exactly, so for offset p:
+        #   (flushed+p) // N = f_done + p//N,  (flushed+p) % N = p % N.
+        nf = (f_now - f_done).astype(jnp.int32)  # flushes completing now
+        r0 = (state.appended - state.flushed).astype(jnp.int32)  # partial len
+
         # completion position (within this batch) of flush f: arrival index of
         # the flush's last event = (f+1)*N - 1 - appended0
         # Candidate currents: overall indices [flushed, f_now*N)
         cur_count_max = B + N
-        o_cur = state.flushed + jnp.arange(cur_count_max, dtype=jnp.int64)
-        cur_exists = o_cur < f_now * Nl
-        cur_cols, cur_ts = _gather_overall(
+        p_cur = jnp.arange(cur_count_max, dtype=jnp.int32)
+        cur_exists = p_cur < nf * N
+        cur_cols, cur_ts = _gather_rel(
             state.ring_cols, state.ring_ts, comp_cols, comp_ts,
-            state.appended, o_cur)
-        cur_flush = o_cur // Nl
-        cur_comp = (cur_flush + 1) * Nl - 1 - state.appended  # batch pos of flush end
-        cur_keys = _emit_key(cur_comp, KIND_CURRENT, o_cur % Nl, N, B)
+            state.appended, state.flushed, p_cur)
+        cur_flush_rel = p_cur // N
+        cur_comp = (cur_flush_rel + 1) * N - 1 - r0  # batch pos of flush end
+        cur_keys = _emit_key(cur_comp, KIND_CURRENT, p_cur % N, B)
 
         # RESET lanes: one per completing flush
         MF = self._max_flushes
-        f_ids = f_done + jnp.arange(MF, dtype=jnp.int64)
-        reset_exists = f_ids < f_now
-        reset_comp = (f_ids + 1) * Nl - 1 - state.appended
-        reset_keys = _emit_key(reset_comp, KIND_RESET, jnp.zeros((MF,), jnp.int64), N, B)
+        f_rel = jnp.arange(MF, dtype=jnp.int32)
+        reset_exists = f_rel < nf
+        reset_comp = (f_rel + 1) * N - 1 - r0
+        reset_keys = _emit_key(reset_comp, KIND_RESET,
+                               jnp.zeros((MF,), jnp.int32), B)
         reset_cols = _empty_like_cols(self.layout, MF)
         safe_rc = jnp.clip(reset_comp, 0, B - 1)
         reset_ts = comp_ts[safe_rc]
@@ -420,16 +480,19 @@ class LengthBatchWindow(WindowOp):
 
         if self.expired_on:
             # expired lanes: events of flush f-1 re-emitted when flush f
-            # completes (only if a previous flush exists)
-            o_exp = (f_done - 1) * Nl + jnp.arange(cur_count_max, dtype=jnp.int64)
-            exp_flush = o_exp // Nl
-            # event of flush f is re-emitted as expired when flush f+1 completes
-            exp_exists = (o_exp >= 0) & ((exp_flush + 1) < f_now)
-            exp_cols, exp_ts_orig = _gather_overall(
+            # completes (only if a previous flush exists); base (f_done-1)*N
+            p_exp = jnp.arange(cur_count_max, dtype=jnp.int32)
+            exp_flush_rel = p_exp // N - 1  # relative to f_done
+            # event of flush f is re-emitted as expired when flush f+1
+            # completes. o_exp >= 0 ⟺ f_done >= 1 or p >= N (two flushes
+            # completing inside the very first batch).
+            exp_exists = ((f_done >= 1) | (p_exp >= N)) & (
+                (exp_flush_rel + 1) < nf)
+            exp_cols, exp_ts_orig = _gather_rel(
                 state.ring_cols, state.ring_ts, comp_cols, comp_ts,
-                state.appended, jnp.clip(o_exp, 0, None))
-            exp_comp = (exp_flush + 2) * Nl - 1 - state.appended
-            exp_keys = _emit_key(exp_comp, KIND_EXPIRED, o_exp % Nl, N, B)
+                state.appended, (f_done - 1) * Nl, p_exp)
+            exp_comp = (exp_flush_rel + 2) * N - 1 - r0
+            exp_keys = _emit_key(exp_comp, KIND_EXPIRED, p_exp % N, B)
             safe_ec = jnp.clip(exp_comp, 0, B - 1)
             exp_ts = comp_ts[safe_ec]  # reference re-stamps with current time
             keys.append(exp_keys)
@@ -438,7 +501,8 @@ class LengthBatchWindow(WindowOp):
             valids.append(exp_exists)
             types.append(jnp.full((cur_count_max,), EventType.EXPIRED, jnp.int8))
 
-        all_keys = jnp.concatenate(keys)
+        all_keys = (jnp.concatenate([k[0] for k in keys]),
+                    jnp.concatenate([k[1] for k in keys]))
         all_cols = {k: jnp.concatenate([c[k] for c in colss]) for k in self.layout}
         all_ts = jnp.concatenate(tss)
         all_valid = jnp.concatenate(valids)
@@ -469,9 +533,12 @@ class LengthBatchWindow(WindowOp):
         return state.ring_cols, state.ring_ts, live
 
 
-def _emit_key(comp_pos, kind, within, N, B):
-    """Emission sort key: (completion batch position, kind, within-flush seq)."""
-    return (jnp.clip(comp_pos, -1, jnp.int64(B)) * 4 + kind) * (2 * N + 2) + within
+def _emit_key(comp_pos, kind, within, B):
+    """Emission sort key pair: hi = (completion batch position, kind),
+    lo = within-flush sequence. Both int32 — sorted with a native two-key
+    comparator instead of one emulated-s64 key (see `_sort_chunk`)."""
+    hi = jnp.clip(comp_pos, -1, B).astype(jnp.int32) * 4 + kind
+    return hi, within.astype(jnp.int32)
 
 
 class TimeBatchWindow(WindowOp):
@@ -528,27 +595,44 @@ class TimeBatchWindow(WindowOp):
         base = jnp.where(state.has_base, state.epoch_base, first_ts)
         has_base = state.has_base | (n_valid > 0)
 
-        bucket = lambda ts: (ts - base) // W  # noqa: E731
-        # bucket of each arrival; a flush of bucket k happens at the first
-        # arrival with bucket > k (or at watermark end)
-        arr_bucket = bucket(comp_ts)
-        now_bucket = bucket(now)
+        # Buckets are computed RELATIVE to now's bucket, in int32: one scalar
+        # s64 division for now_bucket, then per-lane (ts - pivot) clamped into
+        # int32 and divided by W as int32 (vectorized s64 division is
+        # software-emulated on TPU and dominated this step's cost). Events
+        # more than ~12 days (2^30 ms) from the watermark collapse onto the
+        # extreme bucket — ordering/flush decisions stay monotone-correct;
+        # only distinct far-past buckets merge (their RESETs collapse, which
+        # consecutive empty buckets do anyway).
+        now_bucket = (now - base) // W  # scalar
+        pivot = base + now_bucket * W  # scalar; bucket(pivot) == now_bucket
+        LIM = jnp.int64(1 << 30)
+        W32 = jnp.int32(self.W) if self.W < (1 << 31) else None
+
+        def bucket_rel(ts):  # → int32 bucket index relative to now's bucket
+            d = jnp.clip(ts - pivot, -LIM, LIM)
+            if W32 is None:  # window ≥ 2^31 ms: keep the emulated s64 path
+                return (d // W).astype(jnp.int32)
+            return d.astype(jnp.int32) // W32
+
+        arr_bucket = bucket_rel(comp_ts)
         # final flushed bucket boundary: all buckets < flush_hi are emitted
-        flush_hi = jnp.where(has_base, now_bucket, jnp.int64(0))
+        flush_hi = jnp.where(has_base, jnp.int32(0), jnp.int32(-(1 << 30)))
 
         # candidate currents: pending events [flushed, appended1) whose bucket
-        # flushes this step
-        o_cur = state.flushed + jnp.arange(E, dtype=jnp.int64)
-        cur_exists_idx = o_cur < appended1
-        cur_cols, cur_ts = _gather_overall(
+        # flushes this step. Per-lane offsets are int32 (see _gather_rel).
+        pe = jnp.arange(E, dtype=jnp.int32)
+        cur_exists_idx = pe < (appended1 - state.flushed).astype(jnp.int32)
+        cur_cols, cur_ts = _gather_rel(
             state.ring_cols, state.ring_ts, comp_cols, comp_ts,
-            state.appended, o_cur)
-        cur_bucket = bucket(cur_ts)
+            state.appended, state.flushed, pe)
+        cur_bucket = bucket_rel(cur_ts)
         cur_emit = cur_exists_idx & (cur_bucket < flush_hi)
         # trigger position: first arrival in a later bucket
-        padded_buckets = jnp.where(jnp.arange(B) < n_valid, arr_bucket, BIG)
-        trig = jnp.searchsorted(padded_buckets, cur_bucket + 1, side="left").astype(jnp.int64)
-        cur_keys = _emit_key(trig, KIND_CURRENT, o_cur % jnp.int64(E), E, B)
+        I32MAX = jnp.iinfo(jnp.int32).max
+        padded_buckets = jnp.where(jnp.arange(B) < n_valid, arr_bucket, I32MAX)
+        trig = jnp.searchsorted(padded_buckets, cur_bucket + 1,
+                                side="left").astype(jnp.int32)
+        cur_keys = _emit_key(trig, KIND_CURRENT, pe, B)
 
         # RESET: one per flushed bucket — approximate with one reset per step
         # boundary between buckets (sufficient: grouped_scan's reset zeroes all
@@ -556,10 +640,10 @@ class TimeBatchWindow(WindowOp):
         # reset fires right after the last current of each flushed bucket; we
         # emit a reset lane per candidate position where the *next* candidate
         # is in a different bucket.
-        next_bucket = jnp.concatenate([cur_bucket[1:], jnp.full((1,), -1, jnp.int64)])
+        next_bucket = jnp.concatenate([cur_bucket[1:], jnp.full((1,), -1, jnp.int32)])
         is_bucket_end = cur_emit & ((next_bucket != cur_bucket) | ~jnp.concatenate(
             [cur_emit[1:], jnp.zeros((1,), bool)]))
-        reset_keys = _emit_key(trig, KIND_RESET, o_cur % jnp.int64(E), E, B)
+        reset_keys = _emit_key(trig, KIND_RESET, pe, B)
         reset_cols = _empty_like_cols(self.layout, E)
         reset_ts = cur_ts
 
@@ -573,22 +657,23 @@ class TimeBatchWindow(WindowOp):
         if self.expired_on:
             # previous flushed bucket's events re-emitted as expired when the
             # next bucket flushes: events in [prev_start, flushed)
-            o_exp = state.prev_start + jnp.arange(E, dtype=jnp.int64)
-            exp_cols, exp_ts0 = _gather_overall(
+            exp_cols, exp_ts0 = _gather_rel(
                 state.ring_cols, state.ring_ts, comp_cols, comp_ts,
-                state.appended, jnp.clip(o_exp, 0, None))
-            exp_bucket = bucket(exp_ts0)
-            exp_emit = (o_exp >= state.prev_start) & (o_exp < state.flushed) & (
+                state.appended, state.prev_start, pe)
+            exp_bucket = bucket_rel(exp_ts0)
+            exp_emit = (pe < (state.flushed - state.prev_start).astype(jnp.int32)) & (
                 exp_bucket + 1 < flush_hi)
-            trig_e = jnp.searchsorted(padded_buckets, exp_bucket + 2, side="left").astype(jnp.int64)
-            exp_keys = _emit_key(trig_e, KIND_EXPIRED, o_exp % jnp.int64(E), E, B)
+            trig_e = jnp.searchsorted(padded_buckets, exp_bucket + 2,
+                                      side="left").astype(jnp.int32)
+            exp_keys = _emit_key(trig_e, KIND_EXPIRED, pe, B)
             keys.append(exp_keys)
             colss.append(exp_cols)
             tss.append(exp_ts0)
             valids.append(exp_emit)
             types.append(jnp.full((E,), EventType.EXPIRED, jnp.int8))
 
-        all_keys = jnp.concatenate(keys)
+        all_keys = (jnp.concatenate([k[0] for k in keys]),
+                    jnp.concatenate([k[1] for k in keys]))
         all_cols = {k: jnp.concatenate([c[k] for c in colss]) for k in self.layout}
         all_ts = jnp.concatenate(tss)
         all_valid = jnp.concatenate(valids)

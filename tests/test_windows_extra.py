@@ -134,3 +134,26 @@ class TestLossyFrequentWindow:
         ins = [e for i, _ in got for e in i]
         # A is above 50% support throughout; the lone B (1/7 < 0.4) is not
         assert set(e.data[0] for e in ins) == {"A"}
+
+
+class TestLengthBatchDoubleFlushExpired:
+    def test_first_batch_double_flush_expired_values(self):
+        # Two flushes complete while f_done == 0: flush 0's events [10, 11]
+        # sit in the ring and must re-emit as EXPIRED with their true values
+        # when flush 1 completes (regression: the expired-lane gather used a
+        # clamped negative base and read the wrong ring slots)
+        rt = build(
+            S + "@info(name='q') from S#window.lengthBatch(4) "
+            "select symbol, volume insert all events into Out;",
+            batch_size=8)
+        got = q_callback(rt, "q")
+        h = rt.get_input_handler("S")
+        h.send(("A", 1.0, 10)); h.send(("A", 1.0, 11))
+        rt.flush()  # partial bucket: 2 of 4
+        for v in (12, 13, 14, 15, 16, 17):
+            h.send(("A", 1.0, v))
+        rt.flush()  # completes flush 0 (10..13) and flush 1 (14..17)
+        removes = [e.data[1] for _, r in got for e in r]
+        assert removes == [10, 11, 12, 13]
+        ins = [e.data[1] for i, _ in got for e in i]
+        assert ins == [10, 11, 12, 13, 14, 15, 16, 17]
