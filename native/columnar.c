@@ -60,6 +60,15 @@ encode_rows(PyObject *self, PyObject *args)
     const char *typecodes = PyBytes_AS_STRING(typecodes_obj);
     Py_ssize_t n_cols = PyBytes_GET_SIZE(typecodes_obj);
 
+    if (!PyTuple_Check(columns) || PyTuple_GET_SIZE(columns) < n_cols ||
+        !PyTuple_Check(tables) || PyTuple_GET_SIZE(tables) < n_cols ||
+        !PyTuple_Check(nulls) || PyTuple_GET_SIZE(nulls) < n_cols) {
+        PyErr_SetString(PyExc_TypeError,
+                        "columns/tables/nulls must be tuples of arity >= "
+                        "len(typecodes)");
+        return NULL;
+    }
+
     PyObject *rows_fast = PySequence_Fast(rows, "rows must be a sequence");
     if (rows_fast == NULL)
         return NULL;
@@ -78,6 +87,37 @@ encode_rows(PyObject *self, PyObject *args)
         if (PyObject_GetBuffer(col, &bufs[acquired],
                                PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0)
             goto done;
+        /* capacity check: a short buffer would mean silent heap corruption
+         * where the pure-Python fallback raises IndexError */
+        static const Py_ssize_t width[128] = {
+            ['b'] = 1, ['i'] = 4, ['l'] = 8, ['f'] = 4, ['d'] = 8, ['s'] = 4};
+        char tc = typecodes[acquired];
+        Py_ssize_t w = ((unsigned char)tc < 128) ? width[(int)tc] : 0;
+        if (w == 0) {
+            PyErr_Format(PyExc_ValueError, "bad type code %c", tc);
+            acquired++; /* this buffer was acquired; release it in done */
+            goto done;
+        }
+        if (bufs[acquired].len < n_rows * w) {
+            PyErr_Format(PyExc_ValueError,
+                         "column %zd buffer too small: %zd bytes for %zd "
+                         "rows of width %zd", acquired, bufs[acquired].len,
+                         n_rows, w);
+            acquired++;
+            goto done;
+        }
+        if (tc == 's') {
+            PyObject *pair = PyTuple_GET_ITEM(tables, acquired);
+            if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2 ||
+                !PyDict_Check(PyTuple_GET_ITEM(pair, 0)) ||
+                !PyList_Check(PyTuple_GET_ITEM(pair, 1))) {
+                PyErr_Format(PyExc_TypeError,
+                             "tables[%zd] must be (dict, list) for a string "
+                             "column", acquired);
+                acquired++;
+                goto done;
+            }
+        }
     }
 
     for (Py_ssize_t r = 0; r < n_rows; r++) {
@@ -110,9 +150,12 @@ encode_rows(PyObject *self, PyObject *args)
             if (is_null)
                 v = PyTuple_GET_ITEM(nulls, c);
             switch (tc) {
-            case 'b':
-                ((int8_t *)data)[r] = (int8_t)PyObject_IsTrue(v);
+            case 'b': {
+                int x = PyObject_IsTrue(v);
+                if (x < 0) { Py_DECREF(row_fast); goto done; }
+                ((int8_t *)data)[r] = (int8_t)x;
                 break;
+            }
             case 'i': {
                 long x = PyLong_AsLong(v);
                 if (x == -1 && PyErr_Occurred()) { Py_DECREF(row_fast); goto done; }
@@ -169,6 +212,15 @@ fill_ts(PyObject *self, PyObject *args)
     Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
     Py_buffer buf;
     if (PyObject_GetBuffer(out, &buf, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0) {
+        Py_DECREF(fast);
+        return NULL;
+    }
+    if (buf.len < n_pad * (Py_ssize_t)sizeof(int64_t) ||
+        buf.len < n * (Py_ssize_t)sizeof(int64_t)) {
+        PyErr_Format(PyExc_ValueError,
+                     "ts buffer too small: %zd bytes for %zd entries",
+                     buf.len, (n_pad > n) ? n_pad : n);
+        PyBuffer_Release(&buf);
         Py_DECREF(fast);
         return NULL;
     }

@@ -12,6 +12,7 @@ from __future__ import annotations
 import json as _json
 import logging
 import re
+import zlib
 from typing import Optional
 
 from ..errors import SiddhiAppCreationError
@@ -150,9 +151,31 @@ class PartitionedStrategy(DistributionStrategy):
         if key not in names:
             raise SiddhiAppCreationError(f"partitionKey {key!r} not an attribute")
         self._idx = names.index(key)
+        self._type = stream_definition.attributes[self._idx].type
 
     def destinations(self, row):
-        return [hash(row[self._idx]) % self.n]
+        # stable across processes/restarts (built-in hash() is seeded per
+        # process for str) — mirrors the reference's deterministic
+        # String.hashCode() partitioning. The key is canonicalized through
+        # the DECLARED attribute type so equal-comparing values alias
+        # (1 vs 1.0 vs True; -0.0 vs 0.0). OBJECT attributes fall back to
+        # hash(), which keeps equal keys together within a process.
+        from ..query_api.definition import AttributeType as T
+
+        v = row[self._idx]
+        if v is None:
+            canon = "\0null"
+        elif self._type in (T.FLOAT, T.DOUBLE):
+            canon = repr(float(v) + 0.0)  # +0.0 folds -0.0 into 0.0
+        elif self._type in (T.INT, T.LONG):
+            canon = repr(int(v))
+        elif self._type is T.BOOL:
+            canon = repr(bool(v))
+        elif self._type is T.STRING:
+            canon = str(v)
+        else:  # OBJECT — no value-deterministic serialization
+            return [hash(v) % self.n]
+        return [zlib.crc32(canon.encode()) % self.n]
 
 
 class BroadcastStrategy(DistributionStrategy):

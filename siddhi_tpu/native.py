@@ -3,37 +3,56 @@
 Builds native/columnar.c on first import (g++/cc via setuptools), caches the
 shared object under siddhi_tpu/_native_build/, and degrades to the pure-Python
 encoder when no toolchain is available. Set SIDDHI_TPU_NO_NATIVE=1 to force
-the Python path (useful for A/B benchmarking the marshalling hot loop)."""
+the Python path (useful for A/B benchmarking the marshalling hot loop).
+
+The cache is keyed by a hash of the C source: editing columnar.c invalidates
+the cached .so and triggers a rebuild, so a stale binary can never shadow a
+newer source (e.g. new validation guards silently inert)."""
 
 from __future__ import annotations
 
+import hashlib
+import importlib
 import logging
 import os
 import subprocess
 import sys
-import sysconfig
 
 _log = logging.getLogger("siddhi_tpu")
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "_native_build")
+_BUILD_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "_native_build")
 _SRC_DIR = os.path.join(_REPO_ROOT, "native")
+_SRC = os.path.join(_SRC_DIR, "columnar.c")
 
 native = None
+
+
+def _src_tag() -> str | None:
+    try:
+        with open(_SRC, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        return None
+
+
+_BUILD_DIR = os.path.join(_BUILD_ROOT, _src_tag() or "nosrc")
 
 
 def _try_import():
     global native
     if _BUILD_DIR not in sys.path:
         sys.path.insert(0, _BUILD_DIR)
+    # the finder caches a nonexistent/empty dir entry; a fresh build would
+    # otherwise be invisible until the next interpreter start
+    importlib.invalidate_caches()
     import _siddhi_native
     native = _siddhi_native
 
 
 def _build() -> bool:
-    src = os.path.join(_SRC_DIR, "columnar.c")
-    if not os.path.exists(src):
+    if not os.path.exists(_SRC):
         return False
     os.makedirs(_BUILD_DIR, exist_ok=True)
     try:

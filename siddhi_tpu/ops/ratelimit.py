@@ -114,8 +114,12 @@ class BufferedLimiter(RateLimiterOp):
         order = jnp.argsort(~live, stable=True)
         n_new = jnp.sum(live.astype(jnp.int64))
         B = out.ts.shape[0]
-        p = jnp.arange(B, dtype=jnp.int64)
-        slot = jnp.where(p < n_new, (state.appended + p) % C, C)
+        # int32 lane math relative to one scalar s64 reduction — TPU has no
+        # native s64 ALU, so per-lane int64 %/+ lowers to emulated multi-op
+        # sequences (see ops/windows.py _scatter_append)
+        base = (state.appended % C).astype(jnp.int32)
+        p = jnp.arange(B, dtype=jnp.int32)
+        slot = jnp.where(p < n_new.astype(jnp.int32), (base + p) % C, C)
         ring = EventBatch(
             ts=state.ring.ts.at[slot].set(out.ts[order], mode="drop"),
             cols={k: state.ring.cols[k].at[slot].set(out.cols[k][order],
@@ -144,10 +148,18 @@ class BufferedLimiter(RateLimiterOp):
             emit_from = state.flushed
             new_bucket = state.bucket
 
-        # gather [emit_from, flush_to) into an output block of width C
-        o = emit_from + jnp.arange(C, dtype=jnp.int64)
-        sel = o < flush_to
-        oslot = jnp.clip(o, 0, None) % C
+        # gather [emit_from, flush_to) into an output block of width C.
+        # Overflow guard: the ring only retains the newest C appended lanes
+        # (ordinals [appended - C, appended)); if a bucket/group accumulated
+        # more than C lanes, the oldest were overwritten at append time and
+        # emitting their slots would replay newer lanes under stale ordinals.
+        # Clamp to the retained range — documented truncation, as CronWindow.
+        emit_from = jnp.maximum(jnp.maximum(emit_from, appended - C), 0)
+        n_emit = jnp.maximum(flush_to - emit_from, 0).astype(jnp.int32)
+        ebase = (emit_from % C).astype(jnp.int32)
+        i32 = jnp.arange(C, dtype=jnp.int32)
+        sel = i32 < n_emit
+        oslot = (ebase + i32) % C
         emitted = EventBatch(
             ts=ring.ts[oslot],
             cols={k: ring.cols[k][oslot] for k in self.layout},

@@ -600,9 +600,12 @@ class TimeBatchWindow(WindowOp):
         # int32 and divided by W as int32 (vectorized s64 division is
         # software-emulated on TPU and dominated this step's cost). Events
         # more than ~12 days (2^30 ms) from the watermark collapse onto the
-        # extreme bucket — ordering/flush decisions stay monotone-correct;
-        # only distinct far-past buckets merge (their RESETs collapse, which
-        # consecutive empty buckets do anyway).
+        # extreme bucket — ordering/flush decisions stay monotone-correct.
+        # DOCUMENTED DIVERGENCE: if one micro-batch spans >2^30 ms (e.g.
+        # historical replay with a huge watermark jump), distinct NON-empty
+        # far-past buckets merge into one flush group — one RESET and merged
+        # per-bucket aggregates where the reference emits separate batches.
+        # Events this far apart never share a micro-batch in live streams.
         now_bucket = (now - base) // W  # scalar
         pivot = base + now_bucket * W  # scalar; bucket(pivot) == now_bucket
         LIM = jnp.int64(1 << 30)

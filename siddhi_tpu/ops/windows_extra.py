@@ -207,9 +207,14 @@ class HoppingWindow(WindowOp):
         return new_state, chunk
 
     def contents(self, state: HopState, now: jax.Array):
+        # probe the same (boundary - W, boundary] interval step() last
+        # emitted, so joins/pull queries see exactly the emitted hop — not
+        # events newer than the last boundary
+        boundary = state.last_hop * jnp.int64(self.H)
         live = _ring_live_mask(self.C, jnp.maximum(state.appended - self.C, 0),
                                state.appended)
-        in_window = live & (state.ring_ts > now - jnp.int64(self.W))
+        in_window = live & (state.ring_ts > boundary - jnp.int64(self.W)) \
+            & (state.ring_ts <= boundary)
         return state.ring_cols, state.ring_ts, in_window
 
 

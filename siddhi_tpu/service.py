@@ -17,6 +17,13 @@ Usage:  python -m siddhi_tpu.service [port]
 Concurrency note: requests serialize through one lock — the engine is a
 single-controller runtime by design (SURVEY §7); the service is a deployment
 surface, not a data-plane load balancer.
+
+Security: **deploying an app is code execution** — SiddhiQL may contain
+`define function f[python] { ... }` bodies that run in-process. The service
+therefore (a) rejects script-function definitions unless constructed with
+`allow_scripts=True`, and (b) requires a shared bearer token on every request
+when constructed with `token=...`. Always set a token before binding to a
+non-loopback host.
 """
 
 from __future__ import annotations
@@ -30,9 +37,13 @@ from .errors import SiddhiError
 
 
 class SiddhiService:
-    def __init__(self, manager: SiddhiManager | None = None) -> None:
+    def __init__(self, manager: SiddhiManager | None = None, *,
+                 token: str | None = None,
+                 allow_scripts: bool = False) -> None:
         self.manager = manager or SiddhiManager()
         self.lock = threading.Lock()
+        self.token = token
+        self.allow_scripts = allow_scripts
 
     # ------------------------------------------------------------- operations
 
@@ -42,6 +53,12 @@ class SiddhiService:
             text = (compiler.update_variables(siddhi_ql)
                     if "${" in siddhi_ql else siddhi_ql)
             app = compiler.parse(text)
+            if app.function_definitions and not self.allow_scripts:
+                names = ", ".join(sorted(app.function_definitions))
+                raise SiddhiError(
+                    "app defines script functions (" + names + ") which "
+                    "execute arbitrary code; start the service with "
+                    "allow_scripts=True to permit them")
             if app.name in self.manager.runtimes:
                 # reference service rejects duplicate deployment
                 raise SiddhiError(f"app {app.name!r} is already deployed")
@@ -101,7 +118,20 @@ class SiddhiService:
                 n = int(self.headers.get("Content-Length", 0))
                 return self.rfile.read(n).decode()
 
+            def _authorized(self) -> bool:
+                if service.token is None:
+                    return True
+                import hmac
+                got = self.headers.get("Authorization", "")
+                want = f"Bearer {service.token}"
+                if hmac.compare_digest(got.encode(), want.encode()):
+                    return True
+                self._reply(401, {"error": "missing or bad bearer token"})
+                return False
+
             def do_GET(self):
+                if not self._authorized():
+                    return
                 parts = self.path.strip("/").split("/")
                 try:
                     if parts == ["siddhi-apps"]:
@@ -115,6 +145,8 @@ class SiddhiService:
                     self._reply(404, {"error": "unknown app"})
 
             def do_POST(self):
+                if not self._authorized():
+                    return
                 parts = self.path.strip("/").split("/")
                 try:
                     if parts == ["siddhi-apps"]:
@@ -141,6 +173,8 @@ class SiddhiService:
                     self._reply(400, {"error": str(e)})
 
             def do_DELETE(self):
+                if not self._authorized():
+                    return
                 parts = self.path.strip("/").split("/")
                 if len(parts) == 2 and parts[0] == "siddhi-apps":
                     ok = service.undeploy(parts[1])
@@ -153,11 +187,17 @@ class SiddhiService:
 
 
 def main(argv=None) -> None:
+    import os
     import sys
     argv = argv if argv is not None else sys.argv[1:]
+    allow_scripts = "--allow-scripts" in argv
+    argv = [a for a in argv if a != "--allow-scripts"]
     port = int(argv[0]) if argv else 9090
-    server = SiddhiService().make_server(port)
-    print(f"siddhi_tpu service on :{port}")
+    token = os.environ.get("SIDDHI_SERVICE_TOKEN") or None
+    server = SiddhiService(token=token,
+                           allow_scripts=allow_scripts).make_server(port)
+    auth = "token auth" if token else "NO AUTH (loopback only!)"
+    print(f"siddhi_tpu service on :{port} [{auth}]")
     server.serve_forever()
 
 

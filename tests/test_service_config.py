@@ -93,6 +93,53 @@ class TestService:
             assert e.code == 400
         assert raised
 
+    def test_script_functions_rejected_by_default(self, server):
+        base, _svc = server
+        app = ("@app:name('scripted')\n"
+               "define function sq[python] return int { return x * x };\n"
+               "define stream S (x int);\n"
+               "from S select sq(x) as y insert into Out;\n")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(f"{base}/siddhi-apps", "POST", app)
+        assert ei.value.code == 400
+        assert "script" in json.loads(ei.value.read())["error"]
+
+    def test_allow_scripts_opt_in(self):
+        svc = SiddhiService(allow_scripts=True)
+        app = ("@app:name('scripted2')\n"
+               "define function sq[python] return int { return x * x };\n"
+               "define stream S (x int);\n"
+               "from S select sq(x) as y insert into Out;\n")
+        assert svc.deploy(app) == "scripted2"
+        svc.undeploy("scripted2")
+
+
+class TestServiceAuth:
+    @pytest.fixture()
+    def auth_server(self):
+        svc = SiddhiService(token="s3cret")
+        httpd = svc.make_server(port=0)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        yield f"http://127.0.0.1:{httpd.server_address[1]}"
+        httpd.shutdown()
+
+    def test_requests_without_token_rejected(self, auth_server):
+        for method, path, body in [("GET", "/siddhi-apps", None),
+                                   ("POST", "/siddhi-apps", APP),
+                                   ("DELETE", "/siddhi-apps/svc", None)]:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _req(f"{auth_server}{path}", method, body)
+            assert ei.value.code == 401
+
+    def test_bearer_token_accepted(self, auth_server):
+        req = urllib.request.Request(
+            f"{auth_server}/siddhi-apps",
+            headers={"Authorization": "Bearer s3cret"})
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+            assert json.loads(resp.read()) == {"apps": []}
+
 
 class TestConfigManager:
     YAML = """
