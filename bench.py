@@ -1,116 +1,320 @@
-"""Driver benchmark — prints ONE JSON line.
+"""Driver benchmark — one JSON line per BASELINE.md config, headline last.
 
-Config: BASELINE.md #2 — lengthBatch(10000) window, sum/avg group-by over 1M
-distinct keys (the north-star sliding-window group-by shape). Events are
-synthesized host-side as pre-encoded columnar batches (dictionary interning is
-amortized in steady state) and pushed through the jitted query step on the
-default device (real TPU under the driver; CPU elsewhere).
+Configs (BASELINE.md "Baselines to measure"):
+  1. filter      — single filter+project query (SimpleFilterSingleQueryPerformance shape)
+  2. groupby     — lengthBatch(10000) sum/avg group-by over 1M keys  ◄ HEADLINE (printed last)
+  3. distinct    — 60-sec sliding time window, exact distinctCount
+  4. pattern     — every A -> B[b.val == a.val] within 5 sec (batched NFA)
+  5. join        — stream-stream equi join over two length(100k) windows
 
-vs_baseline: BASELINE.json `published` is empty and no JVM exists in this image
-to measure the reference, so the denominator defaults to a nominal 1.0M
-events/sec single-JVM CPU figure (WSO2's published order-of-magnitude for
-simple Siddhi queries; documented assumption). If a measured number is added to
-BASELINE.json under published["groupby_window_events_per_sec"], it is used
-instead.
+Events are synthesized host-side as pre-encoded columnar batches (dictionary
+interning amortizes in steady state) and pushed through each query's jitted
+step on the default device (real TPU under the driver; CPU elsewhere).
+Throughput is pipelined (async dispatch, one barrier per window, best of 3 —
+through the axon tunnel a per-step block costs ~80 ms of RPC sync alone,
+which would measure the tunnel, not the engine). p99 is synchronous per-step.
+
+vs_baseline: BASELINE.json `published` is empty and no JVM exists in this
+image to measure the reference, so each denominator defaults to a nominal
+1.0M events/sec single-JVM CPU figure (WSO2's published order-of-magnitude
+for simple Siddhi queries; documented assumption). Measured numbers added to
+BASELINE.json under published[<metric key>] take precedence.
+
+Usage: python bench.py [config ...]   (default: all five, headline last)
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
 
 BATCH = 8192
-N_KEYS = 1_000_000
-WINDOW = 10_000
 WARMUP = 3
 STEPS = 40
-
-APP = f"""
-define stream TradeStream (symbol string, price double, volume long);
-@info(name = 'bench')
-from TradeStream#window.lengthBatch({WINDOW})
-select symbol, sum(price) as total, avg(price) as avgPrice
-group by symbol
-insert into SummaryStream;
-"""
+LAT_STEPS = 50
+RNG_SEED = 7
 
 
-def main() -> None:
+def _baseline_for(key: str) -> float:
+    try:
+        with open("BASELINE.json") as f:
+            pub = json.load(f).get("published", {})
+        return float(pub.get(key, 1_000_000.0))
+    except Exception:
+        return 1_000_000.0
+
+
+def _measure(run_step, events_per_step: int, metric: str, *,
+             warmup: int = WARMUP, steps: int = STEPS) -> dict:
+    """run_step(i) -> device out; pipelined best-of-3 + synchronous p99."""
     import jax
+
+    for i in range(warmup):
+        out = run_step(i)
+    jax.block_until_ready(out)
+
+    events_per_sec = 0.0
+    for _rep in range(3):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            out = run_step(i)
+        jax.block_until_ready(out)
+        elapsed = time.perf_counter() - t0
+        events_per_sec = max(events_per_sec, events_per_step * steps / elapsed)
+
+    lat = []
+    for i in range(LAT_STEPS):
+        t0 = time.perf_counter()
+        out = run_step(i)
+        jax.block_until_ready(out)
+        lat.append(time.perf_counter() - t0)
+    p99_ms = float(np.percentile(np.array(lat), 99) * 1e3)
+
+    baseline = _baseline_for(metric)
+    return {
+        "metric": metric,
+        "value": round(events_per_sec, 1),
+        "unit": "events/sec",
+        "vs_baseline": round(events_per_sec / baseline, 3),
+        "p99_batch_latency_ms": round(p99_ms, 3),
+    }
+
+
+def _trade_batches(n: int, n_keys: int, *, ms_per_event: int = 0,
+                   price_hi: float = 100.0):
+    from siddhi_tpu.core.event import EventBatch
+
+    rng = np.random.default_rng(RNG_SEED)
+    batches, ts0 = [], 1
+    for _ in range(n):
+        if ms_per_event:
+            ts = np.arange(ts0, ts0 + BATCH * ms_per_event, ms_per_event,
+                           dtype=np.int64)
+            ts0 += BATCH * ms_per_event
+        else:
+            ts = np.arange(ts0, ts0 + BATCH, dtype=np.int64)
+            ts0 += BATCH
+        cols = {
+            # pre-encoded dictionary codes (1..n_keys); code 0 is null
+            "symbol": rng.integers(1, n_keys + 1, BATCH, dtype=np.int32),
+            "price": rng.uniform(1.0, price_hi, BATCH).astype(np.float32),
+            "volume": rng.integers(1, 1000, BATCH, dtype=np.int64),
+        }
+        batches.append(EventBatch.from_numpy(ts, cols, BATCH))
+    return batches, ts0
+
+
+# --------------------------------------------------------------------- configs
+
+
+def bench_filter() -> dict:
+    """BASELINE config 1: single filter+project (reference:
+    SimpleFilterSingleQueryPerformance.java:40-52, `700 > price`)."""
+    import jax.numpy as jnp
+
+    from siddhi_tpu import SiddhiManager
+
+    app = """
+    define stream TradeStream (symbol string, price double, volume long);
+    @info(name = 'bench')
+    from TradeStream[700.0 > price]
+    select symbol, price
+    insert into OutStream;
+    """
+    rt = SiddhiManager().create_siddhi_app_runtime(app, batch_size=BATCH)
+    qr = rt.query_runtimes["bench"]
+    batches, ts_end = _trade_batches(8, 1000, price_hi=1000.0)
+    state = [qr.state]
+
+    def run(i):
+        state[0], out = qr._step(state[0], batches[i % len(batches)],
+                                 jnp.int64(ts_end))
+        return out
+
+    return _measure(run, BATCH, "filter_events_per_sec")
+
+
+def bench_groupby() -> dict:
+    """BASELINE config 2 (headline): lengthBatch(10000) sum/avg group-by, 1M keys."""
+    import jax.numpy as jnp
+
+    from siddhi_tpu import SiddhiManager
+
+    app = """
+    define stream TradeStream (symbol string, price double, volume long);
+    @info(name = 'bench')
+    from TradeStream#window.lengthBatch(10000)
+    select symbol, sum(price) as total, avg(price) as avgPrice
+    group by symbol
+    insert into SummaryStream;
+    """
+    rt = SiddhiManager().create_siddhi_app_runtime(
+        app, batch_size=BATCH, group_capacity=1 << 20)
+    qr = rt.query_runtimes["bench"]
+    batches, ts_end = _trade_batches(8, 1_000_000)
+    state = [qr.state]
+
+    def run(i):
+        state[0], out = qr._step(state[0], batches[i % len(batches)],
+                                 jnp.int64(ts_end))
+        return out
+
+    return _measure(run, BATCH, "lengthBatch10k_groupby_1M_keys_events_per_sec")
+
+
+def bench_distinct() -> dict:
+    """BASELINE config 3: 60-sec sliding time window, exact distinctCount.
+    ~1 ms event spacing -> the window holds ~60k events in steady state."""
+    import jax.numpy as jnp
+
+    from siddhi_tpu import SiddhiManager
+
+    app = """
+    define stream TradeStream (symbol string, price double, volume long);
+    @info(name = 'bench')
+    from TradeStream#window.time(60 sec)
+    select distinctCount(symbol) as distinctSymbols
+    insert into OutStream;
+    """
+    import dataclasses
+
+    # lifetime-unique values bounded (100k) well under the 1M pair capacity
+    rt = SiddhiManager().create_siddhi_app_runtime(
+        app, batch_size=BATCH, group_capacity=1 << 20)
+    qr = rt.query_runtimes["bench"]
+    batches, _ = _trade_batches(8, 100_000, ms_per_event=1)
+    state = [qr.state]
+    # timestamps must keep advancing monotonically across ALL phases
+    # (warmup, 3 throughput reps, latency loop) or the 60 s window drains
+    # and the watermark regresses; a global step counter + device-side ts
+    # shift keeps the window at its ~60k-event steady state
+    step_no = [0]
+
+    def run(_i):
+        k = step_no[0]
+        step_no[0] += 1
+        b = batches[k % len(batches)]
+        shift = jnp.int64((k // len(batches)) * len(batches) * BATCH)
+        b = dataclasses.replace(b, ts=b.ts + shift)
+        now = jnp.int64((k + 1) * BATCH)
+        state[0], out = qr._step(state[0], b, now)
+        return out
+
+    return _measure(run, BATCH, "sliding60s_distinctCount_events_per_sec")
+
+
+def bench_pattern() -> dict:
+    """BASELINE config 4: `every a=A -> b=B[b.val == a.val] within 5 sec`.
+    Alternating A/B batches; every B consumes exactly one pending A."""
+    import jax.numpy as jnp
+
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core import dtypes
+    from siddhi_tpu.core.event import EventBatch
+
+    pb = 1024  # pattern batch: pending capacity bounds concurrent partials
+    prev_cap = dtypes.config.pattern_pending_capacity
+    dtypes.config.pattern_pending_capacity = 4 * pb
+    try:
+        app = """
+        define stream StreamA (val int);
+        define stream StreamB (val int);
+        @info(name = 'bench')
+        from every a=StreamA -> b=StreamB[b.val == a.val] within 5 sec
+        select a.val as aVal, b.val as bVal
+        insert into OutStream;
+        """
+        rt = SiddhiManager().create_siddhi_app_runtime(app, batch_size=pb)
+        qr = rt.query_runtimes["bench"]
+    finally:
+        dtypes.config.pattern_pending_capacity = prev_cap
+
+    n_cycles = 4
+    ab = []
+    ts0 = 1
+    for k in range(n_cycles):
+        vals = np.arange(k * pb, (k + 1) * pb, dtype=np.int32)
+        ts_a = np.arange(ts0, ts0 + pb, dtype=np.int64)
+        a = EventBatch.from_numpy(ts_a, {"val": vals}, pb)
+        ts_b = ts_a + pb
+        b = EventBatch.from_numpy(ts_b, {"val": vals}, pb)
+        ts0 += 2 * pb
+        ab.append((a, b, ts0 - 1))
+    state = [qr.state]
+
+    def run(i):
+        a, b, now = ab[i % n_cycles]
+        state[0], _ = qr._steps["StreamA"](state[0], a, jnp.int64(now - pb))
+        state[0], out = qr._steps["StreamB"](state[0], b, jnp.int64(now))
+        return out
+
+    return _measure(run, 2 * pb, "pattern_everyAB_within5s_events_per_sec")
+
+
+def bench_join() -> dict:
+    """BASELINE config 5: equi join over two length(100000) windows; keys
+    uniform over 100k so each probe matches ~1 build row."""
     import jax.numpy as jnp
 
     from siddhi_tpu import SiddhiManager
     from siddhi_tpu.core.event import EventBatch
 
-    manager = SiddhiManager()
-    rt = manager.create_siddhi_app_runtime(
-        APP, batch_size=BATCH, group_capacity=1 << 20)
+    app = """
+    define stream LeftStream (k int, v double);
+    define stream RightStream (k int, v double);
+    @info(name = 'bench')
+    from LeftStream#window.length(100000) as a
+    join RightStream#window.length(100000) as b
+    on a.k == b.k
+    select a.k as k, a.v as lv, b.v as rv
+    insert into OutStream;
+    """
+    rt = SiddhiManager().create_siddhi_app_runtime(app, batch_size=BATCH)
     qr = rt.query_runtimes["bench"]
 
-    rng = np.random.default_rng(7)
-    n_distinct_batches = 8  # cycle through pre-built batches
-    batches = []
+    rng = np.random.default_rng(RNG_SEED)
+    n_distinct = 8
+    lr = []
     ts0 = 1
-    for i in range(n_distinct_batches):
+    for _ in range(n_distinct):
         ts = np.arange(ts0, ts0 + BATCH, dtype=np.int64)
         ts0 += BATCH
-        cols = {
-            # pre-encoded dictionary codes (1..N_KEYS); code 0 is null
-            "symbol": rng.integers(1, N_KEYS + 1, BATCH, dtype=np.int32),
-            "price": rng.uniform(1.0, 100.0, BATCH).astype(np.float32),
-            "volume": rng.integers(1, 1000, BATCH, dtype=np.int64),
-        }
-        batches.append(EventBatch.from_numpy(ts, cols, BATCH))
+        mk = lambda: {"k": rng.integers(1, 100_001, BATCH, dtype=np.int32),
+                      "v": rng.uniform(1.0, 100.0, BATCH).astype(np.float32)}
+        lr.append((EventBatch.from_numpy(ts, mk(), BATCH),
+                   EventBatch.from_numpy(ts, mk(), BATCH)))
+    state = [qr.state]
 
-    state = qr.state
-    step = qr._step
+    def run(i):
+        l, r = lr[i % n_distinct]
+        now = jnp.int64(ts0)
+        state[0], _ = qr._step_left(state[0], l, now, None)
+        state[0], out = qr._step_right(state[0], r, now, None)
+        return out
 
-    # warmup / compile
-    for i in range(WARMUP):
-        state, out = step(state, batches[i % n_distinct_batches], jnp.int64(ts0))
-    jax.block_until_ready(out)
+    return _measure(run, 2 * BATCH, "join_100kx100k_events_per_sec")
 
-    # throughput: pipelined (async dispatch, one barrier at the end) — the
-    # steady-state streaming mode; batches stay in flight like the reference's
-    # Disruptor pipeline. Through the axon tunnel a per-step block costs
-    # ~80 ms of RPC sync alone, which would measure the tunnel, not the engine.
-    # Best of 3 windows: the shared tunnel's throughput varies run-to-run.
-    events_per_sec = 0.0
-    for _rep in range(3):
-        t_start = time.perf_counter()
-        for i in range(STEPS):
-            state, out = step(state, batches[i % n_distinct_batches],
-                              jnp.int64(ts0))
-        jax.block_until_ready(out)
-        elapsed = time.perf_counter() - t_start
-        events_per_sec = max(events_per_sec, BATCH * STEPS / elapsed)
 
-    # p99 batch latency: synchronous per-step round trips (includes host sync)
-    lat = []
-    for i in range(50):
-        t0 = time.perf_counter()
-        state, out = step(state, batches[i % n_distinct_batches], jnp.int64(ts0))
-        jax.block_until_ready(out)
-        lat.append(time.perf_counter() - t0)
-    p99_ms = float(np.percentile(np.array(lat), 99) * 1e3)
+CONFIGS = {
+    "filter": bench_filter,
+    "distinct": bench_distinct,
+    "pattern": bench_pattern,
+    "join": bench_join,
+    "groupby": bench_groupby,  # headline: keep last so drivers that parse
+    # only the final line keep tracking the round-1 metric
+}
 
-    baseline = 1_000_000.0
-    try:
-        with open("BASELINE.json") as f:
-            pub = json.load(f).get("published", {})
-        baseline = float(pub.get("groupby_window_events_per_sec", baseline))
-    except Exception:
-        pass
 
-    print(json.dumps({
-        "metric": "lengthBatch10k_groupby_1M_keys_events_per_sec",
-        "value": round(events_per_sec, 1),
-        "unit": "events/sec",
-        "vs_baseline": round(events_per_sec / baseline, 3),
-        "p99_batch_latency_ms": round(p99_ms, 3),
-    }))
+def main() -> None:
+    unknown = [n for n in sys.argv[1:] if n not in CONFIGS]
+    if unknown:
+        sys.exit(f"unknown config(s) {unknown}; choose from {list(CONFIGS)}")
+    names = sys.argv[1:] or list(CONFIGS)
+    for name in names:
+        print(json.dumps(CONFIGS[name]()), flush=True)
 
 
 if __name__ == "__main__":

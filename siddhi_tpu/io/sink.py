@@ -151,9 +151,6 @@ class PartitionedStrategy(DistributionStrategy):
         if key not in names:
             raise SiddhiAppCreationError(f"partitionKey {key!r} not an attribute")
         self._idx = names.index(key)
-        self._type = stream_definition.attributes[self._idx].type
-
-    def destinations(self, row):
         # stable across processes/restarts (built-in hash() is seeded per
         # process for str) — mirrors the reference's deterministic
         # String.hashCode() partitioning. The key is canonicalized through
@@ -162,19 +159,23 @@ class PartitionedStrategy(DistributionStrategy):
         # hash(), which keeps equal keys together within a process.
         from ..query_api.definition import AttributeType as T
 
-        v = row[self._idx]
-        if v is None:
-            canon = "\0null"
-        elif self._type in (T.FLOAT, T.DOUBLE):
-            canon = repr(float(v) + 0.0)  # +0.0 folds -0.0 into 0.0
-        elif self._type in (T.INT, T.LONG):
-            canon = repr(int(v))
-        elif self._type is T.BOOL:
-            canon = repr(bool(v))
-        elif self._type is T.STRING:
-            canon = str(v)
+        atype = stream_definition.attributes[self._idx].type
+        if atype in (T.FLOAT, T.DOUBLE):
+            self._canon = lambda v: repr(float(v) + 0.0)  # folds -0.0 to 0.0
+        elif atype in (T.INT, T.LONG):
+            self._canon = lambda v: repr(int(v))
+        elif atype is T.BOOL:
+            self._canon = lambda v: repr(bool(v))
+        elif atype is T.STRING:
+            self._canon = str
         else:  # OBJECT — no value-deterministic serialization
+            self._canon = None
+
+    def destinations(self, row):
+        v = row[self._idx]
+        if self._canon is None:
             return [hash(v) % self.n]
+        canon = "\0null" if v is None else self._canon(v)
         return [zlib.crc32(canon.encode()) % self.n]
 
 

@@ -59,10 +59,23 @@ def _build() -> bool:
         subprocess.run(
             [sys.executable, "setup.py", "build_ext", "--build-lib", _BUILD_DIR],
             cwd=_SRC_DIR, check=True, capture_output=True, timeout=120)
-        return True
     except (subprocess.SubprocessError, OSError) as e:
         _log.info("native extension build failed, using Python encoder: %s", e)
         return False
+    # prune superseded hash dirs (and any pre-hash-scheme loose files) so
+    # iterative source edits don't accumulate orphaned binaries
+    import shutil
+
+    current = os.path.basename(_BUILD_DIR)
+    try:
+        for entry in os.listdir(_BUILD_ROOT):
+            if entry == current:
+                continue
+            path = os.path.join(_BUILD_ROOT, entry)
+            (shutil.rmtree if os.path.isdir(path) else os.remove)(path)
+    except OSError:  # pragma: no cover — cleanup is best-effort
+        pass
+    return True
 
 
 if not os.environ.get("SIDDHI_TPU_NO_NATIVE"):
