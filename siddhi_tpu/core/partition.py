@@ -297,7 +297,7 @@ class PartitionRuntime:
         for g in self._mesh_states[1].groups:
             if isinstance(g, tuple) and g and isinstance(g[0], KeyTable):
                 kt = g[0]
-                cap = kt.sorted_keys.shape[-1]
+                cap = kt.keys.shape[-1] // 2  # hash array is 2x id capacity
                 worst = int(np.max(np.asarray(kt.count)))
                 if worst > int(0.85 * cap):
                     warnings.warn(

@@ -329,17 +329,31 @@ class QueryRuntime(Receiver):
         """distinctCount's (group,value) pair table is append-only (zeroed
         pairs keep their slot, unlike the reference's HashMap entry removal);
         warn before lifetime-unique pairs overflow and alias slot 0."""
-        from ..ops.groupby import KeyTable
+        import warnings
+
+        from ..ops.groupby import GroupState, KeyTable
         for g in self.state[1].groups:
-            if isinstance(g, tuple) and g and isinstance(g[0], KeyTable):
+            if not (isinstance(g, tuple) and g):
+                continue
+            if isinstance(g[0], KeyTable):
                 kt = g[0]
-                cap = kt.sorted_keys.shape[0]
+                cap = kt.keys.shape[0] // 2  # hash array is 2x id capacity
                 if int(kt.count) > int(0.85 * cap):
-                    import warnings
                     warnings.warn(
                         f"query {self.name!r}: distinctCount pair table at "
                         f"{int(kt.count)}/{cap} lifetime-unique (group,value) "
                         "pairs; counts will corrupt past capacity — raise "
+                        "group_capacity", stacklevel=2)
+                    self._capacity_warned = True
+            elif isinstance(g[0], GroupState) and len(g) == 2:
+                # string-code fast path: pair table indexed by interning code
+                cap = g[0].values.shape[0]
+                n_codes = len(self.ctx.global_strings)
+                if n_codes > int(0.85 * cap):
+                    warnings.warn(
+                        f"query {self.name!r}: distinctCount code table at "
+                        f"{n_codes}/{cap} interned strings; codes past "
+                        "capacity are dropped from the count — raise "
                         "group_capacity", stacklevel=2)
                     self._capacity_warned = True
 

@@ -173,10 +173,11 @@ class PartitionedStrategy(DistributionStrategy):
 
     def destinations(self, row):
         v = row[self._idx]
+        if v is None:  # deterministic for every attribute type, OBJECT too
+            return [zlib.crc32(b"\0null") % self.n]
         if self._canon is None:
             return [hash(v) % self.n]
-        canon = "\0null" if v is None else self._canon(v)
-        return [zlib.crc32(canon.encode()) % self.n]
+        return [zlib.crc32(self._canon(v).encode()) % self.n]
 
 
 class BroadcastStrategy(DistributionStrategy):

@@ -51,9 +51,11 @@ class AggregatorSpec:
     #: needs removal support (sliding windows); min/max set False
     supports_removal: bool = True
     #: stateful aggregators that don't decompose into scan components
-    #: (distinctCount): init_custom(group_capacity) -> state pytree;
-    #: custom_scan(state, slots, arg_vals, sign, lane_valid, resets, epoch)
-    #: -> (state', per-lane values)
+    #: (distinctCount): init_custom(group_capacity, grouped) -> state pytree;
+    #: custom_scan(state, slots, arg_vals, sign, lane_valid, resets, epoch,
+    #: grouped) -> (state', per-lane values). `grouped` is a static planner
+    #: hint: ungrouped queries pass all-zero slots, which admits cheaper
+    #: state layouts.
     init_custom: Optional[Callable] = None
     custom_scan: Optional[Callable] = None
 
@@ -177,35 +179,63 @@ def _make_distinct_count(arg_types):
     count == 1 is a 0→1 transition (+1 distinct), an EXPIRED lane reaching 0
     is a 1→0 transition (-1); (2) those ±1 deltas scanned per group give the
     per-lane running distinct count, preserving the reference's event-at-a-time
-    emission semantics inside a batch."""
+    emission semantics inside a batch.
+
+    Fast path: an UNGROUPED distinctCount over a STRING attribute needs no
+    hash table at all — device strings are dictionary codes, already dense
+    ids into the interning table, so the code indexes the pair-count table
+    directly (codes ≥ capacity are dropped with the same documented overflow
+    semantics; the runtime monitors interning size against capacity)."""
     from .groupby import (
         grouped_scan,
         hash_columns,
         init_group_state,
         init_key_table,
         key_lookup_or_insert,
+        ungrouped_scan,
     )
 
     dt = dtypes.device_dtype(_T.LONG)
+    code_arg = bool(arg_types) and arg_types[0] == _T.STRING
 
-    def init_custom(group_capacity: int):
+    def init_custom(group_capacity: int, grouped: bool = True):
         P = group_capacity  # (group, value) pair capacity
+        if code_arg and not grouped:
+            return (init_group_state(P, dt), init_group_state(1, dt))
         return (init_key_table(P), init_group_state(P, dt),
                 init_group_state(group_capacity, dt))
 
-    def custom_scan(state, slots, arg_vals, sign, lane_valid, resets, epoch):
+    def custom_scan(state, slots, arg_vals, sign, lane_valid, resets, epoch,
+                    grouped: bool = True):
+        deltas = sign.astype(dt)
+        if code_arg and not grouped:
+            pair_counts, distinct = state
+            P = pair_counts.values.shape[0]
+            code = arg_vals[0].astype(jnp.int32)
+            ok = lane_valid & (code >= 0) & (code < P)
+            pair_counts2, pair_post = grouped_scan(
+                pair_counts, code, deltas, ok, resets, epoch, op="sum")
+            dd = jnp.where(sign > 0,
+                           (pair_post == 1).astype(dt),
+                           -(pair_post == 0).astype(dt))
+            distinct2, out = ungrouped_scan(
+                distinct, dd, ok, resets, epoch, op="sum")
+            return (pair_counts2, distinct2), out
         kt, pair_counts, distinct = state
         pk = hash_columns([slots.astype(jnp.int64), arg_vals[0]])
         kt2, pair_slots = key_lookup_or_insert(kt, pk, lane_valid)
-        deltas = sign.astype(dt)
         pair_counts2, pair_post = grouped_scan(
             pair_counts, pair_slots, deltas, lane_valid, resets, epoch,
             op="sum")
         dd = jnp.where(sign > 0,
                        (pair_post == 1).astype(dt),
                        -(pair_post == 0).astype(dt))
-        distinct2, out = grouped_scan(
-            distinct, slots, dd, lane_valid, resets, epoch, op="sum")
+        if grouped:
+            distinct2, out = grouped_scan(
+                distinct, slots, dd, lane_valid, resets, epoch, op="sum")
+        else:
+            distinct2, out = ungrouped_scan(
+                distinct, dd, lane_valid, resets, epoch, op="sum")
         return (kt2, pair_counts2, distinct2), out
 
     return AggregatorSpec((), lambda cs: cs[0], _T.LONG,

@@ -27,6 +27,8 @@ from typing import Callable, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from .search import searchsorted32
+
 from ..core import dtypes
 from ..errors import SiddhiAppCreationError
 from ..query_api.definition import AttributeType
@@ -118,7 +120,7 @@ def probe_equi(plan: JoinPlan, probe_scope: Scope, probe_valid: jax.Array,
 
     order = jnp.argsort(bkeys, stable=True)  # invalid rows sort last
     sorted_keys = bkeys[order]
-    start = jnp.searchsorted(sorted_keys, pkeys, side="left")
+    start = searchsorted32(sorted_keys, pkeys, side="left")
 
     k = jnp.arange(k_max)
     pos = start[:, None] + k[None, :]  # [B,K]

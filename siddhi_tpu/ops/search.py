@@ -1,0 +1,37 @@
+"""Branchless unrolled binary search.
+
+XLA lowers `jnp.searchsorted` to a `while` HLO whose per-iteration dispatch
+dominated sliding-window steps on TPU (profiled at ~50% of step time: the
+loop body runs as 2 small fusions x log2(N) iterations with loop overhead
+between each). A static unroll of the same log2(N) halving steps compiles to
+straight-line vector code XLA fuses into neighbouring ops.
+
+Semantics match `jnp.searchsorted(a, v, side=...)` for a sorted 1-D `a`,
+returning int32 (positions are lane indices; int64 lane math is emulated on
+TPU — see ops/windows.py)."""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+
+def searchsorted32(a, v, side: str = "left"):
+    """Positions where `v` would insert into sorted `a`, as int32.
+
+    a: sorted [N]; v: any shape. side='left' counts elements < v,
+    side='right' counts elements <= v — same as jnp.searchsorted.
+    """
+    N = a.shape[0]
+    pos = jnp.zeros(jnp.shape(v), jnp.int32)
+    if N == 0:
+        return pos
+    bits = max(1, math.ceil(math.log2(N + 1)))
+    for shift in range(bits - 1, -1, -1):
+        step = jnp.int32(1 << shift)
+        cand = pos + step
+        probe = a[jnp.clip(cand - 1, 0, N - 1)]
+        ok = probe < v if side == "left" else probe <= v
+        pos = jnp.where((cand <= N) & ok, cand, pos)
+    return pos

@@ -52,6 +52,8 @@ from .groupby import (
     init_group_state,
     init_key_table,
     key_lookup_or_insert,
+    ungrouped_scan,
+    ungrouped_scan_fused,
 )
 
 AGG_FRAME = "__agg__"
@@ -190,7 +192,8 @@ class CompiledSelector:
         any_fused = False
         for _, spec, _ in self.agg_specs:
             if spec.custom_scan is not None:
-                groups.append(spec.init_custom(self.group_capacity))
+                groups.append(spec.init_custom(
+                    self.group_capacity, grouped=bool(self.group_vars)))
                 continue
             for comp in spec.components:
                 if (comp.op == "sum" and not comp.ignore_removal
@@ -251,7 +254,8 @@ class CompiledSelector:
             if spec.custom_scan is not None:
                 g, out_vals = spec.custom_scan(
                     state.groups[gi], slots.astype(jnp.int32), arg_vals,
-                    sign, data_valid, any_reset, state.epoch)
+                    sign, data_valid, any_reset, state.epoch,
+                    grouped=bool(self.group_vars))
                 new_groups[gi] = g
                 results[gi] = out_vals
                 pending.append((slot_name, spec, [gi]))
@@ -269,9 +273,14 @@ class CompiledSelector:
                     lane_valid = data_valid if not comp.ignore_removal else (
                         valid & is_current)
                     resets = no_reset if comp.ignore_reset else any_reset
-                    g, out_vals = grouped_scan(
-                        state.groups[gi], slots.astype(jnp.int32), deltas,
-                        lane_valid, resets, state.epoch, op=comp.op)
+                    if self.group_vars:
+                        g, out_vals = grouped_scan(
+                            state.groups[gi], slots.astype(jnp.int32), deltas,
+                            lane_valid, resets, state.epoch, op=comp.op)
+                    else:
+                        g, out_vals = ungrouped_scan(
+                            state.groups[gi], deltas, lane_valid, resets,
+                            state.epoch, op=comp.op)
                     new_groups[gi] = g
                     results[gi] = out_vals
                 comp_gis.append(gi)
@@ -279,10 +288,18 @@ class CompiledSelector:
             pending.append((slot_name, spec, comp_gis))
 
         shared_epoch = state.shared_epoch
-        if fused_idx:
+        if fused_idx and self.group_vars:
             f_vals, shared_epoch, f_outs = grouped_scan_fused(
                 fused_vals, state.shared_epoch, slots.astype(jnp.int32),
                 fused_deltas, data_valid, any_reset, state.epoch)
+            for i, g in zip(fused_idx, f_vals):
+                new_groups[i] = g
+            for i, o in zip(fused_idx, f_outs):
+                results[i] = o
+        elif fused_idx:
+            f_vals, shared_epoch, f_outs = ungrouped_scan_fused(
+                fused_vals, state.shared_epoch, fused_deltas, data_valid,
+                any_reset, state.epoch)
             for i, g in zip(fused_idx, f_vals):
                 new_groups[i] = g
             for i, o in zip(fused_idx, f_outs):

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import dtypes
+from .search import searchsorted32
 from ..core.event import EventBatch, EventType
 from ..errors import SiddhiAppCreationError
 
@@ -157,6 +158,150 @@ def _empty_like_cols(layout: dict, n: int) -> dict:
     return {k: jnp.zeros((n,), dtype=dt) for k, dt in layout.items()}
 
 
+# --------------------------------------------------------------------------- #
+# packed-row payload: all columns + ts as one u32 matrix
+#
+# TPU per-op overhead dominates these steps (profiled ~0.1 ms per gather/
+# scatter fusion at 8-16k lanes); per-column rings cost one memory op per
+# column per phase. Packing every column into one [*, W] u32 matrix makes
+# ring append, candidate fetch, and the emission-sort gather ONE memory op
+# each, independent of column count. 8-byte payloads (int64/f64 + ts) span
+# two words; bitcasts/stacks fuse into neighbouring elementwise work.
+# --------------------------------------------------------------------------- #
+
+
+def _layout_words(layout: dict) -> int:
+    """u32 words per packed row: columns in layout order, then 2 ts words."""
+    n = 0
+    for dt in layout.values():
+        n += 1 if (jnp.dtype(dt) == jnp.bool_
+                   or jnp.dtype(dt).itemsize == 4) else 2
+    return n + 2
+
+
+def _pack_rows(cols: dict, ts: jax.Array, layout: dict) -> jax.Array:
+    words = []
+    for name, dt in layout.items():
+        a = cols[name]
+        if a.dtype == jnp.bool_:
+            words.append(a.astype(jnp.uint32))
+        elif a.dtype.itemsize == 8:
+            w = jax.lax.bitcast_convert_type(a, jnp.uint32)
+            words.append(w[..., 0])
+            words.append(w[..., 1])
+        else:
+            words.append(jax.lax.bitcast_convert_type(a, jnp.uint32))
+    w = jax.lax.bitcast_convert_type(ts.astype(jnp.int64), jnp.uint32)
+    words.append(w[..., 0])
+    words.append(w[..., 1])
+    return jnp.stack(words, axis=-1)  # [L, W]
+
+
+def _unpack_rows(mat: jax.Array, layout: dict) -> tuple[dict, jax.Array]:
+    cols = {}
+    i = 0
+    for name, dt in layout.items():
+        dt = jnp.dtype(dt)
+        if dt == jnp.bool_:
+            cols[name] = mat[..., i] != 0
+            i += 1
+        elif dt.itemsize == 8:
+            cols[name] = jax.lax.bitcast_convert_type(
+                jnp.stack([mat[..., i], mat[..., i + 1]], axis=-1), dt)
+            i += 2
+        else:
+            cols[name] = jax.lax.bitcast_convert_type(mat[..., i], dt)
+            i += 1
+    ts = jax.lax.bitcast_convert_type(
+        jnp.stack([mat[..., i], mat[..., i + 1]], axis=-1), jnp.int64)
+    return cols, ts
+
+
+def _packed_ts(mat: jax.Array) -> jax.Array:
+    """The ts payload (last two words) of packed rows, as int64."""
+    return jax.lax.bitcast_convert_type(
+        jnp.stack([mat[..., -2], mat[..., -1]], axis=-1), jnp.int64)
+
+
+def compact_packed(batch: EventBatch, layout: dict):
+    """compact() producing one packed matrix: returns (mat[B,W], n_valid32).
+    Rows >= n_valid hold garbage."""
+    live = batch.valid & (batch.types == EventType.CURRENT)
+    mat = _pack_rows(batch.cols, batch.ts, layout)
+    order = jnp.argsort(~live, stable=True).astype(jnp.int32)
+    return mat[order], jnp.sum(live, dtype=jnp.int32)
+
+
+def _append_packed(ring: jax.Array, comp_mat: jax.Array, appended0,
+                   n_valid32) -> jax.Array:
+    """Contiguous FIFO append of comp_mat[:n_valid] at ring row appended0%C.
+    Requires B <= C (callers size rings accordingly). No scatter: one
+    doubled-ring copy + blend + dynamic-update-slice + head fold, all
+    contiguous."""
+    C, W = ring.shape
+    B = comp_mat.shape[0]
+    base = (appended0 % C).astype(jnp.int32)
+    ext = jnp.concatenate([ring, ring[:B]], axis=0)  # [C+B, W]
+    old = jax.lax.dynamic_slice(ext, (base, jnp.int32(0)), (B, W))
+    p = jnp.arange(B, dtype=jnp.int32)
+    blend = jnp.where((p < n_valid32)[:, None], comp_mat, old)
+    ext = jax.lax.dynamic_update_slice(ext, blend, (base, jnp.int32(0)))
+    # rows written past C wrap to the head
+    wrapped = (jnp.arange(B, dtype=jnp.int32) < base + B - C)[:, None]
+    head = jnp.where(wrapped, ext[C:], ext[:B])
+    return jnp.concatenate([head, ext[B:C]], axis=0)
+
+
+def _fetch_rel_packed(ring: jax.Array, comp_mat: jax.Array, base_idx,
+                      appended0, E: int) -> jax.Array:
+    """Rows at overall indices base_idx + [0, E): from the ring for pre-batch
+    rows, from the compacted batch for this batch's arrivals. Contiguous:
+    two dynamic slices + one blend (the packed `_gather_rel`)."""
+    C, W = ring.shape
+    B = comp_mat.shape[0]
+    base = (base_idx % C).astype(jnp.int32)
+    ext = jnp.concatenate([ring, ring[:E]], axis=0)
+    cand = jax.lax.dynamic_slice(ext, (base, jnp.int32(0)), (E, W))
+    rel0 = (appended0 - base_idx).astype(jnp.int32)  # first batch offset
+    # align batch rows so slice row i reads comp_mat[i - rel0]. The slice
+    # origin E - rel0 ranges over [0, E] (rel0 >= 0), so the padded array
+    # needs 2E rows: E leading zeros + comp + trailing zeros. Rows past the
+    # real batch read zeros but are masked by callers (cand_exists), since
+    # pe >= rel0 + n_valid is beyond the window's end.
+    pad_tail = max(E - B, 0)
+    padded = jnp.concatenate(
+        [jnp.zeros((E, W), jnp.uint32), comp_mat,
+         jnp.zeros((pad_tail, W), jnp.uint32)], axis=0)
+    start = jnp.clip(E - rel0, 0, E)
+    bat = jax.lax.dynamic_slice(padded, (start, jnp.int32(0)), (E, W))
+    offs = jnp.arange(E, dtype=jnp.int32)
+    return jnp.where((offs >= rel0)[:, None], bat, cand)
+
+
+def _sort_chunk_packed(hi, lo, payload_mat, emit_ts, valid, types,
+                       layout: dict, width: int) -> EventBatch:
+    """Emission-order sort applied with ONE packed gather: payload + emit ts
+    + (valid, type) meta ride a single [L, W+3] matrix through the two-key
+    int32 sort's permutation."""
+    L = hi.shape[0]
+    hi = jnp.where(valid, hi, jnp.iinfo(jnp.int32).max)
+    iota = jnp.arange(L, dtype=jnp.int32)
+    _, _, order = jax.lax.sort((hi, lo, iota), num_keys=2, is_stable=True)
+    ets = jax.lax.bitcast_convert_type(emit_ts.astype(jnp.int64), jnp.uint32)
+    meta = (valid.astype(jnp.uint32)
+            | (types.astype(jnp.uint32) << 1))
+    W = payload_mat.shape[1]
+    full = jnp.concatenate(
+        [payload_mat, ets, meta[:, None]], axis=1)[order[:width]]
+    cols, _stored_ts = _unpack_rows(full[:, :W], layout)
+    emit = jax.lax.bitcast_convert_type(
+        jnp.stack([full[:, W], full[:, W + 1]], axis=-1), jnp.int64)
+    m = full[:, W + 2]
+    return EventBatch(ts=emit, cols=cols,
+                      valid=(m & 1) != 0,
+                      types=(m >> 1).astype(jnp.int8))
+
+
 def window_has_time_semantics(window: "WindowOp") -> bool:
     """True if the window needs heartbeats (empty timer batches) to emit
     expirations when no data arrives — the TPU analogue of the reference's
@@ -204,8 +349,7 @@ def _ring_live_mask(ring_len: int, lo: jax.Array, hi: jax.Array):
 
 
 class SlidingState(NamedTuple):
-    ring_cols: dict
-    ring_ts: jax.Array
+    ring: jax.Array  # u32[C, W] packed rows (all columns + ts words)
     appended: jax.Array  # int64 total valid arrivals ever
     expired: jax.Array  # int64 total expirations ever
     wm: jax.Array  # int64 external-time watermark (externalTime mode only)
@@ -237,18 +381,20 @@ class SlidingWindow(WindowOp):
         #: externalTime(tsAttr, W): expiry driven by an event attribute clock
         #: (reference: ExternalTimeWindowProcessor) instead of arrival time
         self.ts_attr = ts_attr
+        # packed FIFO appends require B <= C (no last-C overwrite dance)
         if length is not None and time_ms is None:
-            self.C = max(length, 1)
+            self.C = max(length, batch_cap, 1)
         else:
-            self.C = capacity or max(dtypes.config.default_window_capacity, batch_cap)
+            self.C = max(capacity or dtypes.config.default_window_capacity,
+                         batch_cap)
         self.E = max_expired if max_expired is not None else (
             batch_cap if (length is not None and time_ms is None) else max(batch_cap, 1024))
         self.chunk_width = self.B + self.E
+        self.W = _layout_words(layout)
 
     def init_state(self) -> SlidingState:
         return SlidingState(
-            ring_cols=_empty_like_cols(self.layout, self.C),
-            ring_ts=jnp.zeros((self.C,), dtypes.TS_DTYPE),
+            ring=jnp.zeros((self.C, self.W), jnp.uint32),
             appended=jnp.int64(0),
             expired=jnp.int64(0),
             wm=jnp.int64(-(2**62)),
@@ -256,30 +402,37 @@ class SlidingWindow(WindowOp):
 
     def step(self, state: SlidingState, batch: EventBatch, now: jax.Array):
         B, E, C = self.B, self.E, self.C
-        comp_cols, comp_ts, n_valid, _ = compact(batch)
+        comp_mat, n_valid32 = compact_packed(batch, self.layout)
+        n_valid = n_valid32.astype(jnp.int64)
 
         if self.ts_attr is not None:
             # external clock: the time axis is an event attribute; the
-            # watermark advances to the max attribute value seen
-            comp_ts = comp_cols[self.ts_attr].astype(jnp.int64)
+            # watermark advances to the max attribute value seen. The packed
+            # ts words are REPLACED by the attribute clock so ring rows carry
+            # the expiry-relevant time.
+            tcols, _ = _unpack_rows(comp_mat, self.layout)
+            comp_ts = tcols[self.ts_attr].astype(jnp.int64)
+            w = jax.lax.bitcast_convert_type(comp_ts, jnp.uint32)
+            comp_mat = comp_mat.at[:, -2].set(w[..., 0]).at[:, -1].set(
+                w[..., 1])
             wm = jnp.maximum(state.wm, jnp.max(jnp.where(
                 jnp.arange(B) < n_valid, comp_ts, jnp.int64(-(2**62)))))
             now = wm
         else:
+            comp_ts = _packed_ts(comp_mat)
             wm = state.wm
 
         appended1 = state.appended + n_valid
 
         # ---- expiry candidates: the E oldest in-window events ----
-        # Per-lane index math is int32 relative to state.expired (see
-        # _gather_rel — vectorized s64 arithmetic is emulated on TPU).
+        # One contiguous packed fetch (ring rows blended with batch rows);
+        # per-lane index math stays int32 (s64 lane math is emulated on TPU).
         pe = jnp.arange(E, dtype=jnp.int32)
         win_len1 = (appended1 - state.expired).astype(jnp.int32)
         cand_exists = pe < win_len1
-        cand_cols, cand_ts = _gather_rel(
-            state.ring_cols, state.ring_ts, comp_cols, comp_ts,
-            state.appended, state.expired, pe)
-        n_valid32 = n_valid.astype(jnp.int32)
+        cand_mat = _fetch_rel_packed(
+            state.ring, comp_mat, state.expired, state.appended, E)
+        cand_ts = _packed_ts(cand_mat)
 
         if self.time_ms is not None and self.length is None:
             # time(W): candidate expires once now >= cand_ts + W; the trigger
@@ -287,9 +440,9 @@ class SlidingWindow(WindowOp):
             # expire before processing the arrival), or end-of-batch if only
             # the final watermark covers it.
             deadline = cand_ts + jnp.int64(self.time_ms)
-            trig = jnp.searchsorted(
+            trig = searchsorted32(
                 jnp.where(jnp.arange(B) < n_valid, comp_ts, BIG), deadline,
-                side="left").astype(jnp.int32)
+                side="left")
             expires = cand_exists & (deadline <= now)
             emit_ts = deadline
         elif self.time_ms is None:
@@ -307,9 +460,9 @@ class SlidingWindow(WindowOp):
         else:
             # timeLength(W, N): expire on whichever rule fires first.
             deadline = cand_ts + jnp.int64(self.time_ms)
-            trig_time = jnp.searchsorted(
+            trig_time = searchsorted32(
                 jnp.where(jnp.arange(B) < n_valid, comp_ts, BIG), deadline,
-                side="left").astype(jnp.int32)
+                side="left")
             rel = (state.expired + jnp.int64(self.length)
                    - state.appended).astype(jnp.int32)
             trig_len = pe + rel
@@ -335,11 +488,10 @@ class SlidingWindow(WindowOp):
         keys_exp = jnp.clip(trig, 0, B) * 4 + KIND_EXPIRED
         keys_cur = p * 4 + KIND_CURRENT
 
-        all_keys = (jnp.concatenate([keys_exp, keys_cur]),
-                    jnp.concatenate([pe, p]))
-        all_cols = {k: jnp.concatenate([cand_cols[k], comp_cols[k]])
-                    for k in self.layout}
-        all_ts = jnp.concatenate([emit_ts, comp_ts])
+        all_hi = jnp.concatenate([keys_exp, keys_cur])
+        all_lo = jnp.concatenate([pe, p])
+        all_mat = jnp.concatenate([cand_mat, comp_mat], axis=0)
+        all_emit = jnp.concatenate([emit_ts, comp_ts])
         all_valid = jnp.concatenate([expires, cur_valid])
         all_types = jnp.concatenate([
             jnp.full((E,), EventType.EXPIRED, jnp.int8),
@@ -349,23 +501,19 @@ class SlidingWindow(WindowOp):
         if self.is_delay:
             # delay(W): expired lanes are re-emitted as CURRENT after the
             # delay; arrivals are swallowed (reference DelayWindowProcessor).
-            all_types = jnp.concatenate([
-                jnp.full((E,), EventType.CURRENT, jnp.int8),
-                jnp.full((B,), EventType.CURRENT, jnp.int8),
-            ])
+            all_types = jnp.full((E + B,), EventType.CURRENT, jnp.int8)
             all_valid = jnp.concatenate([expires, jnp.zeros((B,), bool)])
 
-        chunk = _sort_chunk(all_keys, all_cols, all_ts, all_valid, all_types,
-                            self.chunk_width)
+        chunk = _sort_chunk_packed(all_hi, all_lo, all_mat, all_emit,
+                                   all_valid, all_types, self.layout,
+                                   self.chunk_width)
 
         # ---- ring update ----
-        new_ring_cols, new_ring_ts = _scatter_append(
-            state.ring_cols, state.ring_ts, comp_cols, comp_ts,
-            state.appended, n_valid)
+        new_ring = _append_packed(state.ring, comp_mat, state.appended,
+                                  n_valid32)
 
         new_state = SlidingState(
-            ring_cols=new_ring_cols,
-            ring_ts=new_ring_ts,
+            ring=new_ring,
             appended=appended1,
             expired=state.expired + n_expired_new,
             wm=wm,
@@ -373,12 +521,13 @@ class SlidingWindow(WindowOp):
         return new_state, chunk
 
     def contents(self, state: SlidingState, now: jax.Array):
+        ring_cols, ring_ts = _unpack_rows(state.ring, self.layout)
         live = _ring_live_mask(self.C, state.expired, state.appended)
         if self.time_ms is not None:
             # probe-time expiry: rows past their deadline are out even if no
             # batch has flushed them yet
-            live = live & (state.ring_ts + jnp.int64(self.time_ms) > now)
-        return state.ring_cols, state.ring_ts, live
+            live = live & (ring_ts + jnp.int64(self.time_ms) > now)
+        return ring_cols, ring_ts, live
 
 
 # --------------------------------------------------------------------------- #
@@ -633,8 +782,7 @@ class TimeBatchWindow(WindowOp):
         # trigger position: first arrival in a later bucket
         I32MAX = jnp.iinfo(jnp.int32).max
         padded_buckets = jnp.where(jnp.arange(B) < n_valid, arr_bucket, I32MAX)
-        trig = jnp.searchsorted(padded_buckets, cur_bucket + 1,
-                                side="left").astype(jnp.int32)
+        trig = searchsorted32(padded_buckets, cur_bucket + 1, side="left")
         cur_keys = _emit_key(trig, KIND_CURRENT, pe, B)
 
         # RESET: one per flushed bucket — approximate with one reset per step
@@ -666,8 +814,8 @@ class TimeBatchWindow(WindowOp):
             exp_bucket = bucket_rel(exp_ts0)
             exp_emit = (pe < (state.flushed - state.prev_start).astype(jnp.int32)) & (
                 exp_bucket + 1 < flush_hi)
-            trig_e = jnp.searchsorted(padded_buckets, exp_bucket + 2,
-                                      side="left").astype(jnp.int32)
+            trig_e = searchsorted32(padded_buckets, exp_bucket + 2,
+                                    side="left")
             exp_keys = _emit_key(trig_e, KIND_EXPIRED, pe, B)
             keys.append(exp_keys)
             colss.append(exp_cols)
@@ -813,10 +961,10 @@ class SessionWindow(WindowOp):
         exp_arr = is_arr & (arr_session < session_open)
         # trigger position: first arrival of a later session (or end of batch)
         arr_sess_padded = jnp.where(is_arr, arr_session, BIG)
-        trig_ring = jnp.searchsorted(arr_sess_padded, ring_sess + 1,
-                                     side="left").astype(jnp.int64)
-        trig_arr = jnp.searchsorted(arr_sess_padded, arr_session + 1,
-                                    side="left").astype(jnp.int64)
+        trig_ring = searchsorted32(arr_sess_padded, ring_sess + 1,
+                                   side="left").astype(jnp.int64)
+        trig_arr = searchsorted32(arr_sess_padded, arr_session + 1,
+                                  side="left").astype(jnp.int64)
         keys_exp_ring = jnp.clip(trig_ring, 0, jnp.int64(B)) * 4 + KIND_EXPIRED
         keys_exp_arr = jnp.clip(trig_arr, 0, jnp.int64(B)) * 4 + KIND_EXPIRED
 

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import dtypes
+from .search import searchsorted32
 from ..core.event import EventBatch, EventType
 from ..errors import SiddhiAppCreationError
 from .windows import (
@@ -372,7 +373,7 @@ def _match(table_keys: jax.Array, query_keys: jax.Array) -> jax.Array:
     N = table_keys.shape[0]
     order = jnp.argsort(table_keys, stable=True)
     sorted_keys = table_keys[order]
-    pos = jnp.searchsorted(sorted_keys, query_keys)
+    pos = searchsorted32(sorted_keys, query_keys)
     pos_c = jnp.clip(pos, 0, N - 1)
     found = sorted_keys[pos_c] == query_keys
     return jnp.where(found, order[pos_c], N).astype(jnp.int32)

@@ -47,7 +47,8 @@ except ImportError:  # older jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.event import EventBatch
-from ..ops.groupby import KeyTable, hash_columns, init_key_table, key_lookup_or_insert
+from ..ops.groupby import (DenseKeyTable, dense_key_lookup_or_insert,
+                           hash_columns, init_dense_key_table)
 
 
 def _zero_masked(batch: EventBatch) -> EventBatch:
@@ -181,9 +182,9 @@ class PartitionedQueryStep:
             **_SHARD_KW,
         )
 
-        def full_step(states, key_table: KeyTable, batch: EventBatch, now):
+        def full_step(states, key_table: DenseKeyTable, batch: EventBatch, now):
             keys = key_fn(batch)
-            key_table, slots = key_lookup_or_insert(
+            key_table, slots = dense_key_lookup_or_insert(
                 key_table, keys, batch.valid)
             states, outs = sharded(states, batch, slots, now)
             # flatten [n_slots, C] per-slot outputs into one wide batch
@@ -200,7 +201,7 @@ class PartitionedQueryStep:
         return (
             jax.tree_util.tree_map(
                 lambda x: jax.device_put(x, sharding), stacked),
-            init_key_table(self.n_slots),
+            init_dense_key_table(self.n_slots),
         )
 
     def __call__(self, states, key_table, batch: EventBatch, now):

@@ -157,3 +157,26 @@ class TestLengthBatchDoubleFlushExpired:
         assert removes == [10, 11, 12, 13]
         ins = [e.data[1] for i, _ in got for e in i]
         assert ins == [10, 11, 12, 13, 14, 15, 16, 17]
+
+
+class TestSmallBatchSlidingWindow:
+    """Regression: the packed candidate fetch misaligned batch rows whenever
+    E (expiry lanes, min 1024 for time windows) exceeded the batch size and
+    the window held fewer than E - B events — expired lanes read zero
+    padding, emitting garbage payloads with ts = 0 + windowTime."""
+
+    def test_time_window_batch_smaller_than_expiry_lanes(self):
+        rt = build(
+            S + "@info(name='q') from S#window.time(5 sec) "
+            "select symbol, price insert all events into Out;", batch_size=8)
+        got = q_callback(rt, "q")
+        h = rt.get_input_handler("S")
+        for i in range(8):
+            h.send((f"s{i}", float(i), i), timestamp=1_000 * i)
+        rt.flush()
+        # events 0..2 are > 5 s older than ts 7000 — they expire with their
+        # real payloads; 3..7 stay current
+        cur = [e.data[0] for pair in got for e in pair[0]]
+        exp = [(e.data[0], e.data[1]) for pair in got for e in pair[1]]
+        assert cur == [f"s{i}" for i in range(8)]
+        assert exp == [(f"s{i}", float(i)) for i in range(3)]
