@@ -215,7 +215,9 @@ def bench_pattern() -> dict:
     from siddhi_tpu.core import dtypes
     from siddhi_tpu.core.event import EventBatch
 
-    pb = 1024  # pattern batch: pending capacity bounds concurrent partials
+    # device NFA time is sub-ms; tunnel dispatch overhead dominates at small
+    # batches, so run full-width batches with pending capacity to match
+    pb = BATCH
     prev_cap = dtypes.config.pattern_pending_capacity
     dtypes.config.pattern_pending_capacity = 4 * pb
     try:
@@ -291,8 +293,8 @@ def bench_join() -> dict:
     def run(i):
         l, r = lr[i % n_distinct]
         now = jnp.int64(ts0)
-        state[0], _ = qr._step_left(state[0], l, now, None)
-        state[0], out = qr._step_right(state[0], r, now, None)
+        state[0], _, _ = qr._step_left(state[0], l, now, None)
+        state[0], out, _ = qr._step_right(state[0], r, now, None)
         return out
 
     return _measure(run, 2 * BATCH, "join_100kx100k_events_per_sec")

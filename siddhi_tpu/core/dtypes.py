@@ -47,6 +47,9 @@ class _Config:
     default_table_capacity = 1 << 16
     #: max matched build rows per probe event in joins (static join fan-out).
     join_max_matches = 16
+    #: compacted pair-block width as a multiple of the probe batch size —
+    #: total matches per step beyond factor*B are dropped (bounded fan-out)
+    join_pair_cap_factor = 4
     #: max concurrent partial matches per pattern position.
     pattern_pending_capacity = 1024
     #: expansion bound for unbounded pattern counts `<m:>`.

@@ -24,7 +24,8 @@ import jax.numpy as jnp
 from ..errors import DefinitionNotExistError, SiddhiAppCreationError
 from ..extension.registry import ExtensionKind, Registry
 from ..ops.expr_compile import Scope, TypeResolver, compile_expression
-from ..ops.join import JoinPlan, plan_join, probe_cross, probe_equi
+from ..ops.join import (JoinPlan, compact_pairs, plan_join, probe_cross,
+                        probe_equi)
 from ..ops.selector import CompiledSelector
 from ..ops.window_factories import WindowFactory
 from ..ops.windows import PassThroughWindow, WindowOp
@@ -124,6 +125,9 @@ class JoinQueryRuntime:
         self.name = name
         self.registry = registry
         self.callbacks: list[QueryCallback] = []
+        self._dropped_dev = None
+        self._drop_checks = 0
+        self._drop_warned = False
         self.output_junction = None
         self.table_executor = None
         self.k_max = dtypes.config.join_max_matches
@@ -270,6 +274,21 @@ class JoinQueryRuntime:
                     build_side.ref, k_max)
             else:
                 lane, brow, pv = probe_cross(mask, b_valid, k_max)
+            # compact the sparse [B*k_max] block before any per-pair gather —
+            # frame materialization, verification, and the selector then run
+            # at ~the real match count instead of k_max x batch. Small blocks
+            # keep full width (compaction would only risk truncation there);
+            # big blocks cap at factor*B with a monitored drop counter.
+            B_probe = batch.ts.shape[0]
+            pair_cap = min(lane.shape[0],
+                           max(dtypes.config.join_pair_cap_factor * B_probe,
+                               32768))
+            if pair_cap < lane.shape[0]:
+                n_matches = jnp.sum(pv, dtype=jnp.int32)
+                dropped = jnp.maximum(n_matches - pair_cap, 0)
+                lane, brow, pv = compact_pairs(lane, brow, pv, pair_cap)
+            else:
+                dropped = jnp.int32(0)
 
             # --- pair frames ---
             p_cols = {k: v[lane] for k, v in batch.cols.items()}
@@ -338,7 +357,7 @@ class JoinQueryRuntime:
             sel, out = selector.step(sel, chunk, out_scope)
 
             new_wl, new_wr = (w_probe, w_build) if from_left else (w_build, w_probe)
-            return (new_wl, new_wr, sel), out
+            return (new_wl, new_wr, sel), out, dropped
 
         return step
 
@@ -368,7 +387,21 @@ class JoinQueryRuntime:
             w2, _ = self._append_only(side, w, batch, now)
             self.state = (w2, wr, sel) if from_left else (wl, w2, sel)
             return
-        self.state, out = step(self.state, batch, jnp.int64(now), tstate)
+        self.state, out, dropped = step(self.state, batch, jnp.int64(now),
+                                        tstate)
+        # accumulate on device; sync only at checkpoints (an int() every
+        # batch would serialize the async dispatch pipeline)
+        self._dropped_dev = (dropped if self._dropped_dev is None
+                             else self._dropped_dev + dropped)
+        self._drop_checks += 1
+        if not self._drop_warned and self._drop_checks % 64 == 0:
+            if int(self._dropped_dev) > 0:
+                import warnings
+                warnings.warn(
+                    f"join {self.name!r}: {int(self._dropped_dev)} matched "
+                    "pairs exceeded the per-step pair block and were dropped "
+                    "— raise config.join_pair_cap_factor", stacklevel=2)
+                self._drop_warned = True
         self._distribute(out, now)
 
     def _append_only(self, side, wstate, batch, now):

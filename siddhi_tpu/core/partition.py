@@ -293,9 +293,11 @@ class PartitionRuntime:
         the fullest slot (mirrors QueryRuntime._check_custom_agg_capacity)."""
         import warnings
 
-        from ..ops.groupby import KeyTable
+        from ..ops.groupby import GroupState, KeyTable
         for g in self._mesh_states[1].groups:
-            if isinstance(g, tuple) and g and isinstance(g[0], KeyTable):
+            if not (isinstance(g, tuple) and g):
+                continue
+            if isinstance(g[0], KeyTable):
                 kt = g[0]
                 cap = kt.keys.shape[-1] // 2  # hash array is 2x id capacity
                 worst = int(np.max(np.asarray(kt.count)))
@@ -304,6 +306,21 @@ class PartitionRuntime:
                         f"partition {self.name!r}: a key slot's distinctCount "
                         f"pair table is at {worst}/{cap} lifetime-unique "
                         "pairs; counts will corrupt past capacity — raise "
+                        "group_capacity", stacklevel=2)
+                elif int(np.max(np.asarray(kt.misses))) > 0:
+                    warnings.warn(
+                        f"partition {self.name!r}: key lookups exhausted "
+                        "their hash probe window and aliased group 0 — raise "
+                        "group_capacity", stacklevel=2)
+            elif isinstance(g[0], GroupState) and len(g) == 2:
+                # string-code fast path: pair table indexed by interning code
+                cap = g[0].values.shape[-1]
+                n_codes = len(self.ctx.global_strings)
+                if n_codes > int(0.85 * cap):
+                    warnings.warn(
+                        f"partition {self.name!r}: distinctCount code table "
+                        f"at {n_codes}/{cap} interned strings; codes past "
+                        "capacity are dropped from the count — raise "
                         "group_capacity", stacklevel=2)
 
     # ------------------------------------------------------------------ build

@@ -345,6 +345,12 @@ class QueryRuntime(Receiver):
                         "pairs; counts will corrupt past capacity — raise "
                         "group_capacity", stacklevel=2)
                     self._capacity_warned = True
+                elif int(kt.misses) > 0:
+                    warnings.warn(
+                        f"query {self.name!r}: {int(kt.misses)} key lookups "
+                        "exhausted their hash probe window and aliased group "
+                        "0 — raise group_capacity", stacklevel=2)
+                    self._capacity_warned = True
             elif isinstance(g[0], GroupState) and len(g) == 2:
                 # string-code fast path: pair table indexed by interning code
                 cap = g[0].values.shape[0]

@@ -320,6 +320,7 @@ class KeyTable(NamedTuple):
     keys: jax.Array  # int64[H = 2K]; _KEY_PAD marks an empty slot
     ids: jax.Array  # int32[H] dense id of the key stored at each slot
     count: jax.Array  # int32 number of live keys (ids assigned)
+    misses: jax.Array  # int32 lifetime lanes left unresolved (aliased to id 0)
 
 
 _KEY_PAD = jnp.iinfo(jnp.int64).max
@@ -340,6 +341,7 @@ def init_key_table(capacity: int) -> KeyTable:
         keys=jnp.full((H,), _KEY_PAD, dtype=jnp.int64),
         ids=jnp.zeros((H,), dtype=jnp.int32),
         count=jnp.int32(0),
+        misses=jnp.int32(0),
     )
 
 
@@ -443,7 +445,11 @@ def key_lookup_or_insert(
 
     resolved = valid & ~need
     ids = jnp.where(resolved, id_arr[slot_of], 0)
-    return KeyTable(keys=tbl, ids=id_arr, count=count), ids
+    # unresolved lanes alias id 0; the lifetime counter lets runtime monitors
+    # surface it (probe-window exhaustion is rare but nonzero even below the
+    # 85% capacity thresholds)
+    misses = table.misses + jnp.sum(valid & need, dtype=jnp.int32)
+    return KeyTable(keys=tbl, ids=id_arr, count=count, misses=misses), ids
 
 
 class DenseKeyTable(NamedTuple):
@@ -519,6 +525,27 @@ def dense_key_lookup_or_insert(
         count=jnp.minimum(table.count + n_new, K),
     )
     return new_table, ids
+
+
+def hash_columns32(cols: list[jax.Array]) -> jax.Array:
+    """32-bit column mix for candidate generation (join probes): all math in
+    u32 — the 64-bit variant's u64 multiplies are software-emulated on TPU
+    and show up at 100k-row build windows. Collisions only cost re-verified
+    candidates, never correctness (callers re-check the exact condition)."""
+    h = jnp.uint32(0x811C9DC5)
+    for c in cols:
+        if jnp.issubdtype(c.dtype, jnp.floating):
+            c = jax.lax.bitcast_convert_type(
+                c, jnp.int32 if c.dtype.itemsize == 4 else jnp.int64)
+        if c.dtype.itemsize == 8:
+            w = jax.lax.bitcast_convert_type(c, jnp.int32)
+            words = [w[..., 0], w[..., 1]]
+        else:  # 4-byte ints and bool
+            words = [c.astype(jnp.int32)]
+        for x in words:
+            h = (h ^ x.astype(jnp.uint32)) * jnp.uint32(0x01000193)
+            h = h ^ (h >> 15)
+    return h
 
 
 def hash_columns(cols: list[jax.Array]) -> jax.Array:

@@ -34,7 +34,7 @@ from ..errors import SiddhiAppCreationError
 from ..query_api.definition import AttributeType
 from ..query_api.expression import And, Compare, CompareOp, Expression, Variable
 from .expr_compile import CompiledExpr, Scope, TypeResolver, compile_expression
-from .groupby import hash_columns
+from .groupby import hash_columns32
 
 BIGKEY = jnp.uint32(0xFFFFFFFF)
 
@@ -100,7 +100,9 @@ def plan_join(on: Optional[Expression], probe_frame: str, build_frame: str,
 
 
 def _hash_exprs(keys: Sequence[CompiledExpr], scope: Scope) -> jax.Array:
-    return hash_columns([k(scope) for k in keys]).astype(jnp.uint32)
+    # avoid colliding with the BIGKEY invalid sentinel
+    h = hash_columns32([k(scope) for k in keys])
+    return jnp.where(h == BIGKEY, jnp.uint32(0xFFFFFFFE), h)
 
 
 def probe_equi(plan: JoinPlan, probe_scope: Scope, probe_valid: jax.Array,
@@ -131,6 +133,27 @@ def probe_equi(plan: JoinPlan, probe_scope: Scope, probe_valid: jax.Array,
 
     probe_lane = jnp.broadcast_to(jnp.arange(B)[:, None], (B, k_max)).reshape(-1)
     return probe_lane, build_row.reshape(-1), cand_valid.reshape(-1)
+
+
+def compact_pairs(probe_lane: jax.Array, build_row: jax.Array,
+                  pair_valid: jax.Array, pair_cap: int):
+    """Compact the sparse [B*k_max] candidate block to `pair_cap` lanes.
+
+    Matches are typically ~1 per probe event, so downstream frame gathers,
+    residual verification, and the selector would otherwise run at k_max x
+    the real pair count. One cumsum + one 2-word row scatter; candidate
+    order (probe-lane major) is preserved, keeping emission order intact.
+    Pairs beyond pair_cap are dropped (bounded fan-out, like k_max — size
+    via dtypes.config.join_pair_cap_factor)."""
+    pos = jnp.cumsum(pair_valid.astype(jnp.int32)) - 1
+    dest = jnp.where(pair_valid & (pos < pair_cap), pos, pair_cap)
+    packed = jnp.stack([probe_lane.astype(jnp.int32),
+                        build_row.astype(jnp.int32)], axis=1)
+    rows = jnp.zeros((pair_cap, 2), jnp.int32).at[dest].set(
+        packed, mode="drop")
+    n = jnp.minimum(jnp.sum(pair_valid, dtype=jnp.int32), pair_cap)
+    pv = jnp.arange(pair_cap, dtype=jnp.int32) < n
+    return rows[:, 0], rows[:, 1], pv
 
 
 def probe_cross(probe_valid: jax.Array, build_valid: jax.Array, k_max: int):

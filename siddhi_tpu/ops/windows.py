@@ -389,6 +389,10 @@ class SlidingWindow(WindowOp):
                          batch_cap)
         self.E = max_expired if max_expired is not None else (
             batch_cap if (length is not None and time_ms is None) else max(batch_cap, 1024))
+        # the packed candidate fetch slices E rows from a ring extended by E —
+        # a ring smaller than E (tiny timeLength counts) would crash at trace
+        # time or misalign once the base wraps
+        self.C = max(self.C, self.E)
         self.chunk_width = self.B + self.E
         self.W = _layout_words(layout)
 

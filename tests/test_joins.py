@@ -160,3 +160,30 @@ class TestStreamTableJoin:
         rt.get_input_handler("S").send(("ORCL", 2))
         rt.flush()
         assert got == [("IBM", 1, 75.0), ("ORCL", 2, 10.0)]
+
+
+class TestHighFanoutPairs:
+    """Regression: pair-block compaction must not truncate below the old
+    k_max-per-probe bound at small batch sizes (review finding: a 4*B cap
+    with B=4 dropped 24 of 40 matched pairs)."""
+
+    def test_all_pairs_survive_small_batches(self):
+        app = ("define stream L (k int, v int);\n"
+               "define stream R (k int, v int);\n"
+               "from L#window.length(16) join R#window.length(16) "
+               "on L.k == R.k "
+               "select L.v as lv, R.v as rv insert into OutStream;")
+        rt, got = make(app, batch_size=4)
+        lh = rt.get_input_handler("L")
+        rh = rt.get_input_handler("R")
+        # 10 build rows with the same key
+        for i in range(10):
+            rh.send((7, i))
+        rt.flush()
+        # 4 probe events, each matches all 10 build rows -> 40 pairs
+        for j in range(4):
+            lh.send((7, 100 + j))
+        rt.flush()
+        assert len(got) == 40
+        assert sorted({p[0] for p in got}) == [100, 101, 102, 103]
+        assert sorted({p[1] for p in got}) == list(range(10))
