@@ -330,8 +330,9 @@ class AggregationRuntime(Receiver):
                 bucket = bucket_start(dur, ts_src)
                 keyparts = [bucket] + [batch.cols[g] for g in group_attrs]
                 key = hash_columns(keyparts)
-                kt, ids = key_lookup_or_insert(store.key_table, key, batch.valid)
-                widx = jnp.where(batch.valid, ids, K)
+                kt, ids, kres = key_lookup_or_insert(
+                    store.key_table, key, batch.valid)
+                widx = jnp.where(batch.valid & kres, ids, K)
                 new_bucket_ts = store.bucket_ts.at[widx].set(bucket, mode="drop")
                 new_group = {g: store.group_cols[g].at[widx].set(
                     batch.cols[g], mode="drop") for g in group_attrs}
@@ -367,8 +368,8 @@ class AggregationRuntime(Receiver):
             keep = store.alive & (store.bucket_ts >= cutoff)
             keys = hash_columns([store.bucket_ts]
                                 + [store.group_cols[g] for g in group_attrs])
-            kt, ids = key_lookup_or_insert(init_key_table(K), keys, keep)
-            widx = jnp.where(keep, ids, K)
+            kt, ids, kres = key_lookup_or_insert(init_key_table(K), keys, keep)
+            widx = jnp.where(keep & kres, ids, K)
             new_bucket = jnp.zeros((K,), jnp.int64).at[widx].set(
                 store.bucket_ts, mode="drop")
             new_group = {g: jnp.zeros((K,), layout[g]).at[widx].set(

@@ -192,15 +192,21 @@ class QueryRuntime(Receiver):
         self.selector = CompiledSelector(
             query.selector, self.resolver, registry,
             ctx.effective_group_capacity, self.frame_ref,
-            select_all_attrs=select_all)
-        # sliding-window removal support check (min/max)
-        if self.is_sliding_window:
-            for _, spec, _ in self.selector.agg_specs:
-                if not spec.supports_removal:
-                    raise SiddhiAppCreationError(
-                        "min/max aggregators over sliding windows are not yet "
-                        "supported (no removal); use minForever/maxForever or a "
-                        "batch window")
+            select_all_attrs=select_all,
+            sliding_window=self.is_sliding_window)
+        if self.selector.extrema_plan:
+            # the range-query extrema path reads WINDOW contents; shapes
+            # where window membership diverges from what the aggregator may
+            # see are rejected rather than silently diverging
+            if self.post_filters:
+                raise SiddhiAppCreationError(
+                    "min()/max() over a sliding window cannot combine with a "
+                    "post-window filter (filtered rows remain in the window); "
+                    "filter before the window instead")
+            if getattr(self.window, "is_delay", False):
+                raise SiddhiAppCreationError(
+                    "min()/max() over #window.delay is not supported "
+                    "(delay re-emits expired lanes as arrivals)")
 
         # --- output stream definition ---
         self.output_attributes = tuple(
@@ -225,6 +231,8 @@ class QueryRuntime(Receiver):
             spec.custom_scan is not None for _, spec, _ in self.selector.agg_specs)
         self._batches_seen = 0
         self._capacity_warned = False
+        self._capacity_pressure = False
+        self._last_compacted_live: dict[int, int] = {}
         #: time-driven windows need heartbeats to flush expirations
         from ..ops.windows import window_has_time_semantics
         self.has_time_semantics = (
@@ -288,6 +296,7 @@ class QueryRuntime(Receiver):
                             default=True)
             batch = apply_fns(pre_fns, batch, scope)
 
+            wstate_pre = wstate
             wstate, chunk = window.step(wstate, batch, now)
 
             cscope = Scope()
@@ -297,6 +306,22 @@ class QueryRuntime(Receiver):
             for f in post_filters:
                 chunk = chunk.where_valid(
                     f(cscope) | (chunk.types != EventType.CURRENT))
+            if selector.extrema_plan:
+                # removal-capable sliding min/max: range queries over the
+                # window's arrival-order sequence (ops/extrema.py)
+                from ..ops.extrema import sliding_extrema_lanes
+                from ..ops.windows import _unpack_rows
+                ring_cols, ring_ts = _unpack_rows(wstate_pre.ring,
+                                                  window.layout)
+                rscope = Scope()
+                rscope.add_frame(
+                    frame_ref, ring_cols, ring_ts,
+                    jnp.ones(ring_ts.shape, bool), default=True)
+                rscope.extras = dict(scope.extras)
+                for slot, eop, args in selector.extrema_plan:
+                    cscope.extras[f"extrema:{slot}"] = sliding_extrema_lanes(
+                        eop, args[0](rscope), wstate_pre.expired,
+                        wstate_pre.appended, chunk, args[0](cscope))
             sstate, out = selector.step(sstate, chunk, cscope)
             rstate, out = limiter.step(rstate, out, now)
 
@@ -320,48 +345,84 @@ class QueryRuntime(Receiver):
         self._distribute(out, now)
         self.ctx.statistics.track_latency(self.name, time.perf_counter_ns() - t0)
         self._batches_seen += 1
-        if (self._has_custom_aggs and not self._capacity_warned
+        # adaptive cadence: cheap (one scalar sync) but sparse normally;
+        # tight once a table runs hot so compaction outruns overflow.
+        # Warnings are one-shot, but the checks (and their compactions)
+        # keep running for the app's lifetime.
+        interval = 4 if self._capacity_pressure else 256
+        if (self._has_custom_aggs
                 and (self._batches_seen in (1, 16, 64)
-                     or self._batches_seen % 256 == 0)):
+                     or self._batches_seen % interval == 0)):
             self._check_custom_agg_capacity()
 
     def _check_custom_agg_capacity(self) -> None:
-        """distinctCount's (group,value) pair table is append-only (zeroed
-        pairs keep their slot, unlike the reference's HashMap entry removal);
-        warn before lifetime-unique pairs overflow and alias slot 0."""
+        """distinctCount's (group,value) pair table is append-only inside
+        the jitted step (zeroed pairs keep their slot, unlike the reference's
+        HashMap entry removal). At 85% occupancy the monitor COMPACTS it —
+        rebuilding with only live pairs (ops/aggregators.py
+        compact_distinct_state) — and only warns if live pairs alone still
+        exceed capacity."""
+        import dataclasses as dc
         import warnings
 
+        from ..ops.aggregators import compact_distinct_state
         from ..ops.groupby import GroupState, KeyTable
-        for g in self.state[1].groups:
+        pressure = False
+        for gi, g in enumerate(self.state[1].groups):
             if not (isinstance(g, tuple) and g):
                 continue
             if isinstance(g[0], KeyTable):
                 kt = g[0]
                 cap = kt.keys.shape[0] // 2  # hash array is 2x id capacity
-                if int(kt.count) > int(0.85 * cap):
-                    warnings.warn(
-                        f"query {self.name!r}: distinctCount pair table at "
-                        f"{int(kt.count)}/{cap} lifetime-unique (group,value) "
-                        "pairs; counts will corrupt past capacity — raise "
-                        "group_capacity", stacklevel=2)
-                    self._capacity_warned = True
-                elif int(kt.misses) > 0:
+                count = int(kt.count)
+                pressure = pressure or count > int(0.5 * cap)
+                # compact early enough that the table cannot fill (and
+                # start dropping pairs) between checks — but only when
+                # enough NEW pairs arrived since the last rebuild that dead
+                # ones can plausibly be reclaimed (a steady 0.6*cap LIVE
+                # set must not trigger an O(cap) rebuild every check)
+                grown = count - self._last_compacted_live.get(gi, 0)
+                if (count > int(0.85 * cap)
+                        or (count > int(0.5 * cap)
+                            and grown > int(0.2 * cap))):
+                    sstate = self.state[1]
+                    epoch = int(sstate.epoch)
+                    new_g = compact_distinct_state(g, epoch)
+                    groups = list(sstate.groups)
+                    groups[gi] = new_g
+                    self.state = (self.state[0],
+                                  dc.replace(sstate, groups=groups),
+                                  self.state[2])
+                    kt = new_g[0]
+                    self._last_compacted_live[gi] = int(kt.count)
+                    if (int(kt.count) > int(0.85 * cap)
+                            and not self._capacity_warned):
+                        warnings.warn(
+                            f"query {self.name!r}: distinctCount pair table "
+                            f"still at {int(kt.count)}/{cap} LIVE "
+                            "(group,value) pairs after compaction; counts "
+                            "will corrupt past capacity — raise "
+                            "group_capacity", stacklevel=2)
+                        self._capacity_warned = True
+                elif int(kt.misses) > 0 and not self._capacity_warned:
                     warnings.warn(
                         f"query {self.name!r}: {int(kt.misses)} key lookups "
-                        "exhausted their hash probe window and aliased group "
-                        "0 — raise group_capacity", stacklevel=2)
+                        "could not be placed and their events were dropped "
+                        "from the aggregate — raise group_capacity",
+                        stacklevel=2)
                     self._capacity_warned = True
             elif isinstance(g[0], GroupState) and len(g) == 2:
                 # string-code fast path: pair table indexed by interning code
                 cap = g[0].values.shape[0]
                 n_codes = len(self.ctx.global_strings)
-                if n_codes > int(0.85 * cap):
+                if n_codes > int(0.85 * cap) and not self._capacity_warned:
                     warnings.warn(
                         f"query {self.name!r}: distinctCount code table at "
                         f"{n_codes}/{cap} interned strings; codes past "
                         "capacity are dropped from the count — raise "
                         "group_capacity", stacklevel=2)
                     self._capacity_warned = True
+        self._capacity_pressure = pressure
 
     def _distribute(self, out: EventBatch, now: int) -> None:
         action = self.query.output_stream.action
@@ -374,6 +435,14 @@ class QueryRuntime(Receiver):
                 debugger.check_break_point(
                     self.name, QueryTerminal.OUT,
                     out.to_host_events(self.output_codec))
+
+        if self.selector.host_uuid_slots:
+            # fresh uuid4 per emitted lane per UUID() slot (reference
+            # UUIDFunctionExecutor), interned into the app string table so
+            # EVERY consumer — query/stream callbacks, downstream queries,
+            # tables, sinks — sees real values. Costs one host round trip
+            # per batch; UUID generation is inherently a host concept.
+            out = self._intern_uuid_columns(out)
 
         if self.callbacks:
             # callbacks see exactly what the query emits (reference:
@@ -396,6 +465,22 @@ class QueryRuntime(Receiver):
                         OutputAction.UPDATE_OR_INSERT) and self.table_executor is not None:
             fwd = self._select_event_type(out, etype)
             self.table_executor.apply(fwd)
+
+    def _intern_uuid_columns(self, out: EventBatch) -> EventBatch:
+        import dataclasses as dc
+        import uuid as _uuid
+
+        import numpy as np
+        valid = np.asarray(out.valid)
+        idx = np.nonzero(valid)[0]
+        cols = dict(out.cols)
+        for slot in self.selector.host_uuid_slots:
+            tbl = self.output_codec.string_tables[slot]
+            codes = np.zeros(out.capacity, np.int32)
+            for i in idx:
+                codes[i] = tbl.encode(str(_uuid.uuid4()))
+            cols[slot] = jnp.asarray(codes)
+        return dc.replace(out, cols=cols)
 
     @staticmethod
     def _select_event_type(out: EventBatch, etype: OutputEventType) -> EventBatch:

@@ -21,6 +21,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core import dtypes
 from ..errors import SiddhiAppCreationError
@@ -58,6 +59,10 @@ class AggregatorSpec:
     #: state layouts.
     init_custom: Optional[Callable] = None
     custom_scan: Optional[Callable] = None
+    #: 'min'/'max' — marks true-extrema aggregators so sliding-window
+    #: planners can swap in the removal-capable range-query path (the
+    #: monotone component scan cannot undo removals)
+    extrema_op: Optional[str] = None
 
 
 class AggregatorFactory:
@@ -111,7 +116,8 @@ def _make_minmax(op: str):
         dt = dtypes.device_dtype(t)
         comp = Component(dtype=dt, delta=lambda v, sign: v.astype(dt), op=op,
                          ignore_removal=True)
-        return AggregatorSpec((comp,), lambda cs: cs[0], t, supports_removal=False)
+        return AggregatorSpec((comp,), lambda cs: cs[0], t,
+                              supports_removal=False, extrema_op=op)
 
     return make
 
@@ -223,7 +229,10 @@ def _make_distinct_count(arg_types):
             return (pair_counts2, distinct2), out
         kt, pair_counts, distinct = state
         pk = hash_columns([slots.astype(jnp.int64), arg_vals[0]])
-        kt2, pair_slots = key_lookup_or_insert(kt, pk, lane_valid)
+        kt2, pair_slots, kres = key_lookup_or_insert(kt, pk, lane_valid)
+        # drop unresolved lanes entirely (pair table exhausted — monitored
+        # truncation) instead of corrupting pair slot 0
+        lane_valid = lane_valid & kres
         pair_counts2, pair_post = grouped_scan(
             pair_counts, pair_slots, deltas, lane_valid, resets, epoch,
             op="sum")
@@ -242,6 +251,83 @@ def _make_distinct_count(arg_types):
                           init_custom=init_custom, custom_scan=custom_scan)
 
 
+_COMPACTION_INSERT = None
+
+
+def _compaction_insert():
+    """Module-cached jitted insert — a fresh jax.jit wrapper per compaction
+    would retrace/recompile every time."""
+    global _COMPACTION_INSERT
+    if _COMPACTION_INSERT is None:
+        from .groupby import key_lookup_or_insert
+        _COMPACTION_INSERT = jax.jit(key_lookup_or_insert)
+    return _COMPACTION_INSERT
+
+
+def compact_distinct_state(state, current_epoch: int):
+    """Evict dead pairs from a distinctCount hash-path state tuple.
+
+    The pair table is append-only inside the jitted step (zeroed pairs keep
+    their slot, unlike the reference's HashMap entry removal) — lifetime-
+    unique (group,value) pairs eventually fill it. This host-triggered
+    rebuild re-inserts only LIVE pairs (count != 0 at the current epoch)
+    into a fresh table, reclaiming every dead slot. Mirrors the reference's
+    natural HashMap removal and AggregationRuntime-style eviction rebuilds.
+
+    Called by the runtime's capacity monitor, never from inside a step.
+    """
+    from .groupby import GroupState, init_key_table, key_lookup_or_insert
+
+    kt, pair_counts, distinct = state
+    H = kt.keys.shape[0]
+    K = H // 2
+    keys = np.asarray(kt.keys)
+    ids = np.asarray(kt.ids)
+    vals = np.asarray(pair_counts.values)
+    eps = np.asarray(pair_counts.epoch)
+    occupied = keys != np.iinfo(np.int64).max
+    live = occupied & (vals[ids] != 0) & (eps[ids] == current_epoch)
+    live_keys = keys[live]
+    live_vals = vals[ids[live]]
+
+    fresh = init_key_table(K)
+    new_vals = np.zeros((K,), vals.dtype)
+    insert = _compaction_insert()
+    CH = 65536
+    n = live_keys.shape[0]
+    for i in range(0, max(n, 1), CH):
+        chunk = live_keys[i:i + CH]
+        if chunk.shape[0] == 0:
+            break
+        pad = CH - chunk.shape[0]
+        ck = jnp.asarray(np.pad(chunk, (0, pad)))
+        cv = jnp.ones((CH,), bool).at[CH - pad:].set(False) if pad else \
+            jnp.ones((CH,), bool)
+        fresh, new_ids, ok = insert(fresh, ck, cv)
+        new_ids = np.asarray(new_ids)[:chunk.shape[0]]
+        ok = np.asarray(ok)[:chunk.shape[0]]
+        new_vals[new_ids[ok]] = live_vals[i:i + CH][ok]
+
+    dt = pair_counts.values.dtype
+    rebuilt = GroupState(
+        values=jnp.asarray(new_vals, dt),
+        epoch=jnp.full((K,), current_epoch,
+                       pair_counts.epoch.dtype))
+    return (fresh, rebuilt, distinct)
+
+
+def _make_union_set(arg_types):
+    """unionSet(set) — reference UnionSetAttributeAggregatorExecutor
+    aggregates java.util.Sets. Host-opaque objects cannot ride device
+    streams; the supported composition sizeOfSet(unionSet(createSet(x)))
+    is rewritten to an exact distinctCount at plan time (ops/selector.py
+    _rewrite_set_idioms) before this factory would ever run."""
+    raise SiddhiAppCreationError(
+        "unionSet() emitting a raw set is not supported on this engine; "
+        "use sizeOfSet(unionSet(...)), which compiles to an exact distinct "
+        "count on device")
+
+
 def register_all() -> None:
     reg = lambda name, make: GLOBAL.register(  # noqa: E731
         ExtensionKind.AGGREGATOR, "", name, AggregatorFactory(make))
@@ -256,6 +342,7 @@ def register_all() -> None:
     reg("and", _make_bool_and)
     reg("or", _make_bool_or)
     reg("distinctCount", _make_distinct_count)
+    reg("unionSet", _make_union_set)
 
 
 register_all()

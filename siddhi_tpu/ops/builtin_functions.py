@@ -132,7 +132,36 @@ def _make_math_unary(jfn, out=_T.DOUBLE):
     return make
 
 
+def _make_uuid(arg_types):
+    """UUID() — reference UUIDFunctionExecutor. Random identifiers are a
+    host concept: device lanes carry a placeholder string code and the
+    runtime substitutes a fresh uuid4 per event at the host boundary
+    (callbacks/sinks). Chaining UUID output through further device queries
+    yields null — documented divergence (docs/PARITY.md)."""
+    # reached only when UUID() is NOT a top-level SELECT attribute — the
+    # selector substitutes those before compilation (ops/selector.py)
+    raise SiddhiAppCreationError(
+        "UUID() is only supported as a top-level SELECT attribute "
+        "(host-boundary substitution); it cannot feed other expressions")
+
+
+def _make_create_set(arg_types):
+    raise SiddhiAppCreationError(
+        "createSet() produces a host-opaque set object; on this engine only "
+        "the sizeOfSet(unionSet(createSet(x))) composition is supported — "
+        "it compiles to an exact distinct count on device")
+
+
+def _make_size_of_set(arg_types):
+    raise SiddhiAppCreationError(
+        "sizeOfSet() over an arbitrary set attribute is not supported; "
+        "sizeOfSet(unionSet(...)) compiles to an exact distinct count")
+
+
 def register_all() -> None:
+    _register("UUID", _make_uuid)
+    _register("createSet", _make_create_set)
+    _register("sizeOfSet", _make_size_of_set)
     _register("convert", _make_convert)
     _register("cast", _make_cast)
     _register("ifThenElse", _make_if_then_else)

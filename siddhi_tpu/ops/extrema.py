@@ -1,0 +1,81 @@
+"""Removal-capable min/max over sliding windows.
+
+Reference: core/query/selector/attribute/aggregator/
+MinAttributeAggregatorExecutor.java:132-154 (and Max...) keep a sorted
+multiset so processRemove can surface the next extremum when the current one
+expires. A multiset is hostile to SIMD; the TPU observation is that a FIFO
+sliding window's contents at any point in event order are a CONTIGUOUS RANGE
+of the arrival sequence, so per-event extrema are range-min/max queries:
+
+  1. materialize the window's arrival-order value sequence (ring rolled to
+     the expiry frontier via one doubled-ring slice + this batch's arrivals
+     scattered behind it);
+  2. build a sparse table — log2(N) levels of shifted min/max, pure vector
+     ops;
+  3. each chunk lane's (l, r) range comes from running counts of EXPIRED /
+     CURRENT lanes in emission order; its extremum is the classic two-probe
+     RMQ lookup, one gather pair for the whole chunk.
+
+Per-step cost is O(N log N) vector work with no data-dependent control flow.
+Grouped variants are not expressible this way (per-group ranges are not
+contiguous in arrival order) — the planner rejects them.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.event import EventBatch, EventType
+from .groupby import _op_max, _op_min
+
+
+def sliding_extrema_lanes(
+    op: str,  # 'min' | 'max'
+    ring_vals: jax.Array,  # [C] arg values over ring rows, slot order
+    expired: jax.Array,  # int64 pre-step expiry frontier (overall idx)
+    appended: jax.Array,  # int64 pre-step append frontier
+    chunk: EventBatch,  # the window's emission chunk
+    cur_vals: jax.Array,  # [L] arg values over chunk rows
+) -> jax.Array:
+    """Per-chunk-lane window extremum after that lane's add/remove applies."""
+    combine, identity = (_op_min if op == "min" else _op_max)(ring_vals.dtype)
+    C = ring_vals.shape[0]
+    L = chunk.capacity
+    N = C + L
+
+    winlen0 = (appended - expired).astype(jnp.int32)
+    base = (expired % C).astype(jnp.int32)
+    arr = jax.lax.dynamic_slice(
+        jnp.concatenate([ring_vals, ring_vals]), (base,), (C,))
+
+    is_cur = chunk.valid & (chunk.types == EventType.CURRENT)
+    is_exp = chunk.valid & (chunk.types == EventType.EXPIRED)
+    cc = jnp.cumsum(is_cur.astype(jnp.int32))
+    ce = jnp.cumsum(is_exp.astype(jnp.int32))
+
+    A = jnp.concatenate([arr, jnp.full((L,), identity, ring_vals.dtype)])
+    dest = jnp.where(is_cur, winlen0 + cc - 1, N)
+    A = A.at[dest].set(cur_vals.astype(ring_vals.dtype), mode="drop")
+
+    # sparse table: level k holds extrema over [i, i + 2^k)
+    levels = [A]
+    span = 1
+    while span < N:
+        prev = levels[-1]
+        shifted = jnp.concatenate(
+            [prev[span:], jnp.full((span,), identity, prev.dtype)])
+        levels.append(combine(prev, shifted))
+        span *= 2
+    M = jnp.stack(levels)  # [n_levels, N]
+    flat = M.reshape(-1)
+
+    l = ce  # expired lanes include their own removal
+    r = winlen0 + cc  # current lanes include their own arrival
+    length = r - l
+    k = 31 - jax.lax.clz(jnp.maximum(length, 1))
+    off = jnp.left_shift(jnp.int32(1), k)
+    g1 = flat[k * N + jnp.clip(l, 0, N - 1)]
+    g2 = flat[k * N + jnp.clip(r - off, 0, N - 1)]
+    out = combine(g1, g2)
+    return jnp.where(length > 0, out, jnp.full_like(out, identity))

@@ -347,12 +347,14 @@ def init_key_table(capacity: int) -> KeyTable:
 
 def key_lookup_or_insert(
     table: KeyTable, keys: jax.Array, valid: jax.Array
-) -> tuple[KeyTable, jax.Array]:
+) -> tuple[KeyTable, jax.Array, jax.Array]:
     """Resolve each lane's key to a dense id, inserting unseen keys.
 
-    Returns (new_table, ids[L]). Invalid lanes get id 0 (callers mask them).
-    Overflow beyond the id capacity silently reuses id 0 — callers size K
-    generously and monitor table.count.
+    Returns (new_table, ids[L], resolved[L]). Invalid lanes get id 0 and
+    resolved=False. Lanes whose key could not be placed (id space or probe
+    window exhausted) also come back unresolved — callers must DROP them
+    from downstream scans (monitored truncation via table.misses) rather
+    than let them alias id 0.
 
     Parallel-insert race (two lanes claiming one empty slot) resolves
     deterministically: both scatter with `.min(key)`, the smaller key wins
@@ -424,11 +426,15 @@ def key_lookup_or_insert(
                 & (sw < H))
         rank = (jnp.cumsum(uniq.astype(jnp.int32)) - 1).astype(jnp.int32)
         new_id = (count + rank).astype(jnp.int32)
-        # ids past the id capacity alias 0 (documented overflow; count
-        # saturates)
-        stored_id = jnp.where(new_id < K, new_id, jnp.int32(0))
-        id_arr = id_arr.at[jnp.where(uniq, sw, H)].set(stored_id, mode="drop")
-        n_new = jnp.sum(uniq, dtype=jnp.int32)
+        # entries past the id capacity are REVERTED to empty slots (leaving
+        # them stored with an aliased id would corrupt group 0 and make dead
+        # pairs look live to the compactor); their lanes count as misses via
+        # the final verification gather below
+        over = uniq & (new_id >= K)
+        tbl = tbl.at[jnp.where(over, sw, H)].set(_KEY_PAD, mode="drop")
+        keep = uniq & (new_id < K)
+        id_arr = id_arr.at[jnp.where(keep, sw, H)].set(new_id, mode="drop")
+        n_new = jnp.sum(keep, dtype=jnp.int32)
         return tbl, id_arr, jnp.minimum(count + n_new, jnp.int32(K)), need, \
             slot_of
 
@@ -443,13 +449,18 @@ def key_lookup_or_insert(
         (table.keys, table.ids, table.count, need, slot_of, won, has_empty,
          eslot))
 
-    resolved = valid & ~need
+    # final verification: a lane is resolved only if its slot still stores
+    # its key (overflow reverts and races can undo an apparent win)
+    t32 = jax.lax.bitcast_convert_type(tbl, jnp.int32)[slot_of]
+    final_ok = (t32[:, 0] == halves[:, 0]) & (t32[:, 1] == halves[:, 1])
+    resolved = valid & ~need & final_ok
     ids = jnp.where(resolved, id_arr[slot_of], 0)
     # unresolved lanes alias id 0; the lifetime counter lets runtime monitors
-    # surface it (probe-window exhaustion is rare but nonzero even below the
-    # 85% capacity thresholds)
-    misses = table.misses + jnp.sum(valid & need, dtype=jnp.int32)
-    return KeyTable(keys=tbl, ids=id_arr, count=count, misses=misses), ids
+    # surface it (id-space exhaustion or probe-window exhaustion — rare but
+    # nonzero even below the 85% capacity thresholds)
+    misses = table.misses + jnp.sum(valid & ~resolved, dtype=jnp.int32)
+    return (KeyTable(keys=tbl, ids=id_arr, count=count, misses=misses),
+            ids, resolved)
 
 
 class DenseKeyTable(NamedTuple):

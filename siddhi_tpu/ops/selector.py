@@ -59,6 +59,34 @@ from .groupby import (
 AGG_FRAME = "__agg__"
 
 
+def _rewrite_set_idioms(expr: Expression) -> Expression:
+    """`sizeOfSet(unionSet(createSet(x)))` (reference:
+    UnionSetAttributeAggregatorExecutor + CreateSet/SizeOfSet function
+    executors) compiles to an EXACT distinct count on device — the set is
+    never materialized. Raw set emission stays host-opaque and is rejected
+    at plan time with guidance (see the unionSet registry entry)."""
+    if isinstance(expr, AttributeFunction):
+        if not expr.namespace and expr.name == "sizeOfSet" and expr.parameters:
+            inner = expr.parameters[0]
+            if (isinstance(inner, AttributeFunction) and not inner.namespace
+                    and inner.name == "unionSet" and inner.parameters):
+                arg = inner.parameters[0]
+                if (isinstance(arg, AttributeFunction) and not arg.namespace
+                        and arg.name == "createSet" and arg.parameters):
+                    arg = arg.parameters[0]
+                return AttributeFunction("", "distinctCount",
+                                         (_rewrite_set_idioms(arg),))
+        return AttributeFunction(
+            expr.namespace, expr.name,
+            tuple(_rewrite_set_idioms(p) for p in expr.parameters))
+    for field in ("left", "right", "expression"):
+        sub = getattr(expr, field, None)
+        if isinstance(sub, Expression):
+            expr = dataclasses.replace(
+                expr, **{field: _rewrite_set_idioms(sub)})
+    return expr
+
+
 def _rewrite_aggregators(expr: Expression, registry: Registry, found: list):
     """Replace aggregator AttributeFunction nodes with Variables into the
     __agg__ frame; collect (name, node) into `found`. Mirrors the reference's
@@ -122,6 +150,7 @@ class CompiledSelector:
         chunk_frame: str,
         select_all_attrs: Optional[list[tuple[str, AttributeType]]] = None,
         emit_final_per_group: bool = False,
+        sliding_window: bool = False,
     ):
         self.registry = registry
         self.group_capacity = group_capacity
@@ -141,17 +170,40 @@ class CompiledSelector:
                 raise SiddhiAppCreationError("select * needs input attribute list")
             from ..query_api.execution import OutputAttribute
             attrs = tuple(OutputAttribute(n, Variable(n)) for n, _ in select_all_attrs)
-        rewritten = [(a.rename, _rewrite_aggregators(a.expression, registry, agg_nodes))
+        rewritten = [(a.rename,
+                      _rewrite_aggregators(_rewrite_set_idioms(a.expression),
+                                           registry, agg_nodes))
                      for a in attrs]
+        #: output slots whose value is generated host-side per event at the
+        #: host boundary (UUID — reference UUIDFunctionExecutor); device
+        #: lanes carry a placeholder code
+        self.host_uuid_slots: list[str] = []
+        for i, (name, e) in enumerate(rewritten):
+            if (isinstance(e, AttributeFunction) and not e.namespace
+                    and e.name == "UUID"):
+                self.host_uuid_slots.append(name or f"UUID{i}")
 
         # --- aggregator specs ---
         self.agg_specs: list[tuple[str, AggregatorSpec, list[CompiledExpr]]] = []
+        #: sliding-window true extrema: (slot, 'min'|'max', arg exprs) — the
+        #: query runtime computes these as range queries over the window's
+        #: arrival-order sequence (reference: Min/MaxAttributeAggregator
+        #: processRemove) and injects per-lane values via scope extras
+        self.extrema_plan: list[tuple[str, str, list[CompiledExpr]]] = []
         for slot_name, node in agg_nodes:
             factory = registry.require(ExtensionKind.AGGREGATOR, node.namespace, node.name)
             assert isinstance(factory, AggregatorFactory)
             args = [compile_expression(p, resolver, registry) for p in node.parameters]
             spec = factory.make(tuple(a.type for a in args))
+            if sliding_window and spec.extrema_op is not None:
+                if selector.group_by:
+                    raise SiddhiAppCreationError(
+                        f"{spec.extrema_op}() with GROUP BY over a sliding "
+                        "window is not yet supported (per-group removal); "
+                        "use minForever/maxForever or a batch window")
+                self.extrema_plan.append((slot_name, spec.extrema_op, args))
             self.agg_specs.append((slot_name, spec, args))
+        self._extrema_slots = {s for s, _, _ in self.extrema_plan}
         self.has_aggregators = bool(self.agg_specs)
 
         # --- resolver extended with the __agg__ frame ---
@@ -160,9 +212,18 @@ class CompiledSelector:
                              for slot, spec, _ in self.agg_specs}
         self.resolver = TypeResolver(frames, resolver.default_frame, resolver.codecs)
 
-        self.out_exprs: list[tuple[str, CompiledExpr]] = [
-            (name, compile_expression(e, self.resolver, registry))
-            for name, e in rewritten]
+        self.out_exprs: list[tuple[str, CompiledExpr]] = []
+        for name, e in rewritten:
+            if name in self.host_uuid_slots:
+                # placeholder string code; the runtime substitutes uuid4()
+                # per event at the host boundary
+                self.out_exprs.append((name, CompiledExpr(
+                    lambda s: jnp.zeros(
+                        s.ts[s.default_frame].shape, jnp.int32),
+                    AttributeType.STRING)))
+            else:
+                self.out_exprs.append(
+                    (name, compile_expression(e, self.resolver, registry)))
         self.out_types: dict[str, AttributeType] = {
             name: ce.type for name, ce in self.out_exprs}
 
@@ -190,7 +251,9 @@ class CompiledSelector:
         groups = []
         K = self.group_capacity if self.group_vars else 1
         any_fused = False
-        for _, spec, _ in self.agg_specs:
+        for slot_name, spec, _ in self.agg_specs:
+            if slot_name in self._extrema_slots:
+                continue  # runtime-computed; no device state
             if spec.custom_scan is not None:
                 groups.append(spec.init_custom(
                     self.group_capacity, grouped=bool(self.group_vars)))
@@ -230,8 +293,12 @@ class CompiledSelector:
             else:
                 key_cols = [scope.col(ref, attr) for ref, attr, _ in self.group_vars]
                 hashed = hash_columns(key_cols)
-                new_key_table, slots = key_lookup_or_insert(
+                new_key_table, slots, kres = key_lookup_or_insert(
                     state.key_table, hashed, data_valid)
+                # unresolved lanes (key table exhausted) must not alias
+                # group 0: sentinel slots sort out of every segment scan
+                # (monitored truncation via the table's miss counter)
+                slots = jnp.where(kres, slots, jnp.int32(self.group_capacity))
         else:
             slots = jnp.zeros((L,), jnp.int32)
 
@@ -249,7 +316,14 @@ class CompiledSelector:
         fused_deltas: list = []
         any_reset = is_reset
         no_reset = jnp.zeros((L,), bool)
+        extrema_values: dict[str, jax.Array] = {}
         for slot_name, spec, args in self.agg_specs:
+            if slot_name in self._extrema_slots:
+                # per-lane window extrema computed by the query runtime
+                # (range queries over the window's arrival-order sequence)
+                extrema_values[slot_name] = scope.extras[
+                    f"extrema:{slot_name}"]
+                continue
             arg_vals = [a(scope) for a in args] if args else [None]
             if spec.custom_scan is not None:
                 g, out_vals = spec.custom_scan(
@@ -305,7 +379,7 @@ class CompiledSelector:
             for i, o in zip(fused_idx, f_outs):
                 results[i] = o
 
-        agg_values: dict[str, jax.Array] = {}
+        agg_values: dict[str, jax.Array] = dict(extrema_values)
         for slot_name, spec, comp_gis in pending:
             if spec.custom_scan is not None:
                 agg_values[slot_name] = results[comp_gis[0]]

@@ -1,0 +1,123 @@
+"""UUID / set-idiom builtins + planner-resolved time functions (reference:
+core/executor/function/ UUIDFunctionExecutor, CreateSetFunctionExecutor,
+SizeOfSetFunctionExecutor, EventTimestampFunctionExecutor,
+CurrentTimeMillisFunctionExecutor; UnionSetAttributeAggregatorExecutor)."""
+
+import re
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.errors import SiddhiAppCreationError
+
+S = "define stream S (symbol string, price double);\n"
+
+
+def build(app, batch_size=4):
+    rt = SiddhiManager().create_siddhi_app_runtime(
+        "@app:playback\n" + app, batch_size=batch_size)
+    rt.start()
+    return rt
+
+
+def collect(rt, name="q"):
+    got = []
+    rt.add_query_callback(name, lambda ts, i, r: got.extend(
+        tuple(e.data) for e in i or []))
+    return got
+
+
+class TestUUID:
+    def test_uuid_per_event(self):
+        rt = build(S + "@info(name='q') from S select UUID() as id, symbol "
+                   "insert into Out;")
+        got = collect(rt)
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0), timestamp=1)
+        h.send(("b", 2.0), timestamp=2)
+        rt.flush()
+        assert len(got) == 2
+        pat = re.compile(
+            r"^[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{12}$")
+        assert all(pat.match(r[0]) for r in got)
+        assert got[0][0] != got[1][0]  # fresh per event
+        assert [r[1] for r in got] == ["a", "b"]
+
+    def test_uuid_nested_rejected(self):
+        with pytest.raises(SiddhiAppCreationError):
+            build(S + "@info(name='q') from S "
+                  "select convert(UUID(), 'string') as x insert into Out;")
+
+
+class TestSetIdioms:
+    def test_size_of_union_set_is_exact_distinct(self):
+        rt = build(S + "@info(name='q') from S#window.lengthBatch(4) "
+                   "select sizeOfSet(unionSet(createSet(symbol))) as n "
+                   "insert into Out;")
+        got = collect(rt)
+        h = rt.get_input_handler("S")
+        for sym in ["a", "b", "a", "c"]:
+            h.send((sym, 1.0), timestamp=1)
+        rt.flush()
+        # per-event running distinct within the batch window
+        assert [r[0] for r in got] == [1, 2, 2, 3]
+
+    def test_size_of_union_set_without_create_set(self):
+        rt = build(S + "@info(name='q') from S#window.lengthBatch(2) "
+                   "select sizeOfSet(unionSet(symbol)) as n insert into Out;")
+        got = collect(rt)
+        h = rt.get_input_handler("S")
+        h.send(("x", 1.0), timestamp=1)
+        h.send(("x", 2.0), timestamp=2)
+        rt.flush()
+        assert [r[0] for r in got] == [1, 1]
+
+    def test_raw_union_set_rejected_with_guidance(self):
+        with pytest.raises(SiddhiAppCreationError, match="sizeOfSet"):
+            build(S + "@info(name='q') from S "
+                  "select unionSet(createSet(symbol)) as s insert into Out;")
+
+    def test_raw_create_set_rejected(self):
+        with pytest.raises(SiddhiAppCreationError, match="createSet"):
+            build(S + "@info(name='q') from S select createSet(symbol) as s "
+                  "insert into Out;")
+
+
+class TestPlannerTimeFunctions:
+    def test_event_timestamp(self):
+        rt = build(S + "@info(name='q') from S "
+                   "select eventTimestamp() as ts, symbol insert into Out;")
+        got = collect(rt)
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0), timestamp=123)
+        h.send(("b", 2.0), timestamp=456)
+        rt.flush()
+        assert [r[0] for r in got] == [123, 456]
+
+    def test_current_time_millis_is_watermark(self):
+        rt = build(S + "@info(name='q') from S "
+                   "select currentTimeMillis() as now, symbol insert into Out;")
+        got = collect(rt)
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0), timestamp=777)
+        rt.flush()
+        assert got[0][0] >= 777
+
+
+class TestUUIDForwarding:
+    def test_uuid_reaches_stream_callbacks_and_tables(self):
+        rt = build("define stream S (symbol string);\n"
+                   "define table T (id string, symbol string);\n"
+                   "@info(name='q') from S select UUID() as id, symbol "
+                   "insert into Mid;\n"
+                   "from Mid select id, symbol insert into T;\n")
+        seen = []
+        rt.add_callback("Mid", lambda evs: seen.extend(e.data for e in evs))
+        h = rt.get_input_handler("S")
+        h.send(("a",), timestamp=1)
+        rt.flush()
+        pat = re.compile(r"^[0-9a-f]{8}-")
+        assert len(seen) == 1 and pat.match(seen[0][0])
+        rows = rt.query("from T select id, symbol")
+        assert len(rows) == 1 and pat.match(rows[0].data[0])
+        assert rows[0].data[0] == seen[0][0]  # one uuid per event, everywhere

@@ -1,0 +1,103 @@
+"""Removal-capable min/max over sliding windows (reference:
+core/query/selector/attribute/aggregator/MinAttributeAggregatorExecutor.java
+processAdd/processRemove; query/aggregator AggregatorTestCases)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.errors import SiddhiAppCreationError
+
+S = "define stream S (symbol string, price double, volume long);\n"
+
+
+def build(app, batch_size=4):
+    rt = SiddhiManager().create_siddhi_app_runtime(
+        "@app:playback\n" + app, batch_size=batch_size)
+    rt.start()
+    return rt
+
+
+def collect(rt, name="q"):
+    got = []
+    rt.add_query_callback(name, lambda ts, i, r: got.extend(
+        tuple(e.data) for e in i or []))
+    return got
+
+
+class TestSlidingMin:
+    def test_length_window_min_recovers_after_eviction(self):
+        rt = build(S + "@info(name='q') from S#window.length(3) "
+                   "select min(price) as mn insert into Out;")
+        got = collect(rt)
+        h = rt.get_input_handler("S")
+        for i, p in enumerate([5.0, 1.0, 7.0, 9.0, 8.0, 2.0]):
+            h.send(("s", p, i), timestamp=i)
+        rt.flush()
+        # windows: [5] [5,1] [5,1,7] [1,7,9] [7,9,8] [9,8,2]
+        assert [r[0] for r in got] == [5.0, 1.0, 1.0, 1.0, 7.0, 2.0]
+
+    def test_length_window_max(self):
+        rt = build(S + "@info(name='q') from S#window.length(2) "
+                   "select max(price) as mx insert into Out;")
+        got = collect(rt)
+        h = rt.get_input_handler("S")
+        for i, p in enumerate([5.0, 9.0, 1.0, 3.0, 2.0]):
+            h.send(("s", p, i), timestamp=i)
+        rt.flush()
+        # windows: [5] [5,9] [9,1] [1,3] [3,2]
+        assert [r[0] for r in got] == [5.0, 9.0, 9.0, 3.0, 3.0]
+
+    def test_time_window_min_expiry_via_heartbeat(self):
+        rt = build(S + "@info(name='q') from S#window.time(5 sec) "
+                   "select min(price) as mn insert into Out;")
+        got = collect(rt)
+        h = rt.get_input_handler("S")
+        h.send(("s", 1.0, 0), timestamp=1_000)
+        h.send(("s", 4.0, 1), timestamp=2_000)
+        rt.flush()
+        assert [r[0] for r in got] == [1.0, 1.0]
+        # ts 1000 expires at 6000 (before the 6500 arrival processes), so
+        # the arrival lane sees min{4.0, 9.0} — the removal took effect
+        h.send(("s", 9.0, 2), timestamp=6_500)
+        rt.flush()
+        assert [r[0] for r in got][-1] == 4.0
+
+    def test_min_carries_across_batches(self):
+        rt = build(S + "@info(name='q') from S#window.length(4) "
+                   "select min(volume) as mn insert into Out;", batch_size=2)
+        got = collect(rt)
+        h = rt.get_input_handler("S")
+        vols = [7, 3, 9, 5, 8, 6]
+        for i, v in enumerate(vols):
+            h.send(("s", 1.0, v), timestamp=i)
+            rt.flush()
+        # windows (len 4): [7] [7,3] [7,3,9] [7,3,9,5] [3,9,5,8] [9,5,8,6]
+        assert [r[0] for r in got] == [7, 3, 3, 3, 3, 5]
+
+    def test_grouped_sliding_min_rejected(self):
+        with pytest.raises(SiddhiAppCreationError, match="GROUP BY"):
+            build(S + "@info(name='q') from S#window.length(3) "
+                  "select symbol, min(price) as mn group by symbol "
+                  "insert into Out;")
+
+    def test_min_over_batch_window_still_works(self):
+        rt = build(S + "@info(name='q') from S#window.lengthBatch(3) "
+                   "select min(price) as mn insert into Out;")
+        got = collect(rt)
+        h = rt.get_input_handler("S")
+        for i, p in enumerate([5.0, 1.0, 7.0]):
+            h.send(("s", p, i), timestamp=i)
+        rt.flush()
+        assert [r[0] for r in got] == [5.0, 1.0, 1.0]
+
+
+class TestExtremaEligibility:
+    def test_post_window_filter_rejected(self):
+        with pytest.raises(SiddhiAppCreationError, match="post-window"):
+            build(S + "@info(name='q') from S#window.length(3)[price > 1.0] "
+                  "select min(price) as mn insert into Out;")
+
+    def test_delay_window_rejected(self):
+        with pytest.raises(SiddhiAppCreationError, match="delay"):
+            build(S + "@info(name='q') from S#window.delay(1 sec) "
+                  "select min(price) as mn insert into Out;")

@@ -118,3 +118,36 @@ class TestDistinctCount:
         currents = [e.data[1] for e in got if not e.is_expired]
         # batch 1: a,b → 1,2 ; batch 2 (after reset): b,b → 1,1
         assert currents == [1, 2, 1, 1]
+
+
+class TestDistinctPairEviction:
+    """Lifetime-unique pairs past capacity must not corrupt counts: the
+    capacity monitor compacts the append-only pair table, evicting dead
+    (count==0) pairs (reference behavior: HashMap entries are removed
+    naturally on processRemove)."""
+
+    def test_counts_stay_correct_past_lifetime_capacity(self):
+        import warnings as _warnings
+
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            "@app:playback\n"
+            "define stream S (k long);\n"
+            "@info(name='q') from S#window.time(1 sec) "
+            "select distinctCount(k) as dc insert into Out;",
+            batch_size=8, group_capacity=64)
+        rt.start()
+        got = []
+        rt.add_query_callback("q", lambda ts, i, r: got.extend(
+            e.data[0] for e in i or []))
+        h = rt.get_input_handler("S")
+        # 64 waves x 8 fresh values = 512 lifetime-unique >> capacity 64;
+        # waves are 2 s apart so at most one wave is ever live
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")  # post-compaction warn = failure
+            for wave in range(64):
+                base_ts = 2_000 * wave
+                for j in range(8):
+                    h.send((wave * 8 + j,), timestamp=base_ts + j)
+                rt.flush()
+        # final wave: running distinct within the window is 1..8
+        assert got[-8:] == [1, 2, 3, 4, 5, 6, 7, 8]
