@@ -19,7 +19,7 @@ from .transformer import AstTransformer
 @functools.lru_cache(maxsize=1)
 def _parser() -> Lark:
     return Lark(GRAMMAR, parser="earley", lexer="dynamic", maybe_placeholders=False,
-                start=["start", "on_demand_query"])
+                start=["start", "on_demand_query", "expression"])
 
 
 _VAR_PATTERN = re.compile(r"\$\{(\w+)\}")
@@ -59,6 +59,22 @@ def parse_on_demand_query(text: str):
     parseStoreQuery)."""
     try:
         tree = _parser().parse(text, start="on_demand_query")
+    except UnexpectedInput as e:
+        raise SiddhiParserError(str(e).split("\n")[0], getattr(e, "line", None),
+                                getattr(e, "column", None)) from e
+    try:
+        return AstTransformer().transform(tree)
+    except VisitError as e:
+        raise SiddhiParserError(f"error building AST: {e.orig_exc}") from e
+
+
+def parse_expression(text: str):
+    """Parse a bare SiddhiQL expression string into an Expression AST
+    (used by expression windows, whose condition arrives as a string
+    parameter — reference: ExpressionWindowProcessor compiles its string
+    with SiddhiCompiler internals)."""
+    try:
+        tree = _parser().parse(text, start="expression")
     except UnexpectedInput as e:
         raise SiddhiParserError(str(e).split("\n")[0], getattr(e, "line", None),
                                 getattr(e, "column", None)) from e

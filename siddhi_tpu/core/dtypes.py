@@ -52,6 +52,8 @@ class _Config:
     join_pair_cap_factor = 4
     #: max concurrent partial matches per pattern position.
     pattern_pending_capacity = 1024
+    #: retained groups for `output snapshot ... group by` (rows per snapshot)
+    snapshot_group_capacity = 1024
     #: expansion bound for unbounded pattern counts `<m:>`.
     pattern_unbounded_count_extra = 8
 

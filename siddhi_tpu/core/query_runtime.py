@@ -180,7 +180,11 @@ class QueryRuntime(Receiver):
             self.window: WindowOp = factory.make(layout, batch_cap, params, expired_on)
         else:
             self.window = PassThroughWindow(layout, batch_cap)
-        self.is_sliding_window = wh is not None and type(self.window).__name__ == "SlidingWindow"
+        # ExpressionWindow shares SlidingState + FIFO suffix semantics, so
+        # the removal-capable extrema path (and the grouped-min rejection)
+        # applies to it identically
+        self.is_sliding_window = wh is not None and type(self.window).__name__ in (
+            "SlidingWindow", "ExpressionWindow")
 
         # --- selector ---
         select_all = [(a.name, a.type) for a in definition.attributes
@@ -222,7 +226,13 @@ class QueryRuntime(Receiver):
                       for n, t in self.selector.out_types.items()}
         self.rate_limiter = make_rate_limiter(
             query.output_rate, out_layout, self.window.chunk_width,
-            grouped=bool(query.selector.group_by))
+            grouped=bool(query.selector.group_by),
+            group_capacity=ctx.effective_group_capacity)
+        from ..ops.ratelimit import GroupedSnapshotLimiter
+        if isinstance(self.rate_limiter, GroupedSnapshotLimiter):
+            # the limiter retains one row per group: have the selector ride
+            # each lane's group slot on a pseudo-column (set before tracing)
+            self.selector.expose_group_slot = True
 
         # --- the jitted step ---
         self._step = jax.jit(self._make_step(), donate_argnums=(0,))
@@ -436,12 +446,17 @@ class QueryRuntime(Receiver):
                     self.name, QueryTerminal.OUT,
                     out.to_host_events(self.output_codec))
 
-        if self.selector.host_uuid_slots:
+        uuid_slots = self.selector.host_uuid_slots
+        forwards = (self.output_junction is not None
+                    or self.table_executor is not None)
+        if uuid_slots and forwards:
             # fresh uuid4 per emitted lane per UUID() slot (reference
             # UUIDFunctionExecutor), interned into the app string table so
-            # EVERY consumer — query/stream callbacks, downstream queries,
-            # tables, sinks — sees real values. Costs one host round trip
-            # per batch; UUID generation is inherently a host concept.
+            # EVERY consumer — downstream queries, tables, sinks — sees real
+            # values. Interned uuids are never reclaimed (the app-global
+            # string table is append-only), so forwarding UUID output grows
+            # host memory with stream volume — documented divergence from
+            # the reference's GC'd per-event Strings (docs/PARITY.md).
             out = self._intern_uuid_columns(out)
 
         if self.callbacks:
@@ -449,6 +464,17 @@ class QueryRuntime(Receiver):
             # outputExpectsExpiredEvents): CURRENT-only queries get no
             # removeEvents regardless of window kind
             events = out.to_host_events(self.output_codec)
+            if uuid_slots and not forwards and events:
+                # callback-only output: substitute decoded events directly —
+                # no interning, no string-table growth
+                import uuid as _uuid
+                names = [a.name for a in self.output_attributes]
+                idxs = [names.index(s) for s in uuid_slots]
+                for e in events:
+                    data = list(e.data)
+                    for i in idxs:
+                        data[i] = str(_uuid.uuid4())
+                    e.data = tuple(data)
             in_events = [e for e in events if not e.is_expired] or None
             remove_events = ([e for e in events if e.is_expired] or None
                              if etype != OutputEventType.CURRENT else None)

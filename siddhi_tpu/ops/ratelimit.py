@@ -257,8 +257,82 @@ class SnapshotLimiter(RateLimiterOp):
         return new_state, emit
 
 
+class GroupedSnapshotState(NamedTuple):
+    rows: dict  # [G] retained last row per group, per column
+    present: jax.Array  # bool[G]
+    bucket: jax.Array  # int64 last observed time bucket
+
+
+class GroupedSnapshotLimiter(RateLimiterOp):
+    """`output snapshot every <t> ... group by k` — periodically re-emits the
+    latest output row of EVERY group (reference:
+    snapshot/GroupByPerSnapshotOutputRateLimiter.java and the aggregation
+    variants, whose per-group running aggregate IS the latest row here).
+
+    The selector rides each lane's group slot on GROUP_SLOT_COL; retention
+    is one scatter of each batch's last-lane-per-slot. Groups beyond the
+    snapshot capacity (config.snapshot_group_capacity) are dropped —
+    documented bound."""
+
+    has_time_semantics = True
+
+    def __init__(self, layout: dict, time_ms: int, n_groups: int,
+                 group_capacity: int):
+        self.layout = layout
+        self.T = time_ms
+        # the selector's overflow sentinel slot is group_capacity: bounding
+        # G by it keeps phantom sentinel rows out of snapshots
+        self.G = min(n_groups, group_capacity)
+
+    def init_state(self) -> GroupedSnapshotState:
+        G = self.G
+        return GroupedSnapshotState(
+            rows={k: jnp.zeros((G,), dt) for k, dt in self.layout.items()},
+            present=jnp.zeros((G,), bool),
+            bucket=jnp.int64(-1),
+        )
+
+    def step(self, state: GroupedSnapshotState, out: EventBatch, now):
+        from .selector import GROUP_SLOT_COL
+        G = self.G
+        L = out.ts.shape[0]
+        slots = out.cols[GROUP_SLOT_COL]
+        live = out.valid & (out.types == EventType.CURRENT) & (slots < G) \
+            & (slots >= 0)
+
+        bucket = now // jnp.int64(self.T)
+        first = state.bucket < 0
+        # fire with the PRE-batch retained rows: the snapshot shows state as
+        # of the boundary (matches SnapshotLimiter's boundary semantics)
+        fire = ~first & (bucket > state.bucket)
+        emit = EventBatch(
+            ts=jnp.broadcast_to(jnp.asarray(now, dtypes.TS_DTYPE), (G,)),
+            cols=dict(state.rows),
+            valid=state.present & jnp.broadcast_to(fire, (G,)),
+            types=jnp.zeros((G,), jnp.int8),
+        )
+
+        # retain the LAST live lane per slot (deterministic last-wins)
+        idx = jnp.arange(L, dtype=jnp.int32)
+        slots_c = jnp.clip(slots, 0, G - 1)
+        last = jax.ops.segment_max(
+            jnp.where(live, idx, -1), slots_c, num_segments=G)
+        is_last = live & (idx == last[slots_c])
+        dest = jnp.where(is_last, slots, G)
+        rows = {k: state.rows[k].at[dest].set(out.cols[k], mode="drop")
+                for k in self.layout}
+        new_state = GroupedSnapshotState(
+            rows=rows,
+            present=state.present.at[dest].set(True, mode="drop"),
+            bucket=jnp.where(first, bucket,
+                             jnp.maximum(state.bucket, bucket)),
+        )
+        return new_state, emit
+
+
 def make_rate_limiter(rate: Optional[OutputRate], layout: dict,
-                      out_width: int, grouped: bool = False) -> RateLimiterOp:
+                      out_width: int, grouped: bool = False,
+                      group_capacity: int = 1 << 20) -> RateLimiterOp:
     if rate is None:
         return PassThroughLimiter()
     if rate.type == OutputRateType.SNAPSHOT:
@@ -266,11 +340,9 @@ def make_rate_limiter(rate: Optional[OutputRate], layout: dict,
             raise SiddhiAppCreationError(
                 "`output snapshot every ...` needs a time period")
         if grouped:
-            # the reference's Grouped/Windowed PerSnapshot limiters retain one
-            # row per group; emitting only the global last row would be
-            # silently wrong — fail fast until those land
-            raise SiddhiAppCreationError(
-                "`output snapshot` with GROUP BY is not yet supported")
+            return GroupedSnapshotLimiter(
+                layout, rate.time_ms, dtypes.config.snapshot_group_capacity,
+                group_capacity)
         return SnapshotLimiter(layout, rate.time_ms)
     if rate.event_count is not None:
         n = rate.event_count

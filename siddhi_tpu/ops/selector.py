@@ -57,6 +57,9 @@ from .groupby import (
 )
 
 AGG_FRAME = "__agg__"
+#: pseudo-column carrying each output lane's group slot (grouped snapshot
+#: rate limiting); never part of the output schema
+GROUP_SLOT_COL = "__slot__"
 
 
 def _rewrite_set_idioms(expr: Expression) -> Expression:
@@ -160,6 +163,9 @@ class CompiledSelector:
         #: aggregate — instead of per-event running values (reference:
         #: FindOnDemandQueryRuntime returns one row per group)
         self.emit_final_per_group = emit_final_per_group
+        #: set by the runtime before tracing when a grouped snapshot limiter
+        #: needs per-lane group slots (GROUP_SLOT_COL)
+        self.expose_group_slot = False
 
         # --- select list: rewrite aggregators, compile expressions ---
         agg_nodes: list[tuple[str, AttributeFunction]] = []
@@ -395,6 +401,10 @@ class CompiledSelector:
             scope.valids[AGG_FRAME] = data_valid
             scope.ts[AGG_FRAME] = chunk.ts
         out_cols = {name: ce(scope) for name, ce in self.out_exprs}
+        if self.expose_group_slot:
+            # grouped snapshot limiters retain one row per group — ride the
+            # per-lane group slot through ordering/limit as a pseudo-column
+            out_cols[GROUP_SLOT_COL] = slots.astype(jnp.int32)
 
         out_valid = data_valid
 

@@ -169,10 +169,45 @@ def _make_lossy_frequent(layout, batch_cap, params, expired_on):
                           support=support, error=error, lossy=True)
 
 
+def _make_expression(layout, batch_cap, params, expired_on):
+    from .expression_window import ExpressionWindow
+    if not params or not isinstance(params[0], str):
+        raise SiddhiAppCreationError(
+            "expression window needs a condition string, e.g. "
+            "expression('count() <= 20')")
+    return ExpressionWindow(layout, batch_cap, params[0])
+
+
+def _make_expression_batch(layout, batch_cap, params, expired_on):
+    """expressionBatch('count() <= N') is exactly lengthBatch(N); other
+    monotone forms segment greedily by running metrics — an inherently
+    sequential recurrence — and are rejected (reference:
+    ExpressionBatchWindowProcessor re-evaluates per event)."""
+    from ..compiler import parse_expression
+    from .expression_window import plan_expression
+    if not params or not isinstance(params[0], str):
+        raise SiddhiAppCreationError(
+            "expressionBatch window needs a condition string")
+    conjuncts = plan_expression(parse_expression(params[0]), layout)
+    if len(conjuncts) == 1 and conjuncts[0].kind == "count":
+        c = conjuncts[0]
+        n = int(c.limit) - (1 if c.strict else 0)
+        if n < 1:
+            raise SiddhiAppCreationError(
+                "expressionBatch count bound admits no events")
+        return LengthBatchWindow(layout, batch_cap, n, expired_on=expired_on)
+    raise SiddhiAppCreationError(
+        "expressionBatch supports only the count() form on this engine "
+        "(greedy batch segmentation by running sums is a sequential "
+        "recurrence); use expression(...) for sliding semantics")
+
+
 def register_all() -> None:
     reg = lambda name, make: GLOBAL.register(  # noqa: E731
         ExtensionKind.WINDOW, "", name, WindowFactory(make))
     reg("length", _make_length)
+    reg("expression", _make_expression)
+    reg("expressionBatch", _make_expression_batch)
     reg("lengthBatch", _make_length_batch)
     reg("time", _make_time)
     reg("timeBatch", _make_time_batch)

@@ -1,0 +1,100 @@
+"""expression / expressionBatch window tests (reference:
+query/window/ExpressionWindowTestCase, ExpressionBatchWindowTestCase)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.errors import SiddhiAppCreationError
+
+S = "define stream S (symbol string, price double, volume long);\n"
+
+
+def build(app, batch_size=4):
+    rt = SiddhiManager().create_siddhi_app_runtime(
+        "@app:playback\n" + app, batch_size=batch_size)
+    rt.start()
+    return rt
+
+
+def collect_all(rt, name="q"):
+    got = []
+    rt.add_query_callback(name, lambda ts, i, r: got.append(
+        ([tuple(e.data) for e in i or []], [tuple(e.data) for e in r or []])))
+    return got
+
+
+class TestExpressionWindow:
+    def test_count_condition_behaves_like_length(self):
+        rt = build(S + "@info(name='q') from S#window.expression('count() <= 2') "
+                   "select symbol, sum(price) as total "
+                   "insert all events into Out;")
+        got = collect_all(rt)
+        h = rt.get_input_handler("S")
+        for i, p in enumerate([1.0, 2.0, 4.0, 8.0]):
+            h.send((f"s{i}", p, i), timestamp=i)
+        rt.flush()
+        sums = [e[1] for pair in got for e in pair[0]]
+        removed = [e[1] for pair in got for e in pair[1]]
+        # pop-after-arrival (reference ExpressionWindowProcessor): the
+        # arrival emits with the pre-pop sum, the popped event emits next
+        assert sums == [1.0, 3.0, 7.0, 14.0]
+        assert removed == [6.0, 12.0]
+
+    def test_sum_condition(self):
+        rt = build(S + "@info(name='q') from S"
+                   "#window.expression('sum(price) <= 10.0') "
+                   "select symbol, price insert all events into Out;")
+        got = collect_all(rt)
+        h = rt.get_input_handler("S")
+        # prices 6,5 -> 6 must expire when 5 arrives (11 > 10)
+        h.send(("a", 6.0, 0), timestamp=0)
+        h.send(("b", 5.0, 1), timestamp=1)
+        h.send(("c", 4.0, 2), timestamp=2)
+        rt.flush()
+        expired = [e[0] for pair in got for e in pair[1]]
+        assert expired == ["a"]  # 6 evicted; 5+4=9 <= 10 stays
+
+    def test_ts_span_condition(self):
+        rt = build(S + "@info(name='q') from S#window.expression("
+                   "'eventTimestamp(last) - eventTimestamp(first) < 5000') "
+                   "select symbol insert all events into Out;")
+        got = collect_all(rt)
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0, 0), timestamp=1_000)
+        h.send(("b", 1.0, 1), timestamp=2_000)
+        h.send(("c", 1.0, 2), timestamp=7_500)  # span 6500 -> a,b evicted
+        rt.flush()
+        expired = [e[0] for pair in got for e in pair[1]]
+        assert expired == ["a", "b"]
+
+    def test_non_monotone_rejected(self):
+        with pytest.raises(SiddhiAppCreationError, match="monotone|bound"):
+            build(S + "@info(name='q') from S"
+                  "#window.expression('count() > 3') "
+                  "select symbol insert into Out;")
+
+    def test_or_rejected(self):
+        with pytest.raises(SiddhiAppCreationError):
+            build(S + "@info(name='q') from S#window.expression("
+                  "'count() < 3 or sum(price) < 5.0') "
+                  "select symbol insert into Out;")
+
+
+class TestExpressionBatchWindow:
+    def test_count_form_is_length_batch(self):
+        rt = build(S + "@info(name='q') from S"
+                   "#window.expressionBatch('count() <= 2') "
+                   "select symbol, sum(price) as t insert into Out;")
+        got = collect_all(rt)
+        h = rt.get_input_handler("S")
+        for i, p in enumerate([1.0, 2.0, 4.0, 8.0]):
+            h.send((f"s{i}", p, i), timestamp=i)
+        rt.flush()
+        sums = [e[1] for pair in got for e in pair[0]]
+        assert sums == [1.0, 3.0, 4.0, 12.0]  # flushes of 2
+
+    def test_non_count_form_rejected(self):
+        with pytest.raises(SiddhiAppCreationError, match="count"):
+            build(S + "@info(name='q') from S"
+                  "#window.expressionBatch('sum(price) <= 10.0') "
+                  "select symbol insert into Out;")

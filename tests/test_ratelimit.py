@@ -93,12 +93,45 @@ class TestSnapshotRateLimit:
         rt.flush()
         assert [e.data[0] for e in got] == ["a"]
 
-    def test_snapshot_with_group_by_rejected(self):
-        from siddhi_tpu.errors import SiddhiAppCreationError
-        with pytest.raises(SiddhiAppCreationError, match="GROUP BY"):
-            build(S + "from S select symbol, sum(price) as t group by symbol "
-                  "output snapshot every 1 sec insert into Out;")
+    def test_grouped_snapshot_retains_last_row_per_group(self):
+        rt = build(S + "@info(name='q') from S select symbol, price "
+                   "group by symbol output snapshot every 1 sec "
+                   "insert into Out;")
+        got = []
+        rt.add_query_callback("q", lambda ts, i, r: got.append(
+            sorted((e.data[0], e.data[1]) for e in i or [])))
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0), timestamp=100)
+        h.send(("b", 2.0), timestamp=200)
+        h.send(("a", 3.0), timestamp=300)
+        rt.flush()
+        assert got == []  # bucket still open
+        rt.heartbeat(1_500)
+        # every group's LAST row re-emits at the boundary
+        assert got == [[("a", 3.0), ("b", 2.0)]]
+        # next period with no arrivals: snapshot repeats
+        rt.heartbeat(2_500)
+        assert got[-1] == [("a", 3.0), ("b", 2.0)]
+        # update one group; others retained
+        h.send(("b", 9.0), timestamp=2_600)
+        rt.flush()
+        rt.heartbeat(3_500)
+        assert got[-1] == [("a", 3.0), ("b", 9.0)]
 
+    def test_grouped_snapshot_with_aggregate(self):
+        rt = build(S + "@info(name='q') from S select symbol, "
+                   "sum(price) as total group by symbol "
+                   "output snapshot every 1 sec insert into Out;")
+        got = []
+        rt.add_query_callback("q", lambda ts, i, r: got.append(
+            sorted((e.data[0], e.data[1]) for e in i or [])))
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0), timestamp=100)
+        h.send(("a", 2.0), timestamp=200)
+        h.send(("b", 5.0), timestamp=300)
+        rt.flush()
+        rt.heartbeat(1_500)
+        assert got == [[("a", 3.0), ("b", 5.0)]]
 
 class TestTimeRateLimits:
     def test_output_first_every_second(self):

@@ -101,3 +101,18 @@ class TestExtremaEligibility:
         with pytest.raises(SiddhiAppCreationError, match="delay"):
             build(S + "@info(name='q') from S#window.delay(1 sec) "
                   "select min(price) as mn insert into Out;")
+
+
+class TestExpressionWindowExtrema:
+    def test_min_over_expression_window(self):
+        rt = build(S + "@info(name='q') from S"
+                   "#window.expression('count() <= 2') "
+                   "select min(price) as mn insert into Out;")
+        got = collect(rt)
+        h = rt.get_input_handler("S")
+        for i, p in enumerate([1.0, 5.0, 7.0, 9.0]):
+            h.send(("s", p, i), timestamp=i)
+        rt.flush()
+        # pop-after-arrival: arrival lane sees pre-pop window, so windows at
+        # emission are [1] [1,5] [1,5,7]->pop1 [5,7,9]->pop5
+        assert [r[0] for r in got] == [1.0, 1.0, 1.0, 5.0]
