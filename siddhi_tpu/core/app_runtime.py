@@ -123,7 +123,14 @@ class SiddhiAppRuntime:
 
         from .table import InMemoryTable
         for td in app.table_definitions.values():
-            self.tables[td.id] = InMemoryTable(td, ctx)
+            store_ann = (td.annotation("store") or td.annotation("Store")) \
+                if td.annotations else None
+            if store_ann is not None:
+                from ..io.record_table import RecordTableRuntime
+                self.tables[td.id] = RecordTableRuntime(
+                    td, ctx, self.ctx.registry)
+            else:
+                self.tables[td.id] = InMemoryTable(td, ctx)
 
         from .window import NamedWindow
         for wd in app.window_definitions.values():
@@ -245,13 +252,18 @@ class SiddhiAppRuntime:
                 qr.output_junction = target
         elif out.action in (OutputAction.DELETE, OutputAction.UPDATE,
                             OutputAction.UPDATE_OR_INSERT):
+            from ..io.record_table import (RecordTableOutputExecutor,
+                                           RecordTableRuntime)
             from .table import TableOutputExecutor
             table = self.tables.get(out.target_id)
             if table is None:
                 raise DefinitionNotExistError(f"table {out.target_id!r} is not defined")
             aliases = [getattr(query.input_stream, "stream_id", None),
                        getattr(query.input_stream, "reference_id", None)]
-            qr.table_executor = TableOutputExecutor(
+            executor_cls = (RecordTableOutputExecutor
+                            if isinstance(table, RecordTableRuntime)
+                            else TableOutputExecutor)
+            qr.table_executor = executor_cls(
                 table, out, qr.selector.out_types, qr.output_codec,
                 self.ctx.registry, out_frame_aliases=aliases)
 
@@ -271,6 +283,9 @@ class SiddhiAppRuntime:
 
     def shutdown(self) -> None:
         self._started = False
+        for t in self.tables.values():
+            if hasattr(t, "shutdown"):
+                t.shutdown()
         for tr in self.triggers.values():
             tr.shutdown()
         for source in self.sources:
@@ -352,12 +367,24 @@ class SiddhiAppRuntime:
     def _build_crud_runtime(self, odq):
         """Write-form on-demand queries (delete/update/update-or-insert/
         select-insert) — reference: OnDemandQueryParser non-find runtimes."""
+        from ..io.record_table import RecordCrudRuntime, RecordTableRuntime
         from ..query_api.execution import OutputAction as _OA
         from .ondemand import OnDemandCrudRuntime
         target = self.tables.get(odq.target_id)
         if target is None:
             raise DefinitionNotExistError(
                 f"table {odq.target_id!r} is not defined")
+        if isinstance(target, RecordTableRuntime):
+            source = None
+            if odq.action == _OA.INSERT:
+                source = self.tables.get(odq.input_store_id)
+                if source is None:
+                    source = self.windows.get(odq.input_store_id)
+                if source is None:
+                    raise DefinitionNotExistError(
+                        f"store {odq.input_store_id!r} is not defined")
+            return RecordCrudRuntime(odq, target, self.ctx,
+                                     self.ctx.registry, source_store=source)
         source = None
         if odq.action == _OA.INSERT:
             source = self.tables.get(odq.input_store_id)

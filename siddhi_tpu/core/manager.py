@@ -79,6 +79,7 @@ class SiddhiManager:
         """Register a per-manager extension as `namespace:name` (reference:
         SiddhiManager.setExtension). `kind` defaults by probing impl type."""
         if kind is None:
+            from ..io.record_table import RecordStore
             from ..ops.aggregators import AggregatorFactory
             from ..ops.expr_compile import ScalarFunction
             from ..ops.window_factories import WindowFactory
@@ -88,6 +89,10 @@ class SiddhiManager:
                 kind = ExtensionKind.FUNCTION
             elif isinstance(impl, WindowFactory):
                 kind = ExtensionKind.WINDOW
+            elif (isinstance(impl, RecordStore)
+                  or (isinstance(impl, type)
+                      and issubclass(impl, RecordStore))):
+                kind = ExtensionKind.STORE
             else:
                 raise SiddhiAppCreationError(
                     f"cannot infer extension kind for {impl!r}; pass kind=")

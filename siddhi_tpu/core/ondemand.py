@@ -32,7 +32,10 @@ class OnDemandQueryRuntime:
                  registry) -> None:
         self.odq = odq
         self.table = table
-        self.is_window = not isinstance(table, InMemoryTable)
+        from ..io.record_table import RecordTableRuntime
+        self.is_record = isinstance(table, RecordTableRuntime)
+        self.is_window = (not self.is_record
+                          and not isinstance(table, InMemoryTable))
         tid = table.definition.id
 
         frames = {tid: dict(table.attr_types)}
@@ -91,8 +94,27 @@ class OnDemandQueryRuntime:
         return run
 
     def execute(self, now: int = 0) -> list[Event]:
-        out = self._fn(self.table.state, jnp.int64(now))
+        if self.is_record:
+            # authoritative fetch from the store (read-through refreshes the
+            # cache); the device selector then projects/aggregates the rows
+            tstate = self._record_state()
+        else:
+            tstate = self.table.state
+        out = self._fn(tstate, jnp.int64(now))
         return out.to_host_events(self.output_codec)
+
+    def _record_state(self) -> TableState:
+        import numpy as np
+        rows = self.table.find_rows(self.odq.on_condition)
+        names = list(self.table.attr_types)
+        tuples = [tuple(r.get(n) for n in names) for r in rows]
+        n = len(tuples)
+        cap = max(16, 1 << (n - 1).bit_length() if n else 4)
+        cols = self.table.codec.rows_to_columns(tuples, n_pad=cap)
+        batch = EventBatch.from_numpy(
+            np.zeros(cap, dtype=np.int64), cols, cap)
+        valid = jnp.arange(cap) < n
+        return TableState(cols=batch.cols, ts=batch.ts, valid=valid)
 
 
 class OnDemandCrudRuntime:

@@ -106,6 +106,9 @@ class QueryRuntime(Receiver):
         # arguments (contents must not be baked into the trace as constants)
         self.dep_tables = sorted(
             tid for tid in _collect_in_sources(query) if tid in self.tables)
+        # tables whose `in` conditions carry an index-eligible equality:
+        # only these pay the (lazy) sorted-index rebuild per mutated batch
+        self._index_tables = _collect_eq_probe_tables(query, self.tables)
 
         in_stream = query.input_stream
         definition = input_junction.definition
@@ -242,6 +245,7 @@ class QueryRuntime(Receiver):
         self._batches_seen = 0
         self._capacity_warned = False
         self._capacity_pressure = False
+        self._snapshot_warned = False
         self._last_compacted_live: dict[int, int] = {}
         #: time-driven windows need heartbeats to flush expirations
         from ..ops.windows import window_has_time_semantics
@@ -295,8 +299,9 @@ class QueryRuntime(Receiver):
             scope.add_frame(frame_ref, batch.cols, batch.ts, batch.valid, default=True)
             scope.extras["now"] = now
             if table_states:
-                for tid, tstate in table_states.items():
+                for tid, (tstate, tidx) in table_states.items():
                     scope.extras[f"table:{tid}"] = tstate
+                    scope.extras[f"tableidx:{tid}"] = tidx
                     scope.extras[f"in:{tid}"] = probes[tid]
             mask = batch.valid
             for f in filters:
@@ -350,7 +355,10 @@ class QueryRuntime(Receiver):
                 debugger.check_break_point(
                     self.name, QueryTerminal.IN,
                     batch.to_host_events(self.codec))
-        tstates = {tid: self.tables[tid].state for tid in self.dep_tables}
+        tstates = {tid: (self.tables[tid].state,
+                         self.tables[tid].probe_indexes()
+                         if tid in self._index_tables else {})
+                   for tid in self.dep_tables}
         self.state, out = self._step(self.state, batch, jnp.int64(now), tstates)
         self._distribute(out, now)
         self.ctx.statistics.track_latency(self.name, time.perf_counter_ns() - t0)
@@ -364,6 +372,16 @@ class QueryRuntime(Receiver):
                 and (self._batches_seen in (1, 16, 64)
                      or self._batches_seen % interval == 0)):
             self._check_custom_agg_capacity()
+        if (not self._snapshot_warned and self._batches_seen % 256 == 0
+                and hasattr(self.state[2], "overflow")):
+            if int(self.state[2].overflow) > 0:
+                import warnings
+                warnings.warn(
+                    f"query {self.name!r}: {int(self.state[2].overflow)} "
+                    "output lanes exceeded snapshot_group_capacity and are "
+                    "missing from periodic snapshots — raise "
+                    "config.snapshot_group_capacity", stacklevel=2)
+                self._snapshot_warned = True
 
     def _check_custom_agg_capacity(self) -> None:
         """distinctCount's (group,value) pair table is append-only inside
@@ -523,6 +541,44 @@ class QueryRuntime(Receiver):
 
     def add_callback(self, cb: QueryCallback) -> None:
         self.callbacks.append(cb)
+
+
+def _collect_eq_probe_tables(query: Query, tables: dict) -> set:
+    """Tables probed by a single-equality `in` condition on an indexable
+    attribute — the only ones whose sorted indexes the step will read."""
+    from ..query_api.expression import Compare, CompareOp, In
+
+    found: set = set()
+
+    def walk(node):
+        if node is None or not isinstance(node, Expression):
+            return
+        if isinstance(node, In):
+            e = node.expression
+            t = tables.get(node.source_id)
+            if (t is not None and isinstance(e, Compare)
+                    and e.op == CompareOp.EQUAL
+                    and hasattr(t, "indexable_eq_attrs")):
+                for side in (e.left, e.right):
+                    if (isinstance(side, Variable)
+                            and side.stream_id == node.source_id
+                            and side.attribute in t.indexable_eq_attrs()):
+                        found.add(node.source_id)
+            walk(e)
+            return
+        for attr in ("left", "right", "expression"):
+            sub = getattr(node, attr, None)
+            if isinstance(sub, Expression):
+                walk(sub)
+        for p in getattr(node, "parameters", ()) or ():
+            walk(p)
+
+    for f in query.input_stream.handlers.filters:
+        walk(f)
+    for f in query.input_stream.handlers.post_window_filters:
+        walk(f)
+    walk(query.selector.having)
+    return found
 
 
 def _collect_in_sources(query: Query) -> set[str]:

@@ -86,7 +86,7 @@ class InMemoryTable:
             else dtypes.config.default_table_capacity)
         self.attr_types = {a.name: a.type for a in definition.attributes
                           if a.type != AttributeType.OBJECT}
-        self.state = TableState(
+        self._state = TableState(
             cols={n: jnp.zeros((self.capacity,), dtypes.device_dtype(t))
                   for n, t in self.attr_types.items()},
             ts=jnp.zeros((self.capacity,), dtypes.TS_DTYPE),
@@ -97,8 +97,72 @@ class InMemoryTable:
         pk = definition.annotation("PrimaryKey") if definition.annotations else None
         self.primary_keys: tuple[str, ...] = tuple(
             e.value for e in pk.elements) if pk is not None else ()
+        # @Index('a' [, 'b']) — reference: IndexEventHolder.java:60 secondary
+        # TreeMap indexes. TPU form: a sorted copy of each indexed column
+        # (invalid rows sort to the end as dtype-max sentinels) rebuilt
+        # lazily after mutations; equality probes binary-search it instead
+        # of scanning the [B, C] cross mask.
+        idx_ann = definition.annotation("Index") if definition.annotations else None
+        self.index_attrs: tuple[str, ...] = tuple(
+            e.value for e in idx_ann.elements) if idx_ann is not None else ()
+        for a in self.index_attrs:
+            if a not in self.attr_types:
+                raise SiddhiAppCreationError(
+                    f"@Index({a!r}): no such attribute on {definition.id!r}")
+            if self.attr_types[a] == AttributeType.BOOL:
+                raise SiddhiAppCreationError(
+                    f"@Index({a!r}): bool attributes are not indexable")
+        self._indexes = None  # dict[attr, (sorted_vals[C], n_live)] | None
+        self._index_fn = None
         self.dropped_duplicates = 0
         self._insert_fn = jax.jit(self._make_insert())
+
+    # ------------------------------------------------------------------ state
+
+    @property
+    def state(self) -> TableState:
+        return self._state
+
+    @state.setter
+    def state(self, new_state: TableState) -> None:
+        self._state = new_state
+        self._indexes = None  # any mutation invalidates the sorted copies
+
+    def clear(self) -> None:
+        """Reset to empty, keeping compiled kernels and capacity."""
+        self.state = TableState(
+            cols={k: jnp.zeros_like(v) for k, v in self._state.cols.items()},
+            ts=jnp.zeros_like(self._state.ts),
+            valid=jnp.zeros_like(self._state.valid),
+        )
+
+    def probe_indexes(self) -> dict:
+        """Sorted-copy indexes for in-kernel equality probes; rebuilt lazily
+        (one jitted sort per indexed column) after mutations."""
+        indexable = tuple(sorted(self.indexable_eq_attrs()))
+        if not indexable:
+            return {}
+        if self._indexes is None:
+            if self._index_fn is None:
+                attrs = indexable
+
+                def build(tstate: TableState):
+                    out = {}
+                    n_live = jnp.sum(tstate.valid, dtype=jnp.int32)
+                    for a in attrs:
+                        col = tstate.cols[a]
+                        if jnp.issubdtype(col.dtype, jnp.floating):
+                            big = jnp.asarray(jnp.inf, col.dtype)
+                        else:
+                            big = jnp.asarray(jnp.iinfo(col.dtype).max,
+                                              col.dtype)
+                        keys = jnp.where(tstate.valid, col, big)
+                        out[a] = (jnp.sort(keys), n_live)
+                    return out
+
+                self._index_fn = jax.jit(build)
+            self._indexes = self._index_fn(self._state)
+        return self._indexes
 
     # ------------------------------------------------------------------ insert
 
@@ -167,16 +231,40 @@ class InMemoryTable:
             jnp.broadcast_to(cond(s2), (B, self.capacity))
         return m & self.state.valid[None, :]
 
-    def contains_probe(self, scope, inner) -> jax.Array:
+    def contains_probe(self, scope, inner, eq_plan=None) -> jax.Array:
         """`expr in Table` membership (reference: InConditionExpressionExecutor):
         any-match over table rows per stream lane. Reads the table state from
-        scope.extras so jitted steps see fresh contents each call."""
-        tstate: TableState = scope.extras.get(f"table:{self.definition.id}", self.state)
-        s2 = _broadcast_scope(scope, self.definition.id, tstate)
+        scope.extras so jitted steps see fresh contents each call.
+
+        When the condition is a single equality on an @Index'd (or sole
+        primary-key) attribute, `eq_plan` carries (attr, stream_expr) and the
+        probe binary-searches the sorted index — O(B log C) instead of the
+        [B, C] cross mask (reference: IndexEventHolder index-aware plans)."""
+        tid = self.definition.id
+        if eq_plan is not None:
+            attr, sexpr = eq_plan
+            idx = scope.extras.get(f"tableidx:{tid}")
+            if idx and attr in idx:
+                from ..ops.search import searchsorted32
+                sorted_vals, n_live = idx[attr]
+                C = sorted_vals.shape[0]
+                v = sexpr(scope).astype(sorted_vals.dtype)
+                pos = searchsorted32(sorted_vals, v, side="left")
+                return (pos < n_live) & \
+                    (sorted_vals[jnp.clip(pos, 0, C - 1)] == v)
+        tstate: TableState = scope.extras.get(f"table:{tid}", self.state)
+        s2 = _broadcast_scope(scope, tid, tstate)
         if inner is None:
             raise SiddhiAppCreationError("`in Table` requires a condition")
         m = inner(s2) & tstate.valid
         return m.any(axis=-1)
+
+    def indexable_eq_attrs(self) -> set:
+        """Attributes whose equality probes can use a sorted index."""
+        out = set(self.index_attrs)
+        if len(self.primary_keys) == 1:
+            out.add(self.primary_keys[0])
+        return out
 
     def all_rows(self) -> list[tuple]:
         batch = EventBatch(ts=self.state.ts, cols=self.state.cols,

@@ -3,6 +3,9 @@
 core/util/transport/)."""
 
 from .broker import InMemoryBroker, Subscriber
+from .record_table import (InMemoryRecordStore, RecordStore,
+                           RecordTableRuntime,
+                           StoreConditionVisitor)
 from .sink import (
     BroadcastStrategy,
     DistributedSink,
@@ -35,6 +38,7 @@ __all__ = [
     "DistributionStrategy",
     "InMemoryBroker",
     "InMemorySink",
+    "InMemoryRecordStore",
     "InMemorySource",
     "JsonSinkMapper",
     "JsonSourceMapper",
@@ -42,7 +46,10 @@ __all__ = [
     "PartitionedStrategy",
     "PassThroughSinkMapper",
     "PassThroughSourceMapper",
+    "RecordStore",
+    "RecordTableRuntime",
     "RoundRobinStrategy",
+    "StoreConditionVisitor",
     "Sink",
     "SinkMapper",
     "Source",

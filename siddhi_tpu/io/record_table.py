@@ -1,0 +1,718 @@
+"""Record-table SPI + `@cache` — the extension seam for external stores.
+
+Reference: core/table/record/AbstractRecordTable.java and
+AbstractQueryableRecordTable.java:99 (1,133 LoC) — RDBMS/Mongo-style stores
+plug in by compiling conditions through an ExpressionVisitor walk and
+implementing add/find/update/delete against the backend; an optional
+in-memory cache (`@cache(size=..., policy=FIFO|LRU|LFU)` —
+CacheTable.java + CacheTableFIFO/LRU/LFU) absorbs reads.
+
+TPU division of labour:
+
+- the STORE is a host-side adapter (network/disk I/O never belongs on
+  device): `RecordStore` SPI registered under `@store(type='name')` via
+  `ExtensionKind.STORE`;
+- conditions reach the store through `StoreConditionVisitor` — the same
+  compile-once visitor-walk contract as the reference, so a SQL store can
+  emit a WHERE clause; `PredicateVisitor` is the built-in fallback that
+  compiles to a Python row predicate;
+- the CACHE is a real device table (core/table.py InMemoryTable): joins and
+  `in Table` probes run against it INSIDE the jitted step at device speed —
+  the reference's cacheEnabled read path. Cache content is mastered by a
+  host-side policy map (FIFO/LRU/LFU) and mirrored to the device table on
+  change. Recency/frequency update on host-path reads and writes; in-kernel
+  probes cannot touch host metadata (documented divergence).
+- on-demand finds are authoritative against the store (reference:
+  AbstractQueryableRecordTable.find) and read-through refresh the cache.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+from ..errors import SiddhiAppCreationError, SiddhiError
+from ..query_api.definition import AttributeType, TableDefinition
+from ..query_api.expression import (
+    And,
+    AttributeFunction,
+    Compare,
+    CompareOp,
+    Constant,
+    Expression,
+    IsNull,
+    MathExpression,
+    Not,
+    Or,
+    Variable,
+)
+
+# --------------------------------------------------------------- visitor SPI
+
+
+class StoreConditionVisitor:
+    """Compile-time walk of an ON condition (reference:
+    core/util/collection/expression ExpressionVisitor contract). Stores
+    override to build native query syntax; every method receives plain AST
+    leaves."""
+
+    def begin_and(self): ...
+    def end_and(self): ...
+    def begin_or(self): ...
+    def end_or(self): ...
+    def begin_not(self): ...
+    def end_not(self): ...
+    def begin_compare(self, op: CompareOp): ...
+    def end_compare(self, op: CompareOp): ...
+    def visit_constant(self, value, type_name: Optional[str]): ...
+    def visit_attribute(self, name: str): ...
+    def visit_stream_value(self, name: str):
+        """A value from the probing stream (parameterized at lookup time)."""
+
+    def visit_is_null(self, name: str): ...
+
+    def result(self):
+        raise NotImplementedError
+
+
+def walk_condition(expr: Optional[Expression], visitor: StoreConditionVisitor,
+                   table_id: str):
+    """Drive a visitor over the condition AST. Attributes of the table visit
+    as visit_attribute; everything else (stream references) as
+    visit_stream_value placeholders."""
+    if expr is None:
+        return visitor.result()
+
+    def walk(e: Expression):
+        if isinstance(e, And):
+            visitor.begin_and()
+            walk(e.left)
+            walk(e.right)
+            visitor.end_and()
+        elif isinstance(e, Or):
+            visitor.begin_or()
+            walk(e.left)
+            walk(e.right)
+            visitor.end_or()
+        elif isinstance(e, Not):
+            visitor.begin_not()
+            walk(e.expression)
+            visitor.end_not()
+        elif isinstance(e, Compare):
+            visitor.begin_compare(e.op)
+            walk(e.left)
+            walk(e.right)
+            visitor.end_compare(e.op)
+        elif isinstance(e, IsNull):
+            if isinstance(e.expression, Variable):
+                visitor.visit_is_null(e.expression.attribute)
+            else:
+                raise SiddhiAppCreationError(
+                    "record-store isNull supports attribute operands only")
+        elif isinstance(e, Constant):
+            visitor.visit_constant(e.value, e.type_name)
+        elif isinstance(e, Variable):
+            if e.stream_id in (None, table_id):
+                visitor.visit_attribute(e.attribute)
+            else:
+                visitor.visit_stream_value(f"{e.stream_id}.{e.attribute}")
+        else:
+            raise SiddhiAppCreationError(
+                f"record-store conditions do not support "
+                f"{type(e).__name__} (math/functions evaluate on device "
+                "tables only)")
+
+    walk(expr)
+    return visitor.result()
+
+
+class PredicateVisitor(StoreConditionVisitor):
+    """Fallback compiler: condition -> Python predicate over row dicts.
+    Used by InMemoryRecordStore and any adapter without native pushdown."""
+
+    _OPS = {
+        CompareOp.EQUAL: lambda a, b: a == b,
+        CompareOp.NOT_EQUAL: lambda a, b: a != b,
+        CompareOp.GREATER_THAN: lambda a, b: a > b,
+        CompareOp.GREATER_THAN_EQUAL: lambda a, b: a >= b,
+        CompareOp.LESS_THAN: lambda a, b: a < b,
+        CompareOp.LESS_THAN_EQUAL: lambda a, b: a <= b,
+    }
+
+    def __init__(self):
+        self._stack: list = []
+
+    def begin_and(self): pass
+
+    def end_and(self):
+        r, l = self._stack.pop(), self._stack.pop()
+        self._stack.append(lambda row, l=l, r=r: l(row) and r(row))
+
+    def begin_or(self): pass
+
+    def end_or(self):
+        r, l = self._stack.pop(), self._stack.pop()
+        self._stack.append(lambda row, l=l, r=r: l(row) or r(row))
+
+    def begin_not(self): pass
+
+    def end_not(self):
+        e = self._stack.pop()
+        self._stack.append(lambda row, e=e: not e(row))
+
+    def begin_compare(self, op): pass
+
+    def end_compare(self, op):
+        rhs, lhs = self._stack.pop(), self._stack.pop()
+        fn = self._OPS[op]
+        self._stack.append(
+            lambda row, lhs=lhs, rhs=rhs, fn=fn: fn(lhs(row), rhs(row)))
+
+    def visit_constant(self, value, type_name):
+        self._stack.append(lambda row, v=value: v)
+
+    def visit_attribute(self, name):
+        self._stack.append(lambda row, n=name: row.get(n))
+
+    def visit_stream_value(self, name):
+        raise SiddhiAppCreationError(
+            "record-store on-demand conditions cannot reference stream "
+            "attributes; join record tables through their @cache instead")
+
+    def visit_is_null(self, name):
+        self._stack.append(lambda row, n=name: row.get(n) is None)
+
+    def result(self) -> Callable:
+        if not self._stack:
+            return lambda row: True
+        assert len(self._stack) == 1
+        return self._stack[0]
+
+
+# ------------------------------------------------------------------ store SPI
+
+
+class RecordStore:
+    """External-store adapter SPI (reference: AbstractRecordTable). One
+    instance per `define table ... @store(type='x', key='val', ...)`.
+
+    Rows cross the SPI as plain dicts keyed by attribute name (decoded host
+    values, strings as str). `compile_condition` may return any handle the
+    adapter's find/delete/update understand."""
+
+    def init(self, definition: TableDefinition, properties: dict,
+             config_reader=None) -> None:
+        self.definition = definition
+        self.properties = properties
+
+    def connect(self) -> None: ...
+    def disconnect(self) -> None: ...
+
+    def compile_condition(self, expr: Optional[Expression], table_id: str):
+        return walk_condition(expr, PredicateVisitor(), table_id)
+
+    def add(self, rows: list[dict]) -> None:
+        raise NotImplementedError
+
+    def find(self, compiled) -> Iterable[dict]:
+        raise NotImplementedError
+
+    def delete(self, compiled) -> int:
+        raise NotImplementedError
+
+    def update(self, compiled, updater: Callable[[dict], dict]) -> int:
+        raise NotImplementedError
+
+    def update_or_add(self, compiled, updater: Callable[[dict], dict],
+                      rows: list[dict]) -> int:
+        n = self.update(compiled, updater)
+        if n == 0:
+            self.add(rows)
+        return n
+
+
+class InMemoryRecordStore(RecordStore):
+    """Reference-shaped demo adapter (the role of the reference's test
+    stores): list-of-dicts backend, predicate-compiled conditions."""
+
+    def init(self, definition, properties, config_reader=None):
+        super().init(definition, properties, config_reader)
+        self.rows: list[dict] = []
+
+    def add(self, rows):
+        self.rows.extend(dict(r) for r in rows)
+
+    def find(self, compiled):
+        return [dict(r) for r in self.rows if compiled(r)]
+
+    def delete(self, compiled):
+        before = len(self.rows)
+        self.rows = [r for r in self.rows if not compiled(r)]
+        return before - len(self.rows)
+
+    def update(self, compiled, updater):
+        n = 0
+        for i, r in enumerate(self.rows):
+            if compiled(r):
+                self.rows[i] = updater(dict(r))
+                n += 1
+        return n
+
+
+# -------------------------------------------------------------------- cache
+
+
+class CachePolicy:
+    """Host-side key → row bookkeeping for the device cache (reference:
+    CacheTableFIFO/LRU/LFU)."""
+
+    def __init__(self, size: int, policy: str):
+        policy = policy.upper()
+        if policy not in ("FIFO", "LRU", "LFU"):
+            raise SiddhiAppCreationError(
+                f"@cache policy must be FIFO, LRU or LFU, got {policy!r}")
+        self.size = size
+        self.policy = policy
+        self.rows: OrderedDict = OrderedDict()  # key -> row dict
+        self.freq: dict = {}
+
+    def _evict_one(self):
+        if self.policy == "LFU":
+            victim = min(self.rows, key=lambda k: self.freq.get(k, 0))
+        else:  # FIFO and LRU both evict the head of the ordering
+            victim = next(iter(self.rows))
+        del self.rows[victim]
+        self.freq.pop(victim, None)
+
+    def put(self, key, row) -> None:
+        if key in self.rows:
+            self.rows[key] = row
+            if self.policy == "LRU":
+                self.rows.move_to_end(key)
+            self.freq[key] = self.freq.get(key, 0) + 1
+            return
+        while len(self.rows) >= self.size:
+            self._evict_one()
+        self.rows[key] = row
+        self.freq[key] = 1
+
+    def touch(self, key) -> None:
+        if key not in self.rows:
+            return
+        if self.policy == "LRU":
+            self.rows.move_to_end(key)
+        self.freq[key] = self.freq.get(key, 0) + 1
+
+    def remove_if(self, pred) -> None:
+        for k in [k for k, r in self.rows.items() if pred(r)]:
+            del self.rows[k]
+            self.freq.pop(k, None)
+
+    def values(self) -> list[dict]:
+        return list(self.rows.values())
+
+
+# ----------------------------------------------------------------- runtime
+
+
+class RecordTableRuntime:
+    """Host runtime for a `@store(...)` table, presenting the same surface as
+    core/table.py InMemoryTable so the rest of the engine (joins, `in`
+    probes, on-demand queries, table CRUD outputs) composes unchanged.
+
+    With `@cache`: `state` is the device cache table's state — in-kernel
+    probes (joins, `in Table`) read it at device speed. Without a cache,
+    in-kernel probes are rejected at plan time (the reference falls back to
+    per-event store round trips there; a per-lane host call inside a jitted
+    step has no TPU analogue).
+    """
+
+    def __init__(self, definition: TableDefinition, ctx, registry) -> None:
+        from ..core.event import StreamCodec
+        from ..core.table import InMemoryTable
+
+        self.definition = definition
+        self.ctx = ctx
+        self.codec = StreamCodec(definition, ctx.global_strings)
+        self.attr_types = {a.name: a.type for a in definition.attributes
+                           if a.type != AttributeType.OBJECT}
+        self._attr_names = [a.name for a in definition.attributes]
+
+        store_ann = (definition.annotation("store")
+                     or definition.annotation("Store"))
+        props = {e.key: e.value for e in store_ann.elements if e.key}
+        store_type = props.pop("type", None)
+        if not store_type:
+            raise SiddhiAppCreationError(
+                f"table {definition.id!r}: @store needs type='...'")
+        from ..extension.registry import ExtensionKind
+        factory = registry.require(ExtensionKind.STORE, "", store_type)
+        self.store: RecordStore = factory() if isinstance(factory, type) \
+            else factory
+        if not isinstance(self.store, RecordStore):
+            raise SiddhiAppCreationError(
+                f"store extension {store_type!r} must be a RecordStore")
+        self.store.init(definition, props,
+                        ctx.config_reader(f"store:{store_type}")
+                        if hasattr(ctx, "config_reader") else None)
+        self.store.connect()
+
+        pk = definition.annotation("PrimaryKey") if definition.annotations \
+            else None
+        self.primary_keys = tuple(e.value for e in pk.elements) \
+            if pk is not None else ()
+
+        cache_ann = (definition.annotation("cache")
+                     or definition.annotation("Cache"))
+        self.cache = None
+        self.cache_policy = None
+        if cache_ann is not None:
+            copts = {e.key: e.value for e in cache_ann.elements if e.key}
+            size = int(copts.get("size", copts.get("max.size", 128)))
+            policy = copts.get("policy", "FIFO")
+            self.cache_policy = CachePolicy(size, policy)
+            self.cache = InMemoryTable(definition, ctx, capacity=size)
+        self.capacity = self.cache.capacity if self.cache else 0
+        self.dropped_duplicates = 0
+
+    # --- device surface (cache-backed) -----------------------------------
+
+    @property
+    def state(self):
+        if self.cache is None:
+            raise SiddhiAppCreationError(
+                f"record table {self.definition.id!r} has no @cache: joins "
+                "and `in` probes need @cache(size='N', policy='FIFO|LRU|LFU')")
+        return self.cache.state
+
+    def find_mask(self, cond, scope):
+        return self.cache_table().find_mask(cond, scope)
+
+    def contains_probe(self, scope, inner, eq_plan=None):
+        return self.cache_table().contains_probe(scope, inner, eq_plan)
+
+    def cache_table(self):
+        if self.cache is None:
+            # raise with the @cache guidance
+            _ = self.state
+        return self.cache
+
+    def probe_indexes(self) -> dict:
+        """Record tables probe through their device cache; index-aware `in`
+        plans read the cache's sorted copies."""
+        if self.cache is None:
+            return {}
+        return self.cache.probe_indexes()
+
+    # --- host row plumbing -------------------------------------------------
+
+    def _key(self, row: dict):
+        if self.primary_keys:
+            return tuple(row[k] for k in self.primary_keys)
+        return tuple(row.get(n) for n in self._attr_names)
+
+    def _rebuild_cache(self) -> None:
+        if self.cache is None:
+            return
+        # reuse the one device table + its jitted insert (a fresh
+        # InMemoryTable per rebuild would retrace/recompile every write)
+        self.cache.clear()
+        rows = [tuple(r.get(n) for n in self._attr_names)
+                for r in self.cache_policy.values()]
+        if rows:
+            self.cache.insert_rows(rows)
+
+    def _cache_put_rows(self, rows: list[dict]) -> None:
+        if self.cache_policy is None:
+            return
+        for r in rows:
+            self.cache_policy.put(self._key(r), r)
+        self._rebuild_cache()
+
+    def _batch_rows(self, batch) -> list[dict]:
+        events = batch.to_host_events(self.codec)
+        return [dict(zip(self._attr_names, e.data)) for e in events]
+
+    # --- table operations (host-side, mirroring InMemoryTable's API) ------
+
+    def insert_batch(self, batch) -> None:
+        rows = self._batch_rows(batch)
+        self.store.add(rows)
+        self._cache_put_rows(rows)
+
+    def insert_rows(self, rows, timestamp: int = 0) -> None:
+        dicts = [dict(zip(self._attr_names, r)) for r in rows]
+        self.store.add(dicts)
+        self._cache_put_rows(dicts)
+
+    def compile_condition(self, expr):
+        return self.store.compile_condition(expr, self.definition.id)
+
+    def find_rows(self, expr) -> list[dict]:
+        """Authoritative find against the store; read-through refreshes the
+        cache (reference: AbstractQueryableRecordTable.find)."""
+        rows = list(self.store.find(self.compile_condition(expr)))
+        if self.cache_policy is not None:
+            for r in rows:
+                k = self._key(r)
+                if k in self.cache_policy.rows:
+                    self.cache_policy.touch(k)
+                else:
+                    self.cache_policy.put(k, r)
+            self._rebuild_cache()
+        return rows
+
+    def delete_where(self, expr) -> int:
+        compiled = self.compile_condition(expr)
+        n = self.store.delete(compiled)
+        if self.cache_policy is not None:
+            self.cache_policy.remove_if(compiled if callable(compiled)
+                                        else (lambda r: True))
+            self._rebuild_cache()
+        return n
+
+    def update_where(self, expr, updater) -> int:
+        compiled = self.compile_condition(expr)
+        n = self.store.update(compiled, updater)
+        if self.cache_policy is not None:
+            if callable(compiled):
+                for k, r in list(self.cache_policy.rows.items()):
+                    if compiled(r):
+                        self.cache_policy.rows[k] = updater(dict(r))
+            self._rebuild_cache()
+        return n
+
+    def update_or_add_where(self, expr, updater, rows) -> int:
+        compiled = self.compile_condition(expr)
+        n = self.store.update_or_add(compiled, updater, rows)
+        if self.cache_policy is not None:
+            if n and callable(compiled):
+                for k, r in list(self.cache_policy.rows.items()):
+                    if compiled(r):
+                        self.cache_policy.rows[k] = updater(dict(r))
+            if n == 0:
+                for r in rows:
+                    self.cache_policy.put(self._key(r), r)
+            self._rebuild_cache()
+        return n
+
+    def all_rows(self) -> list[tuple]:
+        return [tuple(r.get(n) for n in self._attr_names)
+                for r in self.store.find(lambda row: True)]
+
+    def shutdown(self) -> None:
+        self.store.disconnect()
+
+    def __len__(self) -> int:
+        return len(self.all_rows())
+
+
+# ----------------------------------------------------- host row expressions
+
+
+def compile_row_expr(expr: Expression, table_id: str, table_attrs: set,
+                     prefer: str = "stream") -> Callable:
+    """Compile an AST expression to fn(table_row, stream_row) over host row
+    dicts — the record-table analogue of the device expression compiler,
+    used for CRUD conditions and SET clauses where one side is a store row.
+    Unqualified attributes resolve to `prefer` first ('stream' for query
+    outputs, 'table' for on-demand queries)."""
+    from ..query_api.expression import MathOp
+
+    math_ops = {
+        MathOp.ADD: lambda a, b: a + b,
+        MathOp.SUBTRACT: lambda a, b: a - b,
+        MathOp.MULTIPLY: lambda a, b: a * b,
+        MathOp.DIVIDE: lambda a, b: a / b,
+        MathOp.MOD: lambda a, b: a % b,
+    }
+    cmp_ops = PredicateVisitor._OPS
+
+    def compile_(e: Expression) -> Callable:
+        if isinstance(e, Constant):
+            return lambda t, s, v=e.value: v
+        if isinstance(e, Variable):
+            name = e.attribute
+            if e.stream_id == table_id:
+                return lambda t, s, n=name: (t or {}).get(n)
+            if e.stream_id is not None:
+                return lambda t, s, n=name: (s or {}).get(n)
+            if prefer == "table" and name in table_attrs:
+                return lambda t, s, n=name: (t or {}).get(n)
+
+            def unqual(t, s, n=name):
+                if s is not None and n in s:
+                    return s[n]
+                return (t or {}).get(n)
+
+            return unqual
+        if isinstance(e, Compare):
+            l, r, fn = compile_(e.left), compile_(e.right), cmp_ops[e.op]
+            return lambda t, s: fn(l(t, s), r(t, s))
+        if isinstance(e, And):
+            l, r = compile_(e.left), compile_(e.right)
+            return lambda t, s: l(t, s) and r(t, s)
+        if isinstance(e, Or):
+            l, r = compile_(e.left), compile_(e.right)
+            return lambda t, s: l(t, s) or r(t, s)
+        if isinstance(e, Not):
+            inner = compile_(e.expression)
+            return lambda t, s: not inner(t, s)
+        if isinstance(e, IsNull):
+            inner = compile_(e.expression)
+            return lambda t, s: inner(t, s) is None
+        if isinstance(e, MathExpression):
+            l, r, fn = compile_(e.left), compile_(e.right), math_ops[e.op]
+            return lambda t, s: fn(l(t, s), r(t, s))
+        raise SiddhiAppCreationError(
+            f"record-table host expressions do not support "
+            f"{type(e).__name__}")
+
+    return compile_(expr)
+
+
+class RecordTableOutputExecutor:
+    """Host executor for query outputs targeting a record table
+    (reference: Delete/Update/UpdateOrInsertTableCallback over an
+    AbstractRecordTable): decodes the emitted batch and applies per-row
+    store operations through the SPI."""
+
+    def __init__(self, table: RecordTableRuntime, output_stream,
+                 out_types: dict, out_codec, registry,
+                 out_frame_aliases=()) -> None:
+        from ..query_api.execution import OutputAction
+
+        self.table = table
+        self.action = output_stream.action
+        self.out_codec = out_codec
+        self.out_names = list(out_types)
+        tattrs = set(table.attr_types)
+        cond = output_stream.on_condition
+        if cond is None:
+            raise SiddhiAppCreationError(
+                f"{self.action.name} into table requires an ON condition")
+        self.cond = compile_row_expr(cond, table.definition.id, tattrs,
+                                     prefer="stream")
+        self.sets: list[tuple[str, Callable]] = []
+        if output_stream.set_attributes:
+            for sa in output_stream.set_attributes:
+                self.sets.append((
+                    sa.table_variable.attribute,
+                    compile_row_expr(sa.expression, table.definition.id,
+                                     tattrs, prefer="stream")))
+        else:
+            self.sets = [(n, (lambda t, s, n=n: s.get(n)))
+                         for n in table.attr_types if n in out_types]
+
+    def apply(self, out_batch) -> None:
+        events = out_batch.to_host_events(self.out_codec)
+        self.apply_rows([dict(zip(self.out_names, e.data)) for e in events])
+
+    def apply_rows(self, srows: list[dict]) -> None:
+        from ..query_api.execution import OutputAction
+
+        for srow in srows:
+            cond = self.cond
+
+            def pred(trow, srow=srow, cond=cond):
+                return bool(cond(trow, srow))
+
+            if self.action == OutputAction.DELETE:
+                self.table.store.delete(pred)
+                if self.table.cache_policy is not None:
+                    self.table.cache_policy.remove_if(pred)
+            else:
+                def updater(trow, srow=srow):
+                    for name, fn in self.sets:
+                        trow[name] = fn(trow, srow)
+                    return trow
+
+                if self.action == OutputAction.UPDATE:
+                    n = self.table.store.update(pred, updater)
+                else:  # UPDATE_OR_INSERT
+                    new_row = {n: srow.get(n) for n in self.table.attr_types}
+                    n = self.table.store.update_or_add(pred, updater,
+                                                       [new_row])
+                    if n == 0 and self.table.cache_policy is not None:
+                        self.table.cache_policy.put(
+                            self.table._key(new_row), new_row)
+                if self.table.cache_policy is not None and n:
+                    for k, r in list(self.table.cache_policy.rows.items()):
+                        if pred(r):
+                            self.table.cache_policy.rows[k] = updater(dict(r))
+        if self.table.cache_policy is not None:
+            self.table._rebuild_cache()
+
+
+class RecordCrudRuntime:
+    """Host runtime for write-form on-demand queries against a record table
+    (reference: the non-find OnDemandQueryRuntimes over record tables).
+    Mirrors core/ondemand.py OnDemandCrudRuntime: delete/update/
+    update-or-insert reuse the output executor with one synthetic stream
+    row; select-insert runs the device select over the source store and
+    adds the projected rows."""
+
+    def __init__(self, odq, target: RecordTableRuntime, ctx, registry,
+                 source_store=None) -> None:
+        from ..query_api.execution import OutputAction, OutputStream
+        from ..query_api.expression import Constant
+
+        self.odq = odq
+        self.target = target
+        self.select_runtime = None
+        self.executor = None
+        self._srow: dict = {}
+
+        if odq.action == OutputAction.INSERT:
+            import dataclasses as dc
+
+            from ..core.ondemand import OnDemandQueryRuntime
+            sel_odq = dc.replace(odq, action=OutputAction.RETURN,
+                                 target_id=None)
+            self.select_runtime = OnDemandQueryRuntime(
+                sel_odq, source_store, ctx, registry)
+            return
+
+        out_types: dict = {}
+        if odq.action == OutputAction.UPDATE_OR_INSERT:
+            # the SELECT list supplies the row to insert on no-match
+            tattrs = set(target.attr_types)
+            for oa in odq.selector.attributes:
+                name = oa.rename or getattr(oa.expression, "attribute", None)
+                if name is None:
+                    raise SiddhiAppCreationError(
+                        "update-or-insert select items need `as` names")
+                fn = compile_row_expr(oa.expression, target.definition.id,
+                                      tattrs, prefer="table")
+                self._srow[name] = fn(None, None)
+                out_types[name] = target.attr_types.get(name)
+
+        out_stream = OutputStream(
+            action=odq.action, target_id=target.definition.id,
+            on_condition=odq.on_condition or Constant(True, "bool"),
+            set_attributes=odq.set_attributes)
+        self.executor = RecordTableOutputExecutor(
+            target, out_stream, out_types, None, registry)
+
+    def execute(self, now: int = 0):
+        if self.select_runtime is not None:
+            events = self.select_runtime.execute(now)
+            names = [a.name
+                     for a in self.select_runtime.output_definition.attributes]
+            rows = [dict(zip(names, e.data)) for e in events]
+            self.target.store.add(rows)
+            self.target._cache_put_rows(
+                [{n: r.get(n) for n in self.target.attr_types} for r in rows])
+            return []
+        self.executor.apply_rows([self._srow])
+        return []
+
+
+def register_all() -> None:
+    from ..extension.registry import GLOBAL, ExtensionKind
+    GLOBAL.register(ExtensionKind.STORE, "", "inMemory", InMemoryRecordStore)
+
+
+register_all()

@@ -316,14 +316,65 @@ def _compile_in(expr: In, resolver: TypeResolver, registry: Registry) -> Compile
     inner = compile_expression(expr.expression, resolver, registry) if expr.expression else None
     source = expr.source_id
 
+    # index-aware plan (reference: CollectionExpressionParser choosing a
+    # CompareCollectionExecutor over ExhaustiveCollectionExecutor): a single
+    # `T.attr == <stream expr>` equality probes the table's sorted index
+    eq_plan = None
+    e = expr.expression
+    if isinstance(e, Compare) and e.op == CompareOp.EQUAL:
+        for tside, sside in ((e.left, e.right), (e.right, e.left)):
+            if not (isinstance(tside, Variable) and tside.stream_id == source):
+                continue
+            if _references_frame(sside, source, resolver):
+                continue
+            if isinstance(sside, Constant) and sside.type_name == "string":
+                # intern against the TABLE attribute's string table so the
+                # probe compares int32 codes (same app-global space)
+                try:
+                    code = resolver.string_code(source, tside.attribute,
+                                                sside.value)
+                except SiddhiAppCreationError:
+                    break
+                sc = CompiledExpr(
+                    lambda s, c=code: jnp.full(
+                        s.ts[s.default_frame].shape, c, jnp.int32),
+                    AttributeType.STRING)
+            else:
+                try:
+                    sc = compile_expression(sside, resolver, registry)
+                except SiddhiAppCreationError:
+                    break
+            eq_plan = (tside.attribute, sc)
+            break
+
     def fn(s: Scope):
         probe = s.extras.get(f"in:{source}")
         if probe is None:
             raise SiddhiAppCreationError(
                 f"`in {source}` used outside a table-aware context")
-        return probe(s, inner)
+        return probe(s, inner, eq_plan)
 
     return CompiledExpr(fn, AttributeType.BOOL)
+
+
+def _references_frame(e: Expression, frame: str, resolver: TypeResolver) -> bool:
+    if isinstance(e, Variable):
+        if e.stream_id is not None:
+            return e.stream_id == frame
+        # an unqualified variable may resolve to the table frame
+        try:
+            ref, _, _ = resolver.resolve(e)
+        except Exception:
+            return True  # unresolvable: be conservative, decline the plan
+        return ref == frame
+    for attr in ("left", "right", "expression"):
+        sub = getattr(e, attr, None)
+        if isinstance(sub, Expression) and _references_frame(sub, frame, resolver):
+            return True
+    for p_ in getattr(e, "parameters", ()) or ():
+        if isinstance(p_, Expression) and _references_frame(p_, frame, resolver):
+            return True
+    return False
 
 
 def _compile_function(expr: AttributeFunction, resolver: TypeResolver,

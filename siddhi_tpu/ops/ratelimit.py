@@ -261,6 +261,7 @@ class GroupedSnapshotState(NamedTuple):
     rows: dict  # [G] retained last row per group, per column
     present: jax.Array  # bool[G]
     bucket: jax.Array  # int64 last observed time bucket
+    overflow: jax.Array  # int32 lifetime lanes whose group slot exceeded G
 
 
 class GroupedSnapshotLimiter(RateLimiterOp):
@@ -290,6 +291,7 @@ class GroupedSnapshotLimiter(RateLimiterOp):
             rows={k: jnp.zeros((G,), dt) for k, dt in self.layout.items()},
             present=jnp.zeros((G,), bool),
             bucket=jnp.int64(-1),
+            overflow=jnp.int32(0),
         )
 
     def step(self, state: GroupedSnapshotState, out: EventBatch, now):
@@ -321,11 +323,14 @@ class GroupedSnapshotLimiter(RateLimiterOp):
         dest = jnp.where(is_last, slots, G)
         rows = {k: state.rows[k].at[dest].set(out.cols[k], mode="drop")
                 for k in self.layout}
+        cur = out.valid & (out.types == EventType.CURRENT)
         new_state = GroupedSnapshotState(
             rows=rows,
             present=state.present.at[dest].set(True, mode="drop"),
             bucket=jnp.where(first, bucket,
                              jnp.maximum(state.bucket, bucket)),
+            overflow=state.overflow + jnp.sum(cur & (slots >= G),
+                                              dtype=jnp.int32),
         )
         return new_state, emit
 

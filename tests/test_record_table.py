@@ -1,0 +1,186 @@
+"""Record-table SPI + @cache tests (reference: the store/ and
+query/table cache test blocks — AbstractRecordTable extension contract,
+CacheTableFIFO/LRU/LFU policies)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.errors import SiddhiAppCreationError
+from siddhi_tpu.extension.registry import ExtensionKind
+from siddhi_tpu.io.record_table import InMemoryRecordStore, RecordStore
+
+APP = """
+define stream S (sym string, price double);
+@store(type='inMemory')
+define table T (sym string, price double);
+from S select sym, price insert into T;
+"""
+
+
+def build(app, **kw):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(app, **kw)
+    rt.start()
+    return rt
+
+
+class TestRecordStoreSPI:
+    def test_insert_and_on_demand_find(self):
+        rt = build(APP)
+        h = rt.get_input_handler("S")
+        h.send(("IBM", 75.0))
+        h.send(("WSO2", 57.0))
+        rt.flush()
+        rows = rt.query("from T on price > 60.0 select sym, price")
+        assert [r.data for r in rows] == [("IBM", 75.0)]
+        # the store is the authority
+        store = rt.tables["T"].store
+        assert len(store.rows) == 2
+
+    def test_on_demand_crud(self):
+        rt = build("@store(type='inMemory')\n"
+                   "define table T (sym string, price double);")
+        rt.query("select 'a' as sym, 1.0 as price update or insert into T "
+                 "on T.sym == 'a'")
+        rt.query("select 'b' as sym, 2.0 as price update or insert into T "
+                 "on T.sym == 'b'")
+        assert sorted(rt.tables["T"].all_rows()) == [("a", 1.0), ("b", 2.0)]
+        rt.query("update T set T.price = T.price * 10.0 on T.sym == 'a'")
+        assert ("a", 10.0) in rt.tables["T"].all_rows()
+        rt.query("delete T on T.sym == 'b'")
+        assert rt.tables["T"].all_rows() == [("a", 10.0)]
+
+    def test_query_output_crud(self):
+        rt = build("define stream S (sym string, price double);\n"
+                   "@store(type='inMemory')\n"
+                   "define table T (sym string, price double);\n"
+                   "from S select sym, price update or insert into T "
+                   "on T.sym == sym;")
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0))
+        rt.flush()
+        h.send(("a", 5.0))  # updates, not duplicates
+        h.send(("b", 2.0))
+        rt.flush()
+        assert sorted(rt.tables["T"].all_rows()) == [("a", 5.0), ("b", 2.0)]
+
+    def test_custom_store_via_set_extension(self):
+        calls = []
+
+        class AuditedStore(InMemoryRecordStore):
+            def add(self, rows):
+                calls.append(("add", len(rows)))
+                super().add(rows)
+
+            def find(self, compiled):
+                calls.append(("find", None))
+                return super().find(compiled)
+
+        mgr = SiddhiManager()
+        mgr.set_extension("audited", AuditedStore)
+        rt = mgr.create_siddhi_app_runtime(
+            "define stream S (k int);\n"
+            "@store(type='audited')\n"
+            "define table T (k int);\n"
+            "from S select k insert into T;")
+        rt.start()
+        rt.get_input_handler("S").send((7,))
+        rt.flush()
+        rows = rt.query("from T select k")
+        assert [r.data for r in rows] == [(7,)]
+        assert ("add", 1) in calls and ("find", None) in calls
+
+    def test_store_properties_passed(self):
+        seen = {}
+
+        class PropStore(InMemoryRecordStore):
+            def init(self, definition, properties, config_reader=None):
+                seen.update(properties)
+                super().init(definition, properties, config_reader)
+
+        mgr = SiddhiManager()
+        mgr.set_extension("propStore", PropStore)
+        rt = mgr.create_siddhi_app_runtime(
+            "@store(type='propStore', uri='fake://host', mode='rw')\n"
+            "define table T (k int);")
+        rt.start()
+        assert seen == {"uri": "fake://host", "mode": "rw"}
+
+
+class TestRecordTableCache:
+    CACHED = """
+    define stream S (sym string, price double);
+    define stream Q (sym string);
+    @store(type='inMemory')
+    @cache(size='2', policy='{policy}')
+    @PrimaryKey('sym')
+    define table T (sym string, price double);
+    from S select sym, price insert into T;
+    @info(name='j') from Q join T on Q.sym == T.sym
+    select Q.sym as sym, T.price as price insert into Out;
+    """
+
+    def _joined(self, rt, sym):
+        got = []
+        rt.add_query_callback("j", lambda ts, i, r: got.extend(
+            tuple(e.data) for e in i or []))
+        rt.get_input_handler("Q").send((sym,))
+        rt.flush()
+        return got
+
+    def test_join_reads_cache_at_device_speed(self):
+        rt = build(self.CACHED.format(policy="FIFO"))
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0))
+        h.send(("b", 2.0))
+        rt.flush()
+        assert self._joined(rt, "b") == [("b", 2.0)]
+
+    def test_fifo_eviction(self):
+        rt = build(self.CACHED.format(policy="FIFO"))
+        h = rt.get_input_handler("S")
+        for i, sym in enumerate(["a", "b", "c"]):  # size 2: 'a' evicted
+            h.send((sym, float(i)))
+            rt.flush()
+        cp = rt.tables["T"].cache_policy
+        assert [k[0] for k in cp.rows] == ["b", "c"]
+        # the store still has all three (cache is a view, not the authority)
+        assert len(rt.tables["T"].store.rows) == 3
+        # a miss served by the store read-through re-populates the cache
+        rows = rt.query("from T on sym == 'a' select sym, price")
+        assert [r.data for r in rows] == [("a", 0.0)]
+        assert ("a",) in cp.rows
+
+    def test_lru_eviction_prefers_recently_read(self):
+        rt = build(self.CACHED.format(policy="LRU"))
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0))
+        h.send(("b", 2.0))
+        rt.flush()
+        # touch 'a' via a read-through find, then insert 'c': 'b' evicts
+        rt.query("from T on sym == 'a' select sym")
+        h.send(("c", 3.0))
+        rt.flush()
+        cp = rt.tables["T"].cache_policy
+        assert sorted(k[0] for k in cp.rows) == ["a", "c"]
+
+    def test_lfu_eviction_prefers_frequent(self):
+        rt = build(self.CACHED.format(policy="LFU"))
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0))
+        h.send(("b", 2.0))
+        rt.flush()
+        for _ in range(3):
+            rt.query("from T on sym == 'a' select sym")
+        h.send(("c", 3.0))
+        rt.flush()
+        cp = rt.tables["T"].cache_policy
+        assert sorted(k[0] for k in cp.rows) == ["a", "c"]
+
+    def test_uncached_join_rejected_with_guidance(self):
+        with pytest.raises(SiddhiAppCreationError, match="@cache"):
+            build("define stream Q (sym string);\n"
+                  "@store(type='inMemory')\n"
+                  "define table T (sym string, price double);\n"
+                  "from Q join T on Q.sym == T.sym "
+                  "select Q.sym as s insert into Out;")

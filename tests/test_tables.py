@@ -238,3 +238,58 @@ class TestReviewRegressions:
         with pytest.raises(CapacityExceededError):
             t.insert_rows([(2,), (3,), (4,)])
         assert t.all_rows() == [(1,)]  # untouched
+
+
+class TestSecondaryIndex:
+    """@Index sorted-copy planner (reference: IndexEventHolder.java:60 +
+    CompareCollectionExecutor picking index plans over exhaustive scans)."""
+
+    APP = """
+    define stream S (symbol string, price double);
+    @Index('symbol')
+    define table T (symbol string, price double);
+    define stream C (symbol string);
+    @info(name='chk') from C[C.symbol == T.symbol in T]
+    select symbol insert into Hits;
+    """
+
+    def test_indexed_membership_parity(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            self.APP + "from S select symbol, price insert into T;\n")
+        rt.start()
+        got = []
+        rt.add_query_callback("chk", lambda ts, i, r: got.extend(
+            e.data[0] for e in i or []))
+        hs = rt.get_input_handler("S")
+        hc = rt.get_input_handler("C")
+        hs.send(("IBM", 75.0))
+        hs.send(("WSO2", 57.0))
+        rt.flush()
+        for sym in ("IBM", "GOOG", "WSO2"):
+            hc.send((sym,))
+        rt.flush()
+        assert got == ["IBM", "WSO2"]
+        # mutation invalidates and rebuilds the sorted copy
+        rt.query("delete T on T.symbol == 'IBM'")
+        hc.send(("IBM",))
+        hc.send(("WSO2",))
+        rt.flush()
+        assert got == ["IBM", "WSO2", "WSO2"]
+
+    def test_index_plan_is_chosen(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(self.APP)
+        rt.start()
+        t = rt.tables["T"]
+        assert t.index_attrs == ("symbol",)
+        assert "symbol" in t.probe_indexes()
+
+    def test_unknown_index_attr_rejected(self):
+        import pytest as _pytest
+
+        from siddhi_tpu.errors import SiddhiAppCreationError
+        with _pytest.raises(SiddhiAppCreationError, match="Index"):
+            SiddhiManager().create_siddhi_app_runtime(
+                "@Index('nope')\n"
+                "define table T (k int);")
