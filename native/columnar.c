@@ -243,11 +243,176 @@ fill_ts(PyObject *self, PyObject *args)
     return Py_NewRef(Py_None);
 }
 
+/* ------------------------------------------------------------------------
+ * MPSC staging ring — the Disruptor's role (reference:
+ * core/stream/StreamJunction.java:279-316 ring buffer + worker consumers).
+ *
+ * Producers (source threads / user send) claim slots with a C11 atomic
+ * fetch-add and publish with a per-slot sequence stamp; one consumer (the
+ * junction's feeder thread) drains batches. Correct for true concurrent
+ * producers (the design does not lean on the GIL for the index protocol;
+ * the PyObject* payloads themselves are only touched under the GIL, which
+ * every Python-level producer and the feeder hold at the call boundary).
+ * ---------------------------------------------------------------------- */
+
+#include <stdatomic.h>
+
+typedef struct {
+    Py_ssize_t cap;
+    atomic_size_t head;       /* next slot to claim (producers) */
+    size_t tail;              /* next slot to read (single consumer) */
+    atomic_size_t *seq;       /* published when seq[i % cap] == i + 1 */
+    PyObject **rows;          /* owned references */
+    int64_t *ts;
+} mpsc_ring;
+
+static void
+ring_capsule_destruct(PyObject *capsule)
+{
+    mpsc_ring *r = (mpsc_ring *)PyCapsule_GetPointer(capsule, "siddhi.ring");
+    if (r == NULL)
+        return;
+    for (size_t i = r->tail; i < atomic_load(&r->head); i++) {
+        size_t s = i % (size_t)r->cap;
+        if (atomic_load(&r->seq[s]) == i + 1)
+            Py_XDECREF(r->rows[s]);
+    }
+    PyMem_Free(r->seq);
+    PyMem_Free(r->rows);
+    PyMem_Free(r->ts);
+    PyMem_Free(r);
+}
+
+/* ring_new(capacity) -> capsule */
+static PyObject *
+ring_new(PyObject *self, PyObject *args)
+{
+    Py_ssize_t cap;
+    if (!PyArg_ParseTuple(args, "n", &cap))
+        return NULL;
+    if (cap < 1) {
+        PyErr_SetString(PyExc_ValueError, "ring capacity must be >= 1");
+        return NULL;
+    }
+    mpsc_ring *r = PyMem_Calloc(1, sizeof(mpsc_ring));
+    if (r == NULL)
+        return PyErr_NoMemory();
+    r->cap = cap;
+    atomic_init(&r->head, 0);
+    r->tail = 0;
+    r->seq = PyMem_Calloc((size_t)cap, sizeof(atomic_size_t));
+    r->rows = PyMem_Calloc((size_t)cap, sizeof(PyObject *));
+    r->ts = PyMem_Calloc((size_t)cap, sizeof(int64_t));
+    if (!r->seq || !r->rows || !r->ts) {
+        PyMem_Free(r->seq); PyMem_Free(r->rows); PyMem_Free(r->ts);
+        PyMem_Free(r);
+        return PyErr_NoMemory();
+    }
+    return PyCapsule_New(r, "siddhi.ring", ring_capsule_destruct);
+}
+
+static mpsc_ring *
+ring_of(PyObject *capsule)
+{
+    return (mpsc_ring *)PyCapsule_GetPointer(capsule, "siddhi.ring");
+}
+
+/* ring_push(ring, ts, row) -> bool (False = full, caller applies
+ * backpressure like the Disruptor's blocking wait) */
+static PyObject *
+ring_push(PyObject *self, PyObject *args)
+{
+    PyObject *capsule, *row;
+    long long ts;
+    if (!PyArg_ParseTuple(args, "OLO", &capsule, &ts, &row))
+        return NULL;
+    mpsc_ring *r = ring_of(capsule);
+    if (r == NULL)
+        return NULL;
+    size_t cap = (size_t)r->cap;
+    size_t claimed = atomic_load(&r->head);
+    for (;;) {
+        if (claimed - r->tail >= cap)
+            Py_RETURN_FALSE; /* full */
+        if (atomic_compare_exchange_weak(&r->head, &claimed, claimed + 1))
+            break;
+    }
+    size_t s = claimed % cap;
+    Py_INCREF(row);
+    r->rows[s] = row;
+    r->ts[s] = (int64_t)ts;
+    atomic_store(&r->seq[s], claimed + 1); /* publish */
+    Py_RETURN_TRUE;
+}
+
+/* ring_pop_batch(ring, max_n) -> (ts_list, row_list) — single consumer */
+static PyObject *
+ring_pop_batch(PyObject *self, PyObject *args)
+{
+    PyObject *capsule;
+    Py_ssize_t max_n;
+    if (!PyArg_ParseTuple(args, "On", &capsule, &max_n))
+        return NULL;
+    mpsc_ring *r = ring_of(capsule);
+    if (r == NULL)
+        return NULL;
+    PyObject *ts_list = PyList_New(0);
+    PyObject *row_list = PyList_New(0);
+    if (!ts_list || !row_list) {
+        Py_XDECREF(ts_list);
+        Py_XDECREF(row_list);
+        return NULL;
+    }
+    size_t cap = (size_t)r->cap;
+    for (Py_ssize_t n = 0; n < max_n; n++) {
+        size_t i = r->tail;
+        size_t s = i % cap;
+        if (atomic_load(&r->seq[s]) != i + 1)
+            break; /* not yet published (or empty) */
+        PyObject *ts_obj = PyLong_FromLongLong((long long)r->ts[s]);
+        if (ts_obj == NULL || PyList_Append(ts_list, ts_obj) < 0 ||
+            PyList_Append(row_list, r->rows[s]) < 0) {
+            Py_XDECREF(ts_obj);
+            Py_DECREF(ts_list);
+            Py_DECREF(row_list);
+            return NULL;
+        }
+        Py_DECREF(ts_obj);
+        Py_DECREF(r->rows[s]);
+        r->rows[s] = NULL;
+        atomic_store(&r->seq[s], 0);
+        r->tail = i + 1;
+    }
+    return Py_BuildValue("(NN)", ts_list, row_list);
+}
+
+/* ring_size(ring) -> int (published, unconsumed entries; approximate
+ * under concurrent producers) */
+static PyObject *
+ring_size(PyObject *self, PyObject *args)
+{
+    PyObject *capsule;
+    if (!PyArg_ParseTuple(args, "O", &capsule))
+        return NULL;
+    mpsc_ring *r = ring_of(capsule);
+    if (r == NULL)
+        return NULL;
+    return PyLong_FromSize_t(atomic_load(&r->head) - r->tail);
+}
+
 static PyMethodDef methods[] = {
     {"encode_rows", encode_rows, METH_VARARGS,
      "Encode row tuples into columnar buffers with string interning."},
     {"fill_ts", fill_ts, METH_VARARGS,
      "Fill an int64 timestamp buffer with monotone padding."},
+    {"ring_new", ring_new, METH_VARARGS,
+     "Create an MPSC staging ring of (ts, row) slots."},
+    {"ring_push", ring_push, METH_VARARGS,
+     "Push one (ts, row); returns False when full (backpressure)."},
+    {"ring_pop_batch", ring_pop_batch, METH_VARARGS,
+     "Drain up to max_n published entries (single consumer)."},
+    {"ring_size", ring_size, METH_VARARGS,
+     "Published, unconsumed entry count."},
     {NULL, NULL, 0, NULL},
 };
 

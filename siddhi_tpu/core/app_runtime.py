@@ -271,6 +271,8 @@ class SiddhiAppRuntime:
 
     def start(self) -> None:
         self._started = True
+        for j in self.junctions.values():
+            j.start_async()
         for sink in self.sinks:
             sink.connect()
         for source in self.sources:
@@ -283,6 +285,8 @@ class SiddhiAppRuntime:
 
     def shutdown(self) -> None:
         self._started = False
+        for j in self.junctions.values():
+            j.stop_async()
         for t in self.tables.values():
             if hasattr(t, "shutdown"):
                 t.shutdown()

@@ -9,6 +9,7 @@ registry snapshot, batching knobs, and statistics.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -32,6 +33,7 @@ class TimestampGenerator:
         self.playback = playback
         self.playback_increment_ms = playback_increment_ms
         self.idle_time_ms = idle_time_ms
+        self._observe_lock = threading.Lock()
         self._last_event_ts: Optional[int] = None
 
     def current_time(self) -> int:
@@ -42,8 +44,11 @@ class TimestampGenerator:
         return int(time.time() * 1000)
 
     def observe_event_time(self, ts: int) -> None:
-        if self._last_event_ts is None or ts > self._last_event_ts:
-            self._last_event_ts = ts
+        # multiple producer threads race this check-then-set; the watermark
+        # must never regress (time-window expiry ordering depends on it)
+        with self._observe_lock:
+            if self._last_event_ts is None or ts > self._last_event_ts:
+                self._last_event_ts = ts
 
     def advance_idle(self) -> int:
         """Playback idle bump: virtual clock += increment. Returns new time."""
@@ -153,6 +158,10 @@ class SiddhiAppContext:
     #: app-global string interning table shared by every codec (stream, table,
     #: window, query output) so dictionary codes are consistent app-wide
     global_strings: object = None
+    #: single-controller gate: async feeder threads and user-thread
+    #: flush/heartbeat/query serialize device work through this RLock (the
+    #: role of the reference's ThreadBarrier + per-query locks)
+    controller_lock: object = field(default_factory=threading.RLock)
 
     @property
     def effective_batch_size(self) -> int:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import time
 from typing import Callable, Optional, Sequence
 
 import numpy as np
@@ -69,17 +70,32 @@ class StreamJunction:
         self.codec = codec or StreamCodec(definition, ctx.global_strings)
         self.receivers: list[Receiver] = []
         self.batch_size = ctx.effective_batch_size
-        # async annotation: in the reference this switches to a Disruptor ring
-        # (StreamJunction.java:104-134); here it only tunes the batch size.
+        # @Async: the reference switches to a Disruptor ring with worker
+        # consumers (StreamJunction.java:104-134, 279-316). Here:
+        # buffer.size tunes the micro-batch AND, once the app starts, a C
+        # MPSC staging ring (native/columnar.c) + feeder thread decouple
+        # producers from the controller — send() stages in O(1) and the
+        # feeder encodes/dispatches batches under the controller lock.
         ann = definition.annotation("async") if definition.annotations else None
+        self.is_async = ann is not None
+        self._ring = None
+        self._ring_cap = 0
+        self._feeder = None
+        self._feeder_stop = None
+        self._feeder_wake = None
         if ann is not None:
             bs = ann.element("buffer.size")
             if bs:
                 self.batch_size = int(bs)
+            self._ring_cap = max(4 * self.batch_size, 1024)
         self._staged_rows: list = []
         self._staged_ts: list[int] = []
         self.on_error: Optional[Callable] = None
-        self._flushing = False
+        # per-THREAD re-entrancy guards (flushing during callbacks; drain
+        # nesting): shared booleans would make one thread's activity no-op
+        # another thread's barrier
+        import threading as _threading
+        self._reentry = _threading.local()
         # @OnError(action=LOG|STREAM|STORE) (reference:
         # StreamJunction.java:371-463, OnErrorAction); None = propagate
         on_error_ann = (definition.annotation("OnError")
@@ -101,30 +117,155 @@ class StreamJunction:
     # ---------------------------------------------------------------- ingest
 
     def send_row(self, ts: int, data: Sequence) -> None:
+        if self._ring is not None and not self._lock_owned():
+            self.ctx.timestamp_generator.observe_event_time(ts)
+            # blocking backpressure when the ring is full, like the
+            # Disruptor's blocking wait strategy. No per-send wake: the
+            # feeder polls at 1 ms, and an Event.set() per row costs more
+            # than the stage itself. Re-read the ring each spin: shutdown
+            # detaches it, and late sends must fall back to the sync path.
+            push = self._ring_push
+            while True:
+                ring = self._ring
+                if ring is None:
+                    break
+                if push(ring, ts, tuple(data)):
+                    return
+                self._feeder_wake.set()
+                time.sleep(0.0002)
         self._staged_ts.append(ts)
         self._staged_rows.append(data)
         self.ctx.timestamp_generator.observe_event_time(ts)
         if len(self._staged_rows) >= self.batch_size:
             self.flush()
 
+    # ------------------------------------------------------------ async mode
+
+    def _lock_owned(self) -> bool:
+        """True when THIS thread already holds the controller lock (a
+        callback inside _deliver sending into an async stream): pushing to
+        the ring there can deadlock — the only drainer needs the lock we
+        hold — so those sends take the synchronous staging path."""
+        try:
+            return self.ctx.controller_lock._is_owned()
+        except AttributeError:  # pragma: no cover — non-CPython RLock
+            return getattr(self._reentry, "flushing", False) or \
+                getattr(self._reentry, "draining", False)
+
+    def start_async(self) -> None:
+        """Spin up the staging ring + feeder thread (app start; reference:
+        StreamJunction.startProcessing starting the Disruptor)."""
+        from .. import native as native_mod
+        if not self.is_async or self._feeder is not None:
+            return
+        if native_mod.native is None:
+            logging.getLogger("siddhi_tpu").info(
+                "@Async on %r: native ring unavailable (no C toolchain); "
+                "staying synchronous", self.definition.id)
+            return
+        import threading
+        self._ring_push = native_mod.native.ring_push
+        self._ring = native_mod.native.ring_new(self._ring_cap)
+        self._feeder_stop = threading.Event()
+        self._feeder_wake = threading.Event()
+        self._feeder = threading.Thread(
+            target=self._feed_loop, daemon=True,
+            name=f"siddhi-feeder-{self.definition.id}")
+        self._feeder.start()
+
+    def stop_async(self) -> None:
+        if self._feeder is None:
+            return
+        self._feeder_stop.set()
+        self._feeder_wake.set()
+        # detach FIRST: producers mid-spin fall back to the synchronous
+        # staging path instead of landing rows in a ring nobody will drain
+        ring, self._ring = self._ring, None
+        # generous: the feeder may sit inside a first-compile (~40 s on TPU)
+        self._feeder.join(timeout=120)
+        if self._feeder.is_alive():  # pragma: no cover — wedged device step
+            logging.getLogger("siddhi_tpu").warning(
+                "async feeder for %r did not stop; leaving its ring "
+                "attached (a second consumer would race it)",
+                self.definition.id)
+            return
+        # feeder is gone: drain anything still staged (under the lock so a
+        # concurrent user flush cannot become a second consumer)
+        with self.ctx.controller_lock:
+            self._drain_ring(ring=ring)
+        self._feeder = None
+
+    def _feed_loop(self) -> None:
+        from .. import native as native_mod
+        n = native_mod.native
+        while not self._feeder_stop.is_set():
+            ring = self._ring
+            if ring is None:  # detached by shutdown
+                break
+            if n.ring_size(ring) == 0:
+                self._feeder_wake.wait(timeout=0.001)
+                self._feeder_wake.clear()
+                continue
+            try:
+                with self.ctx.controller_lock:
+                    self._drain_ring(max_batches=4, ring=ring)
+            except Exception:  # pragma: no cover — surfaced via @OnError/log
+                logging.getLogger("siddhi_tpu").exception(
+                    "async feeder error on %r", self.definition.id)
+
+    def _drain_ring(self, max_batches: Optional[int] = None,
+                    ring=None) -> None:
+        """Pop ring entries into the staging buffers and flush as batches.
+        Single-consumer discipline: callers hold the controller lock. Owns
+        the _draining flag so the nested flush() calls cannot re-enter the
+        drain (which would defeat max_batches and hold the lock unbounded)."""
+        from .. import native as native_mod
+        ring = ring if ring is not None else self._ring
+        if ring is None or getattr(self._reentry, "draining", False):
+            return
+        n = native_mod.native
+        self._reentry.draining = True
+        try:
+            batches = 0
+            while max_batches is None or batches < max_batches:
+                tss, rows = n.ring_pop_batch(ring, self.batch_size)
+                if not rows:
+                    break
+                self._staged_ts.extend(tss)
+                self._staged_rows.extend(rows)
+                self.flush()
+                batches += 1
+        finally:
+            self._reentry.draining = False
+
     def publish_batch(self, batch: EventBatch, now: int) -> None:
         """Device-side publication (query output chaining). Staged host rows
         are flushed first to preserve arrival order."""
-        if self._staged_rows:
-            self.flush()
-        self._deliver(batch, now)
+        with self.ctx.controller_lock:
+            if self._staged_rows:
+                self.flush()
+            self._deliver(batch, now)
 
     # ----------------------------------------------------------------- flush
 
     def flush(self, now: Optional[int] = None) -> None:
-        if self._flushing:
-            # re-entrant flush (a callback sending into its own stream): defer
+        if getattr(self._reentry, "flushing", False):
+            # same-thread re-entrant flush (a callback sending into its own
+            # stream): defer to the outer delivery
             return
-        if not self._staged_rows:
-            return
-        rows, tss = self._staged_rows, self._staged_ts
-        self._staged_rows, self._staged_ts = [], []
+        # the staged-list swap and delivery run under the controller lock:
+        # the feeder thread extends/flushes the same lists
+        with self.ctx.controller_lock:
+            if self._ring is not None and not getattr(self._reentry,
+                                                      "draining", False):
+                self._drain_ring()
+            if not self._staged_rows:
+                return
+            rows, tss = self._staged_rows, self._staged_ts
+            self._staged_rows, self._staged_ts = [], []
+            self._flush_rows(rows, tss, now)
 
+    def _flush_rows(self, rows, tss, now) -> None:
         cap = self.batch_size
         n = len(rows)
         for start in range(0, n, cap):
@@ -168,12 +309,13 @@ class StreamJunction:
         """Advance time with no data: flush staged rows then deliver an empty
         batch so time-window expirations fire (the watermark analogue of the
         reference's Scheduler TIMER events, core/util/Scheduler.java:48)."""
-        self.flush(now)
-        empty = EventBatch.empty(self.definition, self.batch_size)
-        self._deliver(empty, now)
+        with self.ctx.controller_lock:
+            self.flush(now)
+            empty = EventBatch.empty(self.definition, self.batch_size)
+            self._deliver(empty, now)
 
     def _deliver(self, batch: EventBatch, now: int) -> None:
-        self._flushing = True
+        self._reentry.flushing = True
         try:
             n = int(batch.count()) if self.ctx.statistics.enabled else 0
             self.ctx.statistics.track_in(self.definition.id, n)
@@ -189,7 +331,7 @@ class StreamJunction:
                     else:
                         raise
         finally:
-            self._flushing = False
+            self._reentry.flushing = False
         # deliver rows staged re-entrantly during callbacks
         if self._staged_rows and len(self._staged_rows) >= self.batch_size:
             self.flush()

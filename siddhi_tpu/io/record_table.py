@@ -313,6 +313,12 @@ class CachePolicy:
     def values(self) -> list[dict]:
         return list(self.rows.values())
 
+    def clear(self) -> None:
+        """Conservative full invalidation (pushdown conditions we cannot
+        evaluate host-side)."""
+        self.rows.clear()
+        self.freq.clear()
+
 
 # ----------------------------------------------------------------- runtime
 
@@ -468,8 +474,10 @@ class RecordTableRuntime:
         compiled = self.compile_condition(expr)
         n = self.store.delete(compiled)
         if self.cache_policy is not None:
-            self.cache_policy.remove_if(compiled if callable(compiled)
-                                        else (lambda r: True))
+            if callable(compiled):
+                self.cache_policy.remove_if(compiled)
+            else:  # pushdown handle: conservative full invalidation
+                self.cache_policy.clear()
             self._rebuild_cache()
         return n
 
@@ -481,6 +489,10 @@ class RecordTableRuntime:
                 for k, r in list(self.cache_policy.rows.items()):
                     if compiled(r):
                         self.cache_policy.rows[k] = updater(dict(r))
+            else:
+                # pushdown handle we can't evaluate host-side: drop the
+                # whole cache rather than serve stale rows
+                self.cache_policy.clear()
             self._rebuild_cache()
         return n
 
@@ -492,6 +504,9 @@ class RecordTableRuntime:
                 for k, r in list(self.cache_policy.rows.items()):
                     if compiled(r):
                         self.cache_policy.rows[k] = updater(dict(r))
+            elif n:
+                # non-callable pushdown handle: conservative invalidation
+                self.cache_policy.clear()
             if n == 0:
                 for r in rows:
                     self.cache_policy.put(self._key(r), r)
@@ -499,8 +514,11 @@ class RecordTableRuntime:
         return n
 
     def all_rows(self) -> list[tuple]:
+        # an empty condition must go through the SPI compile so pushdown
+        # adapters receive a handle they understand, not a Python lambda
+        match_all = self.compile_condition(None)
         return [tuple(r.get(n) for n in self._attr_names)
-                for r in self.store.find(lambda row: True)]
+                for r in self.store.find(match_all)]
 
     def shutdown(self) -> None:
         self.store.disconnect()

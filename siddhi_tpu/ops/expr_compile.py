@@ -344,6 +344,16 @@ def _compile_in(expr: In, resolver: TypeResolver, registry: Registry) -> Compile
                     sc = compile_expression(sside, resolver, registry)
                 except SiddhiAppCreationError:
                     break
+            # type divergence guard: the sorted-copy probe compares in the
+            # TABLE column's dtype; mixed-type compares (int column vs
+            # double stream value) must keep the exhaustive path, which
+            # promotes both sides
+            try:
+                _, _, t_type = resolver.resolve(tside)
+            except Exception:
+                break
+            if sc.type != t_type:
+                break
             eq_plan = (tside.attribute, sc)
             break
 

@@ -244,7 +244,11 @@ class SnapshotService:
             "queries": {name: _to_host(qr.state)
                         for name, qr in rt.query_runtimes.items()
                         if not getattr(qr, "_partitioned", False)},
-            "tables": {tid: _to_host(t.state) for tid, t in rt.tables.items()},
+            # record (@store) tables are external authorities: their rows
+            # live in the store, not in device state — skip them (the cache
+            # rebuilds from the store/policy on use)
+            "tables": {tid: _to_host(t.state) for tid, t in rt.tables.items()
+                       if not hasattr(t, "store")},
             "windows": {wid: _to_host(w.state)
                         for wid, w in getattr(rt, "windows", {}).items()},
             "aggregations": {aid: _to_host(a.state)
@@ -271,7 +275,7 @@ class SnapshotService:
                 if name in snap["queries"] and not getattr(qr, "_partitioned", False):
                     qr.state = _to_device(snap["queries"][name], qr.state)
             for tid, t in rt.tables.items():
-                if tid in snap["tables"]:
+                if tid in snap["tables"] and not hasattr(t, "store"):
                     t.state = _to_device(snap["tables"][tid], t.state)
             for wid, w in getattr(rt, "windows", {}).items():
                 if wid in snap.get("windows", {}):

@@ -67,16 +67,20 @@ class TestQueryChaining:
 
     def test_async_annotation_buffer_size(self):
         # @Async(buffer.size=N) tunes the micro-batch (the Disruptor knob)
+        # and enables ring+feeder ingestion: delivery is asynchronous, and
+        # flush() is the barrier that drains the staging ring
         rt = build(
             "@Async(buffer.size='4')\n"
             "define stream S (v long);\n"
             "@info(name='q') from S select count() as n insert into Out;")
         assert rt.junctions["S"].batch_size == 4
+        assert rt.junctions["S"].is_async
         got = []
         rt.add_query_callback("q", lambda ts, i, r: got.extend(i or []))
         h = rt.get_input_handler("S")
         for i in range(4):
-            h.send((i,))  # 4th send crosses the buffer → auto-flush
+            h.send((i,))
+        rt.flush()
         assert got and got[-1].data[0] == 4
 
     def test_many_entities_one_app(self):

@@ -184,3 +184,20 @@ class TestRecordTableCache:
                   "define table T (sym string, price double);\n"
                   "from Q join T on Q.sym == T.sym "
                   "select Q.sym as s insert into Out;")
+
+
+class TestRecordTablePersistence:
+    def test_persist_restore_skips_external_store(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            "define stream S (k int);\n"
+            "@store(type='inMemory')\n"
+            "define table T (k int);\n"
+            "from S select k insert into T;")
+        rt.start()
+        rt.get_input_handler("S").send((1,))
+        rt.flush()
+        blob = rt.snapshot()
+        rt.restore(blob)
+        # store rows survive independently of engine snapshots
+        assert rt.tables["T"].all_rows() == [(1,)]
