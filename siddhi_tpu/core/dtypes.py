@@ -54,6 +54,8 @@ class _Config:
     pattern_pending_capacity = 1024
     #: retained groups for `output snapshot ... group by` (rows per snapshot)
     snapshot_group_capacity = 1024
+    #: key slots for keyed session windows (session(gap, key))
+    session_key_capacity = 4096
     #: expansion bound for unbounded pattern counts `<m:>`.
     pattern_unbounded_count_extra = 8
 

@@ -108,7 +108,8 @@ class _Side:
             self.attr_types = {
                 a.name: a.type for a in self.junction.definition.attributes
                 if a.type != AttributeType.OBJECT}
-            layout = {n: dtypes.device_dtype(t) for n, t in self.attr_types.items()}
+            from ..ops.windows import make_layout
+            layout = make_layout(self.attr_types)
             batch_cap = self.junction.batch_size
             wh = ins.handlers.window
             if wh is not None:

@@ -164,11 +164,13 @@ class QueryRuntime(Receiver):
 
         # --- window (layout includes stream-function columns) ---
         batch_cap = input_junction.batch_size
-        layout = {a.name: dtypes.device_dtype(a.type)
-                  for a in definition.attributes if a.type != AttributeType.OBJECT}
+        from ..ops.windows import make_layout
+        layout = make_layout({a.name: a.type for a in definition.attributes
+                              if a.type != AttributeType.OBJECT})
         for spec, _ in self.pre_window_fns:
             for n, t in spec.new_attrs:
                 layout[n] = dtypes.device_dtype(t)
+                layout.attr_types[n] = t
         # expired-lane emission (reference: outputExpectsExpiredEvents wiring,
         # QueryParser): batch windows only materialize EXPIRED lanes when the
         # query output wants them (`insert all/expired events`) — a CURRENT

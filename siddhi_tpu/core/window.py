@@ -50,7 +50,8 @@ class NamedWindow:
         self.output_junction = StreamJunction(
             self.stream_definition, ctx, codec=self.codec)
 
-        layout = {n: dtypes.device_dtype(t) for n, t in self.attr_types.items()}
+        from ..ops.windows import make_layout
+        layout = make_layout(self.attr_types)
         batch_cap = ctx.effective_batch_size
         wh = definition.window
         if wh is not None:

@@ -91,10 +91,15 @@ def _make_external_time_batch(layout, batch_cap, params, expired_on):
 
 
 def _make_session(layout, batch_cap, params, expired_on):
+    from ..query_api.expression import Variable
     gap = _int_param(params, 0, "session")
     if len(params) > 1:
-        raise SiddhiAppCreationError(
-            "keyed sessions (session(gap, key)) are not yet supported")
+        key = params[1]
+        if not isinstance(key, Variable):
+            raise SiddhiAppCreationError(
+                "session key must be a stream attribute")
+        from .windows_extra import KeyedSessionWindow
+        return KeyedSessionWindow(layout, batch_cap, gap, key.attribute)
     return SessionWindow(layout, batch_cap, gap)
 
 

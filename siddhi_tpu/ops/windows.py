@@ -158,6 +158,22 @@ def _empty_like_cols(layout: dict, n: int) -> dict:
     return {k: jnp.zeros((n,), dtype=dt) for k, dt in layout.items()}
 
 
+class TypedLayout(dict):
+    """Column layout (name -> device dtype) carrying the AttributeTypes
+    behind it, for window factories that must distinguish STRING codes from
+    raw ints (both int32 on device). Build via `make_layout`."""
+
+    attr_types: dict
+
+
+def make_layout(attr_types: dict) -> TypedLayout:
+    """attr_types: name -> AttributeType (OBJECT already excluded)."""
+    from ..core import dtypes as _dt
+    lo = TypedLayout({n: _dt.device_dtype(t) for n, t in attr_types.items()})
+    lo.attr_types = dict(attr_types)
+    return lo
+
+
 # --------------------------------------------------------------------------- #
 # packed-row payload: all columns + ts as one u32 matrix
 #
@@ -906,8 +922,8 @@ class SessionWindow(WindowOp):
     larger than `gap` opens (next arrival or watermark), the closed session's
     events are re-emitted as EXPIRED (reference: SessionWindowProcessor.java —
     current chunk passes through:308, expired chunk of the previous session
-    prepended on rollover:303-307). Keyed sessions (`session(gap, key)`) are
-    not yet supported."""
+    prepended on rollover:303-307). Keyed sessions (`session(gap, key)`)
+    live in ops/windows_extra.py KeyedSessionWindow."""
 
     def __init__(self, layout: dict, batch_cap: int, gap_ms: int,
                  capacity: Optional[int] = None):

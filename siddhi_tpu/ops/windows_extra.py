@@ -377,3 +377,195 @@ def _match(table_keys: jax.Array, query_keys: jax.Array) -> jax.Array:
     pos_c = jnp.clip(pos, 0, N - 1)
     found = sorted_keys[pos_c] == query_keys
     return jnp.where(found, order[pos_c], N).astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------- #
+# keyed session window
+# --------------------------------------------------------------------------- #
+
+
+class KeyedSessionState(NamedTuple):
+    ring_cols: dict
+    ring_ts: jax.Array  # int64[C]
+    ring_key: jax.Array  # int32[C] key slot per row
+    ring_sess: jax.Array  # int32[C] session id per row (per key)
+    ring_emitted: jax.Array  # bool[C] expired emission already happened
+    appended: jax.Array  # int64 total arrivals
+    last_ts: jax.Array  # int64[K] newest event ts per key
+    sess: jax.Array  # int32[K] current open session id per key
+    has: jax.Array  # bool[K] key has an open session
+
+
+class KeyedSessionWindow(WindowOp):
+    """session(gap, key): one independent session per key value (reference:
+    SessionWindowProcessor with a session-key parameter keeps a per-key
+    session map). Device design: key slots are the key attribute's
+    dictionary codes (string keys — dense by construction); per-key
+    last-ts/session tables replace the scalar session state, ring rows carry
+    (key, session, emitted) tags, and a session closing (in-batch gap or
+    watermark) expires exactly its rows via a masked ring scan.
+
+    Documented divergences: expired lanes of sessions closed within a batch
+    emit BEFORE that batch's CURRENT lanes (the reference interleaves per
+    triggering event); key codes beyond the slot capacity
+    (config.session_key_capacity) have their events dropped from the window
+    — size the capacity to the key domain."""
+
+    needs_heartbeat = True
+
+    def __init__(self, layout: dict, batch_cap: int, gap_ms: int,
+                 key_attr: str, capacity: Optional[int] = None):
+        if gap_ms <= 0:
+            raise SiddhiAppCreationError("session gap must be positive")
+        if key_attr not in layout:
+            raise SiddhiAppCreationError(
+                f"session key {key_attr!r} is not a stream attribute")
+        attr_types = getattr(layout, "attr_types", None)
+        if attr_types is None:
+            raise SiddhiAppCreationError(
+                "keyed sessions need attribute type information "
+                "(ops/windows.py make_layout) to validate the key attribute")
+        from ..query_api.definition import AttributeType
+        if attr_types.get(key_attr) not in (AttributeType.STRING,
+                                            AttributeType.INT,
+                                            AttributeType.LONG):
+            raise SiddhiAppCreationError(
+                "session keys must be string (dictionary codes) or "
+                "small non-negative int attributes")
+        self.layout = dict(layout)
+        self.B = batch_cap
+        self.gap = gap_ms
+        self.key_attr = key_attr
+        self.K = dtypes.config.session_key_capacity
+        self.C = capacity or max(dtypes.config.default_window_capacity // 4,
+                                 2 * batch_cap)
+        # emission block cannot exceed the ring (slicing would misalign the
+        # fixed-width chunk concatenation)
+        self.E = min(max(batch_cap, 1024), self.C)
+        self.chunk_width = self.B + self.E
+
+    def init_state(self) -> KeyedSessionState:
+        C, K = self.C, self.K
+        return KeyedSessionState(
+            ring_cols=_empty_like_cols(self.layout, C),
+            ring_ts=jnp.zeros((C,), dtypes.TS_DTYPE),
+            ring_key=jnp.zeros((C,), jnp.int32),
+            ring_sess=jnp.zeros((C,), jnp.int32),
+            ring_emitted=jnp.ones((C,), bool),  # empty slots count as done
+            appended=jnp.int64(0),
+            last_ts=jnp.zeros((K,), dtypes.TS_DTYPE),
+            sess=jnp.zeros((K,), jnp.int32),
+            has=jnp.zeros((K,), bool),
+        )
+
+    def step(self, state: KeyedSessionState, batch: EventBatch,
+             now: jax.Array):
+        from ..core.event import EventType
+        from .windows import compact
+
+        B, C, E, K = self.B, self.C, self.E, self.K
+        gap = jnp.int64(self.gap)
+        comp_cols, comp_ts, n_valid, _ = compact(batch)
+        p32 = jnp.arange(B, dtype=jnp.int32)
+        is_arr = p32 < n_valid
+        key = comp_cols[self.key_attr].astype(jnp.int32)
+        ok = is_arr & (key >= 0) & (key < K)
+        key_c = jnp.clip(key, 0, K - 1)
+
+        # --- per-arrival session ids: group arrivals by key (stable sort
+        # keeps arrival order inside each key run) ---
+        skey = jnp.where(ok, key_c, jnp.int32(K))
+        order = jnp.argsort(skey, stable=True)
+        o_key = skey[order]
+        o_ts = comp_ts[order]
+        o_ok = ok[order]
+        seg_start = jnp.concatenate(
+            [jnp.ones((1,), bool), o_key[1:] != o_key[:-1]])
+        prev_ts = jnp.concatenate([jnp.zeros((1,), o_ts.dtype), o_ts[:-1]])
+        base_last = state.last_ts[jnp.clip(o_key, 0, K - 1)]
+        base_has = state.has[jnp.clip(o_key, 0, K - 1)]
+        # break before this arrival: vs the key's stored last ts at segment
+        # start, vs the in-batch predecessor inside a segment
+        brk = jnp.where(seg_start,
+                        base_has & (o_ts - base_last > gap),
+                        o_ts - prev_ts > gap) & o_ok
+        # per-key cumulative breaks (segmented cumsum)
+        from .groupby import _segmented_scan
+        incr = _segmented_scan(brk.astype(jnp.int32), seg_start,
+                               jnp.add, jnp.int32(0))
+        base_sess = state.sess[jnp.clip(o_key, 0, K - 1)]
+        o_sess = base_sess + incr
+        # back to arrival order
+        arr_sess = jnp.zeros((B,), jnp.int32).at[order].set(o_sess)
+
+        # --- per-key tables after this batch ---
+        seg_end = jnp.concatenate([seg_start[1:], jnp.ones((1,), bool)])
+        wkey = jnp.where(o_ok & seg_end, o_key, K)
+        new_last = state.last_ts.at[wkey].set(o_ts, mode="drop")
+        new_sess = state.sess.at[wkey].set(o_sess, mode="drop")
+        new_has = state.has.at[wkey].set(True, mode="drop")
+
+        # watermark closure: keys whose open session has gone quiet bump
+        # their session id (their rows become expired below) and reset
+        wm_close = new_has & (now - new_last > gap)
+        new_sess = jnp.where(wm_close, new_sess + 1, new_sess)
+        new_has = new_has & ~wm_close
+
+        # --- ring append (arrivals with their session tags): PACK ok lanes
+        # so dropped-key arrivals leave no holes (appended advances by
+        # sum(ok); a positional write would misalign every later lane) ---
+        rank = jnp.cumsum(ok.astype(jnp.int32)) - 1
+        slot = jnp.where(ok, ((state.appended % C).astype(jnp.int32) + rank) % C,
+                         C)
+        ring_cols = {k: state.ring_cols[k].at[slot].set(comp_cols[k],
+                                                        mode="drop")
+                     for k in self.layout}
+        ring_ts = state.ring_ts.at[slot].set(comp_ts, mode="drop")
+        ring_key = state.ring_key.at[slot].set(key_c, mode="drop")
+        ring_sess = state.ring_sess.at[slot].set(arr_sess, mode="drop")
+        ring_emitted = state.ring_emitted.at[slot].set(False, mode="drop")
+        appended1 = state.appended + jnp.sum(ok, dtype=jnp.int32).astype(
+            jnp.int64)
+
+        # --- expired: un-emitted rows whose session is no longer open ---
+        live = _ring_live_mask(C, jnp.maximum(appended1 - C, 0), appended1)
+        open_sess = new_sess[ring_key]
+        closed = live & ~ring_emitted & (ring_sess < open_sess)
+        # top-E selection in ARRIVAL order (ring slots rotate once the ring
+        # wraps; expired lanes must emit oldest-first). Sessions close
+        # rarely; E bounds the per-step emission — the rest emit next step.
+        base1 = (appended1 % C).astype(jnp.int32)
+        rel_age = (jnp.arange(C, dtype=jnp.int32) - base1) % C
+        ekey = jnp.where(closed, rel_age, jnp.int32(C))
+        eorder = jnp.argsort(ekey, stable=True)[:E]
+        esel = closed[eorder]
+        emitted2 = ring_emitted | (jnp.zeros((C,), bool).at[
+            jnp.where(esel, eorder, C)].set(True, mode="drop"))
+
+        exp_cols = {k: ring_cols[k][eorder] for k in self.layout}
+        exp_ts = ring_ts[eorder]
+
+        all_cols = {k: jnp.concatenate([exp_cols[k], comp_cols[k]])
+                    for k in self.layout}
+        all_ts = jnp.concatenate([exp_ts, comp_ts])
+        all_valid = jnp.concatenate([esel, ok])
+        all_types = jnp.concatenate([
+            jnp.full((E,), EventType.EXPIRED, jnp.int8),
+            jnp.full((B,), EventType.CURRENT, jnp.int8),
+        ])
+        chunk = EventBatch(ts=all_ts, cols=all_cols, valid=all_valid,
+                           types=all_types)
+
+        new_state = KeyedSessionState(
+            ring_cols=ring_cols, ring_ts=ring_ts, ring_key=ring_key,
+            ring_sess=ring_sess, ring_emitted=emitted2,
+            appended=appended1, last_ts=new_last, sess=new_sess,
+            has=new_has)
+        return new_state, chunk
+
+    def contents(self, state: KeyedSessionState, now: jax.Array):
+        live = _ring_live_mask(self.C, jnp.maximum(state.appended - self.C, 0),
+                               state.appended)
+        open_rows = live & ~state.ring_emitted & (
+            state.ring_sess >= state.sess[state.ring_key])
+        return state.ring_cols, state.ring_ts, open_rows

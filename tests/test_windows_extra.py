@@ -180,3 +180,55 @@ class TestSmallBatchSlidingWindow:
         exp = [(e.data[0], e.data[1]) for pair in got for e in pair[1]]
         assert cur == [f"s{i}" for i in range(8)]
         assert exp == [(f"s{i}", float(i)) for i in range(3)]
+
+
+class TestKeyedSessionWindow:
+    """session(gap, key) — reference SessionWindowProcessor with a session
+    key keeps independent per-key sessions."""
+
+    def _build(self):
+        rt = build(
+            S + "@info(name='q') from S#window.session(2 sec, symbol) "
+            "select symbol, price insert all events into Out;", batch_size=4)
+        got = []
+        rt.add_query_callback("q", lambda ts, i, r: got.append(
+            ([tuple(e.data[:2]) for e in i or []],
+             [tuple(e.data[:2]) for e in r or []])))
+        return rt, got
+
+    def test_per_key_sessions_close_independently(self):
+        rt, got = self._build()
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0, 0), timestamp=1_000)
+        h.send(("b", 2.0, 0), timestamp=1_500)
+        h.send(("a", 3.0, 0), timestamp=2_000)
+        rt.flush()
+        # 'a' goes quiet; 'b' keeps its session alive
+        h.send(("b", 4.0, 0), timestamp=3_400)
+        rt.flush()
+        h.send(("b", 5.0, 0), timestamp=4_600)
+        rt.flush()
+        # watermark far past a's last event (2000): a's session expires;
+        # b's latest (4600) is still within gap at 4_700? advance past a only
+        rt.heartbeat(4_700)
+        expired = [e for pair in got for e in pair[1]]
+        assert sorted(expired) == [("a", 1.0), ("a", 3.0)]
+        # now b goes quiet too
+        rt.heartbeat(7_000)
+        expired = [e for pair in got for e in pair[1]]
+        assert sorted(expired) == [
+            ("a", 1.0), ("a", 3.0), ("b", 2.0), ("b", 4.0), ("b", 5.0)]
+
+    def test_in_batch_gap_closes_only_that_key(self):
+        rt, got = self._build()
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0, 0), timestamp=1_000)
+        h.send(("b", 2.0, 0), timestamp=1_100)
+        # a's next event gaps (>2s since 1000); the watermark at 5000 also
+        # closes b's idle session (last event 1100 + gap < 5000)
+        h.send(("a", 9.0, 0), timestamp=5_000)
+        rt.flush()
+        expired = [e for pair in got for e in pair[1]]
+        assert sorted(expired) == [("a", 1.0), ("b", 2.0)]
+        currents = [e for pair in got for e in pair[0]]
+        assert ("a", 9.0) in currents and ("b", 2.0) in currents
