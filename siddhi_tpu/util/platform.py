@@ -17,18 +17,21 @@ import os
 import re
 
 
+def set_host_device_count_flag(flags: str, n_devices: int) -> str:
+    """Return ``flags`` with ``--xla_force_host_platform_device_count`` set
+    to exactly ``n_devices``, replacing any inherited count rather than
+    trusting it (it may be smaller than what we need; older jax has no
+    jax_num_cpu_devices config, so XLA_FLAGS must carry the right value)."""
+    flag = "--xla_force_host_platform_device_count"
+    if flag in flags:
+        return re.sub(rf"{flag}=\S+", f"{flag}={n_devices}", flags)
+    return (flags + f" {flag}={n_devices}").strip()
+
+
 def force_cpu_platform(n_devices: int) -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
-    flag = "--xla_force_host_platform_device_count"
-    flags = os.environ.get("XLA_FLAGS", "")
-    if flag in flags:
-        # Replace an inherited count rather than trusting it: it may be
-        # smaller than what we need (older jax has no jax_num_cpu_devices
-        # config, so XLA_FLAGS must carry the right value by itself).
-        flags = re.sub(rf"{flag}=\S+", f"{flag}={n_devices}", flags)
-    else:
-        flags = (flags + f" {flag}={n_devices}").strip()
-    os.environ["XLA_FLAGS"] = flags
+    os.environ["XLA_FLAGS"] = set_host_device_count_flag(
+        os.environ.get("XLA_FLAGS", ""), n_devices)
 
     import jax
 

@@ -1,0 +1,74 @@
+"""Hardening tests for the driver entry points in __graft_entry__.
+
+The official multi-chip gate calls ``dryrun_multichip`` from a process that
+may already hold an initialised (possibly broken) TPU backend; the proof must
+verify the CPU mesh with real dispatches and fall back to a clean subprocess
+when in-process recovery fails (reference behaviour being proven:
+single-JVM Siddhi partitions, `core/partition/PartitionStreamReceiver.java:82`,
+re-expressed as a mesh-sharded SPMD step).
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import __graft_entry__ as graft
+
+
+def test_verify_cpu_mesh_passes_under_cpu_conftest():
+    # conftest already forced an 8-device CPU platform; verification must
+    # agree (this is the gate's happy path — no subprocess needed).
+    assert graft._verify_cpu_mesh(8)
+
+
+def test_subprocess_fallback_env(monkeypatch):
+    # When in-process verification fails, dryrun must re-exec in a clean
+    # interpreter with JAX_PLATFORMS=cpu and the device-count flag exported
+    # BEFORE any jax import, and must not recurse in the child.
+    captured = {}
+
+    def fake_run(cmd, cwd=None, env=None, capture_output=None, text=None,
+                 timeout=None):
+        captured.update(cmd=cmd, cwd=cwd, env=env)
+        return types.SimpleNamespace(returncode=0, stdout="ok\n", stderr="")
+
+    monkeypatch.setattr(graft.subprocess, "run", fake_run)
+    monkeypatch.setattr(graft, "_verify_cpu_mesh", lambda n: False)
+    monkeypatch.delenv("SIDDHI_TPU_DRYRUN_CHILD", raising=False)
+
+    graft.dryrun_multichip(8)
+
+    env = captured["env"]
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    assert env["XLA_FLAGS"].count("--xla_force_host_platform_device_count") == 1
+    assert env["SIDDHI_TPU_DRYRUN_CHILD"] == "1"
+    assert captured["cmd"][0] == sys.executable
+    assert "dryrun_multichip(8)" in captured["cmd"][-1]
+
+
+def test_child_does_not_recurse(monkeypatch):
+    monkeypatch.setattr(graft, "_verify_cpu_mesh", lambda n: False)
+    monkeypatch.setenv("SIDDHI_TPU_DRYRUN_CHILD", "1")
+    try:
+        graft.dryrun_multichip(8)
+    except RuntimeError as e:
+        assert "clean subprocess" in str(e)
+    else:
+        raise AssertionError("child with no CPU mesh must raise, not recurse")
+
+
+def test_subprocess_failure_raises(monkeypatch):
+    def fake_run(*a, **k):
+        return types.SimpleNamespace(returncode=3, stdout="", stderr="boom")
+
+    monkeypatch.setattr(graft.subprocess, "run", fake_run)
+    monkeypatch.setattr(graft, "_verify_cpu_mesh", lambda n: False)
+    monkeypatch.delenv("SIDDHI_TPU_DRYRUN_CHILD", raising=False)
+    try:
+        graft.dryrun_multichip(8)
+    except RuntimeError as e:
+        assert "rc=3" in str(e) and "boom" in str(e)
+    else:
+        raise AssertionError("subprocess failure must propagate")
