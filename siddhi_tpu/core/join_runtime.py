@@ -24,11 +24,13 @@ import jax.numpy as jnp
 from ..errors import DefinitionNotExistError, SiddhiAppCreationError
 from ..extension.registry import ExtensionKind, Registry
 from ..ops.expr_compile import Scope, TypeResolver, compile_expression
-from ..ops.join import (JoinPlan, compact_pairs, plan_join, probe_cross,
-                        probe_equi)
+from ..ops.join import (JoinPlan, _hash_exprs, compact_pairs, multimap_append,
+                        multimap_buckets, multimap_init, plan_join,
+                        probe_cross, probe_equi, probe_equi_mm)
 from ..ops.selector import CompiledSelector
 from ..ops.window_factories import WindowFactory
-from ..ops.windows import PassThroughWindow, WindowOp
+from ..ops.windows import (PassThroughWindow, SlidingWindow, WindowOp,
+                           _unpack_rows)
 from ..query_api.definition import Attribute, AttributeType, StreamDefinition
 from ..query_api.execution import (
     EventTrigger,
@@ -189,14 +191,37 @@ class JoinQueryRuntime:
             attributes=self.output_attributes)
         self.output_codec = StreamCodec(self.output_definition, ctx.global_strings)
 
+        # --- incremental hash multimaps (one per hashable build side) ---
+        # A side's multimap serves probes FROM the other side; it indexes the
+        # side's sliding ring by the equi-key hash of the plan that treats it
+        # as the build frame. Inserted at append time, probed chain-walk only
+        # — no per-step build sort (reference find(): JoinProcessor.java:140).
+        def _mm_setup(side, plan_as_build):
+            if (isinstance(side.window, SlidingWindow)
+                    and plan_as_build.probe_keys):
+                return multimap_buckets(side.window.C)
+            return None
+
+        self.left._mm_buckets = _mm_setup(self.left, self.plan_from_right)
+        self.right._mm_buckets = _mm_setup(self.right, self.plan_from_left)
+        self.left._mm_build_keys = self.plan_from_right.build_keys
+        self.right._mm_build_keys = self.plan_from_left.build_keys
+
         def _side_state(s):
             if s.is_table or s.is_named_window or s.is_aggregation:
                 return ()
             return s.window.init_state()
 
+        def _mm_state(s):
+            if s._mm_buckets is None:
+                return ()
+            return multimap_init(s.window.C, s._mm_buckets)
+
         self.state = (
             _side_state(self.left),
             _side_state(self.right),
+            _mm_state(self.left),
+            _mm_state(self.right),
             self.selector.init_state(),
         )
         self._step_left = jax.jit(self._make_step(from_left=True),
@@ -229,9 +254,15 @@ class JoinQueryRuntime:
         outer = self._probe_outer(from_left)
         filters = probe_side.filters
 
+        use_mm = (build_side._mm_buckets is not None
+                  and not (build_side.is_table or build_side.is_named_window
+                           or build_side.is_aggregation)
+                  and bool(plan.probe_keys))
+
         def step(state, batch: EventBatch, now, build_tstate=None):
-            wl, wr, sel = state
+            wl, wr, mml, mmr, sel = state
             w_probe, w_build = (wl, wr) if from_left else (wr, wl)
+            mm_probe, mm_build = (mml, mmr) if from_left else (mmr, mml)
 
             # --- probe-side filter + window append ---
             pscope = Scope()
@@ -249,10 +280,19 @@ class JoinQueryRuntime:
 
             if not (probe_side.is_table or probe_side.is_named_window
                     or probe_side.is_aggregation):
+                appended0 = getattr(w_probe, "appended", None)
                 w_probe, _chunk = probe_side.window.step(w_probe, batch, now)
+                if probe_side._mm_buckets is not None:
+                    live = mask & (batch.types == EventType.CURRENT)
+                    hashes = _hash_exprs(probe_side._mm_build_keys, pscope)
+                    mm_probe = multimap_append(mm_probe, hashes, live,
+                                               appended0)
 
-            # --- build-side contents ---
-            if build_side.is_table:
+            # --- build-side contents (multimap path never materializes
+            #     the full ring — candidates gather packed rows below) ---
+            if use_mm:
+                b_cols = b_ts = b_valid = None
+            elif build_side.is_table:
                 b_cols = build_tstate.cols
                 b_ts = build_tstate.ts
                 b_valid = build_tstate.valid
@@ -264,9 +304,9 @@ class JoinQueryRuntime:
                     build_tstate, now)
             else:
                 b_cols, b_ts, b_valid = build_side.window.contents(w_build, now)
-            if build_side.filters and (build_side.is_table
-                                       or build_side.is_named_window
-                                       or build_side.is_aggregation):
+            if (not use_mm) and build_side.filters and (
+                    build_side.is_table or build_side.is_named_window
+                    or build_side.is_aggregation):
                 # stream sides are filtered before their ring append; probed
                 # contents (tables / named windows) are filtered here
                 bscope = Scope()
@@ -277,7 +317,24 @@ class JoinQueryRuntime:
                     b_valid = b_valid & f(bscope)
 
             # --- candidate pairs ---
-            if plan.probe_keys:
+            truncated = jnp.int32(0)
+            if use_mm:
+                bw = build_side.window
+                window_len = w_build.appended - jnp.maximum(
+                    w_build.expired, w_build.appended - bw.C)
+                lane, brow, pv, truncated = probe_equi_mm(
+                    plan, pscope, mask, mm_build, w_build.appended,
+                    window_len, k_max)
+                if bw.time_ms is not None:
+                    # probe-time expiry BEFORE pair compaction, mirroring
+                    # SlidingWindow.contents(): a time window whose own side
+                    # went idle holds rows past their deadline that would
+                    # otherwise consume pair_cap slots and evict live matches
+                    tsw = w_build.ring[-2:, brow]
+                    cand_ts = jax.lax.bitcast_convert_type(
+                        jnp.stack([tsw[0], tsw[1]], axis=-1), jnp.int64)
+                    pv = pv & (cand_ts + jnp.int64(bw.time_ms) > now)
+            elif plan.probe_keys:
                 lane, brow, pv = probe_equi(
                     plan, pscope, mask, b_cols, b_ts, b_valid,
                     build_side.ref, k_max)
@@ -294,16 +351,20 @@ class JoinQueryRuntime:
                                32768))
             if pair_cap < lane.shape[0]:
                 n_matches = jnp.sum(pv, dtype=jnp.int32)
-                dropped = jnp.maximum(n_matches - pair_cap, 0)
+                dropped = jnp.maximum(n_matches - pair_cap, 0) + truncated
                 lane, brow, pv = compact_pairs(lane, brow, pv, pair_cap)
             else:
-                dropped = jnp.int32(0)
+                dropped = truncated
 
             # --- pair frames ---
             p_cols = {k: v[lane] for k, v in batch.cols.items()}
             p_ts = batch.ts[lane]
-            g_cols = {k: v[brow] for k, v in b_cols.items()}
-            g_ts = b_ts[brow]
+            if use_mm:
+                rows = w_build.ring[:, brow]  # [W, P] packed lane gather
+                g_cols, g_ts = _unpack_rows(rows, build_side.window.layout)
+            else:
+                g_cols = {k: v[brow] for k, v in b_cols.items()}
+                g_ts = b_ts[brow]
 
             pair = Scope()
             if from_left:
@@ -328,7 +389,12 @@ class JoinQueryRuntime:
                 matched = jax.ops.segment_max(
                     pv.astype(jnp.int32), lane, num_segments=B) > 0
                 o_valid = mask & ~matched
-                zero_g = {k: jnp.zeros((B,), v.dtype) for k, v in b_cols.items()}
+                if use_mm:
+                    zero_g = {k: jnp.zeros((B,), jnp.dtype(dt))
+                              for k, dt in build_side.window.layout.items()}
+                else:
+                    zero_g = {k: jnp.zeros((B,), v.dtype)
+                              for k, v in b_cols.items()}
                 lane = jnp.concatenate([lane, jnp.arange(B)])
                 all_pv = jnp.concatenate([pv, o_valid])
                 has_build = jnp.concatenate(
@@ -366,7 +432,9 @@ class JoinQueryRuntime:
             sel, out = selector.step(sel, chunk, out_scope)
 
             new_wl, new_wr = (w_probe, w_build) if from_left else (w_build, w_probe)
-            return (new_wl, new_wr, sel), out, dropped
+            new_mml, new_mmr = ((mm_probe, mm_build) if from_left
+                                else (mm_build, mm_probe))
+            return (new_wl, new_wr, new_mml, new_mmr, sel), out, dropped
 
         return step
 
@@ -388,13 +456,15 @@ class JoinQueryRuntime:
         else:
             tstate = None
         if not triggers:
-            # non-triggering side still feeds its window
+            # non-triggering side still feeds its window (+ multimap)
             if side.is_table or side.is_named_window or side.is_aggregation:
                 return
-            wl, wr, sel = self.state
+            wl, wr, mml, mmr, sel = self.state
             w = wl if from_left else wr
-            w2, _ = self._append_only(side, w, batch, now)
-            self.state = (w2, wr, sel) if from_left else (wl, w2, sel)
+            mm = mml if from_left else mmr
+            w2, mm2 = self._append_only(side, w, mm, batch, now)
+            self.state = ((w2, wr, mm2, mmr, sel) if from_left
+                          else (wl, w2, mml, mm2, sel))
             return
         self.state, out, dropped = step(self.state, batch, jnp.int64(now),
                                         tstate)
@@ -408,16 +478,34 @@ class JoinQueryRuntime:
                 import warnings
                 warnings.warn(
                     f"join {self.name!r}: {int(self._dropped_dev)} matched "
-                    "pairs exceeded the per-step pair block and were dropped "
-                    "— raise config.join_pair_cap_factor", stacklevel=2)
+                    "pairs exceeded the per-step pair block or the per-probe "
+                    "candidate walk and were dropped — raise "
+                    "config.join_pair_cap_factor / config.join_max_matches",
+                    stacklevel=2)
                 self._drop_warned = True
         self._distribute(out, now)
 
-    def _append_only(self, side, wstate, batch, now):
+    def _append_only(self, side, wstate, mmstate, batch, now):
         if not hasattr(side, "_append_fn"):
-            side._append_fn = jax.jit(
-                lambda w, b, n: side.window.step(w, b, n))
-        return side._append_fn(wstate, batch, jnp.int64(now))
+            filters = side.filters
+
+            def fn(w, mm, b, n):
+                scope = Scope()
+                scope.add_frame(side.ref, b.cols, b.ts, b.valid, default=True)
+                scope.extras["now"] = n
+                mask = b.valid
+                for f in filters:
+                    mask = mask & f(scope)
+                b = dataclasses.replace(b, valid=mask)
+                w2, _chunk = side.window.step(w, b, n)
+                if side._mm_buckets is not None:
+                    live = mask & (b.types == EventType.CURRENT)
+                    hashes = _hash_exprs(side._mm_build_keys, scope)
+                    mm = multimap_append(mm, hashes, live, w.appended)
+                return w2, mm
+
+            side._append_fn = jax.jit(fn)
+        return side._append_fn(wstate, mmstate, batch, jnp.int64(now))
 
     def _distribute(self, out: EventBatch, now: int) -> None:
         from .query_runtime import QueryRuntime

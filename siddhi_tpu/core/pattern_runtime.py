@@ -68,7 +68,7 @@ from .event import EventBatch, EventType, StreamCodec
 from .query_runtime import QueryCallback
 from .stream import Receiver, StreamJunction
 
-BIGSEQ = jnp.int64(2**62)
+BIGSEQ = 2**62  # Python int literal — see ops/windows.py BIG note (tunnel cost)
 
 
 @dataclass

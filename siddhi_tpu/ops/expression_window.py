@@ -167,7 +167,7 @@ class ExpressionWindow(WindowOp):
 
     def init_state(self) -> SlidingState:
         return SlidingState(
-            ring=jnp.zeros((self.C, self.W), jnp.uint32),
+            ring=jnp.zeros((self.W, self.C), jnp.uint32),
             appended=jnp.int64(0),
             expired=jnp.int64(0),
             wm=jnp.int64(-(2**62)),
@@ -276,7 +276,7 @@ class ExpressionWindow(WindowOp):
 
         all_hi = jnp.concatenate([keys_exp, keys_cur])
         all_lo = jnp.concatenate([pe, p])
-        all_mat = jnp.concatenate([cand_mat, comp_mat], axis=0)
+        all_mat = jnp.concatenate([cand_mat, comp_mat], axis=1)
         all_emit = jnp.concatenate([emit_ts, comp_ts])
         all_valid = jnp.concatenate([expires, cur_valid])
         all_types = jnp.concatenate([

@@ -22,10 +22,11 @@ for left/right/full outer), each pair carrying both frames' columns.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Callable, Optional, Sequence
+from typing import Callable, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .search import searchsorted32
 
@@ -36,7 +37,7 @@ from ..query_api.expression import And, Compare, CompareOp, Expression, Variable
 from .expr_compile import CompiledExpr, Scope, TypeResolver, compile_expression
 from .groupby import hash_columns32
 
-BIGKEY = jnp.uint32(0xFFFFFFFF)
+BIGKEY = np.uint32(0xFFFFFFFF)  # numpy literal — see ops/windows.py BIG note
 
 
 def split_conjuncts(expr: Optional[Expression]) -> list[Expression]:
@@ -154,6 +155,158 @@ def compact_pairs(probe_lane: jax.Array, build_row: jax.Array,
     n = jnp.minimum(jnp.sum(pair_valid, dtype=jnp.int32), pair_cap)
     pv = jnp.arange(pair_cap, dtype=jnp.int32) < n
     return rows[:, 0], rows[:, 1], pv
+
+
+class MultimapState(NamedTuple):
+    """Incrementally maintained hash multimap over a FIFO window ring.
+
+    Replaces the per-step build-side sort of `probe_equi` for sliding-window
+    build sides (the reference's per-event `find()` against the opposite
+    window, JoinProcessor.java:140-143): entries are inserted as rows append
+    to the ring and never explicitly deleted — FIFO overwrite invalidates
+    them, and chains through an overwritten slot terminate safely because
+    every entry past it is older and therefore also overwritten.
+
+    Everything is i32/u32 — int64 lane math is software-emulated on TPU and
+    dominated the first cut of this structure. Entries are addressed by RING
+    POSITION; liveness rides a u32 arrival-index tag per slot compared by
+    wraparound age (`appended - tag`), exact while the window length stays
+    under 2^32 (a slot idle for exactly ~2^32 arrivals could alias — every
+    slot is rewritten each C arrivals, so this needs a 4-billion-event gap).
+    """
+
+    heads: jax.Array  # i32[H] ring position of the newest entry per bucket
+    nexts: jax.Array  # i32[C] ring position of the next-older chain entry
+    slot_hash: jax.Array  # u32[C] full 32-bit key hash of the slot's row
+    slot_seq: jax.Array  # u32[C] arrival index (mod 2^32) of the slot's row
+
+
+def multimap_init(ring_capacity: int, n_buckets: int) -> MultimapState:
+    return MultimapState(
+        heads=jnp.full((n_buckets,), -1, jnp.int32),
+        nexts=jnp.full((ring_capacity,), -1, jnp.int32),
+        slot_hash=jnp.zeros((ring_capacity,), jnp.uint32),
+        slot_seq=jnp.full((ring_capacity,), 0xFFFFFFFF, jnp.uint32),
+    )
+
+
+def multimap_buckets(ring_capacity: int) -> int:
+    """Power-of-two bucket count ~2x the ring: short chains, cheap masking."""
+    h = 1
+    while h < 2 * ring_capacity:
+        h *= 2
+    return h
+
+
+def multimap_append(mm: MultimapState, hashes: jax.Array, live: jax.Array,
+                    appended0: jax.Array) -> MultimapState:
+    """Insert this batch's live rows, which the window appends (compacted,
+    arrival order) at overall indices [appended0, appended0 + n_live).
+
+    Vectorized intra-batch chaining: one [B] sort by bucket; within a bucket
+    run rows link oldest <- newest, the run's oldest links to the bucket's
+    previous head, and each run's END (the newest row) becomes the head —
+    one duplicate-free scatter per array, no atomics.
+    """
+    C = mm.nexts.shape[0]
+    H = mm.heads.shape[0]
+    B = hashes.shape[0]
+    # mirror compact_packed: live rows first, stable → arrival order
+    order = jnp.argsort(~live, stable=True)
+    hashes = hashes[order]
+    valid = live[order]
+    j = jnp.arange(B, dtype=jnp.int32)
+    seq = (appended0.astype(jnp.uint32) + j.astype(jnp.uint32))
+    base = (appended0 % C).astype(jnp.int32)
+    pos = base + j
+    pos = jnp.where(pos >= C, pos - C, pos)  # base + j < 2C always
+    bucket = (hashes & jnp.uint32(H - 1)).astype(jnp.int32)
+
+    sortkey = jnp.where(valid, bucket, jnp.int32(H))
+    run = jnp.argsort(sortkey, stable=True)
+    b_s = sortkey[run]
+    seq_s = seq[run]
+    hash_s = hashes[run]
+    pos_s = pos[run]
+    same_as_prev = jnp.concatenate(
+        [jnp.zeros((1,), bool), b_s[1:] == b_s[:-1]])
+    old_head = mm.heads[jnp.clip(b_s, 0, H - 1)]
+    prev_pos = jnp.concatenate(
+        [jnp.full((1,), -1, jnp.int32), pos_s[:-1]])
+    next_val = jnp.where(same_as_prev, prev_pos, old_head)
+
+    dest = jnp.where(b_s < H, pos_s, jnp.int32(C))
+    nexts = mm.nexts.at[dest].set(next_val, mode="drop")
+    slot_hash = mm.slot_hash.at[dest].set(hash_s, mode="drop")
+    slot_seq = mm.slot_seq.at[dest].set(seq_s, mode="drop")
+    is_end = jnp.concatenate(
+        [b_s[1:] != b_s[:-1], jnp.ones((1,), bool)]) & (b_s < H)
+    hdest = jnp.where(is_end, b_s, jnp.int32(H))
+    heads = mm.heads.at[hdest].set(pos_s, mode="drop")
+    return MultimapState(heads, nexts, slot_hash, slot_seq)
+
+
+def multimap_probe(mm: MultimapState, probe_hash: jax.Array,
+                   probe_valid: jax.Array, appended: jax.Array,
+                   window_len: jax.Array, k_max: int):
+    """Walk bucket chains for each probe lane; k_max candidates max.
+
+    Liveness is the u32 age test `0 < appended - slot_seq <= window_len`,
+    and the walk additionally requires ages to STRICTLY INCREASE: a chain
+    diverted through an overwritten slot jumps to a newer row, the age
+    drops, and the walk stops — no stale or duplicate candidates.
+
+    Returns (cand_pos i32[B,K] ring positions oldest-first, cand_ok
+    bool[B,K], truncated i32 — probe lanes whose chain still had live
+    entries after k_max steps, i.e. potential matches never examined).
+    """
+    H = mm.heads.shape[0]
+    app32 = appended.astype(jnp.uint32)
+    wlen = window_len.astype(jnp.uint32)
+    bucket = (probe_hash & jnp.uint32(H - 1)).astype(jnp.int32)
+    pos = jnp.where(probe_valid, mm.heads[bucket], jnp.int32(-1))
+    alive = probe_valid
+    prev_age = jnp.zeros_like(app32, shape=pos.shape)
+    cands, oks = [], []
+    for _ in range(k_max):
+        ok_pos = alive & (pos >= 0)
+        p = jnp.where(ok_pos, pos, 0)
+        age = app32 - mm.slot_seq[p]
+        live = ok_pos & (age > prev_age) & (age <= wlen)
+        match = live & (mm.slot_hash[p] == probe_hash)
+        cands.append(jnp.where(match, p, jnp.int32(0)))
+        oks.append(match)
+        alive = live
+        prev_age = age
+        pos = mm.nexts[p]
+    # truncation = the (k_max+1)-th chain entry is genuinely LIVE (one extra
+    # age probe, no emission) — a dead or diverted tail is not a lost match
+    ok_pos = alive & (pos >= 0)
+    p = jnp.where(ok_pos, pos, 0)
+    age = app32 - mm.slot_seq[p]
+    truncated = jnp.sum(ok_pos & (age > prev_age) & (age <= wlen),
+                        dtype=jnp.int32)
+    # chains run newest → oldest; reverse so pair emission (and k_max
+    # truncation) is oldest-first like the sorted probe path
+    cand_pos = jnp.stack(cands[::-1], axis=1)
+    cand_ok = jnp.stack(oks[::-1], axis=1)
+    return cand_pos, cand_ok, truncated
+
+
+def probe_equi_mm(plan: JoinPlan, probe_scope: Scope, probe_valid: jax.Array,
+                  mm: MultimapState, appended: jax.Array,
+                  window_len: jax.Array, k_max: int):
+    """`probe_equi` against an incrementally maintained multimap: no build
+    sort, no full-ring hash — only chain walks. Returns
+    (probe_lane[P], build_row[P] i32 ring positions, pair_valid[P],
+    truncated) with P = B*k_max."""
+    B = probe_valid.shape[0]
+    pkeys = _hash_exprs(plan.probe_keys, probe_scope)
+    cand_pos, cand_ok, truncated = multimap_probe(
+        mm, pkeys, probe_valid, appended, window_len, k_max)
+    probe_lane = jnp.broadcast_to(
+        jnp.arange(B)[:, None], (B, k_max)).reshape(-1)
+    return probe_lane, cand_pos.reshape(-1), cand_ok.reshape(-1), truncated
 
 
 def probe_cross(probe_valid: jax.Array, build_valid: jax.Array, k_max: int):

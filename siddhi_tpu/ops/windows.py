@@ -40,7 +40,10 @@ KIND_EXPIRED = 0
 KIND_RESET = 1
 KIND_CURRENT = 2
 
-BIG = jnp.int64(2**62)
+# Python int, NOT a jnp scalar: a device-resident constant captured by a jit
+# closure forces a per-execution constant upload on the axon TPU tunnel
+# (~4.6 ms/step measured) — literals trace into the HLO for free.
+BIG = 2**62
 
 
 # --------------------------------------------------------------------------- #
@@ -196,6 +199,12 @@ def _layout_words(layout: dict) -> int:
 
 
 def _pack_rows(cols: dict, ts: jax.Array, layout: dict) -> jax.Array:
+    """Pack columns + ts into a [W, L] u32 word matrix.
+
+    TPU layout note: the LANE (minor) axis must be the long row axis — a
+    [L, W] matrix with W ~ 4-8 pads the minor dim to 128 lanes, physically
+    inflating a 100k-row ring ~20-30x and turning every ring copy into a
+    multi-ms HBM burn. [W, L] keeps lanes fully packed."""
     words = []
     for name, dt in layout.items():
         a = cols[name]
@@ -210,7 +219,7 @@ def _pack_rows(cols: dict, ts: jax.Array, layout: dict) -> jax.Array:
     w = jax.lax.bitcast_convert_type(ts.astype(jnp.int64), jnp.uint32)
     words.append(w[..., 0])
     words.append(w[..., 1])
-    return jnp.stack(words, axis=-1)  # [L, W]
+    return jnp.stack(words, axis=0)  # [W, L]
 
 
 def _unpack_rows(mat: jax.Array, layout: dict) -> tuple[dict, jax.Array]:
@@ -219,53 +228,53 @@ def _unpack_rows(mat: jax.Array, layout: dict) -> tuple[dict, jax.Array]:
     for name, dt in layout.items():
         dt = jnp.dtype(dt)
         if dt == jnp.bool_:
-            cols[name] = mat[..., i] != 0
+            cols[name] = mat[i] != 0
             i += 1
         elif dt.itemsize == 8:
             cols[name] = jax.lax.bitcast_convert_type(
-                jnp.stack([mat[..., i], mat[..., i + 1]], axis=-1), dt)
+                jnp.stack([mat[i], mat[i + 1]], axis=-1), dt)
             i += 2
         else:
-            cols[name] = jax.lax.bitcast_convert_type(mat[..., i], dt)
+            cols[name] = jax.lax.bitcast_convert_type(mat[i], dt)
             i += 1
     ts = jax.lax.bitcast_convert_type(
-        jnp.stack([mat[..., i], mat[..., i + 1]], axis=-1), jnp.int64)
+        jnp.stack([mat[i], mat[i + 1]], axis=-1), jnp.int64)
     return cols, ts
 
 
 def _packed_ts(mat: jax.Array) -> jax.Array:
     """The ts payload (last two words) of packed rows, as int64."""
     return jax.lax.bitcast_convert_type(
-        jnp.stack([mat[..., -2], mat[..., -1]], axis=-1), jnp.int64)
+        jnp.stack([mat[-2], mat[-1]], axis=-1), jnp.int64)
 
 
 def compact_packed(batch: EventBatch, layout: dict):
-    """compact() producing one packed matrix: returns (mat[B,W], n_valid32).
-    Rows >= n_valid hold garbage."""
+    """compact() producing one packed matrix: returns (mat[W,B], n_valid32).
+    Lanes >= n_valid hold garbage."""
     live = batch.valid & (batch.types == EventType.CURRENT)
     mat = _pack_rows(batch.cols, batch.ts, layout)
     order = jnp.argsort(~live, stable=True).astype(jnp.int32)
-    return mat[order], jnp.sum(live, dtype=jnp.int32)
+    return mat[:, order], jnp.sum(live, dtype=jnp.int32)
 
 
 def _append_packed(ring: jax.Array, comp_mat: jax.Array, appended0,
                    n_valid32) -> jax.Array:
-    """Contiguous FIFO append of comp_mat[:n_valid] at ring row appended0%C.
-    Requires B <= C (callers size rings accordingly). No scatter: one
-    doubled-ring copy + blend + dynamic-update-slice + head fold, all
-    contiguous."""
-    C, W = ring.shape
-    B = comp_mat.shape[0]
+    """Contiguous FIFO append of comp_mat[:, :n_valid] at ring lane
+    appended0%C. Requires B <= C (callers size rings accordingly). No
+    scatter: one doubled-ring copy + blend + dynamic-update-slice + head
+    fold, all contiguous along the lane axis."""
+    W, C = ring.shape
+    B = comp_mat.shape[1]
     base = (appended0 % C).astype(jnp.int32)
-    ext = jnp.concatenate([ring, ring[:B]], axis=0)  # [C+B, W]
-    old = jax.lax.dynamic_slice(ext, (base, jnp.int32(0)), (B, W))
+    ext = jnp.concatenate([ring, ring[:, :B]], axis=1)  # [W, C+B]
+    old = jax.lax.dynamic_slice(ext, (jnp.int32(0), base), (W, B))
     p = jnp.arange(B, dtype=jnp.int32)
-    blend = jnp.where((p < n_valid32)[:, None], comp_mat, old)
-    ext = jax.lax.dynamic_update_slice(ext, blend, (base, jnp.int32(0)))
-    # rows written past C wrap to the head
-    wrapped = (jnp.arange(B, dtype=jnp.int32) < base + B - C)[:, None]
-    head = jnp.where(wrapped, ext[C:], ext[:B])
-    return jnp.concatenate([head, ext[B:C]], axis=0)
+    blend = jnp.where((p < n_valid32)[None, :], comp_mat, old)
+    ext = jax.lax.dynamic_update_slice(ext, blend, (jnp.int32(0), base))
+    # lanes written past C wrap to the head
+    wrapped = (jnp.arange(B, dtype=jnp.int32) < base + B - C)[None, :]
+    head = jnp.where(wrapped, ext[:, C:], ext[:, :B])
+    return jnp.concatenate([head, ext[:, B:C]], axis=1)
 
 
 def _fetch_rel_packed(ring: jax.Array, comp_mat: jax.Array, base_idx,
@@ -273,31 +282,31 @@ def _fetch_rel_packed(ring: jax.Array, comp_mat: jax.Array, base_idx,
     """Rows at overall indices base_idx + [0, E): from the ring for pre-batch
     rows, from the compacted batch for this batch's arrivals. Contiguous:
     two dynamic slices + one blend (the packed `_gather_rel`)."""
-    C, W = ring.shape
-    B = comp_mat.shape[0]
+    W, C = ring.shape
+    B = comp_mat.shape[1]
     base = (base_idx % C).astype(jnp.int32)
-    ext = jnp.concatenate([ring, ring[:E]], axis=0)
-    cand = jax.lax.dynamic_slice(ext, (base, jnp.int32(0)), (E, W))
+    ext = jnp.concatenate([ring, ring[:, :E]], axis=1)
+    cand = jax.lax.dynamic_slice(ext, (jnp.int32(0), base), (W, E))
     rel0 = (appended0 - base_idx).astype(jnp.int32)  # first batch offset
-    # align batch rows so slice row i reads comp_mat[i - rel0]. The slice
-    # origin E - rel0 ranges over [0, E] (rel0 >= 0), so the padded array
-    # needs 2E rows: E leading zeros + comp + trailing zeros. Rows past the
-    # real batch read zeros but are masked by callers (cand_exists), since
-    # pe >= rel0 + n_valid is beyond the window's end.
+    # align batch lanes so slice lane i reads comp_mat[:, i - rel0]. The
+    # slice origin E - rel0 ranges over [0, E] (rel0 >= 0), so the padded
+    # array needs 2E lanes: E leading zeros + comp + trailing zeros. Lanes
+    # past the real batch read zeros but are masked by callers
+    # (cand_exists), since pe >= rel0 + n_valid is beyond the window's end.
     pad_tail = max(E - B, 0)
     padded = jnp.concatenate(
-        [jnp.zeros((E, W), jnp.uint32), comp_mat,
-         jnp.zeros((pad_tail, W), jnp.uint32)], axis=0)
+        [jnp.zeros((W, E), jnp.uint32), comp_mat,
+         jnp.zeros((W, pad_tail), jnp.uint32)], axis=1)
     start = jnp.clip(E - rel0, 0, E)
-    bat = jax.lax.dynamic_slice(padded, (start, jnp.int32(0)), (E, W))
+    bat = jax.lax.dynamic_slice(padded, (jnp.int32(0), start), (W, E))
     offs = jnp.arange(E, dtype=jnp.int32)
-    return jnp.where((offs >= rel0)[:, None], bat, cand)
+    return jnp.where((offs >= rel0)[None, :], bat, cand)
 
 
 def _sort_chunk_packed(hi, lo, payload_mat, emit_ts, valid, types,
                        layout: dict, width: int) -> EventBatch:
     """Emission-order sort applied with ONE packed gather: payload + emit ts
-    + (valid, type) meta ride a single [L, W+3] matrix through the two-key
+    + (valid, type) meta ride a single [W+3, L] matrix through the two-key
     int32 sort's permutation."""
     L = hi.shape[0]
     hi = jnp.where(valid, hi, jnp.iinfo(jnp.int32).max)
@@ -306,13 +315,13 @@ def _sort_chunk_packed(hi, lo, payload_mat, emit_ts, valid, types,
     ets = jax.lax.bitcast_convert_type(emit_ts.astype(jnp.int64), jnp.uint32)
     meta = (valid.astype(jnp.uint32)
             | (types.astype(jnp.uint32) << 1))
-    W = payload_mat.shape[1]
+    W = payload_mat.shape[0]
     full = jnp.concatenate(
-        [payload_mat, ets, meta[:, None]], axis=1)[order[:width]]
-    cols, _stored_ts = _unpack_rows(full[:, :W], layout)
+        [payload_mat, ets.T, meta[None, :]], axis=0)[:, order[:width]]
+    cols, _stored_ts = _unpack_rows(full[:W], layout)
     emit = jax.lax.bitcast_convert_type(
-        jnp.stack([full[:, W], full[:, W + 1]], axis=-1), jnp.int64)
-    m = full[:, W + 2]
+        jnp.stack([full[W], full[W + 1]], axis=-1), jnp.int64)
+    m = full[W + 2]
     return EventBatch(ts=emit, cols=cols,
                       valid=(m & 1) != 0,
                       types=(m >> 1).astype(jnp.int8))
@@ -365,7 +374,7 @@ def _ring_live_mask(ring_len: int, lo: jax.Array, hi: jax.Array):
 
 
 class SlidingState(NamedTuple):
-    ring: jax.Array  # u32[C, W] packed rows (all columns + ts words)
+    ring: jax.Array  # u32[W, C] packed rows (all columns + ts words)
     appended: jax.Array  # int64 total valid arrivals ever
     expired: jax.Array  # int64 total expirations ever
     wm: jax.Array  # int64 external-time watermark (externalTime mode only)
@@ -414,7 +423,7 @@ class SlidingWindow(WindowOp):
 
     def init_state(self) -> SlidingState:
         return SlidingState(
-            ring=jnp.zeros((self.C, self.W), jnp.uint32),
+            ring=jnp.zeros((self.W, self.C), jnp.uint32),
             appended=jnp.int64(0),
             expired=jnp.int64(0),
             wm=jnp.int64(-(2**62)),
@@ -433,8 +442,7 @@ class SlidingWindow(WindowOp):
             tcols, _ = _unpack_rows(comp_mat, self.layout)
             comp_ts = tcols[self.ts_attr].astype(jnp.int64)
             w = jax.lax.bitcast_convert_type(comp_ts, jnp.uint32)
-            comp_mat = comp_mat.at[:, -2].set(w[..., 0]).at[:, -1].set(
-                w[..., 1])
+            comp_mat = comp_mat.at[-2].set(w[..., 0]).at[-1].set(w[..., 1])
             wm = jnp.maximum(state.wm, jnp.max(jnp.where(
                 jnp.arange(B) < n_valid, comp_ts, jnp.int64(-(2**62)))))
             now = wm
@@ -510,7 +518,7 @@ class SlidingWindow(WindowOp):
 
         all_hi = jnp.concatenate([keys_exp, keys_cur])
         all_lo = jnp.concatenate([pe, p])
-        all_mat = jnp.concatenate([cand_mat, comp_mat], axis=0)
+        all_mat = jnp.concatenate([cand_mat, comp_mat], axis=1)
         all_emit = jnp.concatenate([emit_ts, comp_ts])
         all_valid = jnp.concatenate([expires, cur_valid])
         all_types = jnp.concatenate([
