@@ -254,13 +254,68 @@ class AggregationRuntime(Receiver):
 
         self.capacity = max(ctx.effective_group_capacity, 4096)
         self.state = tuple(self._init_store() for _ in self.durations)
-        self._ingest = jax.jit(self._make_ingest(), donate_argnums=(0,))
-        self._evict = jax.jit(self._make_evict())
         self._batches_since_check = 0
         #: retention per duration (@purge/@retentionPeriod), ms; None = keep
         self.retention_ms = self._parse_retention(definition)
 
+        # --- distributed (sharded) mode over a device mesh ---
+        # The reference's `isDistributed` (AggregationRuntime.java:87,266,384):
+        # each shard owns the (bucket, group) rows whose GROUP-key hash lands
+        # on it, scatters locally, and `find()` merges shard stores. Here the
+        # duration stores gain a leading mesh-sharded shard axis; ingest runs
+        # as one shard_map step (each shard masks the replicated batch down to
+        # its keys), and reads flatten [n_shards, K] -> [n_shards*K] — the
+        # flatten IS the gather, inserted by XLA where the read computes.
+        self.mesh = getattr(ctx, "mesh", None) if self.group_attrs else None
+        self.n_shards = 1
+        if self.mesh is not None:
+            self.n_shards = self.mesh.shape[self.mesh.axis_names[0]]
+        self._build_steps()
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.sharded import stack_states
+
+            sharding = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+            self.state = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sharding),
+                stack_states(self.state, self.n_shards))
+
         input_junction.subscribe(self)
+
+    def _build_steps(self) -> None:
+        """(Re)build the jitted ingest/evict for the current capacity —
+        plain single-device, or shard_map over the mesh in distributed
+        mode."""
+        if self.mesh is None:
+            self._ingest = jax.jit(self._make_ingest(), donate_argnums=(0,))
+            self._evict = jax.jit(self._make_evict())
+            return
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.sharded import _SHARD_KW, shard_map
+
+        mesh = self.mesh
+        axis = mesh.axis_names[0]
+        n_shards = self.n_shards
+        group_attrs = self.group_attrs
+        ingest = self._make_ingest()
+
+        def shard_ingest(state, batch: EventBatch, now):
+            from ..parallel.sharded import shard_owned
+
+            local = jax.tree_util.tree_map(lambda x: x[0], state)
+            mine = shard_owned(batch, [batch.cols[g] for g in group_attrs],
+                               axis, n_shards)
+            local = ingest(local, mine, now)
+            return jax.tree_util.tree_map(lambda x: x[None], local)
+
+        self._ingest = jax.jit(
+            shard_map(shard_ingest, mesh=mesh,
+                      in_specs=(P(axis), P(), P()), out_specs=P(axis),
+                      **_SHARD_KW),
+            donate_argnums=(0,))
+        self._evict = jax.jit(jax.vmap(self._make_evict(), in_axes=(0, 0)))
 
     @staticmethod
     def _parse_retention(definition) -> dict:
@@ -401,47 +456,55 @@ class AggregationRuntime(Receiver):
             f"aggregation {self.definition.id!r}: growing bucket stores to "
             f"{self.capacity} slots (set group_capacity higher to avoid the "
             "rehash)", stacklevel=2)
-        self._ingest = jax.jit(self._make_ingest(), donate_argnums=(0,))
-        self._evict = jax.jit(self._make_evict())
+        self._build_steps()
         # rehash every store into the new capacity (cutoff far in the past
         # keeps everything)
+        keep_all = (jnp.full((self.n_shards,), -(1 << 62), jnp.int64)
+                    if self.mesh is not None else jnp.int64(-(1 << 62)))
         self.state = tuple(
-            self._evict(store, jnp.int64(-(1 << 62))) for store in self.state)
+            self._evict(store, keep_all) for store in self.state)
 
     def _maybe_evict(self, now: int) -> None:
         """Retention purge + capacity-pressure handling: evict buckets older
         than the newest half when age explains the pressure, grow the store
-        when group cardinality does — never silently drop or corrupt."""
+        when group cardinality does — never silently drop or corrupt.
+
+        All statistics are PER SHARD (capacity is a per-shard quantity in
+        distributed mode; global math here would over-evict by ~n_shards)."""
         import numpy as np
+        S, K = self.n_shards, self.capacity
         grow = False
         for d_idx, dur in enumerate(self.durations):
             store = self.state[d_idx]
-            cutoff = None
             retention = self.retention_ms.get(dur)
-            if retention is not None:
-                cutoff = now - retention
-            if int(store.key_table.count) > int(0.85 * self.capacity):
-                alive = np.asarray(store.alive)
-                bts = np.asarray(store.bucket_ts)[alive]
-                newest_half = np.sort(bts)[::-1][:self.capacity // 2]
-                pressure_cutoff = int(newest_half[-1])
-                would_keep = int((bts >= max(cutoff or 0, pressure_cutoff)).sum())
-                if would_keep > int(0.7 * self.capacity):
+            base_cutoff = (now - retention) if retention is not None else 0
+            counts = np.atleast_1d(np.asarray(store.key_table.count))
+            alive = np.asarray(store.alive).reshape(S, K)
+            bts = np.asarray(store.bucket_ts).reshape(S, K)
+            cutoffs = np.full((S,), base_cutoff, dtype=np.int64)
+            for s in range(S):
+                if int(counts[s]) <= int(0.85 * K):
+                    continue
+                live_b = np.sort(bts[s][alive[s]])[::-1]
+                pressure_cutoff = int(live_b[:K // 2][-1])
+                would_keep = int(
+                    (live_b >= max(base_cutoff, pressure_cutoff)).sum())
+                if would_keep > int(0.7 * K):
                     grow = True  # eviction can't help: too many live groups
                 else:
-                    cutoff = max(cutoff or 0, pressure_cutoff)
+                    cutoffs[s] = max(cutoffs[s], pressure_cutoff)
                     import warnings
                     warnings.warn(
-                        f"aggregation {self.definition.id!r} [{dur.value}]: "
-                        f"store at capacity; evicting buckets older than "
+                        f"aggregation {self.definition.id!r} [{dur.value}]"
+                        f"{f' shard {s}' if S > 1 else ''}: store at "
+                        f"capacity; evicting buckets older than "
                         f"{pressure_cutoff} (raise group_capacity or add "
                         "@purge retention)", stacklevel=2)
-            if cutoff is not None and cutoff > 0:
-                alive = np.asarray(self.state[d_idx].alive)
-                bts = np.asarray(self.state[d_idx].bucket_ts)
-                if (alive & (bts < cutoff)).any():
-                    self._replace_store(
-                        d_idx, self._evict(store, jnp.int64(cutoff)))
+            evictable = (alive & (bts < cutoffs[:, None])).any()
+            if (cutoffs > 0).any() and evictable:
+                arg = (jnp.asarray(cutoffs) if self.mesh is not None
+                       else jnp.int64(int(cutoffs[0])))
+                self._replace_store(d_idx, self._evict(store, arg))
         if grow:
             self._grow()
 
@@ -473,7 +536,13 @@ class AggregationRuntime(Receiver):
                        within: Optional[tuple[int, int]] = None):
         """Output-frame view over one duration's store: (cols, ts, valid) —
         the findable surface for store queries and joins (reference:
-        AggregationRuntime.find / compileExpression:384+)."""
+        AggregationRuntime.find / compileExpression:384+). In distributed
+        mode the store arrives with a leading shard axis; flattening it to
+        [n_shards*K] is the shard-merged `find()` — rows are disjoint across
+        shards (group-hash ownership), so the union needs no combining."""
+        if store.bucket_ts.ndim == 2:
+            store = jax.tree_util.tree_map(
+                lambda x: x.reshape((-1,) + x.shape[2:]), store)
         cols = {}
         for o in self.outputs:
             if o.is_group:

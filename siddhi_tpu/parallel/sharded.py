@@ -51,6 +51,17 @@ from ..ops.groupby import (DenseKeyTable, dense_key_lookup_or_insert,
                            hash_columns, init_dense_key_table)
 
 
+def shard_owned(batch: EventBatch, key_cols, axis_name: str,
+                n_shards: int) -> EventBatch:
+    """Mask a replicated batch down to the lanes THIS shard owns by key-hash
+    ownership. The single definition of shard assignment — queries
+    (ShardedQueryStep) and distributed aggregations must agree on it."""
+    my_shard = jax.lax.axis_index(axis_name)
+    keys = hash_columns(key_cols)
+    owned = (keys.astype(jnp.uint32) % n_shards) == my_shard.astype(jnp.uint32)
+    return batch.where_valid(owned)
+
+
 def _zero_masked(batch: EventBatch) -> EventBatch:
     """Zero every lane that is invalid so cross-shard psum merges cleanly."""
     v = batch.valid
@@ -99,10 +110,8 @@ class ShardedQueryStep:
         def shard_step(state, batch: EventBatch, now):
             # state arrives with a leading local axis of size 1 — unstack
             local = jax.tree_util.tree_map(lambda x: x[0], state)
-            my_shard = jax.lax.axis_index(axis_name)
-            keys = hash_columns([batch.cols[a] for a in self.key_attrs])
-            owned = (keys.astype(jnp.uint32) % n_shards) == my_shard.astype(jnp.uint32)
-            mine = batch.where_valid(owned)
+            mine = shard_owned(batch, [batch.cols[a] for a in self.key_attrs],
+                               axis_name, n_shards)
             local, out = step_fn(local, mine, now)
             merged = merge_shard_outputs(out, axis_name)
             restacked = jax.tree_util.tree_map(lambda x: x[None], local)
