@@ -479,6 +479,10 @@ class AggregationRuntime(Receiver):
             retention = self.retention_ms.get(dur)
             base_cutoff = (now - retention) if retention is not None else 0
             counts = np.atleast_1d(np.asarray(store.key_table.count))
+            pressure = int(counts.max()) > int(0.85 * K)
+            if retention is None and not pressure:
+                # fast path: only the scalar count crosses to the host
+                continue
             alive = np.asarray(store.alive).reshape(S, K)
             bts = np.asarray(store.bucket_ts).reshape(S, K)
             cutoffs = np.full((S,), base_cutoff, dtype=np.int64)

@@ -216,11 +216,20 @@ class SiddhiAppRuntime:
         return qr
 
     def _add_pattern_query(self, query: Query, name: str):
-        from .pattern_runtime import PatternQueryRuntime, _PatternSideReceiver
+        from .pattern_runtime import (MERGED_SID, PatternQueryRuntime,
+                                      _PatternSideReceiver)
         qr = PatternQueryRuntime(query, self.ctx, self.junctions, self.tables,
                                  self.ctx.registry, name)
-        for sid in qr.junctions:
-            qr.junctions[sid].subscribe(_PatternSideReceiver(qr, sid))
+        if qr.merged_junction is not None:
+            # multi-stream sequence: the tagged merged junction (fed by
+            # send-order taps on the sources) is the only feed; register it
+            # so flush()/shutdown drive it like any other junction
+            qr.merged_junction.subscribe(_PatternSideReceiver(qr, MERGED_SID))
+            self.junctions[qr.merged_junction.definition.id] = \
+                qr.merged_junction
+        else:
+            for sid in qr.junctions:
+                qr.junctions[sid].subscribe(_PatternSideReceiver(qr, sid))
         return qr
 
     def _wire_output(self, qr, query: Query) -> None:

@@ -70,6 +70,9 @@ from .stream import Receiver, StreamJunction
 
 BIGSEQ = 2**62  # Python int literal — see ops/windows.py BIG note (tunnel cost)
 
+#: junction key for the merged multi-stream sequence step
+MERGED_SID = "#merged"
+
 
 @dataclass
 class _Leg:
@@ -268,12 +271,27 @@ class PatternQueryRuntime:
 
         self.plan = _PatternPlan(sis, ctx)
         plan = self.plan
+        # Multi-stream sequences: strict contiguity needs ONE arrival order
+        # across the participating streams (the reference's sequence
+        # receivers consume streams in arrival order). Those queries run off
+        # a MERGED junction — source junctions are tapped at send() time so
+        # true per-event send order survives micro-batching; the merged
+        # batch carries a stream tag + each stream's columns under
+        # "<sid>::<attr>" names.
+        self.merged_mode = False
+        self.merged_junction: Optional[StreamJunction] = None
+        self._tag_codes: dict[str, int] = {}
         if plan.is_sequence:
             jset = {leg.stream_id for pos in plan.positions for leg in pos.legs}
-            if len(jset) > 1:
+            self.merged_mode = len(jset) > 1
+            if self.merged_mode and any(
+                    p.kind == "logical" for p in plan.positions):
+                # the per-leg strict-contiguity kill treats the other leg's
+                # arrival as a sequence breaker; reject loudly rather than
+                # silently never matching
                 raise SiddhiAppCreationError(
-                    "sequences across multiple streams are not yet supported "
-                    "(strict contiguity is per-stream in this build)")
+                    "logical (and/or) conditions inside multi-stream "
+                    "sequences are not supported")
 
         # --- junctions / frames / codecs ---
         self.junctions: dict[str, StreamJunction] = {}
@@ -292,6 +310,9 @@ class PatternQueryRuntime:
                 frames[leg.ref] = attr_types
                 codecs[leg.ref] = j.codec
                 self.ref_types[leg.ref] = attr_types
+        if self.merged_mode:
+            self._build_merged_junction()
+
         # bare stream names resolve when unambiguous
         sid_count: dict[str, int] = {}
         for pos in plan.positions:
@@ -346,14 +367,76 @@ class PatternQueryRuntime:
 
         # --- state & jitted steps (one per junction + heartbeat) ---
         self.state = self._init_state()
-        self._steps = {
-            sid: jax.jit(self._make_step(sid), donate_argnums=(0,))
-            for sid in self.junctions
-        }
+        if self.merged_mode:
+            self._steps = {MERGED_SID: jax.jit(
+                self._make_step(MERGED_SID), donate_argnums=(0,))}
+        else:
+            self._steps = {
+                sid: jax.jit(self._make_step(sid), donate_argnums=(0,))
+                for sid in self.junctions
+            }
         self._heartbeat_step = jax.jit(self._make_step(None), donate_argnums=(0,))
         self.has_time_semantics = (
             plan.within_ms is not None
             or any(p.kind == "absent" for p in plan.positions))
+
+    # ---------------------------------------------------------- merged stream
+
+    def _build_merged_junction(self) -> None:
+        """One tagged union junction over the sequence's source streams, fed
+        by send-order taps so strict contiguity sees the true interleave."""
+        participants = []
+        for pos in self.plan.positions:
+            for leg in pos.legs:
+                if leg.stream_id not in participants:
+                    participants.append(leg.stream_id)
+        self._tag_codes = {sid: i for i, sid in enumerate(participants)}
+        attrs = [Attribute("_tag", AttributeType.INT)]
+        self._merged_slots: dict[str, tuple[int, list[int]]] = {}
+        pad_of = {AttributeType.STRING: "", AttributeType.BOOL: False}
+        pads: list = []
+        for sid in participants:
+            j = self.junctions[sid]
+            src_idx = []
+            base = len(attrs) - 1  # offset into the padded tail
+            for i, a in enumerate(j.definition.attributes):
+                if a.type == AttributeType.OBJECT:
+                    continue
+                attrs.append(Attribute(f"{sid}::{a.name}", a.type))
+                src_idx.append(i)
+                pads.append(pad_of.get(a.type, 0))
+            self._merged_slots[sid] = (base, src_idx)
+        merged_def = StreamDefinition(id=f"#seq:{self.name}",
+                                      attributes=tuple(attrs))
+        self.merged_junction = StreamJunction(merged_def, self.ctx)
+        self._merged_pads = tuple(pads)
+
+        for sid in participants:
+            code = self._tag_codes[sid]
+            base, src_idx = self._merged_slots[sid]
+            merged = self.merged_junction
+
+            def tap(ts, data, code=code, base=base, src_idx=src_idx,
+                    merged=merged):
+                tail = list(self._merged_pads)
+                for k, i in enumerate(src_idx):
+                    tail[base + k] = data[i]
+                # single atomic append (GIL) — taps run on producer threads
+                merged.stage_row(ts, (code, *tail))
+
+            self.junctions[sid].taps.append(tap)
+
+    def _leg_batch(self, batch: EventBatch, leg) -> EventBatch:
+        """The leg's view of the incoming batch: identity on per-junction
+        steps; tag-masked de-prefixed columns on the merged sequence step."""
+        if not self.merged_mode:
+            return batch
+        code = self._tag_codes[leg.stream_id]
+        cols = {a: batch.cols[f"{leg.stream_id}::{a}"]
+                for a in self.ref_types[leg.ref]}
+        valid = batch.valid & (batch.cols["_tag"] == code)
+        return EventBatch(ts=batch.ts, cols=cols, valid=valid,
+                          types=batch.types)
 
     # ------------------------------------------------------------------ state
 
@@ -455,19 +538,22 @@ class PatternQueryRuntime:
 
             pending = [expire(p) for p in pending]
 
+            merged = junction_sid == MERGED_SID
             for pi, pos in enumerate(plan.positions):
                 pend = pending[pi - 1] if pi > 0 else None
-                feeds = junction_sid is not None and any(
-                    leg.stream_id == junction_sid for leg in pos.legs)
+                feeds = junction_sid is not None and (merged or any(
+                    leg.stream_id == junction_sid for leg in pos.legs))
 
                 # ---- absent completion (time-driven, runs on every step) ----
                 if pos.kind == "absent" and pi > 0:
                     due = pend.valid & (now >= pend.armed_ts +
                                         jnp.int64(pos.wait_ms))
                     if junction_sid is not None and \
-                            pos.legs[0].stream_id == junction_sid:
+                            (merged or pos.legs[0].stream_id == junction_sid):
                         # a matching event kills waiting entries first
-                        kill = self._leg_cond(pos.legs[0], batch, pend, now)
+                        kill = self._leg_cond(
+                            pos.legs[0], self._leg_batch(batch, pos.legs[0]),
+                            pend, now)
                         kill = kill & (arr_seq[:, None] > pend.last_seq[None, :])
                         kill = kill & (batch.ts[:, None] <
                                        pend.armed_ts[None, :] + jnp.int64(pos.wait_ms))
@@ -505,16 +591,17 @@ class PatternQueryRuntime:
                         raise SiddhiAppCreationError(
                             "logical conditions at the first pattern position "
                             "are not yet supported")
-                    if leg.stream_id != junction_sid:
+                    if not merged and leg.stream_id != junction_sid:
                         continue
-                    m = self._leg_cond(leg, batch, None, now)[:, 0]  # [B]
+                    leg_b = self._leg_batch(batch, leg)
+                    m = self._leg_cond(leg, leg_b, None, now)[:, 0]  # [B]
                     if not every:
                         # only the first match consumes the start state
                         first_lane = jnp.argmax(m)
                         only = jnp.zeros((B,), bool).at[first_lane].set(True)
                         m = m & only & active0
                         active0 = active0 & ~m.any()
-                    frames = {leg.ref: dict(batch.cols)}
+                    frames = {leg.ref: dict(leg_b.cols)}
                     fvalid = {leg.ref: m}
                     fts = {leg.ref: batch.ts}
                     self._advance(pending, out_blocks, 1, frames, fvalid, fts,
@@ -522,10 +609,11 @@ class PatternQueryRuntime:
                     continue
 
                 for li, leg in enumerate(pos.legs):
-                    if leg.stream_id != junction_sid:
+                    if not merged and leg.stream_id != junction_sid:
                         continue
                     pend = pending[pi - 1]
-                    q = self._leg_cond(leg, batch, pend, now)  # [B,P]
+                    leg_b = self._leg_batch(batch, leg)
+                    q = self._leg_cond(leg, leg_b, pend, now)  # [B,P]
                     q = q & pend.valid[None, :]
                     if is_seq:
                         q = q & (arr_seq[:, None] == pend.last_seq[None, :] + 1)
@@ -549,7 +637,7 @@ class PatternQueryRuntime:
                     b_star = jnp.argmin(qseq, axis=0)  # [P]
                     matched = q.any(axis=0)
 
-                    cap = {n: v[b_star] for n, v in batch.cols.items()}
+                    cap = {n: v[b_star] for n, v in leg_b.cols.items()}
                     cap_ts = batch.ts[b_star]
 
                     if pos.kind == "logical":

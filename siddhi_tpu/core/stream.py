@@ -90,6 +90,16 @@ class StreamJunction:
             self._ring_cap = max(4 * self.batch_size, 1024)
         self._staged_rows: list = []
         self._staged_ts: list[int] = []
+        #: send-order interceptors fn(ts, data) — multi-stream sequence
+        #: queries tap their source junctions to build a merged arrival
+        #: stream that preserves TRUE per-event send order across streams
+        #: (the reference's sequence receivers consume streams in arrival
+        #: order, core/query/input/stream/state/receiver/)
+        self.taps: list[Callable] = []
+        #: thread-safe pre-staging: one list of (ts, row) tuples appended
+        #: atomically (GIL) from producer threads via stage_row(), drained
+        #: into the staging buffers under the controller lock at flush
+        self._tap_queue: list = []
         self.on_error: Optional[Callable] = None
         # per-THREAD re-entrancy guards (flushing during callbacks; drain
         # nesting): shared booleans would make one thread's activity no-op
@@ -116,7 +126,19 @@ class StreamJunction:
 
     # ---------------------------------------------------------------- ingest
 
+    def stage_row(self, ts: int, data: Sequence) -> None:
+        """Thread-safe staging from arbitrary producer threads: one atomic
+        list append; rows enter the real staging buffers under the
+        controller lock at the next flush. Used by sequence taps, which run
+        on whichever thread called the source's send()."""
+        self._tap_queue.append((ts, data))
+        self.ctx.timestamp_generator.observe_event_time(ts)
+        if len(self._tap_queue) >= self.batch_size:
+            self.flush()
+
     def send_row(self, ts: int, data: Sequence) -> None:
+        for tap in self.taps:
+            tap(ts, data)
         if self._ring is not None and not self._lock_owned():
             self.ctx.timestamp_generator.observe_event_time(ts)
             # blocking backpressure when the ring is full, like the
@@ -242,6 +264,12 @@ class StreamJunction:
         """Device-side publication (query output chaining). Staged host rows
         are flushed first to preserve arrival order."""
         with self.ctx.controller_lock:
+            if self.taps:
+                # taps need host rows; only derived streams feeding a
+                # multi-stream sequence pay this decode
+                for ev in batch.to_host_events(self.codec):
+                    for tap in self.taps:
+                        tap(ev.timestamp, tuple(ev.data))
             if self._staged_rows:
                 self.flush()
             self._deliver(batch, now)
@@ -259,6 +287,11 @@ class StreamJunction:
             if self._ring is not None and not getattr(self._reentry,
                                                       "draining", False):
                 self._drain_ring()
+            if self._tap_queue:
+                q, self._tap_queue = self._tap_queue, []  # atomic swap (GIL)
+                for ts, row in q:
+                    self._staged_ts.append(ts)
+                    self._staged_rows.append(row)
             if not self._staged_rows:
                 return
             rows, tss = self._staged_rows, self._staged_ts

@@ -216,3 +216,83 @@ class TestSequence:
         h.send(("x", 7)); rt.flush()  # intervening event kills the partial
         h.send(("b", 2)); rt.flush()
         assert got == []
+
+
+class TestMultiStreamSequence:
+    """Sequences across DIFFERENT streams (reference: query/sequence/
+    SequenceTestCase — e1=Stream1, e2=Stream2): strict contiguity over the
+    merged send-order arrival stream."""
+
+    APP = (TWO +
+           "from every e1=S1[price > 20.0], e2=S2[price > 30.0] "
+           "select e1.symbol as s1, e2.symbol as s2 insert into OutStream;")
+
+    def test_cross_stream_match(self):
+        rt, got = make(self.APP)
+        rt.get_input_handler("S1").send(("IBM", 25.0))
+        rt.get_input_handler("S2").send(("WSO2", 35.0))
+        rt.flush()
+        assert got == [("IBM", "WSO2")]
+
+    def test_intervening_event_breaks(self):
+        rt, got = make(self.APP)
+        s1, s2 = rt.get_input_handler("S1"), rt.get_input_handler("S2")
+        s1.send(("IBM", 25.0))
+        s1.send(("DOX", 26.0))   # S1 event intervenes: kills the partial,
+        s2.send(("WSO2", 35.0))  # ...but itself starts a new partial
+        rt.flush()
+        assert got == [("DOX", "WSO2")]
+
+    def test_non_matching_next_kills(self):
+        rt, got = make(self.APP)
+        s1, s2 = rt.get_input_handler("S1"), rt.get_input_handler("S2")
+        s1.send(("IBM", 25.0))
+        s2.send(("BAD", 5.0))    # next arrival fails e2's filter: killed
+        s2.send(("WSO2", 35.0))
+        rt.flush()
+        assert got == []
+
+    def test_every_rearms_across_streams(self):
+        rt, got = make(self.APP)
+        s1, s2 = rt.get_input_handler("S1"), rt.get_input_handler("S2")
+        s1.send(("A", 21.0)); s2.send(("B", 31.0))
+        s1.send(("C", 22.0)); s2.send(("D", 32.0))
+        rt.flush()
+        assert got == [("A", "B"), ("C", "D")]
+
+    def test_interleave_within_one_flush(self):
+        # true per-event interleave inside a single micro-batch window —
+        # per-junction batching alone would see S1:[A,C] then S2:[B,D]
+        rt, got = make(self.APP, batch_size=16)
+        s1, s2 = rt.get_input_handler("S1"), rt.get_input_handler("S2")
+        s1.send(("A", 25.0))
+        s2.send(("B", 35.0))
+        s2.send(("X", 36.0))   # consecutive S2: no live partial, ignored
+        s1.send(("C", 27.0))
+        s2.send(("D", 37.0))
+        rt.flush()
+        assert got == [("A", "B"), ("C", "D")]
+
+    def test_three_streams(self):
+        app = (TWO +
+               "define stream S3 (symbol string, price float);\n"
+               "from every e1=S1[price > 20.0], e2=S2[price > 30.0], "
+               "e3=S3[price > 40.0] "
+               "select e1.symbol as s1, e2.symbol as s2, e3.symbol as s3 "
+               "insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("A", 25.0))
+        rt.get_input_handler("S2").send(("B", 35.0))
+        rt.get_input_handler("S3").send(("C", 45.0))
+        rt.flush()
+        assert got == [("A", "B", "C")]
+
+    def test_condition_referencing_earlier_stream(self):
+        app = (TWO +
+               "from every e1=S1[price > 20.0], e2=S2[price > e1.price] "
+               "select e1.price as p1, e2.price as p2 insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("A", 25.0))
+        rt.get_input_handler("S2").send(("B", 26.0))
+        rt.flush()
+        assert got == [(25.0, 26.0)]
