@@ -454,6 +454,9 @@ class SiddhiAppRuntime:
             if j is not None and id(j) not in seen:
                 seen.add(id(j))
                 j.heartbeat(t)
+        # overflow counters warn from the heartbeat too, not only when the
+        # user polls statistics_report()
+        self.collect_overflow()
 
     # ----------------------------------------------------- persist / restore
 
@@ -533,6 +536,75 @@ class SiddhiAppRuntime:
 
     def statistics_report(self) -> dict:
         return self.ctx.statistics.report(runtime=self)
+
+    def collect_overflow(self) -> None:
+        """Sweep every runtime's device state for capacity-overflow counters
+        and surface them via Statistics.record_overflow (one-shot warning
+        per counter). Syncs a handful of scalars — called from
+        statistics_report() and the heartbeat, not the hot path.
+
+        Counters: window-ring overwrites of live rows (SlidingState /
+        expression windows), key-table unresolved lanes (group-by, distinct
+        pairs, aggregation buckets), pattern pending-table drops, keyed
+        session key-capacity drops, join pair-block/candidate-walk drops."""
+        import numpy as np
+
+        from ..ops.groupby import KeyTable
+        from ..ops.windows import SlidingState
+        from ..ops.windows_extra import KeyedSessionState
+        from .join_runtime import JoinQueryRuntime
+        from .pattern_runtime import PatternState
+
+        stats = self.ctx.statistics
+
+        def scan(label: str, obj, acc: dict) -> None:
+            if isinstance(obj, KeyTable):
+                acc["key_table_unresolved"] = acc.get(
+                    "key_table_unresolved", 0) + int(
+                    np.sum(np.asarray(obj.misses)))
+            elif isinstance(obj, SlidingState):
+                acc["window_ring_overflow"] = acc.get(
+                    "window_ring_overflow", 0) + int(
+                    np.sum(np.asarray(obj.overflow)))
+            elif isinstance(obj, KeyedSessionState):
+                acc["session_key_dropped"] = acc.get(
+                    "session_key_dropped", 0) + int(
+                    np.sum(np.asarray(obj.dropped)))
+            elif isinstance(obj, PatternState):
+                acc["pattern_pending_dropped"] = acc.get(
+                    "pattern_pending_dropped", 0) + int(
+                    np.sum(np.asarray(obj.dropped)))
+            import dataclasses as _dc
+            if isinstance(obj, dict):
+                for v in obj.values():
+                    scan(label, v, acc)
+            elif hasattr(obj, "_fields"):  # NamedTuple: recurse into fields
+                for f in obj._fields:
+                    scan(label, getattr(obj, f), acc)
+            elif isinstance(obj, (tuple, list)):
+                for v in obj:
+                    scan(label, v, acc)
+            elif _dc.is_dataclass(obj) and not isinstance(obj, type):
+                for f in _dc.fields(obj):  # e.g. SelectorState
+                    scan(label, getattr(obj, f.name), acc)
+
+        sources: list[tuple[str, object]] = []
+        sources += [(f"query:{n}", qr.state)
+                    for n, qr in self.query_runtimes.items()
+                    if hasattr(qr, "state")]
+        sources += [(f"window:{n}", w.state) for n, w in self.windows.items()]
+        sources += [(f"aggregation:{n}", a.state)
+                    for n, a in self.aggregations.items()]
+        for label, obj in sources:
+            acc: dict = {}
+            scan(label, obj, acc)
+            for k, v in acc.items():
+                stats.record_overflow(f"{label}.{k}", v)
+        for n, qr in self.query_runtimes.items():
+            if isinstance(qr, JoinQueryRuntime) and qr._dropped_dev is not None:
+                stats.record_overflow(
+                    f"query:{n}.join_pairs_dropped",
+                    int(np.asarray(qr._dropped_dev)))
 
     # ---------------------------------------------------------------- debugger
 

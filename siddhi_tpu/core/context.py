@@ -77,6 +77,12 @@ class Statistics:
     batches: dict = field(default_factory=dict)
     query_latency_ns: dict = field(default_factory=dict)  # query -> (total, count)
     started_at: float = field(default_factory=time.time)
+    #: capacity-overflow counters ("<runtime>.<structure>" -> lifetime rows
+    #: dropped/overwritten/unresolved). Tracked regardless of level — silent
+    #: capacity loss is a correctness signal, not a metric (SURVEY §7
+    #: "overflow-to-host escape hatches"). Each counter warns once.
+    overflow: dict = field(default_factory=dict)
+    _overflow_warned: set = field(default_factory=set)
 
     @property
     def detail(self) -> bool:
@@ -102,20 +108,41 @@ class Statistics:
             t, c = self.query_latency_ns.get(query, (0, 0))
             self.query_latency_ns[query] = (t + ns, c + 1)
 
+    def record_overflow(self, name: str, n: int) -> None:
+        """Register a lifetime overflow counter reading; warns ONCE per
+        counter the first time it goes positive (an @OnError-style signal —
+        results past this point may be missing rows)."""
+        if n <= 0:
+            self.overflow.pop(name, None)
+            return
+        self.overflow[name] = n
+        if name not in self._overflow_warned:
+            self._overflow_warned.add(name)
+            import warnings
+            warnings.warn(
+                f"{name}: {n} rows exceeded a fixed device capacity and "
+                "were dropped/overwritten — results may be missing rows; "
+                "raise the relevant capacity (see Statistics.report()"
+                "['overflow'])", stacklevel=3)
+
     def reset(self) -> None:
         self.events_in.clear()
         self.events_out.clear()
         self.batches.clear()
         self.query_latency_ns.clear()
+        self.overflow.clear()
         self.started_at = time.time()
 
     def report(self, runtime=None) -> dict:
         elapsed = max(time.time() - self.started_at, 1e-9)
+        if runtime is not None:
+            runtime.collect_overflow()
         out = {
             "level": self.level,
             "events_in": dict(self.events_in),
             "batches": dict(self.batches),
             "throughput_eps": {s: n / elapsed for s, n in self.events_in.items()},
+            "overflow": dict(self.overflow),
         }
         if self.detail:
             out["query_latency_ms"] = {

@@ -269,10 +269,11 @@ class EventBatch:
 
     def to_host_events(self, codec: StreamCodec) -> list[Event]:
         """Compact valid lanes, in lane order, into host Events."""
-        ts = np.asarray(self.ts)
-        valid = np.asarray(self.valid)
-        types = np.asarray(self.types)
-        host_cols = {k: np.asarray(v) for k, v in self.cols.items()}
+        # ONE device_get for the whole batch: a synchronous np.asarray per
+        # array costs a full round trip EACH (~100 ms through the axon
+        # tunnel); the single tree fetch cuts decode cost ~3x there
+        ts, valid, types, host_cols = jax.device_get(
+            (self.ts, self.valid, self.types, dict(self.cols)))
         out: list[Event] = []
         attrs = codec.definition.attributes
         for i in np.nonzero(valid)[0]:

@@ -59,12 +59,13 @@ class _Side:
         self.table = tables.get(ins.stream_id)
         if self.is_table:
             from ..io.record_table import RecordTableRuntime
-            if (isinstance(self.table, RecordTableRuntime)
-                    and self.table.cache is None):
-                raise SiddhiAppCreationError(
-                    f"record table {ins.stream_id!r} has no @cache: joins "
-                    "probe tables inside the jitted step and need "
-                    "@cache(size='N', policy='FIFO|LRU|LFU')")
+            if isinstance(self.table, RecordTableRuntime):
+                if self.table.cache is None:
+                    raise SiddhiAppCreationError(
+                        f"record table {ins.stream_id!r} has no @cache: joins "
+                        "probe tables inside the jitted step and need "
+                        "@cache(size='N', policy='FIFO|LRU|LFU')")
+                self.table._used_in_probe = True  # cache-miss monitor
         self.named_window = (windows or {}).get(ins.stream_id)
         self.is_named_window = self.named_window is not None and not self.is_table
         self.aggregation = (aggregations or {}).get(ins.stream_id)

@@ -250,6 +250,7 @@ class PatternState(NamedTuple):
     active0: jax.Array  # bool — start state armed (non-every consumes it)
     seq: jax.Array  # int64 global arrival counter
     sel_state: object
+    dropped: jax.Array  # int64 lifetime partial matches dropped (table full)
 
 
 class PatternQueryRuntime:
@@ -481,6 +482,7 @@ class PatternQueryRuntime:
             active0=jnp.bool_(True),
             seq=jnp.int64(0),
             sel_state=self.selector.init_state(),
+            dropped=jnp.int64(0),
         )
 
     # ------------------------------------------------------------------- step
@@ -529,6 +531,7 @@ class PatternQueryRuntime:
 
             # collected outputs: one block per completion source
             out_blocks = []  # (frames {ref: cols}, fvalid {ref}, fts, ts, valid)
+            drop_acc = [jnp.int64(0)]  # pending-table insert overflow
 
             def expire(pend: PendingTable) -> PendingTable:
                 if within is None:
@@ -575,7 +578,7 @@ class PatternQueryRuntime:
                         pending, out_blocks, pi + 1,
                         comp_frames, comp_fvalid, comp_fts,
                         jnp.where(pend.valid, pend.start_ts, 0),
-                        pend.last_seq, comp_ts, due)
+                        pend.last_seq, comp_ts, due, drop_acc)
                     pend = pend._replace(valid=pend.valid & ~due)
                     pending[pi - 1] = pend
                     continue
@@ -605,7 +608,7 @@ class PatternQueryRuntime:
                     fvalid = {leg.ref: m}
                     fts = {leg.ref: batch.ts}
                     self._advance(pending, out_blocks, 1, frames, fvalid, fts,
-                                  batch.ts, arr_seq, batch.ts, m)
+                                  batch.ts, arr_seq, batch.ts, m, drop_acc)
                     continue
 
                 for li, leg in enumerate(pos.legs):
@@ -691,7 +694,7 @@ class PatternQueryRuntime:
                         ins_frames, ins_fvalid, ins_fts,
                         jnp.where(adv_valid, pend.start_ts, 0),
                         jnp.where(adv_valid, arr_seq[b_star], pend.last_seq),
-                        comp_ts, adv_valid)
+                        comp_ts, adv_valid, drop_acc)
 
             # ---- merge output blocks through the selector ----
             new_sel, out = self._emit(state.sel_state, out_blocks, now)
@@ -700,6 +703,7 @@ class PatternQueryRuntime:
                 active0=active0,
                 seq=state.seq + n_valid,
                 sel_state=new_sel,
+                dropped=state.dropped + drop_acc[0],
             )
             return new_state, out
 
@@ -709,7 +713,7 @@ class PatternQueryRuntime:
 
     def _advance(self, pending: list, out_blocks: list, target_pos: int,
                  frames, fvalid, fts, start_ts, last_seq, armed_ts,
-                 valid) -> None:
+                 valid, drop_acc=None) -> None:
         """Move completed entries to `target_pos` (insert into its waiting
         table, or emit if past the last position). Optional count positions
         add an epsilon edge: entries also advance past them immediately
@@ -722,9 +726,11 @@ class PatternQueryRuntime:
             if target_pos >= S:
                 out_blocks.append((frames, fvalid, fts, armed_ts, valid))
                 return
-            pending[target_pos - 1] = self._insert_entries(
+            pending[target_pos - 1], n_drop = self._insert_entries(
                 pending[target_pos - 1], frames, fvalid, fts,
                 start_ts, last_seq, armed_ts, valid)
+            if drop_acc is not None:
+                drop_acc[0] = drop_acc[0] + n_drop
             if not self.plan.positions[target_pos].optional:
                 return
             target_pos += 1
@@ -737,6 +743,7 @@ class PatternQueryRuntime:
         n_free = jnp.sum((~dst.valid).astype(jnp.int32))
         rank = jnp.cumsum(valid.astype(jnp.int32)) - 1
         fits = valid & (rank < n_free)
+        n_drop = jnp.sum(valid & ~fits, dtype=jnp.int64)
         slot = jnp.where(fits, free_order[jnp.clip(rank, 0, P - 1)], P)
 
         new_frames = {}
@@ -764,7 +771,7 @@ class PatternQueryRuntime:
             valid=dst.valid.at[slot].set(valid, mode="drop"),
             leg_done=dst.leg_done.at[slot].set(
                 jnp.zeros((slot.shape[0], 2), bool), mode="drop"),
-        )
+        ), n_drop
 
     # ------------------------------------------------------------------ emit
 

@@ -278,6 +278,9 @@ class QueryRuntime(Receiver):
         frame_ref = self.frame_ref
         dep_tables = self.dep_tables
         probes = {tid: self.tables[tid].contains_probe for tid in dep_tables}
+        for tid in dep_tables:
+            if hasattr(self.tables[tid], "_used_in_probe"):
+                self.tables[tid]._used_in_probe = True  # cache-miss monitor
 
         limiter = self.rate_limiter
 

@@ -96,10 +96,14 @@ class StreamJunction:
         #: (the reference's sequence receivers consume streams in arrival
         #: order, core/query/input/stream/state/receiver/)
         self.taps: list[Callable] = []
-        #: thread-safe pre-staging: one list of (ts, row) tuples appended
-        #: atomically (GIL) from producer threads via stage_row(), drained
-        #: into the staging buffers under the controller lock at flush
+        #: thread-safe pre-staging: a list of (ts, row) tuples appended from
+        #: producer threads via stage_row() under its own small lock (an
+        #: unlocked append could land on a list flush() just swapped out and
+        #: drained — a silently lost event), drained into the staging
+        #: buffers under the controller lock at flush
+        import threading as _t
         self._tap_queue: list = []
+        self._tap_lock = _t.Lock()
         self.on_error: Optional[Callable] = None
         # per-THREAD re-entrancy guards (flushing during callbacks; drain
         # nesting): shared booleans would make one thread's activity no-op
@@ -127,13 +131,15 @@ class StreamJunction:
     # ---------------------------------------------------------------- ingest
 
     def stage_row(self, ts: int, data: Sequence) -> None:
-        """Thread-safe staging from arbitrary producer threads: one atomic
-        list append; rows enter the real staging buffers under the
-        controller lock at the next flush. Used by sequence taps, which run
-        on whichever thread called the source's send()."""
-        self._tap_queue.append((ts, data))
+        """Thread-safe staging from arbitrary producer threads; rows enter
+        the real staging buffers under the controller lock at the next
+        flush. Used by sequence taps, which run on whichever thread called
+        the source's send()."""
+        with self._tap_lock:
+            self._tap_queue.append((ts, data))
+            full = len(self._tap_queue) >= self.batch_size
         self.ctx.timestamp_generator.observe_event_time(ts)
-        if len(self._tap_queue) >= self.batch_size:
+        if full:
             self.flush()
 
     def send_row(self, ts: int, data: Sequence) -> None:
@@ -288,7 +294,8 @@ class StreamJunction:
                                                       "draining", False):
                 self._drain_ring()
             if self._tap_queue:
-                q, self._tap_queue = self._tap_queue, []  # atomic swap (GIL)
+                with self._tap_lock:
+                    q, self._tap_queue = self._tap_queue, []
                 for ts, row in q:
                     self._staged_ts.append(ts)
                     self._staged_rows.append(row)

@@ -273,10 +273,17 @@ class CachePolicy:
         if policy not in ("FIFO", "LRU", "LFU"):
             raise SiddhiAppCreationError(
                 f"@cache policy must be FIFO, LRU or LFU, got {policy!r}")
+        if size < 1:
+            raise SiddhiAppCreationError(
+                f"@cache size must be >= 1, got {size} — use "
+                "@cache(size='N', policy='FIFO|LRU|LFU')")
         self.size = size
         self.policy = policy
         self.rows: OrderedDict = OrderedDict()  # key -> row dict
         self.freq: dict = {}
+        #: True once the backing store has held more rows than the cache —
+        #: joins and `in` probes read ONLY the cache, so evicted rows miss
+        self.overflowed = False
 
     def _evict_one(self):
         if self.policy == "LFU":
@@ -295,6 +302,7 @@ class CachePolicy:
             return
         while len(self.rows) >= self.size:
             self._evict_one()
+            self.overflowed = True
         self.rows[key] = row
         self.freq[key] = 1
 
@@ -374,6 +382,10 @@ class RecordTableRuntime:
                      or definition.annotation("Cache"))
         self.cache = None
         self.cache_policy = None
+        #: set by join/`in`-probe planners: enables the evicted-rows-miss
+        #: warning when the store outgrows the cache
+        self._used_in_probe = False
+        self._probe_miss_warned = False
         if cache_ann is not None:
             copts = {e.key: e.value for e in cache_ann.elements if e.key}
             size = int(copts.get("size", copts.get("max.size", 128)))
@@ -435,6 +447,20 @@ class RecordTableRuntime:
             return
         for r in rows:
             self.cache_policy.put(self._key(r), r)
+        if (self.cache_policy.overflowed and self._used_in_probe
+                and not self._probe_miss_warned):
+            # documented semantics (PARITY.md): in-kernel probes (joins,
+            # `in Table`) read ONLY the device cache; rows the policy
+            # evicted silently miss — the reference's cache-enabled read
+            # path falls back to the store instead
+            self._probe_miss_warned = True
+            import warnings
+            warnings.warn(
+                f"@store table {self.definition.id!r}: the backing store "
+                f"exceeded @cache(size='{self.cache_policy.size}') and the "
+                "table is probed by joins/`in` — evicted rows will MISS "
+                "those probes; raise the cache size to cover the store",
+                stacklevel=2)
         self._rebuild_cache()
 
     def _batch_rows(self, batch) -> list[dict]:

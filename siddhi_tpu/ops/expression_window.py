@@ -160,6 +160,13 @@ class ExpressionWindow(WindowOp):
         self.B = batch_cap
         self.conjuncts = plan_expression(parse_expression(condition), layout)
         self.C = max(dtypes.config.default_window_capacity, batch_cap)
+        # count() bounds are statically known: size the ring so the retained
+        # window can never wrap past capacity (mirrors length(N) setting
+        # C = max(N, batch_cap); sum/span forms have no static bound and
+        # rely on the step's monitored overflow counter instead)
+        for conj in self.conjuncts:
+            if conj.kind == "count":
+                self.C = max(self.C, int(conj.limit) + batch_cap)
         self.E = max(batch_cap, 1024)
         self.C = max(self.C, self.E)
         self.chunk_width = self.B + self.E
@@ -171,6 +178,7 @@ class ExpressionWindow(WindowOp):
             appended=jnp.int64(0),
             expired=jnp.int64(0),
             wm=jnp.int64(-(2**62)),
+            overflow=jnp.int64(0),
         )
 
     def _metric_seq(self, conj: _Conjunct, ring_cols, ring_ts, comp_cols,
@@ -289,11 +297,17 @@ class ExpressionWindow(WindowOp):
 
         new_ring = _append_packed(state.ring, comp_mat, state.appended,
                                   n_valid32)
+        # sum/span conjuncts have no static bound: count live rows the ring
+        # wrap overwrote (ADVICE r02: count() forms are sized statically)
+        expired1 = state.expired + s_end.astype(jnp.int64)
+        over0 = jnp.maximum(state.appended - state.expired - C, 0)
+        over1 = jnp.maximum(appended1 - expired1 - C, 0)
         new_state = SlidingState(
             ring=new_ring,
             appended=appended1,
-            expired=state.expired + s_end.astype(jnp.int64),
+            expired=expired1,
             wm=state.wm,
+            overflow=state.overflow + jnp.maximum(over1 - over0, 0),
         )
         return new_state, chunk
 

@@ -378,6 +378,7 @@ class SlidingState(NamedTuple):
     appended: jax.Array  # int64 total valid arrivals ever
     expired: jax.Array  # int64 total expirations ever
     wm: jax.Array  # int64 external-time watermark (externalTime mode only)
+    overflow: jax.Array  # int64 lifetime live rows overwritten past capacity
 
 
 class SlidingWindow(WindowOp):
@@ -427,6 +428,7 @@ class SlidingWindow(WindowOp):
             appended=jnp.int64(0),
             expired=jnp.int64(0),
             wm=jnp.int64(-(2**62)),
+            overflow=jnp.int64(0),
         )
 
     def step(self, state: SlidingState, batch: EventBatch, now: jax.Array):
@@ -540,11 +542,17 @@ class SlidingWindow(WindowOp):
         new_ring = _append_packed(state.ring, comp_mat, state.appended,
                                   n_valid32)
 
+        # live rows overwritten by ring wrap (a time window holding more
+        # than C un-expired rows): new excess this step, monotone
+        expired1 = state.expired + n_expired_new
+        over0 = jnp.maximum(state.appended - state.expired - self.C, 0)
+        over1 = jnp.maximum(appended1 - expired1 - self.C, 0)
         new_state = SlidingState(
             ring=new_ring,
             appended=appended1,
-            expired=state.expired + n_expired_new,
+            expired=expired1,
             wm=wm,
+            overflow=state.overflow + jnp.maximum(over1 - over0, 0),
         )
         return new_state, chunk
 

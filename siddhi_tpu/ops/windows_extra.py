@@ -394,6 +394,7 @@ class KeyedSessionState(NamedTuple):
     last_ts: jax.Array  # int64[K] newest event ts per key
     sess: jax.Array  # int32[K] current open session id per key
     has: jax.Array  # bool[K] key has an open session
+    dropped: jax.Array  # int64 lifetime events dropped (key >= capacity)
 
 
 class KeyedSessionWindow(WindowOp):
@@ -456,6 +457,7 @@ class KeyedSessionWindow(WindowOp):
             last_ts=jnp.zeros((K,), dtypes.TS_DTYPE),
             sess=jnp.zeros((K,), jnp.int32),
             has=jnp.zeros((K,), bool),
+            dropped=jnp.int64(0),
         )
 
     def step(self, state: KeyedSessionState, batch: EventBatch,
@@ -560,7 +562,8 @@ class KeyedSessionWindow(WindowOp):
             ring_cols=ring_cols, ring_ts=ring_ts, ring_key=ring_key,
             ring_sess=ring_sess, ring_emitted=emitted2,
             appended=appended1, last_ts=new_last, sess=new_sess,
-            has=new_has)
+            has=new_has,
+            dropped=state.dropped + jnp.sum(is_arr & ~ok, dtype=jnp.int64))
         return new_state, chunk
 
     def contents(self, state: KeyedSessionState, now: jax.Array):
