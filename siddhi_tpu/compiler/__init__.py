@@ -39,6 +39,19 @@ def update_variables(siddhi_ql: str, env: dict | None = None) -> str:
     return _VAR_PATTERN.sub(sub, siddhi_ql)
 
 
+def _transform(tree):
+    """Run the AST transformer, unwrapping semantic rejections
+    (SiddhiAppCreationError) from lark's VisitError so callers see the real
+    error type; anything else is a parse/AST bug."""
+    try:
+        return AstTransformer().transform(tree)
+    except VisitError as e:
+        from ..errors import SiddhiAppCreationError
+        if isinstance(e.orig_exc, SiddhiAppCreationError):
+            raise e.orig_exc from e
+        raise SiddhiParserError(f"error building AST: {e.orig_exc}") from e
+
+
 def parse(siddhi_ql: str) -> SiddhiApp:
     """Parse a full SiddhiQL app definition string into a SiddhiApp AST."""
     try:
@@ -47,10 +60,7 @@ def parse(siddhi_ql: str) -> SiddhiApp:
         line = getattr(e, "line", None)
         column = getattr(e, "column", None)
         raise SiddhiParserError(str(e).split("\n")[0], line, column) from e
-    try:
-        return AstTransformer().transform(tree)
-    except VisitError as e:
-        raise SiddhiParserError(f"error building AST: {e.orig_exc}") from e
+    return _transform(tree)
 
 
 def parse_on_demand_query(text: str):
@@ -62,10 +72,7 @@ def parse_on_demand_query(text: str):
     except UnexpectedInput as e:
         raise SiddhiParserError(str(e).split("\n")[0], getattr(e, "line", None),
                                 getattr(e, "column", None)) from e
-    try:
-        return AstTransformer().transform(tree)
-    except VisitError as e:
-        raise SiddhiParserError(f"error building AST: {e.orig_exc}") from e
+    return _transform(tree)
 
 
 def parse_expression(text: str):
@@ -78,10 +85,7 @@ def parse_expression(text: str):
     except UnexpectedInput as e:
         raise SiddhiParserError(str(e).split("\n")[0], getattr(e, "line", None),
                                 getattr(e, "column", None)) from e
-    try:
-        return AstTransformer().transform(tree)
-    except VisitError as e:
-        raise SiddhiParserError(f"error building AST: {e.orig_exc}") from e
+    return _transform(tree)
 
 
 def parse_query(query_text: str) -> Query:

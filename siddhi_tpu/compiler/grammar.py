@@ -62,7 +62,11 @@ query_input: join_stream | state_stream | standard_stream
 // standard single stream (priority: a bare `S[f]#window.w()` must win over a
 // single-element pattern chain)
 standard_stream.10: source handler_chain
-source: INNER_STREAM_ID | FAULT_STREAM_ID | stream_id
+source: INNER_STREAM_ID | FAULT_STREAM_ID | stream_id | anon_stream
+// anonymous stream: `from (from S select ...) ...` — desugared by the
+// transformer into a synthetic stream fed by the inner query (reference:
+// api/execution/query/input/stream/AnonymousInputStream.java)
+anon_stream: "(" FROM query_input select_clause? group_by_clause? having_clause? order_by_clause? limit_clause? offset_clause? ")"
 stream_id: NAME
 INNER_STREAM_ID: /#[A-Za-z_][A-Za-z_0-9]*/
 FAULT_STREAM_ID: /![A-Za-z_][A-Za-z_0-9]*/

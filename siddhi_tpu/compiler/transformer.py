@@ -3,6 +3,8 @@ reference's SiddhiQLBaseVisitorImpl.java, 3,080 LoC)."""
 
 from __future__ import annotations
 
+import dataclasses
+
 from lark import Token, Transformer, v_args
 
 from ..query_api import (
@@ -423,12 +425,35 @@ class AstTransformer(Transformer):
     # ---------------- query input ----------------
 
     def source(self, tok):
+        if isinstance(tok, tuple) and tok and tok[0] == "anon":
+            return tok
         s = str(tok)
         if s.startswith("#"):
             return ("inner", s[1:])
         if s.startswith("!"):
             return ("fault", s[1:])
         return ("plain", s)
+
+    def anon_stream(self, *parts):
+        """`from (from S select ...) ...`: desugar to a synthetic stream fed
+        by the inner query (reference: AnonymousInputStream.java). The inner
+        query is queued and emitted just before the enclosing query."""
+        n = getattr(self, "_anon_n", 0)
+        self._anon_n = n + 1
+        name = f"_anon_{n}"
+        inner = self.query(*parts)
+        if isinstance(inner, tuple) and inner and inner[0] == "queries":
+            qs = list(inner[1])
+            inner = qs.pop()
+            self._pending_anon = getattr(self, "_pending_anon", [])
+            self._pending_anon.extend(qs)
+        inner = dataclasses.replace(
+            inner, output_stream=OutputStream(OutputAction.INSERT,
+                                              target_id=name))
+        if not hasattr(self, "_pending_anon"):
+            self._pending_anon = []
+        self._pending_anon.append(inner)
+        return ("anon", name)
 
     def handler_chain(self, *handlers):
         return list(handlers)
@@ -543,6 +568,11 @@ class AstTransformer(Transformer):
             ref = items.pop(0)
         source, handlers = items
         kind, sid = source
+        if kind == "anon":
+            from ..errors import SiddhiAppCreationError
+            raise SiddhiAppCreationError(
+                "anonymous streams are not supported inside patterns/"
+                "sequences — define the inner query as its own stream")
         s = SingleInputStream(stream_id=sid, alias=ref,
                               handlers=_build_chain(handlers),
                               is_inner=kind == "inner", is_fault=kind == "fault")
@@ -832,9 +862,16 @@ class AstTransformer(Transformer):
             limit=selector_parts["limit"],
             offset=selector_parts["offset"],
         )
-        return Query(input_stream=input_stream, selector=selector,
-                     output_stream=output_stream or OutputStream(OutputAction.RETURN),
-                     output_rate=output_rate, annotations=anns)
+        q = Query(input_stream=input_stream, selector=selector,
+                  output_stream=output_stream or OutputStream(OutputAction.RETURN),
+                  output_rate=output_rate, annotations=anns)
+        pending = getattr(self, "_pending_anon", None)
+        if pending:
+            # desugared anonymous-stream inner queries run before the query
+            # that consumes their synthetic streams
+            self._pending_anon = []
+            return ("queries", (*pending, q))
+        return q
 
     # ---------------- on-demand (store) query ----------------
 
@@ -952,6 +989,11 @@ class AstTransformer(Transformer):
                 ptypes.append(p)
             elif isinstance(p, Query):
                 queries.append(p)
+            elif isinstance(p, tuple) and p and p[0] == "queries":
+                from ..errors import SiddhiAppCreationError
+                raise SiddhiAppCreationError(
+                    "anonymous streams are not supported inside partitions — "
+                    "define the inner query as its own stream")
         return Partition(partition_types=tuple(ptypes), queries=tuple(queries),
                          annotations=anns)
 
@@ -979,6 +1021,9 @@ class AstTransformer(Transformer):
                 app.define_function(item)
             elif isinstance(item, Query):
                 app.add_query(item)
+            elif isinstance(item, tuple) and item and item[0] == "queries":
+                for q in item[1]:
+                    app.add_query(q)
             elif isinstance(item, Partition):
                 app.add_partition(item)
         return app

@@ -550,6 +550,7 @@ class SiddhiAppRuntime:
         import numpy as np
 
         from ..ops.groupby import KeyTable
+        from ..ops.ratelimit import WindowedSnapshotState
         from ..ops.windows import SlidingState
         from ..ops.windows_extra import KeyedSessionState
         from .join_runtime import JoinQueryRuntime
@@ -558,22 +559,22 @@ class SiddhiAppRuntime:
         stats = self.ctx.statistics
 
         def scan(label: str, obj, acc: dict) -> None:
+            # accumulate DEVICE scalars; the single device_get below fetches
+            # everything in one round trip (a per-counter np.asarray costs a
+            # full tunnel sync EACH — see event.to_host_events)
+            def add(key, arr):
+                acc.setdefault(key, []).append(arr)
+
             if isinstance(obj, KeyTable):
-                acc["key_table_unresolved"] = acc.get(
-                    "key_table_unresolved", 0) + int(
-                    np.sum(np.asarray(obj.misses)))
+                add("key_table_unresolved", obj.misses)
             elif isinstance(obj, SlidingState):
-                acc["window_ring_overflow"] = acc.get(
-                    "window_ring_overflow", 0) + int(
-                    np.sum(np.asarray(obj.overflow)))
+                add("window_ring_overflow", obj.overflow)
             elif isinstance(obj, KeyedSessionState):
-                acc["session_key_dropped"] = acc.get(
-                    "session_key_dropped", 0) + int(
-                    np.sum(np.asarray(obj.dropped)))
+                add("session_key_dropped", obj.dropped)
             elif isinstance(obj, PatternState):
-                acc["pattern_pending_dropped"] = acc.get(
-                    "pattern_pending_dropped", 0) + int(
-                    np.sum(np.asarray(obj.dropped)))
+                add("pattern_pending_dropped", obj.dropped)
+            elif isinstance(obj, WindowedSnapshotState):
+                add("snapshot_ring_overflow", obj.overflow)
             import dataclasses as _dc
             if isinstance(obj, dict):
                 for v in obj.values():
@@ -595,16 +596,19 @@ class SiddhiAppRuntime:
         sources += [(f"window:{n}", w.state) for n, w in self.windows.items()]
         sources += [(f"aggregation:{n}", a.state)
                     for n, a in self.aggregations.items()]
+        pending: dict[str, list] = {}
         for label, obj in sources:
             acc: dict = {}
             scan(label, obj, acc)
-            for k, v in acc.items():
-                stats.record_overflow(f"{label}.{k}", v)
+            for k, arrs in acc.items():
+                pending[f"{label}.{k}"] = arrs
         for n, qr in self.query_runtimes.items():
             if isinstance(qr, JoinQueryRuntime) and qr._dropped_dev is not None:
-                stats.record_overflow(
-                    f"query:{n}.join_pairs_dropped",
-                    int(np.asarray(qr._dropped_dev)))
+                pending[f"query:{n}.join_pairs_dropped"] = [qr._dropped_dev]
+        import jax
+        fetched = jax.device_get(pending)  # ONE device->host round trip
+        for name, arrs in fetched.items():
+            stats.record_overflow(name, int(sum(np.sum(a) for a in arrs)))
 
     # ---------------------------------------------------------------- debugger
 

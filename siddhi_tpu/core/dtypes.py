@@ -54,6 +54,9 @@ class _Config:
     pattern_pending_capacity = 1024
     #: retained groups for `output snapshot ... group by` (rows per snapshot)
     snapshot_group_capacity = 1024
+    #: full-window snapshot limiter ring (non-aggregated `output snapshot`);
+    #: sized up automatically when the window's own capacity is known
+    snapshot_window_capacity = 4096
     #: key slots for keyed session windows (session(gap, key))
     session_key_capacity = 4096
     #: expansion bound for unbounded pattern counts `<m:>`.

@@ -229,10 +229,17 @@ class QueryRuntime(Receiver):
         from ..ops.ratelimit import make_rate_limiter
         out_layout = {n: dtypes.device_dtype(t)
                       for n, t in self.selector.out_types.items()}
+        from ..ops.windows import (LengthBatchWindow, SlidingWindow,
+                                   TimeBatchWindow)
+        fifo = isinstance(self.window,
+                          (SlidingWindow, LengthBatchWindow, TimeBatchWindow))
         self.rate_limiter = make_rate_limiter(
             query.output_rate, out_layout, self.window.chunk_width,
             grouped=bool(query.selector.group_by),
-            group_capacity=ctx.effective_group_capacity)
+            group_capacity=ctx.effective_group_capacity,
+            fifo_window=fifo,
+            has_aggregates=self.selector.has_aggregators,
+            window_capacity=getattr(self.window, "C", 0))
         from ..ops.ratelimit import GroupedSnapshotLimiter
         if isinstance(self.rate_limiter, GroupedSnapshotLimiter):
             # the limiter retains one row per group: have the selector ride
@@ -329,7 +336,8 @@ class QueryRuntime(Receiver):
             if selector.extrema_plan:
                 # removal-capable sliding min/max: range queries over the
                 # window's arrival-order sequence (ops/extrema.py)
-                from ..ops.extrema import sliding_extrema_lanes
+                from ..ops.extrema import (grouped_sliding_extrema_lanes,
+                                           sliding_extrema_lanes)
                 from ..ops.windows import _unpack_rows
                 ring_cols, ring_ts = _unpack_rows(wstate_pre.ring,
                                                   window.layout)
@@ -338,10 +346,19 @@ class QueryRuntime(Receiver):
                     frame_ref, ring_cols, ring_ts,
                     jnp.ones(ring_ts.shape, bool), default=True)
                 rscope.extras = dict(scope.extras)
+                ghash = selector.extrema_group_hash
                 for slot, eop, args in selector.extrema_plan:
-                    cscope.extras[f"extrema:{slot}"] = sliding_extrema_lanes(
-                        eop, args[0](rscope), wstate_pre.expired,
-                        wstate_pre.appended, chunk, args[0](cscope))
+                    if ghash is not None:
+                        cscope.extras[f"extrema:{slot}"] = \
+                            grouped_sliding_extrema_lanes(
+                                eop, args[0](rscope), ghash(rscope),
+                                wstate_pre.expired, wstate_pre.appended,
+                                chunk, args[0](cscope), ghash(cscope))
+                    else:
+                        cscope.extras[f"extrema:{slot}"] = \
+                            sliding_extrema_lanes(
+                                eop, args[0](rscope), wstate_pre.expired,
+                                wstate_pre.appended, chunk, args[0](cscope))
             sstate, out = selector.step(sstate, chunk, cscope)
             rstate, out = limiter.step(rstate, out, now)
 

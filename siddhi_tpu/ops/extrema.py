@@ -17,8 +17,13 @@ of the arrival sequence, so per-event extrema are range-min/max queries:
      RMQ lookup, one gather pair for the whole chunk.
 
 Per-step cost is O(N log N) vector work with no data-dependent control flow.
-Grouped variants are not expressible this way (per-group ranges are not
-contiguous in arrival order) — the planner rejects them.
+
+GROUPED variants add one stable 3-key sort by (group-hash64, position):
+per-group rows become contiguous runs, each lane's range endpoints land by
+vectorized binary search on the composite key, and the same two-probe RMQ
+applies (a range never crosses its group's run, so boundary-mixing sparse
+levels are harmless). 64-bit group hashes make cross-group merges a 2^-64
+event — the engine-wide hashing policy (ops/groupby.hash_columns).
 """
 
 from __future__ import annotations
@@ -78,4 +83,94 @@ def sliding_extrema_lanes(
     g1 = flat[k * N + jnp.clip(l, 0, N - 1)]
     g2 = flat[k * N + jnp.clip(r - off, 0, N - 1)]
     out = combine(g1, g2)
+    return jnp.where(length > 0, out, jnp.full_like(out, identity))
+
+
+def _split64(h: jax.Array):
+    """int64/uint64 hash → two u32 word arrays (any consistent total order
+    works for grouping; both the sort and the search use this split)."""
+    w = jax.lax.bitcast_convert_type(h, jnp.uint32)
+    return w[..., 0], w[..., 1]
+
+
+def grouped_sliding_extrema_lanes(
+    op: str,  # 'min' | 'max'
+    ring_vals: jax.Array,  # [C] arg values over ring rows, slot order
+    ring_gkey: jax.Array,  # [C] 64-bit group hash per ring row
+    expired: jax.Array,
+    appended: jax.Array,
+    chunk: EventBatch,
+    cur_vals: jax.Array,  # [L] arg values over chunk rows
+    cur_gkey: jax.Array,  # [L] 64-bit group hash per chunk row
+) -> jax.Array:
+    """Per-chunk-lane extremum over the lane's GROUP within the window
+    (reference: per-group AggregatorState multisets in
+    Min/MaxAttributeAggregatorExecutor.processRemove)."""
+    combine, identity = (_op_min if op == "min" else _op_max)(ring_vals.dtype)
+    C = ring_vals.shape[0]
+    L = chunk.capacity
+    N = C + L
+
+    winlen0 = (appended - expired).astype(jnp.int32)
+    base = (expired % C).astype(jnp.int32)
+    arr = jax.lax.dynamic_slice(
+        jnp.concatenate([ring_vals, ring_vals]), (base,), (C,))
+    garr = jax.lax.dynamic_slice(
+        jnp.concatenate([ring_gkey, ring_gkey]), (base,), (C,))
+
+    is_cur = chunk.valid & (chunk.types == EventType.CURRENT)
+    is_exp = chunk.valid & (chunk.types == EventType.EXPIRED)
+    cc = jnp.cumsum(is_cur.astype(jnp.int32))
+    ce = jnp.cumsum(is_exp.astype(jnp.int32))
+
+    A = jnp.concatenate([arr, jnp.full((L,), identity, ring_vals.dtype)])
+    ah, al = _split64(garr)
+    gh = jnp.concatenate([ah, jnp.zeros((L,), jnp.uint32)])
+    gl = jnp.concatenate([al, jnp.zeros((L,), jnp.uint32)])
+    dest = jnp.where(is_cur, winlen0 + cc - 1, N)
+    A = A.at[dest].set(cur_vals.astype(ring_vals.dtype), mode="drop")
+    ch, cl = _split64(cur_gkey)
+    gh = gh.at[dest].set(ch, mode="drop")
+    gl = gl.at[dest].set(cl, mode="drop")
+    # stale slots (pos >= winlen0 + total curs) and the unwritten tail sort
+    # inside or after their groups but every lane's r-bound excludes them
+    pos = jnp.arange(N, dtype=jnp.int32)
+
+    sgh, sgl, spos, sval = jax.lax.sort((gh, gl, pos, A), num_keys=3,
+                                        is_stable=False)
+
+    # sparse table over the sorted values
+    levels = [sval]
+    span = 1
+    while span < N:
+        prev = levels[-1]
+        shifted = jnp.concatenate(
+            [prev[span:], jnp.full((span,), identity, prev.dtype)])
+        levels.append(combine(prev, shifted))
+        span *= 2
+    flat = jnp.stack(levels).reshape(-1)
+
+    def lower_bound(tp):
+        """First sorted index with key >= (lane's group, tp)."""
+        lo = jnp.zeros(tp.shape, jnp.int32)
+        hi = jnp.full(tp.shape, N, jnp.int32)
+        for _ in range(N.bit_length() + 1):
+            mid = (lo + hi) >> 1
+            m = jnp.clip(mid, 0, N - 1)
+            a1, a2, ap = sgh[m], sgl[m], spos[m]
+            lt = (a1 < ch) | ((a1 == ch) & (
+                (a2 < cl) | ((a2 == cl) & (ap < tp))))
+            take = lo < hi
+            lo = jnp.where(take & lt, mid + 1, lo)
+            hi = jnp.where(take & ~lt, mid, hi)
+        return lo
+
+    l = lower_bound(ce)            # group rows removed so far excluded
+    r = lower_bound(winlen0 + cc)  # group rows arrived so far included
+    length = r - l
+    k = 31 - jax.lax.clz(jnp.maximum(length, 1))
+    off = jnp.left_shift(jnp.int32(1), k)
+    p1 = flat[k * N + jnp.clip(l, 0, N - 1)]
+    p2 = flat[k * N + jnp.clip(r - off, 0, N - 1)]
+    out = combine(p1, p2)
     return jnp.where(length > 0, out, jnp.full_like(out, identity))

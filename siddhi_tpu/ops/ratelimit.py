@@ -257,6 +257,95 @@ class SnapshotLimiter(RateLimiterOp):
         return new_state, emit
 
 
+class WindowedSnapshotState(NamedTuple):
+    cols: dict  # {name: [Cap]} projected rows currently in the window
+    appended: jax.Array  # int64 projected CURRENT rows ever
+    expired: jax.Array  # int64 projected EXPIRED rows ever
+    bucket: jax.Array  # int64 last observed time bucket
+    overflow: jax.Array  # int64 live rows overwritten past capacity
+
+
+class WindowedSnapshotLimiter(RateLimiterOp):
+    """`output snapshot every <t>` on a NON-aggregated window query: each
+    tick re-emits EVERY event currently in the window (reference:
+    snapshot/WindowedPerSnapshotOutputRateLimiter.java keeps an eventList,
+    appending CURRENTs and removing on EXPIREDs).
+
+    The TPU shape: a FIFO ring of the PROJECTED output rows — CURRENT lanes
+    append, EXPIRED lanes pop the front. Valid for windows that expire in
+    arrival order (length/time/timeLength/delay/externalTime/batch
+    families); non-FIFO windows (sort, session, frequent) keep the
+    retained-last-row SnapshotLimiter (documented in PARITY.md)."""
+
+    has_time_semantics = True
+
+    def __init__(self, layout: dict, time_ms: int, capacity: int):
+        self.layout = layout
+        self.T = time_ms
+        self.Cap = capacity
+        self.chunk_width = capacity
+
+    def init_state(self) -> WindowedSnapshotState:
+        Cap = self.Cap
+        return WindowedSnapshotState(
+            cols={k: jnp.zeros((Cap,), dt) for k, dt in self.layout.items()},
+            appended=jnp.int64(0),
+            expired=jnp.int64(0),
+            bucket=jnp.int64(-1),
+            overflow=jnp.int64(0),
+        )
+
+    def step(self, state: WindowedSnapshotState, out: EventBatch, now):
+        Cap = self.Cap
+        cur = out.valid & (out.types == EventType.CURRENT)
+        exp = out.valid & (out.types == EventType.EXPIRED)
+        n_cur = jnp.sum(cur, dtype=jnp.int64)
+        n_exp = jnp.sum(exp, dtype=jnp.int64)
+
+        # --- ring update: CURRENT appends, EXPIRED pops the front ---
+        rank = jnp.cumsum(cur.astype(jnp.int32)) - 1
+        slot = (state.appended % Cap).astype(jnp.int32) + rank
+        slot = jnp.where(slot >= Cap, slot - Cap, slot)
+        slot = jnp.where(cur, slot, Cap)
+        new_cols = {k: state.cols[k].at[slot].set(out.cols[k], mode="drop")
+                    for k in state.cols}
+        appended1 = state.appended + n_cur
+        expired1 = state.expired + n_exp
+        over0 = jnp.maximum(state.appended - state.expired - Cap, 0)
+        over1 = jnp.maximum(appended1 - expired1 - Cap, 0)
+
+        # --- tick emission: the snapshot shows the window AS OF the newest
+        # crossed boundary — this chunk's adds/removes stamped at or before
+        # the boundary apply, later ones wait (lane ts carry arrival/expiry
+        # instants, so the split is exact even inside one batch) ---
+        bucket = now // jnp.int64(self.T)
+        first = state.bucket < 0
+        fire = ~first & (bucket > state.bucket)
+        boundary_ts = bucket * jnp.int64(self.T)
+        n_exp_pre = jnp.sum(exp & (out.ts <= boundary_ts), dtype=jnp.int64)
+        n_cur_pre = jnp.sum(cur & (out.ts <= boundary_ts), dtype=jnp.int64)
+        lo = state.expired + n_exp_pre
+        winlen = (state.appended + n_cur_pre - lo).astype(jnp.int32)
+        pe = jnp.arange(Cap, dtype=jnp.int32)
+        base = (lo % Cap).astype(jnp.int32)
+        row = base + pe
+        row = jnp.where(row >= Cap, row - Cap, row)
+        emit = EventBatch(
+            ts=jnp.broadcast_to(now, (Cap,)),
+            cols={k: v[row] for k, v in new_cols.items()},
+            valid=fire & (pe < winlen),
+            types=jnp.zeros((Cap,), jnp.int8))
+
+        new_state = WindowedSnapshotState(
+            cols=new_cols,
+            appended=appended1, expired=expired1,
+            bucket=jnp.where(first, bucket,
+                             jnp.maximum(state.bucket, bucket)),
+            overflow=state.overflow + jnp.maximum(over1 - over0, 0),
+        )
+        return new_state, emit
+
+
 class GroupedSnapshotState(NamedTuple):
     rows: dict  # [G] retained last row per group, per column
     present: jax.Array  # bool[G]
@@ -337,7 +426,10 @@ class GroupedSnapshotLimiter(RateLimiterOp):
 
 def make_rate_limiter(rate: Optional[OutputRate], layout: dict,
                       out_width: int, grouped: bool = False,
-                      group_capacity: int = 1 << 20) -> RateLimiterOp:
+                      group_capacity: int = 1 << 20,
+                      fifo_window: bool = False,
+                      has_aggregates: bool = False,
+                      window_capacity: int = 0) -> RateLimiterOp:
     if rate is None:
         return PassThroughLimiter()
     if rate.type == OutputRateType.SNAPSHOT:
@@ -348,6 +440,12 @@ def make_rate_limiter(rate: Optional[OutputRate], layout: dict,
             return GroupedSnapshotLimiter(
                 layout, rate.time_ms, dtypes.config.snapshot_group_capacity,
                 group_capacity)
+        if fifo_window and not has_aggregates:
+            # reference WindowedPerSnapshotOutputRateLimiter: re-emit the
+            # FULL window contents each tick
+            cap = max(window_capacity,
+                      dtypes.config.snapshot_window_capacity)
+            return WindowedSnapshotLimiter(layout, rate.time_ms, cap)
         return SnapshotLimiter(layout, rate.time_ms)
     if rate.event_count is not None:
         n = rate.event_count

@@ -202,15 +202,24 @@ class CompiledSelector:
             args = [compile_expression(p, resolver, registry) for p in node.parameters]
             spec = factory.make(tuple(a.type for a in args))
             if sliding_window and spec.extrema_op is not None:
-                if selector.group_by:
-                    raise SiddhiAppCreationError(
-                        f"{spec.extrema_op}() with GROUP BY over a sliding "
-                        "window is not yet supported (per-group removal); "
-                        "use minForever/maxForever or a batch window")
                 self.extrema_plan.append((slot_name, spec.extrema_op, args))
             self.agg_specs.append((slot_name, spec, args))
         self._extrema_slots = {s for s, _, _ in self.extrema_plan}
         self.has_aggregators = bool(self.agg_specs)
+
+        # grouped extrema need the group hash of both ring rows and chunk
+        # lanes (ops/extrema.grouped_sliding_extrema_lanes); defined here so
+        # ring-side and lane-side hashing can never diverge
+        if self.extrema_plan and selector.group_by:
+            gvars = [resolver.resolve(v) for v in selector.group_by]
+
+            def group_hash(scope):
+                return hash_columns(
+                    [scope.col(ref, attr) for ref, attr, _ in gvars])
+
+            self.extrema_group_hash = group_hash
+        else:
+            self.extrema_group_hash = None
 
         # --- resolver extended with the __agg__ frame ---
         frames = dict(resolver.frames)

@@ -1,0 +1,100 @@
+"""Anonymous streams in FROM clauses (reference:
+api/execution/query/input/stream/AnonymousInputStream.java; grammar rule
+anonymous stream in SiddhiQL.g4): `from (from S select ...) ...` desugars at
+parse time to a synthetic stream fed by the inner query."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.compiler import parse
+from siddhi_tpu.errors import SiddhiAppCreationError
+
+
+def run(app, sends, out="Out", batch_size=8):
+    rt = SiddhiManager().create_siddhi_app_runtime(app, batch_size=batch_size)
+    rows = []
+    rt.add_callback(out, lambda evs: rows.extend(tuple(e) for e in evs))
+    rt.start()
+    for stream, row in sends:
+        rt.get_input_handler(stream).send(row)
+    rt.flush()
+    rt.shutdown()
+    return rows
+
+
+class TestAnonymousStreams:
+    def test_desugars_to_inner_query(self):
+        app = """
+        define stream S (sym string, price double);
+        from (from S[price > 10.0] select sym, price) #window.lengthBatch(4)
+        select sym, sum(price) as total
+        group by sym
+        insert into Out;
+        """
+        sapp = parse(app)
+        assert len(sapp.queries) == 2
+        inner, outer = sapp.queries
+        assert inner.output_stream.target_id == outer.input_stream.stream_id
+        assert outer.input_stream.handlers.window is not None
+
+    def test_filter_project_feeds_window(self):
+        app = """
+        define stream S (sym string, price double);
+        from (from S[price > 10.0] select sym, price) #window.lengthBatch(4)
+        select sym, sum(price) as total
+        group by sym
+        insert into Out;
+        """
+        sends = [("S", ("A", p)) for p in (5.0, 20.0, 30.0, 40.0, 50.0, 7.0)]
+        rows = run(app, sends)
+        # 4 events pass the inner filter; per-event emission inside the
+        # lengthBatch flush ends on the full batch sum
+        assert rows[-1] == ("A", 140.0)
+
+    def test_inner_aggregation(self):
+        app = """
+        define stream S (sym string, price double);
+        from (from S#window.lengthBatch(2) select sym, sum(price) as p2)
+        select sym, p2
+        insert into Out;
+        """
+        sends = [("S", ("A", 1.0)), ("S", ("A", 2.0)),
+                 ("S", ("B", 10.0)), ("S", ("B", 20.0))]
+        rows = run(app, sends)
+        assert ("A", 3.0) in rows and ("B", 30.0) in rows
+
+    def test_join_side_anonymous(self):
+        app = """
+        define stream L (k int, v double);
+        define stream R (k int, w double);
+        from L#window.length(4) as a
+        join (from R[w > 1.0] select k, w) #window.length(4) as b
+        on a.k == b.k
+        select a.k as k, b.w as w
+        insert into Out;
+        """
+        sends = [("R", (1, 5.0)), ("R", (2, 0.5)),
+                 ("L", (1, 9.0)), ("L", (2, 9.0))]
+        rows = run(app, sends)
+        assert rows == [(1, 5.0)]
+
+    def test_rejected_in_partitions(self):
+        app = """
+        define stream S (sym string, price double);
+        partition with (sym of S)
+        begin
+          from (from S select sym, price) select sym insert into Out;
+        end;
+        """
+        with pytest.raises(SiddhiAppCreationError, match="partitions"):
+            SiddhiManager().create_siddhi_app_runtime(app)
+
+    def test_rejected_in_patterns(self):
+        app = """
+        define stream S (sym string, price double);
+        define stream T (sym string, price double);
+        from every e1=(from S select sym, price) -> e2=T
+        select e1.sym as s insert into Out;
+        """
+        with pytest.raises(Exception):  # parse or creation error
+            SiddhiManager().create_siddhi_app_runtime(app)

@@ -204,3 +204,55 @@ class TestBufferedLimiterOverflow:
         # bucket closes: only the newest 8 lanes survive, in order, no dupes
         state, out = lim.step(state, batch([], 1500), jnp.int64(1500))
         assert out.cols["x"][out.valid].tolist() == list(range(4, 12))
+
+
+class TestWindowedSnapshot:
+    """Non-aggregated window query + `output snapshot`: each tick re-emits
+    the FULL window contents (reference:
+    snapshot/WindowedPerSnapshotOutputRateLimiter.java eventList)."""
+
+    def test_snapshot_emits_all_window_rows(self):
+        rt = build(S + "@info(name='q') from S#window.length(3) "
+                   "select symbol, price "
+                   "output snapshot every 1 sec insert into Out;")
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        for i, (s, p) in enumerate([("a", 1.0), ("b", 2.0), ("c", 3.0),
+                                    ("d", 4.0)]):
+            h.send((s, p), timestamp=100 + i)
+        rt.flush()
+        rt.heartbeat(1_500)
+        # window.length(3) holds the last 3 rows: b, c, d
+        assert [tuple(e.data) for e in got] == [
+            ("b", 2.0), ("c", 3.0), ("d", 4.0)]
+
+    def test_snapshot_tracks_time_window_expiry(self):
+        rt = build(S + "@info(name='q') from S#window.time(2 sec) "
+                   "select symbol, price "
+                   "output snapshot every 1 sec insert into Out;")
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0), timestamp=100)
+        h.send(("b", 2.0), timestamp=1_900)
+        rt.flush()
+        # boundary 2000: 'a' (expires 2100) is STILL in the window
+        rt.heartbeat(2_500)
+        assert [tuple(e.data) for e in got] == [("a", 1.0), ("b", 2.0)]
+        del got[:]
+        rt.heartbeat(3_500)   # boundary 3000: only 'b' (expires 3900) left
+        assert [tuple(e.data) for e in got] == [("b", 2.0)]
+        del got[:]
+        rt.heartbeat(4_500)   # 'b' expired too: empty snapshot emits nothing
+        assert got == []
+
+    def test_aggregated_window_snapshot_keeps_value_semantics(self):
+        rt = build(S + "@info(name='q') from S#window.length(3) "
+                   "select sum(price) as total "
+                   "output snapshot every 1 sec insert into Out;")
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        for i, p in enumerate([1.0, 2.0, 3.0]):
+            h.send(("a", p), timestamp=100 + i)
+        rt.flush()
+        rt.heartbeat(1_500)
+        assert [tuple(e.data) for e in got] == [(6.0,)]

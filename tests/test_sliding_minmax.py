@@ -74,12 +74,6 @@ class TestSlidingMin:
         # windows (len 4): [7] [7,3] [7,3,9] [7,3,9,5] [3,9,5,8] [9,5,8,6]
         assert [r[0] for r in got] == [7, 3, 3, 3, 3, 5]
 
-    def test_grouped_sliding_min_rejected(self):
-        with pytest.raises(SiddhiAppCreationError, match="GROUP BY"):
-            build(S + "@info(name='q') from S#window.length(3) "
-                  "select symbol, min(price) as mn group by symbol "
-                  "insert into Out;")
-
     def test_min_over_batch_window_still_works(self):
         rt = build(S + "@info(name='q') from S#window.lengthBatch(3) "
                    "select min(price) as mn insert into Out;")
@@ -116,3 +110,69 @@ class TestExpressionWindowExtrema:
         # pop-after-arrival: arrival lane sees pre-pop window, so windows at
         # emission are [1] [1,5] [1,5,7]->pop1 [5,7,9]->pop5
         assert [r[0] for r in got] == [1.0, 1.0, 1.0, 5.0]
+
+
+class TestGroupedSlidingMinMax:
+    """Per-group removal-capable extrema (reference keeps one sorted multiset
+    per AggregatorState group key): sorted-run RMQ in ops/extrema.py."""
+
+    def test_grouped_length_window_min(self):
+        rt = build(S + "@info(name='q') from S#window.length(4) "
+                   "select symbol, min(price) as mn group by symbol "
+                   "insert into Out;")
+        got = collect(rt)
+        h = rt.get_input_handler("S")
+        rows = [("a", 5.0), ("b", 10.0), ("a", 1.0), ("b", 20.0),
+                ("a", 7.0), ("b", 2.0), ("a", 9.0)]
+        for i, (s, p) in enumerate(rows):
+            h.send((s, p, i), timestamp=i)
+        rt.flush()
+        by = {}
+        for sym, mn in [(r[0], r[1]) for r in got]:
+            by.setdefault(sym, []).append(mn)
+        # window(len 4) evolution per event:
+        # a5 | b10 | a1 | b20 | a7(evicts a5) | b2(evicts b10) | a9(evicts a1)
+        assert by["a"] == [5.0, 1.0, 1.0, 7.0]
+        assert by["b"] == [10.0, 10.0, 2.0]
+
+    def test_grouped_time_window_max(self):
+        rt = build(S + "@info(name='q') from S#window.time(10) "
+                   "select symbol, max(price) as mx group by symbol "
+                   "insert into Out;")
+        got = collect(rt)
+        h = rt.get_input_handler("S")
+        sends = [(0, "a", 5.0), (1, "b", 30.0), (2, "a", 9.0),
+                 (12, "a", 3.0), (13, "b", 4.0)]
+        for t, s, p in sends:
+            h.send((s, p, 1), timestamp=t)
+        rt.flush()
+        by = {}
+        for r in got:
+            by.setdefault(r[0], []).append(r[1])
+        # at t=12 the t<=2 events expired: a's max falls back to 3.0
+        assert by["a"] == [5.0, 9.0, 3.0]
+        assert by["b"] == [30.0, 4.0]
+
+    def test_grouped_min_many_keys_parity_with_host(self):
+        import numpy as np
+        rt = build(S + "@info(name='q') from S#window.length(8) "
+                   "select symbol, min(price) as mn group by symbol "
+                   "insert into Out;", batch_size=16)
+        got = collect(rt)
+        rng = np.random.default_rng(5)
+        rows = [(f"k{int(k)}", float(round(p, 1)))
+                for k, p in zip(rng.integers(0, 5, 64),
+                                rng.uniform(1, 100, 64))]
+        h = rt.get_input_handler("S")
+        for i, (s, p) in enumerate(rows):
+            h.send((s, p, i), timestamp=i)
+        rt.flush()
+        # host reference: per event, min over the group's rows within the
+        # last-8 window
+        expect = []
+        window = []
+        for s, p in rows:
+            window.append((s, p))
+            window = window[-8:]
+            expect.append((s, min(pp for ss, pp in window if ss == s)))
+        assert [(r[0], pytest.approx(r[1])) for r in got] == expect
