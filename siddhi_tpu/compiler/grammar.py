@@ -150,7 +150,8 @@ event_type: CURRENT | EXPIRED | ALL
 
 // on-demand (store) query — reference grammar rule store_query; executed via
 // SiddhiAppRuntime.query() against tables/windows/aggregations
-on_demand_query: od_from | od_delete_q | od_update_q | od_update_or_insert_q
+on_demand_query: od_from | od_insert_q | od_delete_q | od_update_q | od_update_or_insert_q
+od_insert_q: select_clause INSERT INTO NAME
 od_from: FROM NAME od_on? od_within? od_per? select_clause? group_by_clause? having_clause? order_by_clause? limit_clause? offset_clause? od_insert?
 od_insert: INSERT INTO NAME
 od_delete_q: DELETE NAME od_on

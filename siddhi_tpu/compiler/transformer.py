@@ -904,6 +904,13 @@ class AstTransformer(Transformer):
             target_id=str(name), on_condition=on_cond,
             set_attributes=set_c[1])
 
+    def od_insert_q(self, selector, _insert, _into, name):
+        # standalone `select <constants> insert into T` (reference: the
+        # insert OnDemandQueryRuntime with no source store)
+        return OnDemandQuery(
+            input_store_id=None, action=OutputAction.INSERT,
+            target_id=str(name), selector=selector)
+
     def od_update_or_insert_q(self, selector, _update, _or, _insert, _into,
                               name, *rest):
         # `select ... update or insert into T [set ...] on <cond>`

@@ -389,7 +389,7 @@ class SiddhiAppRuntime:
                 f"table {odq.target_id!r} is not defined")
         if isinstance(target, RecordTableRuntime):
             source = None
-            if odq.action == _OA.INSERT:
+            if odq.action == _OA.INSERT and odq.input_store_id is not None:
                 source = self.tables.get(odq.input_store_id)
                 if source is None:
                     source = self.windows.get(odq.input_store_id)
@@ -399,7 +399,7 @@ class SiddhiAppRuntime:
             return RecordCrudRuntime(odq, target, self.ctx,
                                      self.ctx.registry, source_store=source)
         source = None
-        if odq.action == _OA.INSERT:
+        if odq.action == _OA.INSERT and odq.input_store_id is not None:
             source = self.tables.get(odq.input_store_id)
             if source is None:  # NOT `or`: an empty table is falsy (__len__)
                 source = self.windows.get(odq.input_store_id)

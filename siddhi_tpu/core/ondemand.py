@@ -142,7 +142,42 @@ class OnDemandCrudRuntime:
         self.select_runtime = None
         self._out_batch = None
 
+        self._const_row = None
         if self.action == OutputAction.INSERT:
+            if odq.input_store_id is None:
+                # standalone `select <constants> insert into T` (reference:
+                # the insert OnDemandQueryRuntime with no source): evaluate
+                # the select list once on a dummy lane, map by NAME onto the
+                # table schema, insert one host row
+                import numpy as np
+                empty = TypeResolver({"__out__": {}}, "__out__",
+                                     {"__out__": None})
+                scope = Scope()
+                scope.add_frame("__out__", {}, jnp.zeros((1,), jnp.int64),
+                                jnp.ones((1,), bool), default=True)
+                by_name = {}
+                for oa in odq.selector.attributes:
+                    name = (oa.rename
+                            or getattr(oa.expression, "attribute", None))
+                    if name is None:
+                        raise SiddhiAppCreationError(
+                            "standalone insert select items need `as` names")
+                    ce = compile_expression(oa.expression, empty, registry)
+                    val = ce(scope)
+                    by_name[name] = (val if isinstance(val, str)
+                                     else np.asarray(val).reshape(()).item())
+                schema = [a.name for a in target.definition.attributes]
+                unknown = set(by_name) - set(schema)
+                missing = set(schema) - set(by_name)
+                if unknown or missing:
+                    raise SiddhiAppCreationError(
+                        f"insert into {target.definition.id!r}: select list "
+                        f"must name every table attribute exactly "
+                        f"(missing {sorted(missing)}, unknown "
+                        f"{sorted(unknown)})")
+                self._const_row = tuple(by_name[n] for n in schema)
+                self.executor = None
+                return
             # select over the source store, insert results into the target
             import dataclasses as dc
             sel_odq = dc.replace(odq, action=OutputAction.RETURN, target_id=None)
@@ -194,6 +229,9 @@ class OnDemandCrudRuntime:
             types=jnp.zeros((1,), jnp.int8))
 
     def execute(self, now: int = 0) -> list[Event]:
+        if self._const_row is not None:
+            self.target.insert_rows([self._const_row], timestamp=now)
+            return []
         if self.select_runtime is not None:
             events = self.select_runtime.execute(now)
             rows = [tuple(e.data) for e in events]

@@ -78,6 +78,29 @@ class QueryPlanInputs:
     frame_ref: str
 
 
+def _selects_aggregates(selector, registry) -> bool:
+    """True if any select item contains an aggregator call — the same
+    detection CompiledSelector performs, needed BEFORE the window is built
+    (full-window snapshots change the window's expired-lane emission)."""
+    from ..extension.registry import ExtensionKind
+    from ..ops.aggregators import AggregatorFactory
+    from ..query_api.expression import AttributeFunction, Expression
+
+    def walk(e) -> bool:
+        if isinstance(e, AttributeFunction):
+            f = registry.lookup(ExtensionKind.AGGREGATOR, e.namespace, e.name)
+            if isinstance(f, AggregatorFactory):
+                return True
+        for a in ("left", "right", "expression"):
+            sub = getattr(e, a, None)
+            if isinstance(sub, Expression) and walk(sub):
+                return True
+        return any(isinstance(p, Expression) and walk(p)
+                   for p in getattr(e, "parameters", ()) or ())
+
+    return any(walk(a.expression) for a in selector.attributes)
+
+
 class QueryRuntime(Receiver):
     """Runtime for a single-input-stream query (joins/patterns have their own
     runtimes). Subscribes to the input junction; publishes to the output
@@ -177,6 +200,18 @@ class QueryRuntime(Receiver):
         # insert halves the emission chunk the selector sorts. Sliding windows
         # ignore this flag: their expired lanes drive aggregator removal.
         expired_on = query.output_stream.event_type != OutputEventType.CURRENT
+        # full-window snapshot (non-aggregated, ungrouped `output snapshot`):
+        # the limiter pops its FIFO ring on EXPIRED lanes, so batch windows
+        # must materialize them even for CURRENT-only output. The SAME flag
+        # later selects the limiter, so the two decisions cannot diverge.
+        from ..query_api.execution import OutputRateType
+        self._snapshot_full_window = (
+            query.output_rate is not None
+            and query.output_rate.type == OutputRateType.SNAPSHOT
+            and not query.selector.group_by
+            and not _selects_aggregates(query.selector, registry))
+        if self._snapshot_full_window:
+            expired_on = True
         wh = in_stream.handlers.window
         if wh is not None:
             factory = registry.require(ExtensionKind.WINDOW, wh.namespace, wh.name)
@@ -237,8 +272,8 @@ class QueryRuntime(Receiver):
             query.output_rate, out_layout, self.window.chunk_width,
             grouped=bool(query.selector.group_by),
             group_capacity=ctx.effective_group_capacity,
-            fifo_window=fifo,
-            has_aggregates=self.selector.has_aggregators,
+            fifo_window=fifo and self._snapshot_full_window,
+            has_aggregates=not self._snapshot_full_window,
             window_capacity=getattr(self.window, "C", 0))
         from ..ops.ratelimit import GroupedSnapshotLimiter
         if isinstance(self.rate_limiter, GroupedSnapshotLimiter):

@@ -709,7 +709,31 @@ class RecordCrudRuntime:
         self.executor = None
         self._srow: dict = {}
 
+        self._const_row = None
         if odq.action == OutputAction.INSERT:
+            if odq.input_store_id is None:
+                # standalone `select <constants> insert into T`
+                from ..query_api.expression import Constant as _Const
+                row = {}
+                for oa in odq.selector.attributes:
+                    name = (oa.rename
+                            or getattr(oa.expression, "attribute", None))
+                    if name is None or not isinstance(oa.expression, _Const):
+                        raise SiddhiAppCreationError(
+                            "standalone insert select items must be named "
+                            "constants (`select 1 as x ... insert into T`)")
+                    row[name] = oa.expression.value
+                schema = set(target.attr_types)
+                unknown = set(row) - schema
+                missing = schema - set(row)
+                if unknown or missing:
+                    raise SiddhiAppCreationError(
+                        f"insert into {target.definition.id!r}: select list "
+                        f"must name every table attribute exactly "
+                        f"(missing {sorted(missing)}, unknown "
+                        f"{sorted(unknown)})")
+                self._const_row = row
+                return
             import dataclasses as dc
 
             from ..core.ondemand import OnDemandQueryRuntime
@@ -741,6 +765,12 @@ class RecordCrudRuntime:
             target, out_stream, out_types, None, registry)
 
     def execute(self, now: int = 0):
+        if self._const_row is not None:
+            self.target.store.add([dict(self._const_row)])
+            self.target._cache_put_rows(
+                [{n: self._const_row.get(n)
+                  for n in self.target.attr_types}])
+            return []
         if self.select_runtime is not None:
             events = self.select_runtime.execute(now)
             names = [a.name

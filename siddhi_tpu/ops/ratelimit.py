@@ -442,9 +442,12 @@ def make_rate_limiter(rate: Optional[OutputRate], layout: dict,
                 group_capacity)
         if fifo_window and not has_aggregates:
             # reference WindowedPerSnapshotOutputRateLimiter: re-emit the
-            # FULL window contents each tick
-            cap = max(window_capacity,
-                      dtypes.config.snapshot_window_capacity)
+            # FULL window contents each tick. Cap = the window's own
+            # capacity when known (fallback to the config default), but
+            # never below the per-step chunk width — the append slot math
+            # wraps at most once, so one step's CURRENT lanes must fit.
+            cap = max(window_capacity
+                      or dtypes.config.snapshot_window_capacity, out_width)
             return WindowedSnapshotLimiter(layout, rate.time_ms, cap)
         return SnapshotLimiter(layout, rate.time_ms)
     if rate.event_count is not None:

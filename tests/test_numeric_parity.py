@@ -1,0 +1,148 @@
+"""Numeric-parity tolerance suite (SURVEY §4: "numeric-parity tests
+Java-Siddhi never needed — float accumulation order").
+
+The reference accumulates Java doubles SEQUENTIALLY per event
+(SumAttributeAggregatorExecutor.java:132-154); this engine reduces in
+parallel (segment scans, psum trees), so float results may differ in the
+last ulps. Policy, documented here as the executable contract:
+
+- DOUBLE attributes map to float32 by DEFAULT (f64 is software-emulated
+  on TPU, ~10x slower — core/dtypes.py): parity with Java double to
+  ~1e-5 relative on 2e4-event sums (pairwise f32 reduction loses LESS
+  than sequential f32);
+- `config.double_dtype = jnp.float64` restores ~1e-9 double parity;
+- FLOAT attributes accumulate in float32 — parity to ~1e-4 relative;
+- integer sums/counts are EXACT at any order;
+- avg/stdDev inherit their component tolerances.
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+N = 20_000
+
+
+def run_agg(attr_type: str, values, extra_select=""):
+    app = f"""
+    define stream S (k string, v {attr_type});
+    @info(name='q')
+    from S#window.lengthBatch({len(values)})
+    select sum(v) as s, avg(v) as a, count() as n{extra_select}
+    insert into Out;
+    """
+    rt = SiddhiManager().create_siddhi_app_runtime(
+        app, batch_size=4096)
+    rows = []
+    rt.add_callback("Out", lambda evs: rows.extend(tuple(e) for e in evs))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for v in values:
+        h.send(("a", v))
+    rt.flush()
+    rt.shutdown()
+    return rows[-1]
+
+
+class TestNumericParity:
+    def test_double_default_f32_policy(self):
+        rng = np.random.default_rng(11)
+        vals = rng.uniform(-1000.0, 1000.0, N)
+        seq = 0.0
+        for v in vals:  # the reference's per-event accumulation order
+            seq += float(v)
+        s, a, n = run_agg("double", [float(v) for v in vals])
+        assert n == N
+        assert s == pytest.approx(seq, rel=1e-5)
+        assert a == pytest.approx(seq / N, rel=1e-5)
+
+    def test_double_f64_config_restores_double_parity(self):
+        import jax.numpy as jnp
+
+        from siddhi_tpu.core import dtypes
+        rng = np.random.default_rng(11)
+        vals = rng.uniform(-1000.0, 1000.0, N)
+        seq = 0.0
+        for v in vals:
+            seq += float(v)
+        prev = dtypes.config.double_dtype
+        dtypes.config.double_dtype = jnp.float64
+        try:
+            s, a, n = run_agg("double", [float(v) for v in vals])
+        finally:
+            dtypes.config.double_dtype = prev
+        assert s == pytest.approx(seq, rel=1e-9)
+        assert a == pytest.approx(seq / N, rel=1e-9)
+
+    def test_float_sum_matches_float64_reference_to_1e4(self):
+        rng = np.random.default_rng(12)
+        vals = rng.uniform(0.0, 100.0, N).astype(np.float32)
+        ref = float(np.sum(vals.astype(np.float64)))
+        s, a, n = run_agg("float", [float(v) for v in vals])
+        assert s == pytest.approx(ref, rel=1e-4)
+        assert a == pytest.approx(ref / N, rel=1e-4)
+
+    def test_long_sum_exact(self):
+        rng = np.random.default_rng(13)
+        vals = [int(v) for v in rng.integers(-10**12, 10**12, N)]
+        s, a, n = run_agg("long", vals)
+        assert s == sum(vals)  # exact, any reduction order
+
+    def test_stddev_double(self):
+        rng = np.random.default_rng(14)
+        vals = rng.uniform(-50.0, 50.0, 5000)
+        s, a, n, sd = run_agg("double", [float(v) for v in vals],
+                              extra_select=", stdDev(v) as sd")
+        # reference computes population stdDev incrementally
+        assert sd == pytest.approx(float(np.std(vals)), rel=1e-7)
+
+    def test_stddev_sliding_window_removal(self):
+        # stdDev must also be removal-capable (sliding windows)
+        app = """
+        define stream S (k string, v double);
+        @info(name='q')
+        from S#window.length(3)
+        select stdDev(v) as sd
+        insert into Out;
+        """
+        rt = SiddhiManager().create_siddhi_app_runtime(app, batch_size=4)
+        rows = []
+        rt.add_callback("Out", lambda evs: rows.extend(tuple(e) for e in evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        vals = [1.0, 5.0, 9.0, 13.0]  # windows [1],[1,5],[1,5,9],[5,9,13]
+        for v in vals:
+            h.send(("a", v))
+        rt.flush()
+        rt.shutdown()
+        expect = [np.std([1.0]), np.std([1.0, 5.0]),
+                  np.std([1.0, 5.0, 9.0]), np.std([5.0, 9.0, 13.0])]
+        got = [r[0] for r in rows]
+        assert got == pytest.approx([float(e) for e in expect], rel=1e-5)
+
+    def test_stddev_grouped(self):
+        app = """
+        define stream S (k string, v double);
+        @info(name='q')
+        from S#window.lengthBatch(6)
+        select k, stdDev(v) as sd
+        group by k
+        insert into Out;
+        """
+        rt = SiddhiManager().create_siddhi_app_runtime(app, batch_size=8)
+        rows = []
+        rt.add_callback("Out", lambda evs: rows.extend(tuple(e) for e in evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        data = [("a", 1.0), ("b", 10.0), ("a", 3.0), ("b", 30.0),
+                ("a", 5.0), ("b", 50.0)]
+        for k, v in data:
+            h.send((k, v))
+        rt.flush()
+        rt.shutdown()
+        final = {}
+        for r in rows:
+            final[r[0]] = r[1]
+        assert final["a"] == pytest.approx(float(np.std([1.0, 3.0, 5.0])))
+        assert final["b"] == pytest.approx(float(np.std([10.0, 30.0, 50.0])))

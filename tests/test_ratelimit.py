@@ -256,3 +256,32 @@ class TestWindowedSnapshot:
         rt.flush()
         rt.heartbeat(1_500)
         assert [tuple(e.data) for e in got] == [(6.0,)]
+
+    def test_snapshot_over_batch_window(self):
+        # regression: batch windows default to CURRENT-only emission; the
+        # full-window limiter must still see EXPIRED lanes to pop its ring
+        rt = build(S + "@info(name='q') from S#window.lengthBatch(2) "
+                   "select symbol, price "
+                   "output snapshot every 1 sec insert into Out;")
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        for i, (s, p) in enumerate([("a", 1.0), ("b", 2.0), ("c", 3.0),
+                                    ("d", 4.0)]):
+            h.send((s, p), timestamp=100 + i)
+        rt.flush()
+        rt.heartbeat(1_500)
+        # the second batch [c, d] replaced [a, b] at its flush
+        assert [tuple(e.data) for e in got] == [("c", 3.0), ("d", 4.0)]
+
+    def test_const_insert_rejects_schema_mismatch(self):
+        import pytest as _pytest
+
+        from siddhi_tpu.errors import SiddhiAppCreationError
+        rt = build("define table T (sym string, price double);")
+        with _pytest.raises(SiddhiAppCreationError, match="missing"):
+            rt.query("select 5.0 as wrongname insert into T")
+
+    def test_const_insert_maps_by_name(self):
+        rt = build("define table T (sym string, price double);")
+        rt.query("select 5.0 as price, 'NEW' as sym insert into T")
+        assert rt.tables["T"].all_rows() == [("NEW", 5.0)]

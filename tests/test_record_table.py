@@ -201,3 +201,58 @@ class TestRecordTablePersistence:
         rt.restore(blob)
         # store rows survive independently of engine snapshots
         assert rt.tables["T"].all_rows() == [(1,)]
+
+
+class TestRecordStoreOnDemandQueries:
+    """Deeper store-query block over record tables (reference:
+    store/OnDemandQueryTableTestCase shapes run against @store tables):
+    aggregation, group-by, having, order-by/limit, and pushdown counting."""
+
+    def _loaded(self):
+        rt = build(APP)
+        h = rt.get_input_handler("S")
+        for sym, p in [("IBM", 75.0), ("WSO2", 57.0), ("IBM", 25.0),
+                       ("GOOG", 90.0), ("WSO2", 63.0)]:
+            h.send((sym, p))
+        rt.flush()
+        return rt
+
+    def test_aggregate_over_store(self):
+        rt = self._loaded()
+        rows = rt.query("from T select count() as n, sum(price) as total")
+        assert [r.data for r in rows] == [(5, pytest.approx(310.0))]
+
+    def test_group_by_having(self):
+        rt = self._loaded()
+        rows = rt.query("from T select sym, sum(price) as total "
+                        "group by sym having total > 100.0")
+        # IBM: 100.0 (excluded by >), WSO2: 120.0, GOOG: 90.0
+        assert [r.data for r in rows] == [("WSO2", pytest.approx(120.0))]
+
+    def test_order_by_limit(self):
+        rt = self._loaded()
+        rows = rt.query("from T select sym, price "
+                        "order by price desc limit 2")
+        assert [r.data for r in rows] == [("GOOG", 90.0), ("IBM", 75.0)]
+
+    def test_condition_pushdown_reaches_store(self):
+        rt = self._loaded()
+        store = rt.tables["T"].store
+        before = len(getattr(store, "find_calls", []))
+        rows = rt.query("from T on sym == 'IBM' select sym, price")
+        assert sorted(r.data for r in rows) == [("IBM", 25.0), ("IBM", 75.0)]
+        calls = getattr(store, "find_calls", None)
+        if calls is not None:  # SPI records pushdown visits
+            assert len(calls) > before
+
+    def test_on_demand_insert_into_store(self):
+        rt = build(APP)
+        rt.query("select 'NEW' as sym, 5.0 as price insert into T")
+        assert ("NEW", 5.0) in rt.tables["T"].all_rows()
+
+    def test_within_like_range_condition(self):
+        rt = self._loaded()
+        rows = rt.query("from T on price >= 57.0 and price <= 75.0 "
+                        "select sym, price")
+        assert sorted(r.data for r in rows) == [
+            ("IBM", 75.0), ("WSO2", 57.0), ("WSO2", 63.0)]
