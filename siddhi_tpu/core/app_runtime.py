@@ -296,6 +296,9 @@ class SiddhiAppRuntime:
         self._started = False
         for j in self.junctions.values():
             j.stop_async()
+        for a in self.aggregations.values():
+            a.flush_durable()  # durable duration tables (restart rebuild)
+            a.close_durable()
         for t in self.tables.values():
             if hasattr(t, "shutdown"):
                 t.shutdown()
@@ -500,6 +503,8 @@ class SiddhiAppRuntime:
         self._last_rev_ms = ms
         revision = f"{ms}_{self.app.name}"
         store.save(self.app.name, revision, self.snapshot())
+        for a in self.aggregations.values():
+            a.flush_durable()  # write-through the durable duration tables
         return revision
 
     def restore_revision(self, revision: str) -> None:

@@ -5,17 +5,23 @@ terminals (:249), acquireBreakPoint:95, blocking checkBreakPoint:133 driven
 from ProcessStreamReceiver:101-175, next()/play() stepping, and a
 SiddhiDebuggerCallback receiving each held event.
 
-TPU adaptation: execution is synchronous single-controller, so a breakpoint
-does not suspend a thread — the debugger callback runs INLINE at the terminal
-with the decoded events (batch-level capture of the masked lanes, per SURVEY
-§7 "mask-level event capture"). The callback's return value steers stepping:
-SiddhiDebugger.PLAY keeps flowing, SiddhiDebugger.NEXT keeps the breakpoint
-armed (the default). Returning STOP releases all breakpoints.
+TPU adaptation: execution is synchronous single-controller. The debugger
+callback runs at the terminal with decoded events, per event. Two modes:
+
+- INLINE: the callback RETURNS an action — PLAY releases the rest of the
+  batch with the breakpoint still armed, NEXT steps to the next event
+  (the default), STOP releases every breakpoint.
+- INTERACTIVE (the reference's blocking checkBreakPoint:133): the callback
+  returns None and the CONTROLLER THREAD BLOCKS on each held event until
+  another thread (or the callback itself) calls next()/play()/stop() —
+  next() steps one event, play() releases the rest of the batch with
+  breakpoints still armed, stop() releases every breakpoint.
 """
 
 from __future__ import annotations
 
 import enum
+import threading
 from typing import Callable, Optional
 
 
@@ -33,6 +39,35 @@ class SiddhiDebugger:
         self.runtime = runtime
         self._breakpoints: set[tuple[str, QueryTerminal]] = set()
         self._callback: Optional[Callable] = None
+        self._cv = threading.Condition()
+        self._actions: list[str] = []  # FIFO: scripted next();next() queues
+
+    # ------------------------------------------------------------- stepping
+
+    def next(self) -> None:
+        """Release the currently held event and stop at the next one
+        (reference: SiddhiDebugger.next():182)."""
+        self._post(self.NEXT)
+
+    def play(self) -> None:
+        """Release the held event and the rest of its batch; breakpoints
+        stay armed for future batches (reference: play():190)."""
+        self._post(self.PLAY)
+
+    def stop(self) -> None:
+        """Release everything and drop all breakpoints."""
+        self._post(self.STOP)
+
+    def _post(self, action: str) -> None:
+        with self._cv:
+            self._actions.append(action)
+            self._cv.notify_all()
+
+    def _wait_action(self) -> str:
+        with self._cv:
+            while not self._actions:
+                self._cv.wait()
+            return self._actions.pop(0)
 
     def acquire_break_point(self, query_name: str,
                             terminal: QueryTerminal | str) -> None:
@@ -70,11 +105,23 @@ class SiddhiDebugger:
     def check_break_point(self, query_name: str, terminal: QueryTerminal,
                           events: list) -> None:
         """Called from the query runtime at each terminal (the batch analogue
-        of ProcessStreamReceiver's per-event checkBreakPoint:133)."""
+        of ProcessStreamReceiver's per-event checkBreakPoint:133).
+
+        A callback returning an action keeps the legacy inline protocol; a
+        callback returning None holds each event and BLOCKS the controller
+        until next()/play()/stop() arrives."""
         if not events or not self.wants(query_name, terminal):
             return
-        action = self._callback(events, query_name, terminal, self)
-        if action == self.PLAY:
-            self.release_break_point(query_name, terminal)
-        elif action == self.STOP:
-            self.release_all_break_points()
+        for i, ev in enumerate(events):
+            if not self.wants(query_name, terminal):
+                return
+            action = self._callback([ev], query_name, terminal, self)
+            if action is None:  # interactive: block for next()/play()/stop()
+                action = self._wait_action()
+                if action == self.NEXT:
+                    continue
+            if action == self.PLAY:
+                return  # release the rest of the batch; stays armed
+            if action == self.STOP:
+                self.release_all_break_points()
+                return

@@ -61,6 +61,11 @@ class _Config:
     session_key_capacity = 4096
     #: expansion bound for unbounded pattern counts `<m:>`.
     pattern_unbounded_count_extra = 8
+    #: HyperLogLog registers per group for hll:distinctCount (power of two;
+    #: std error ~1.04/sqrt(m))
+    hll_registers = 1024
+    #: max groups tracked by hll:distinctCount (each holds hll_registers)
+    hll_group_capacity = 4096
 
 
 config = _Config()

@@ -117,6 +117,40 @@ class OnDemandQueryRuntime:
         return TableState(cols=batch.cols, ts=batch.ts, valid=valid)
 
 
+
+def eval_standalone_insert_row(selector, registry, definition) -> dict:
+    """Standalone `select <const exprs> insert into T` (reference: the
+    insert OnDemandQueryRuntime with no source): evaluate the select list
+    once on a dummy lane, validate names against the table schema, return
+    {attr: python value}. Shared by the in-memory and record-table paths so
+    the same query text means the same thing on either backend."""
+    import numpy as np
+
+    empty = TypeResolver({"__out__": {}}, "__out__", {"__out__": None})
+    scope = Scope()
+    scope.add_frame("__out__", {}, jnp.zeros((1,), jnp.int64),
+                    jnp.ones((1,), bool), default=True)
+    by_name = {}
+    for oa in selector.attributes:
+        name = oa.rename or getattr(oa.expression, "attribute", None)
+        if name is None:
+            raise SiddhiAppCreationError(
+                "standalone insert select items need `as` names")
+        ce = compile_expression(oa.expression, empty, registry)
+        val = ce(scope)
+        by_name[name] = (val if isinstance(val, str)
+                         else np.asarray(val).reshape(()).item())
+    schema = [a.name for a in definition.attributes]
+    unknown = set(by_name) - set(schema)
+    missing = set(schema) - set(by_name)
+    if unknown or missing:
+        raise SiddhiAppCreationError(
+            f"insert into {definition.id!r}: select list must name every "
+            f"table attribute exactly (missing {sorted(missing)}, unknown "
+            f"{sorted(unknown)})")
+    return by_name
+
+
 class OnDemandCrudRuntime:
     """Write-form on-demand queries (reference: Insert/Delete/Update/
     UpdateOrInsert OnDemandQueryRuntime under core/query/):
@@ -145,37 +179,10 @@ class OnDemandCrudRuntime:
         self._const_row = None
         if self.action == OutputAction.INSERT:
             if odq.input_store_id is None:
-                # standalone `select <constants> insert into T` (reference:
-                # the insert OnDemandQueryRuntime with no source): evaluate
-                # the select list once on a dummy lane, map by NAME onto the
-                # table schema, insert one host row
-                import numpy as np
-                empty = TypeResolver({"__out__": {}}, "__out__",
-                                     {"__out__": None})
-                scope = Scope()
-                scope.add_frame("__out__", {}, jnp.zeros((1,), jnp.int64),
-                                jnp.ones((1,), bool), default=True)
-                by_name = {}
-                for oa in odq.selector.attributes:
-                    name = (oa.rename
-                            or getattr(oa.expression, "attribute", None))
-                    if name is None:
-                        raise SiddhiAppCreationError(
-                            "standalone insert select items need `as` names")
-                    ce = compile_expression(oa.expression, empty, registry)
-                    val = ce(scope)
-                    by_name[name] = (val if isinstance(val, str)
-                                     else np.asarray(val).reshape(()).item())
-                schema = [a.name for a in target.definition.attributes]
-                unknown = set(by_name) - set(schema)
-                missing = set(schema) - set(by_name)
-                if unknown or missing:
-                    raise SiddhiAppCreationError(
-                        f"insert into {target.definition.id!r}: select list "
-                        f"must name every table attribute exactly "
-                        f"(missing {sorted(missing)}, unknown "
-                        f"{sorted(unknown)})")
-                self._const_row = tuple(by_name[n] for n in schema)
+                by_name = eval_standalone_insert_row(
+                    odq.selector, registry, target.definition)
+                self._const_row = tuple(
+                    by_name[a.name] for a in target.definition.attributes)
                 self.executor = None
                 return
             # select over the source store, insert results into the target

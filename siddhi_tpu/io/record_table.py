@@ -712,27 +712,12 @@ class RecordCrudRuntime:
         self._const_row = None
         if odq.action == OutputAction.INSERT:
             if odq.input_store_id is None:
-                # standalone `select <constants> insert into T`
-                from ..query_api.expression import Constant as _Const
-                row = {}
-                for oa in odq.selector.attributes:
-                    name = (oa.rename
-                            or getattr(oa.expression, "attribute", None))
-                    if name is None or not isinstance(oa.expression, _Const):
-                        raise SiddhiAppCreationError(
-                            "standalone insert select items must be named "
-                            "constants (`select 1 as x ... insert into T`)")
-                    row[name] = oa.expression.value
-                schema = set(target.attr_types)
-                unknown = set(row) - schema
-                missing = schema - set(row)
-                if unknown or missing:
-                    raise SiddhiAppCreationError(
-                        f"insert into {target.definition.id!r}: select list "
-                        f"must name every table attribute exactly "
-                        f"(missing {sorted(missing)}, unknown "
-                        f"{sorted(unknown)})")
-                self._const_row = row
+                # standalone `select <const exprs> insert into T` — same
+                # helper as the in-memory path, so backend choice cannot
+                # change query semantics
+                from ..core.ondemand import eval_standalone_insert_row
+                self._const_row = eval_standalone_insert_row(
+                    odq.selector, registry, target.definition)
                 return
             import dataclasses as dc
 

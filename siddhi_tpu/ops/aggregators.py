@@ -251,6 +251,88 @@ def _make_distinct_count(arg_types):
                           init_custom=init_custom, custom_scan=custom_scan)
 
 
+def _make_hll_distinct_count(arg_types):
+    """hll:distinctCount(attr) — APPROXIMATE distinct count via a
+    HyperLogLog sketch (BASELINE.md config 3 names the HLL variant; the
+    EXACT pair-table distinctCount stays the default `distinctCount`).
+
+    m = config.hll_registers registers per group (standard error
+    ~1.04/sqrt(m): 1024 → ~3.3%). Each CURRENT lane scatter-maxes one
+    register with the rank of its value-hash; the per-group estimate is the
+    classic alpha_m * m^2 / sum(2^-M) harmonic mean with the small-range
+    linear-counting correction. Removals (sliding EXPIRED lanes) are
+    IGNORED — a sketch cannot forget; use exact distinctCount where
+    sliding-window removal matters. RESET (batch-window flush) clears the
+    registers. Per-lane emission reports the POST-BATCH estimate
+    (documented batch-granularity divergence from per-event emission)."""
+    from .groupby import hash_columns
+
+    dt = dtypes.device_dtype(_T.LONG)
+    M = int(dtypes.config.hll_registers)
+    P_BITS = M.bit_length() - 1
+    assert M == 1 << P_BITS, "hll_registers must be a power of two"
+
+    def init_custom(group_capacity: int, grouped: bool = True):
+        G = (min(group_capacity, dtypes.config.hll_group_capacity)
+             if grouped else 1)
+        return jnp.zeros((G * M,), jnp.int32)
+
+    def _estimate(regs):
+        R = regs.reshape(-1, M).astype(jnp.float32)
+        inv = jnp.sum(jnp.exp2(-R), axis=1)
+        alpha = 0.7213 / (1.0 + 1.079 / M)
+        E = alpha * M * M / inv
+        zeros = jnp.sum(R == 0, axis=1)
+        lin = M * jnp.log(M / jnp.maximum(zeros, 1).astype(jnp.float32))
+        E = jnp.where((E <= 2.5 * M) & (zeros > 0), lin, E)
+        return jnp.round(E).astype(dt)
+
+    def custom_scan(state, slots, arg_vals, sign, lane_valid, resets, epoch,
+                    grouped: bool = True):
+        regs = state
+        G = regs.shape[0] // M
+        h = hash_columns([arg_vals[0]]).astype(jnp.uint64)
+        # murmur3 fmix64 avalanche: the column mix leaves low bits
+        # correlated for dense inputs (string codes!), which skews both the
+        # register index and the rank distribution
+        h = h ^ (h >> 33)
+        h = h * jnp.uint64(0xFF51AFD7ED558CCD)
+        h = h ^ (h >> 33)
+        h = h * jnp.uint64(0xC4CEB9FE1A85EC53)
+        h = h ^ (h >> 33)
+        j = (h & jnp.uint64(M - 1)).astype(jnp.int32)
+        w = (h >> jnp.uint64(P_BITS)).astype(jnp.uint32)
+        rho = jax.lax.clz(
+            jax.lax.bitcast_convert_type(w, jnp.int32)) + 1
+        ok = lane_valid & (sign > 0) & (slots >= 0) & (slots < G)
+        idx = jnp.where(ok, slots * M + j, G * M)
+        sl = jnp.clip(slots, 0, G - 1)
+
+        # RESET handling at lane position (batch-window flushes mid-chunk):
+        # lanes BEFORE the first reset continue the incoming sketch; lanes
+        # AFTER the last reset start a fresh one. Chunks holding >1 reset
+        # approximate the middle segments with the final sketch's estimate
+        # (documented — sketches are for large windows; a tiny batch window
+        # flushing several times per chunk wants exact distinctCount).
+        n_resets = jnp.sum(resets, dtype=jnp.int32)
+        rk = jnp.cumsum(resets.astype(jnp.int32))
+        before_first = rk == 0
+        after_last = rk == n_resets
+
+        regs_a = regs.at[jnp.where(before_first, idx, G * M)].max(
+            rho, mode="drop")
+        est_a = _estimate(regs_a)[sl]
+        fresh = jnp.where(n_resets > 0, jnp.zeros_like(regs), regs)
+        regs_b = fresh.at[jnp.where(after_last, idx, G * M)].max(
+            rho, mode="drop")
+        est_b = _estimate(regs_b)[sl]
+        out = jnp.where(before_first & (n_resets > 0), est_a, est_b)
+        return regs_b, out
+
+    return AggregatorSpec((), lambda cs: cs[0], _T.LONG,
+                          init_custom=init_custom, custom_scan=custom_scan)
+
+
 _COMPACTION_INSERT = None
 
 
@@ -343,6 +425,8 @@ def register_all() -> None:
     reg("or", _make_bool_or)
     reg("distinctCount", _make_distinct_count)
     reg("unionSet", _make_union_set)
+    GLOBAL.register(ExtensionKind.AGGREGATOR, "hll", "distinctCount",
+                    AggregatorFactory(_make_hll_distinct_count))
 
 
 register_all()

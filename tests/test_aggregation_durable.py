@@ -1,0 +1,109 @@
+"""Durable aggregation stores + restart rebuild (reference:
+core/aggregation/IncrementalExecutorsInitialiser.java — on restart,
+in-memory buckets rebuild from the per-duration tables the aggregation
+persisted; VERDICT r02 missing item 6)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.io.record_table import InMemoryRecordStore
+
+APP = """
+define stream TradeStream (symbol string, price double, ts long);
+@store(type='durable')
+define aggregation TradeAgg
+from TradeStream
+select symbol, sum(price) as total, count() as n
+group by symbol
+aggregate by ts every sec, min;
+"""
+
+
+class DurableStore(InMemoryRecordStore):
+    """Class-level row persistence so a NEW app instance (a 'restart')
+    sees what the previous one wrote — the role an RDBMS plays for the
+    reference."""
+
+    _tables: dict = {}
+
+    def init(self, definition, properties, config_reader=None):
+        super().init(definition, properties, config_reader)
+        self.rows = list(DurableStore._tables.get(definition.id, []))
+
+    def _sync(self):
+        DurableStore._tables[self.definition.id] = list(self.rows)
+
+    def add(self, rows):
+        super().add(rows)
+        self._sync()
+
+    def delete(self, compiled):
+        n = super().delete(compiled)
+        self._sync()
+        return n
+
+
+def make_runtime():
+    mgr = SiddhiManager()
+    mgr.set_extension("durable", DurableStore)
+    rt = mgr.create_siddhi_app_runtime(APP, batch_size=16)
+    rt.start()
+    return rt
+
+
+class TestDurableAggregation:
+    def setup_method(self):
+        DurableStore._tables.clear()
+
+    def test_flush_and_rebuild_across_restart(self):
+        rt = make_runtime()
+        h = rt.get_input_handler("TradeStream")
+        for sym, p, t in [("A", 10.0, 100), ("B", 5.0, 200),
+                          ("A", 7.0, 1500)]:
+            h.send((sym, p, t))
+        rt.flush()
+        before = sorted(
+            tuple(e.data) for e in rt.query(
+                "from TradeAgg within 0, 10000 per 'sec' "
+                "select symbol, total, n"))
+        rt.shutdown()  # flushes the durable duration tables
+
+        # durable tables hold the buckets
+        assert len(DurableStore._tables["TradeAgg_sec"]) == 3
+
+        # a fresh app instance rebuilds its device buckets from them
+        rt2 = make_runtime()
+        after = sorted(
+            tuple(e.data) for e in rt2.query(
+                "from TradeAgg within 0, 10000 per 'sec' "
+                "select symbol, total, n"))
+        assert after == before
+        assert len(after) == 3
+        rt2.shutdown()
+
+    def test_rebuilt_buckets_keep_accumulating(self):
+        rt = make_runtime()
+        h = rt.get_input_handler("TradeStream")
+        h.send(("A", 10.0, 100))
+        rt.flush()
+        rt.shutdown()
+
+        rt2 = make_runtime()
+        h2 = rt2.get_input_handler("TradeStream")
+        h2.send(("A", 2.0, 300))  # same second bucket as the restored row
+        rt2.flush()
+        rows = rt2.query("from TradeAgg within 0, 10000 per 'sec' "
+                         "select symbol, total, n")
+        assert [tuple(e.data) for e in rows] == [
+            ("A", pytest.approx(12.0), 2)]
+        rt2.shutdown()
+
+    def test_no_store_annotation_keeps_snapshot_only_path(self):
+        app = APP.replace("@store(type='durable')\n", "")
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(app, batch_size=16)
+        rt.start()
+        rt.get_input_handler("TradeStream").send(("A", 1.0, 100))
+        rt.flush()
+        rt.shutdown()  # no durable store: nothing written, no error
+        assert DurableStore._tables == {}

@@ -1,0 +1,97 @@
+"""hll:distinctCount accuracy tests (BASELINE.md config 3 names the HLL
+sketch variant; exact distinctCount stays the default)."""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def run(app, rows, out="Out", batch_size=4096):
+    rt = SiddhiManager().create_siddhi_app_runtime(app, batch_size=batch_size)
+    got = []
+    rt.add_callback(out, lambda evs: got.extend(tuple(e) for e in evs))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for r in rows:
+        h.send(r)
+    rt.flush()
+    rt.shutdown()
+    return got
+
+
+class TestHLLDistinctCount:
+    def test_accuracy_within_standard_error(self):
+        # 1024 registers -> ~3.3% std error; assert within 4 sigma (13%)
+        app = """
+        define stream S (v long);
+        @info(name='q')
+        from S select hll:distinctCount(v) as d insert into Out;
+        """
+        rng = np.random.default_rng(21)
+        true_n = 50_000
+        vals = rng.choice(10**12, true_n, replace=False)
+        rows = [(int(v),) for v in np.repeat(vals, 2)]  # duplicates collapse
+        got = run(app, rows)
+        est = got[-1][0]
+        assert est == pytest.approx(true_n, rel=0.13)
+
+    def test_small_cardinality_linear_counting_is_tight(self):
+        app = """
+        define stream S (v int);
+        @info(name='q')
+        from S select hll:distinctCount(v) as d insert into Out;
+        """
+        rows = [(i % 37,) for i in range(500)]
+        got = run(app, rows, batch_size=512)
+        # linear-counting regime: near-exact for tiny cardinalities
+        assert got[-1][0] == pytest.approx(37, abs=2)
+
+    def test_grouped_and_string_args(self):
+        app = """
+        define stream S (k string, v string);
+        @info(name='q')
+        from S#window.lengthBatch(600)
+        select k, hll:distinctCount(v) as d
+        group by k
+        insert into Out;
+        """
+        rng = np.random.default_rng(22)
+        rows = []
+        for _ in range(300):
+            rows.append(("a", f"u{int(rng.integers(0, 50))}"))
+            rows.append(("b", f"u{int(rng.integers(0, 200))}"))
+        got = run(app, rows, batch_size=600)
+        final = {}
+        for k, d in got:
+            final[k] = d
+        assert final["a"] == pytest.approx(50, abs=5)
+        assert final["b"] == pytest.approx(
+            len({r[1] for r in rows if r[0] == "b"}), rel=0.13)
+
+    def test_reset_clears_sketch_between_batches(self):
+        app = """
+        define stream S (v int);
+        @info(name='q')
+        from S#window.lengthBatch(100)
+        select hll:distinctCount(v) as d insert into Out;
+        """
+        rows = [(i,) for i in range(100)] + [(0,)] * 100
+        got = run(app, rows, batch_size=100)
+        # first flush ~100 distinct; second flush: sketch reset, 1 distinct
+        assert got[-1][0] == 1
+        assert got[99][0] == pytest.approx(100, abs=10)
+
+    def test_multiple_flushes_in_one_chunk(self):
+        # regression: two lengthBatch flushes sharing one device chunk must
+        # not merge into one sketch
+        app = """
+        define stream S (v int);
+        @info(name='q')
+        from S#window.lengthBatch(3)
+        select hll:distinctCount(v) as d insert into Out;
+        """
+        rows = [(v,) for v in (1, 2, 3, 101, 102, 103)]
+        got = run(app, rows, batch_size=8)
+        # the second batch's final estimate reflects ONLY its own 3 values
+        assert got[-1][0] == 3

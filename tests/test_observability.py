@@ -89,10 +89,15 @@ class TestDebugger:
         h.send(("B", 0.5))  # filtered out
         rt.flush()
         assert seen == [("A",)]
-        # PLAY released the breakpoint
+        # PLAY keeps the breakpoint armed (reference: play() continues and
+        # stops at the next hit; releasing is explicit)
         h.send(("C", 5.0))
         rt.flush()
-        assert seen == [("A",)]
+        assert seen == [("A",), ("C",)]
+        dbg.release_break_point("q", QueryTerminal.OUT)
+        h.send(("D", 6.0))
+        rt.flush()
+        assert seen == [("A",), ("C",)]
 
 
 class TestPlaybackIdle:
@@ -111,3 +116,83 @@ class TestPlaybackIdle:
         assert got == []  # bucket not closed yet
         rt.heartbeat()  # idle bump: +2 sec virtual → bucket closes
         assert [e.data[1] for e in got] == [1, 2]  # per-event running counts
+
+
+class TestInteractiveDebugger:
+    """Blocking step/next/play protocol (reference:
+    SiddhiDebugger.checkBreakPoint:133 blocks the sender thread until
+    next():182 / play():190 arrive from the debugger thread)."""
+
+    def _build(self):
+        from siddhi_tpu.core.debugger import QueryTerminal
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            S + "@info(name='q') from S select symbol insert into Out;",
+            batch_size=8)
+        dbg = rt.debug()
+        dbg.acquire_break_point("q", QueryTerminal.IN)
+        return rt, dbg
+
+    def test_next_steps_one_event_at_a_time(self):
+        import threading
+        import time
+
+        rt, dbg = self._build()
+        held = []
+        dbg.set_debugger_callback(
+            lambda evs, q, t, d: held.append(evs[0].data) or None)
+        h = rt.get_input_handler("S")
+        for sym in "abc":
+            h.send((sym, 1.0))
+
+        done = threading.Event()
+        t = threading.Thread(target=lambda: (rt.flush(), done.set()))
+        t.start()
+        deadline = time.time() + 10
+        # step through all three events one by one
+        for i in (1, 2, 3):
+            while len(held) < i and time.time() < deadline:
+                time.sleep(0.005)
+            assert len(held) == i  # controller is HELD at event i
+            assert not done.is_set() or i == 3
+            dbg.next()
+        t.join(timeout=10)
+        assert done.is_set()
+        assert [d[0] for d in held] == ["a", "b", "c"]
+
+    def test_play_releases_rest_of_batch(self):
+        import threading
+        import time
+
+        rt, dbg = self._build()
+        held = []
+        dbg.set_debugger_callback(
+            lambda evs, q, t, d: held.append(evs[0].data) or None)
+        h = rt.get_input_handler("S")
+        for sym in "abc":
+            h.send((sym, 1.0))
+        done = threading.Event()
+        t = threading.Thread(target=lambda: (rt.flush(), done.set()))
+        t.start()
+        deadline = time.time() + 10
+        while not held and time.time() < deadline:
+            time.sleep(0.005)
+        dbg.play()  # first event held, rest of the batch flows
+        t.join(timeout=10)
+        assert done.is_set()
+        assert [d[0] for d in held] == ["a"]
+
+    def test_callback_calling_next_inline_does_not_block(self):
+        rt, dbg = self._build()
+        held = []
+
+        def cb(evs, q, t, d):
+            held.append(evs[0].data)
+            d.next()  # posts the action before the block: no deadlock
+            return None
+
+        dbg.set_debugger_callback(cb)
+        h = rt.get_input_handler("S")
+        for sym in "ab":
+            h.send((sym, 1.0))
+        rt.flush()
+        assert [d[0] for d in held] == ["a", "b"]
