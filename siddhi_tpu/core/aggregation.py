@@ -291,6 +291,7 @@ class AggregationRuntime(Receiver):
         # writes bucket rows through, and construction rebuilds from any
         # rows found.
         self._durable_stores = None
+        self._rebuild_truncated = False
         store_ann = next((a for a in (definition.annotations or ())
                           if a.name.lower() == "store"), None)
         if store_ann is not None:
@@ -368,15 +369,28 @@ class AggregationRuntime(Receiver):
         return out
 
     def flush_durable(self) -> None:
-        """Overwrite the durable duration tables with the current buckets."""
+        """Overwrite the durable duration tables with the current buckets.
+        If the last REBUILD truncated (more durable rows than device
+        capacity), merge instead — an authoritative overwrite would
+        permanently erase the buckets that never fit."""
         if self._durable_stores is None:
             return
         exported = self.export_rows()
         for dur, store in self._durable_stores.items():
-            store.delete(store.compile_condition(
-                None, f"{self.definition.id}_{dur.value}"))
-            if exported[dur]:
-                store.add(exported[dur])
+            tid = f"{self.definition.id}_{dur.value}"
+            rows = exported[dur]
+            if self._rebuild_truncated:
+                def _k(r):
+                    return (r[AGG_TIMESTAMP],
+                            tuple(r[g] for g in self.group_attrs))
+                merged = {_k(r): r for r in store.find(
+                    store.compile_condition(None, tid))}
+                for r in rows:
+                    merged[_k(r)] = r
+                rows = list(merged.values())
+            store.delete(store.compile_condition(None, tid))
+            if rows:
+                store.add(rows)
 
     def close_durable(self) -> None:
         if self._durable_stores is None:
@@ -416,6 +430,7 @@ class AggregationRuntime(Receiver):
                 {g: jnp.asarray(v) for g, v in gcols.items()},
                 [jnp.asarray(c) for c in comps], jnp.int32(n))
             if int(n_restored) < n:
+                self._rebuild_truncated = True
                 import warnings
                 warnings.warn(
                     f"aggregation {self.definition.id!r} [{dur.value}]: only "

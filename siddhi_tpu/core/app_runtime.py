@@ -292,12 +292,13 @@ class SiddhiAppRuntime:
                 tr.start(now)
             self.flush(now)
 
-    def shutdown(self) -> None:
+    def shutdown(self, *, flush_durable: bool = True) -> None:
         self._started = False
         for j in self.junctions.values():
             j.stop_async()
         for a in self.aggregations.values():
-            a.flush_durable()  # durable duration tables (restart rebuild)
+            if flush_durable:
+                a.flush_durable()  # durable duration tables (restart rebuild)
             a.close_durable()
         for t in self.tables.values():
             if hasattr(t, "shutdown"):
@@ -554,6 +555,7 @@ class SiddhiAppRuntime:
         session key-capacity drops, join pair-block/candidate-walk drops."""
         import numpy as np
 
+        from ..ops.aggregators import HLLState
         from ..ops.groupby import KeyTable
         from ..ops.ratelimit import WindowedSnapshotState
         from ..ops.windows import SlidingState

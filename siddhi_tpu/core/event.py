@@ -64,9 +64,15 @@ class StringTable:
     into device integer ops.
     """
 
+    #: transient codes live at the top of the code space (see
+    #: encode_transient)
+    TRANSIENT_BASE = 1 << 30
+
     def __init__(self) -> None:
         self._to_code: dict[str, int] = {}
         self._to_str: list[Optional[str]] = [None]  # code 0 = null
+        self._transient: list[Optional[str]] = []
+        self._transient_next = 0
 
     def encode(self, s: Optional[str]) -> int:
         if s is None:
@@ -78,7 +84,26 @@ class StringTable:
             self._to_str.append(s)
         return code
 
+    def encode_transient(self, s: str, capacity: int = 1 << 20) -> int:
+        """Intern a NEVER-REPEATING string (UUID() output) into a bounded
+        recycling ring instead of the append-only table — unbounded interning
+        of per-event uniques is a host memory leak. Codes recycle after
+        `capacity` newer entries; a consumer that stored a code for that long
+        (e.g. a huge window over a uuid column) decodes the newer string —
+        documented bound, vs. the reference's GC'd per-event Strings."""
+        pos = self._transient_next
+        if len(self._transient) <= pos:
+            self._transient.append(s)
+        else:
+            self._transient[pos] = s
+        self._transient_next = (pos + 1) % capacity
+        return self.TRANSIENT_BASE + pos
+
     def decode(self, code: int) -> Optional[str]:
+        if code >= self.TRANSIENT_BASE:
+            idx = code - self.TRANSIENT_BASE
+            return (self._transient[idx]
+                    if 0 <= idx < len(self._transient) else None)
         return self._to_str[code] if 0 <= code < len(self._to_str) else None
 
     def encode_many(self, values: Sequence[Optional[str]]) -> np.ndarray:
@@ -88,15 +113,24 @@ class StringTable:
         return len(self._to_str)
 
     # snapshot support
-    def snapshot(self) -> list:
-        return list(self._to_str)
+    def snapshot(self):
+        # transient ring included: persisted state (tables/windows) may hold
+        # transient codes (UUID columns) that must decode after restore
+        return {"strings": list(self._to_str),
+                "transient": list(self._transient),
+                "transient_next": self._transient_next}
 
-    def restore(self, strings: list) -> None:
+    def restore(self, snap) -> None:
+        if isinstance(snap, list):  # pre-transient snapshot format
+            snap = {"strings": snap, "transient": [], "transient_next": 0}
+        strings = snap["strings"]
         # mutate in place: native encode plans hold references to these
         self._to_str[:] = list(strings)
         self._to_code.clear()
         self._to_code.update(
             {s: i for i, s in enumerate(strings) if s is not None})
+        self._transient[:] = list(snap["transient"])
+        self._transient_next = snap["transient_next"]
 
 
 class StreamCodec:

@@ -29,14 +29,19 @@ class SiddhiManager:
         #: deployment config (reference: SiddhiManager.setConfigManager)
         self.config_manager = None
 
+    @staticmethod
+    def _parse(app: Union[str, SiddhiApp]) -> SiddhiApp:
+        if isinstance(app, str):
+            text = compiler.update_variables(app) if "${" in app else app
+            app = compiler.parse(text)
+        return app
+
     def create_siddhi_app_runtime(
         self, app: Union[str, SiddhiApp], *,
         batch_size: int = 0, group_capacity: int = 0,
         mesh=None, partition_capacity: int = 0,
     ) -> SiddhiAppRuntime:
-        if isinstance(app, str):
-            text = compiler.update_variables(app) if "${" in app else app
-            app = compiler.parse(text)
+        app = self._parse(app)
         rt = SiddhiAppRuntime(app, self.registry, batch_size=batch_size,
                               group_capacity=group_capacity,
                               error_store=self.error_store,
@@ -46,6 +51,44 @@ class SiddhiManager:
             rt.persistence_store = self.persistence_store
         self.runtimes[app.name] = rt
         return rt
+
+    def validate_siddhi_app(self, app: Union[str, "SiddhiApp"]) -> None:
+        """Parse AND plan the app, then discard it — surfacing every
+        creation-time error without starting anything (reference:
+        SiddhiManager.validateSiddhiApp / managment/ValidateTestCase)."""
+        rt = SiddhiAppRuntime(self._parse(app), self.registry,
+                              error_store=self.error_store,
+                              config_manager=self.config_manager)
+        # validation must be read-only: never rewrite durable stores
+        rt.shutdown(flush_durable=False)
+
+    def create_sandbox_siddhi_app_runtime(
+        self, app: Union[str, "SiddhiApp"], **kw,
+    ) -> SiddhiAppRuntime:
+        """Build the app with every @source/@sink/@store annotation STRIPPED
+        so it runs fully in-memory — the reference's sandbox mode
+        (SiddhiManager.createSandboxSiddhiAppRuntime /
+        managment/SandboxTestCase): feed via InputHandler, observe via
+        callbacks, no external transports or stores."""
+        import dataclasses as dc
+        app = self._parse(app)
+        drop = {"source", "sink", "store", "cache"}
+
+        def strip(defn):
+            anns = tuple(a for a in (defn.annotations or ())
+                         if a.name.lower() not in drop)
+            return dc.replace(defn, annotations=anns)
+
+        app = dc.replace(
+            app,
+            stream_definitions={k: strip(v) for k, v
+                                in app.stream_definitions.items()},
+            table_definitions={k: strip(v) for k, v
+                               in app.table_definitions.items()},
+            aggregation_definitions={k: strip(v) for k, v
+                                     in app.aggregation_definitions.items()},
+        )
+        return self.create_siddhi_app_runtime(app, **kw)
 
     def set_persistence_store(self, store) -> None:
         """Reference: SiddhiManager.setPersistenceStore — shared by all apps."""

@@ -526,12 +526,10 @@ class QueryRuntime(Receiver):
                     or self.table_executor is not None)
         if uuid_slots and forwards:
             # fresh uuid4 per emitted lane per UUID() slot (reference
-            # UUIDFunctionExecutor), interned into the app string table so
-            # EVERY consumer — downstream queries, tables, sinks — sees real
-            # values. Interned uuids are never reclaimed (the app-global
-            # string table is append-only), so forwarding UUID output grows
-            # host memory with stream volume — documented divergence from
-            # the reference's GC'd per-event Strings (docs/PARITY.md).
+            # UUIDFunctionExecutor), interned into the string table's
+            # BOUNDED transient ring so every consumer — downstream
+            # queries, tables, sinks — sees real values with O(1) host
+            # memory (codes recycle after ~1M newer uuids; docs/PARITY.md)
             out = self._intern_uuid_columns(out)
 
         if self.callbacks:
@@ -579,7 +577,7 @@ class QueryRuntime(Receiver):
             tbl = self.output_codec.string_tables[slot]
             codes = np.zeros(out.capacity, np.int32)
             for i in idx:
-                codes[i] = tbl.encode(str(_uuid.uuid4()))
+                codes[i] = tbl.encode_transient(str(_uuid.uuid4()))
             cols[slot] = jnp.asarray(codes)
         return dc.replace(out, cols=cols)
 

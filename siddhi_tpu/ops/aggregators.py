@@ -17,7 +17,7 @@ in SURVEY §7; a segment-tree ring is the planned upgrade).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Callable, Optional
+from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -251,6 +251,14 @@ def _make_distinct_count(arg_types):
                           init_custom=init_custom, custom_scan=custom_scan)
 
 
+class HLLState(NamedTuple):
+    """hll:distinctCount sketch state; `dropped` counts lanes whose group
+    slot exceeded config.hll_group_capacity (monitored overflow)."""
+
+    regs: jax.Array  # int32[G * M] registers
+    dropped: jax.Array  # int64 lifetime lanes with no sketch
+
+
 def _make_hll_distinct_count(arg_types):
     """hll:distinctCount(attr) — APPROXIMATE distinct count via a
     HyperLogLog sketch (BASELINE.md config 3 names the HLL variant; the
@@ -275,7 +283,8 @@ def _make_hll_distinct_count(arg_types):
     def init_custom(group_capacity: int, grouped: bool = True):
         G = (min(group_capacity, dtypes.config.hll_group_capacity)
              if grouped else 1)
-        return jnp.zeros((G * M,), jnp.int32)
+        return HLLState(regs=jnp.zeros((G * M,), jnp.int32),
+                        dropped=jnp.int64(0))
 
     def _estimate(regs):
         R = regs.reshape(-1, M).astype(jnp.float32)
@@ -289,7 +298,7 @@ def _make_hll_distinct_count(arg_types):
 
     def custom_scan(state, slots, arg_vals, sign, lane_valid, resets, epoch,
                     grouped: bool = True):
-        regs = state
+        regs = state.regs
         G = regs.shape[0] // M
         h = hash_columns([arg_vals[0]]).astype(jnp.uint64)
         # murmur3 fmix64 avalanche: the column mix leaves low bits
@@ -304,9 +313,13 @@ def _make_hll_distinct_count(arg_types):
         w = (h >> jnp.uint64(P_BITS)).astype(jnp.uint32)
         rho = jax.lax.clz(
             jax.lax.bitcast_convert_type(w, jnp.int32)) + 1
-        ok = lane_valid & (sign > 0) & (slots >= 0) & (slots < G)
+        in_cap = (slots >= 0) & (slots < G)
+        ok = lane_valid & (sign > 0) & in_cap
         idx = jnp.where(ok, slots * M + j, G * M)
         sl = jnp.clip(slots, 0, G - 1)
+        # group slots beyond hll_group_capacity track NO sketch: emit 0 and
+        # count them (collect_overflow surfaces the counter with a warning)
+        n_drop = jnp.sum(lane_valid & (sign > 0) & ~in_cap, dtype=jnp.int64)
 
         # RESET handling at lane position (batch-window flushes mid-chunk):
         # lanes BEFORE the first reset continue the incoming sketch; lanes
@@ -327,7 +340,8 @@ def _make_hll_distinct_count(arg_types):
             rho, mode="drop")
         est_b = _estimate(regs_b)[sl]
         out = jnp.where(before_first & (n_resets > 0), est_a, est_b)
-        return regs_b, out
+        out = jnp.where(in_cap, out, jnp.zeros_like(out))
+        return HLLState(regs=regs_b, dropped=state.dropped + n_drop), out
 
     return AggregatorSpec((), lambda cs: cs[0], _T.LONG,
                           init_custom=init_custom, custom_scan=custom_scan)

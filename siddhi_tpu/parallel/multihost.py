@@ -1,0 +1,84 @@
+"""Multi-host execution over DCN — jax.distributed bring-up + mesh builder.
+
+Reference counterpart: none — the reference is single-JVM (SURVEY §2.5); its
+scale-out story is keyed partitions and sharded aggregations, which this
+framework already runs over an ICI mesh (parallel/sharded.py,
+core/aggregation.py mesh mode). This module extends the SAME mesh programming
+model across hosts: every host runs the same single-controller program,
+`jax.distributed` connects the processes, and `global_mesh()` lays the
+partition axis over ALL devices so shard_map collectives ride ICI within a
+slice and DCN across slices — exactly the "pick a mesh, annotate shardings,
+let XLA insert collectives" recipe.
+
+Deployment (one process per host, identical code):
+
+    from siddhi_tpu.parallel.multihost import init_distributed, global_mesh
+
+    init_distributed(coordinator="10.0.0.1:8476",
+                     num_processes=4, process_id=HOST_INDEX)
+    mesh = global_mesh()                      # all hosts' devices, one axis
+    rt = SiddhiManager().create_siddhi_app_runtime(app, mesh=mesh, ...)
+
+Each host feeds ITS OWN events through its InputHandlers; key-hash ownership
+(parallel/sharded.shard_owned) makes every shard process only its keys, so a
+round-robin (or any) external partitioner in front of the hosts yields the
+same results as one big host. On-demand reads that merge shards
+(aggregation find(), partition state) execute as global programs — call them
+from every process collectively, per SPMD rules.
+
+Caveats (documented, enforced where cheap):
+- all hosts must run the SAME app and the SAME sequence of global programs
+  (standard jax multi-process discipline);
+- host-side state (tables without mesh sharding, record stores, string
+  interning) is per-host; multi-host apps should key all cross-host state by
+  the mesh (partitions, sharded aggregations) or an external store;
+- this module only wires processes together — single-host multi-chip apps
+  never need it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def init_distributed(coordinator: str, num_processes: int, process_id: int,
+                     local_device_ids: Optional[list[int]] = None) -> None:
+    """Connect this process to the jax.distributed cluster (idempotent).
+
+    coordinator: "host:port" of process 0; every process passes the same.
+    """
+    import jax
+
+    try:
+        already = jax.distributed.is_initialized()
+    except AttributeError:  # older jax: no public probe
+        from jax._src import distributed as _dist
+        already = getattr(_dist.global_state, "client", None) is not None
+    if already:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+
+
+def global_mesh(axis_name: str = "part"):
+    """One-axis mesh over every device of every connected process — the
+    partition/shard axis used by mesh-enabled runtimes. Within a slice the
+    axis rides ICI; across slices XLA routes collectives over DCN."""
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), (axis_name,))
+
+
+def is_coordinator() -> bool:
+    """True on process 0 — the conventional place for host-only side effects
+    (REST service, persistence-store writes, log sinks)."""
+    import jax
+
+    return jax.process_index() == 0

@@ -1,0 +1,65 @@
+"""Sandbox + Validate manager surfaces (reference:
+managment/SandboxTestCase, managment/ValidateTestCase)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.errors import SiddhiAppCreationError, SiddhiError
+
+
+class TestValidate:
+    def test_valid_app_passes(self):
+        SiddhiManager().validate_siddhi_app(
+            "define stream S (k int);\n"
+            "from S select k insert into Out;")
+
+    def test_unknown_stream_raises(self):
+        with pytest.raises(SiddhiError):
+            SiddhiManager().validate_siddhi_app(
+                "define stream S (k int);\n"
+                "from Nope select k insert into Out;")
+
+    def test_bad_expression_raises(self):
+        with pytest.raises(SiddhiError):
+            SiddhiManager().validate_siddhi_app(
+                "define stream S (k int);\n"
+                "from S select missingAttr insert into Out;")
+
+    def test_validate_does_not_register_runtime(self):
+        mgr = SiddhiManager()
+        mgr.validate_siddhi_app(
+            "define stream S (k int);\nfrom S select k insert into Out;")
+        assert mgr.runtimes == {}
+
+
+class TestSandbox:
+    APP = """
+    @source(type='inMemory', topic='t1', @map(type='passThrough'))
+    define stream S (k string, v double);
+    @store(type='inMemory')
+    define table T (k string, v double);
+    from S select k, v insert into T;
+    @info(name='q')
+    from S select k, sum(v) as total group by k insert into Out;
+    """
+
+    def test_sources_sinks_stores_stripped(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_sandbox_siddhi_app_runtime(self.APP, batch_size=8)
+        assert rt.sources == [] and rt.sinks == []
+        from siddhi_tpu.core.table import InMemoryTable
+        assert isinstance(rt.tables["T"], InMemoryTable)  # not a RecordTable
+
+    def test_sandboxed_app_runs_via_input_handler(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_sandbox_siddhi_app_runtime(self.APP, batch_size=8)
+        rows = []
+        rt.add_callback("Out", lambda evs: rows.extend(tuple(e) for e in evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0))
+        h.send(("a", 2.0))
+        rt.flush()
+        rt.shutdown()
+        assert rows[-1] == ("a", 3.0)
+        assert sorted(rt.tables["T"].all_rows()) == [("a", 1.0), ("a", 2.0)]
