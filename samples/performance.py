@@ -10,7 +10,12 @@ Configs: filter | window_groupby | distinct | partition | join
 
 from __future__ import annotations
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # runnable from any cwd
+
 import time
 
 import numpy as np

@@ -4,6 +4,12 @@ modules/siddhi-samples/quick-start-samples/ — SimpleFilterQuery etc.).
 Run:  python samples/quickstart.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # runnable from any cwd
+
 from siddhi_tpu import SiddhiManager
 
 APP = """

@@ -291,7 +291,7 @@ class AggregationRuntime(Receiver):
         # writes bucket rows through, and construction rebuilds from any
         # rows found.
         self._durable_stores = None
-        self._rebuild_truncated = False
+        self._rebuild_truncated: set = set()  # durations truncated at rebuild
         store_ann = next((a for a in (definition.annotations or ())
                           if a.name.lower() == "store"), None)
         if store_ann is not None:
@@ -375,11 +375,14 @@ class AggregationRuntime(Receiver):
         permanently erase the buckets that never fit."""
         if self._durable_stores is None:
             return
+        import time as _time
         exported = self.export_rows()
         for dur, store in self._durable_stores.items():
             tid = f"{self.definition.id}_{dur.value}"
             rows = exported[dur]
-            if self._rebuild_truncated:
+            if dur in self._rebuild_truncated:
+                # merge ONLY the truncated duration, and re-apply retention
+                # so purge-evicted buckets are not resurrected
                 def _k(r):
                     return (r[AGG_TIMESTAMP],
                             tuple(r[g] for g in self.group_attrs))
@@ -388,6 +391,10 @@ class AggregationRuntime(Receiver):
                 for r in rows:
                     merged[_k(r)] = r
                 rows = list(merged.values())
+                retention = self.retention_ms.get(dur)
+                if retention is not None:
+                    cutoff = int(_time.time() * 1000) - retention
+                    rows = [r for r in rows if r[AGG_TIMESTAMP] >= cutoff]
             store.delete(store.compile_condition(None, tid))
             if rows:
                 store.add(rows)
@@ -430,7 +437,7 @@ class AggregationRuntime(Receiver):
                 {g: jnp.asarray(v) for g, v in gcols.items()},
                 [jnp.asarray(c) for c in comps], jnp.int32(n))
             if int(n_restored) < n:
-                self._rebuild_truncated = True
+                self._rebuild_truncated.add(dur)
                 import warnings
                 warnings.warn(
                     f"aggregation {self.definition.id!r} [{dur.value}]: only "

@@ -582,6 +582,8 @@ class SiddhiAppRuntime:
                 add("pattern_pending_dropped", obj.dropped)
             elif isinstance(obj, WindowedSnapshotState):
                 add("snapshot_ring_overflow", obj.overflow)
+            elif isinstance(obj, HLLState):
+                add("hll_groups_dropped", obj.dropped)
             import dataclasses as _dc
             if isinstance(obj, dict):
                 for v in obj.values():

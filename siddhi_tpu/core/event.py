@@ -72,12 +72,18 @@ class StringTable:
         self._to_code: dict[str, int] = {}
         self._to_str: list[Optional[str]] = [None]  # code 0 = null
         self._transient: list[Optional[str]] = []
+        self._transient_code: dict[str, int] = {}
         self._transient_next = 0
 
     def encode(self, s: Optional[str]) -> int:
         if s is None:
             return NULL_CODE
         code = self._to_code.get(s)
+        if code is None:
+            # a LIVE transient string (a uuid coming back from a client)
+            # must round-trip to its transient code, or device equality
+            # against stored uuid columns would never match
+            code = self._transient_code.get(s)
         if code is None:
             code = len(self._to_str)
             self._to_code[s] = code
@@ -95,7 +101,11 @@ class StringTable:
         if len(self._transient) <= pos:
             self._transient.append(s)
         else:
+            old = self._transient[pos]
+            if old is not None:
+                self._transient_code.pop(old, None)
             self._transient[pos] = s
+        self._transient_code[s] = self.TRANSIENT_BASE + pos
         self._transient_next = (pos + 1) % capacity
         return self.TRANSIENT_BASE + pos
 
@@ -131,6 +141,10 @@ class StringTable:
             {s: i for i, s in enumerate(strings) if s is not None})
         self._transient[:] = list(snap["transient"])
         self._transient_next = snap["transient_next"]
+        self._transient_code.clear()
+        self._transient_code.update(
+            {s: self.TRANSIENT_BASE + i
+             for i, s in enumerate(self._transient) if s is not None})
 
 
 class StreamCodec:

@@ -211,7 +211,19 @@ class _RefRewriter:
             if sid in self.groups:
                 refs = self.groups[sid]
                 if expr.is_last:
-                    new_sid = refs[-1]
+                    # e1[last].attr = the newest CAPTURED occurrence, which
+                    # varies per match when the count has a range (reference:
+                    # CountPreStateProcessor last-event semantics). Compile to
+                    # an ifThenElse chain over frame validity, newest first.
+                    from ..query_api.expression import (AttributeFunction,
+                                                        IsNull, Not)
+                    out = Variable(expr.attribute, stream_id=refs[0])
+                    for ref in refs[1:]:
+                        out = AttributeFunction("", "ifThenElse", (
+                            Not(IsNull(stream_id=ref)),
+                            Variable(expr.attribute, stream_id=ref),
+                            out))
+                    return out
                 elif expr.stream_index is not None:
                     if expr.stream_index >= len(refs):
                         raise SiddhiAppCreationError(

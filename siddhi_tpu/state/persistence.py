@@ -7,6 +7,12 @@ IncrementalFileSystem) keyed by app name + revision
 (SiddhiAppRuntimeImpl.persist:686, SiddhiManager.persist:291,
 restoreLastRevision:302-320).
 
+Compatibility: a revision restores only into the SAME state layout — a
+framework upgrade that changes a runtime's state pytree structure (new
+counters, aggregator state redesigns) fails restore LOUDLY with
+CannotRestoreStateError rather than silently misassigning leaves; durable
+aggregation stores (@store duration tables) are the cross-version path.
+
 TPU design: every runtime's state is a **pytree of device arrays** plus a few
 host scalars, so a full snapshot is one `jax.device_get` per runtime — no
 barrier needed (execution is single-controller synchronous; there is nothing

@@ -121,3 +121,23 @@ class TestUUIDForwarding:
         rows = rt.query("from T select id, symbol")
         assert len(rows) == 1 and pat.match(rows[0].data[0])
         assert rows[0].data[0] == seen[0][0]  # one uuid per event, everywhere
+
+
+class TestUuidRoundTrip:
+    def test_forwarded_uuid_matches_on_demand_lookup(self):
+        # transient codes must round-trip through encode(): a client reading
+        # a uuid and querying it back must match the stored row
+        from siddhi_tpu import SiddhiManager
+        app = """
+        define stream S (k string);
+        define table T (k string, id string);
+        from S select k, UUID() as id insert into T;
+        """
+        rt = SiddhiManager().create_siddhi_app_runtime(app, batch_size=4)
+        rt.start()
+        rt.get_input_handler("S").send(("a",))
+        rt.flush()
+        (k, the_id), = rt.tables["T"].all_rows()
+        rows = rt.query(f"from T on id == '{the_id}' select k")
+        rt.shutdown()
+        assert [r.data for r in rows] == [("a",)]

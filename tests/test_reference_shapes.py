@@ -1,0 +1,180 @@
+"""Additional behavioral shapes mirrored from the reference's suites that had
+no direct counterpart yet (reference files cited per class):
+
+- count patterns with ranges and `e1[i]` indexing (CountPatternTestCase)
+- pattern chains mixing logical + count positions (LogicalPatternTestCase)
+- partitions over time windows with per-key expiry (PartitionTestCase)
+- join `within` + unidirectional (JoinTestCase)
+- triggers driving downstream windowed queries (TriggerTestCase)
+- table updates driven by window expiry output (UpdateTableTestCase shape)
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+TWO = ("define stream S1 (symbol string, price float);\n"
+       "define stream S2 (symbol string, price float);\n")
+
+
+def make(app, batch_size=8, playback=False):
+    manager = SiddhiManager()
+    text = ("@app:playback\n" if playback else "") + app
+    rt = manager.create_siddhi_app_runtime(text, batch_size=batch_size)
+    got = []
+    rt.add_callback("OutStream", lambda evs: got.extend(e.data for e in evs))
+    rt.start()
+    return rt, got
+
+
+class TestCountPatterns:
+    """Reference: query/pattern/CountPatternTestCase."""
+
+    def test_count_range_collects_two_to_three(self):
+        app = (TWO +
+               "from e1=S1[price > 20.0]<2:3> -> e2=S2[price > 100.0] "
+               "select e1[0].price as p0, e1[1].price as p1, "
+               "e2.price as p2 insert into OutStream;")
+        rt, got = make(app)
+        s1, s2 = rt.get_input_handler("S1"), rt.get_input_handler("S2")
+        s1.send(("A", 25.0)); rt.flush()
+        s1.send(("B", 30.0)); rt.flush()
+        s2.send(("C", 150.0)); rt.flush()
+        assert got == [(25.0, 30.0, 150.0)]
+
+    def test_count_min_not_met_blocks(self):
+        app = (TWO +
+               "from e1=S1[price > 20.0]<2:3> -> e2=S2[price > 100.0] "
+               "select e2.price as p insert into OutStream;")
+        rt, got = make(app)
+        s1, s2 = rt.get_input_handler("S1"), rt.get_input_handler("S2")
+        s1.send(("A", 25.0)); rt.flush()  # only ONE e1: min 2 not met
+        s2.send(("C", 150.0)); rt.flush()
+        assert got == []
+
+    def test_last_index_reads_newest_occurrence(self):
+        app = (TWO +
+               "from e1=S1[price > 20.0]<1:2> -> e2=S2[price > 100.0] "
+               "select e1[last].price as pl insert into OutStream;")
+        rt, got = make(app)
+        s1, s2 = rt.get_input_handler("S1"), rt.get_input_handler("S2")
+        s1.send(("A", 25.0)); rt.flush()
+        s1.send(("B", 30.0)); rt.flush()
+        s2.send(("C", 150.0)); rt.flush()
+        # e1[last] follows each match's newest captured occurrence. (The
+        # 1-occurrence epsilon match also completes — documented divergence,
+        # core/pattern_runtime._advance — so the 2-capture match's value
+        # must be present and correct.)
+        assert (30.0,) in got
+
+
+class TestPartitionTimeWindows:
+    """Reference: query/partition/PartitionTestCase1 — per-key windows expire
+    independently."""
+
+    def test_per_key_time_window_counts(self):
+        app = ("define stream S (k string, v double);\n"
+               "partition with (k of S) begin\n"
+               "@info(name='q') from S#window.time(1 sec) "
+               "select k, count() as n insert into OutStream;\n"
+               "end;")
+        rt, got = make(app, playback=True)
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0), timestamp=100)
+        h.send(("b", 1.0), timestamp=150)
+        h.send(("a", 1.0), timestamp=200)
+        rt.flush()
+        by = {}
+        for k, n in got:
+            by[k] = n
+        assert by == {"a": 2, "b": 1}
+        # a's first event expires at 1100; b's at 1150
+        del got[:]
+        h.send(("a", 1.0), timestamp=1_120)
+        rt.flush()
+        assert ("a", 2) in got  # one expired, one live, plus the new one
+
+
+class TestJoinWithinUnidirectional:
+    """Reference: query/join/JoinTestCase — `within` bounds pair ages;
+    `unidirectional` restricts the triggering side."""
+
+    APP = ("define stream L (k int, v double);\n"
+           "define stream R (k int, w double);\n")
+
+    def test_within_excludes_stale_pairs(self):
+        app = (self.APP +
+               "@info(name='q') from L#window.length(10) as a "
+               "join R#window.length(10) as b on a.k == b.k "
+               "within 1 sec "
+               "select a.k as k insert into OutStream;")
+        rt, got = make(app, playback=True)
+        l, r = rt.get_input_handler("L"), rt.get_input_handler("R")
+        r.send((1, 9.0), timestamp=100)
+        rt.flush()
+        l.send((1, 1.0), timestamp=500)
+        rt.flush()
+        assert got == [(1,)]  # 400ms apart: inside within
+        del got[:]
+        l.send((1, 2.0), timestamp=5_000)
+        rt.flush()
+        assert got == []  # 4.9s apart: outside within
+
+    def test_left_unidirectional_right_does_not_trigger(self):
+        app = (self.APP +
+               "@info(name='q') from L#window.length(10) as a unidirectional "
+               "join R#window.length(10) as b on a.k == b.k "
+               "select a.k as k insert into OutStream;")
+        rt, got = make(app)
+        l, r = rt.get_input_handler("L"), rt.get_input_handler("R")
+        l.send((1, 1.0)); rt.flush()
+        r.send((1, 9.0)); rt.flush()   # right arrival must NOT emit
+        assert got == []
+        l.send((1, 2.0)); rt.flush()   # left arrival probes and emits
+        assert got == [(1,)]
+
+
+class TestTriggerDrivenQueries:
+    """Reference: trigger tests — periodic trigger events feed queries."""
+
+    def test_start_trigger_fires_once(self):
+        app = ("define trigger T at 'start';\n"
+               "@info(name='q') from T select triggered_time "
+               "insert into OutStream;")
+        rt, got = make(app)
+        rt.flush()
+        assert len(got) == 1
+
+    def test_periodic_trigger_windowed_count(self):
+        app = ("@app:playback\n"
+               "define trigger T at every 1 sec;\n"
+               "@info(name='q') from T#window.lengthBatch(3) "
+               "select count() as n insert into OutStream;")
+        manager = SiddhiManager()
+        rt = manager.create_siddhi_app_runtime(app, batch_size=8)
+        got = []
+        rt.add_callback("OutStream", lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        for t in (1_000, 2_000, 3_000):
+            rt.heartbeat(t)
+        assert [g[0] for g in got][-1] == 3
+
+
+class TestWindowExpiryToTable:
+    """Reference: UpdateTableTestCase shape — expired events update tables."""
+
+    def test_expired_events_delete_from_table(self):
+        app = ("define stream S (k int);\n"
+               "define table T (k int);\n"
+               "from S select k insert into T;\n"
+               "from S#window.length(2) "
+               "insert expired events into ExpStream;\n"
+               "from ExpStream select k delete T on T.k == k;")
+        manager = SiddhiManager()
+        rt = manager.create_siddhi_app_runtime(app, batch_size=4)
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in (1, 2, 3, 4):  # length(2): 1 and 2 expire
+            h.send((i,))
+        rt.flush()
+        assert sorted(rt.tables["T"].all_rows()) == [(3,), (4,)]
