@@ -291,7 +291,8 @@ class AggregationRuntime(Receiver):
         # writes bucket rows through, and construction rebuilds from any
         # rows found.
         self._durable_stores = None
-        self._rebuild_truncated: set = set()  # durations truncated at rebuild
+        #: rows held back at a capacity-truncated rebuild, re-merged at flush
+        self._unrestored: dict = {}
         store_ann = next((a for a in (definition.annotations or ())
                           if a.name.lower() == "store"), None)
         if store_ann is not None:
@@ -375,25 +376,27 @@ class AggregationRuntime(Receiver):
         permanently erase the buckets that never fit."""
         if self._durable_stores is None:
             return
-        import time as _time
         exported = self.export_rows()
         for dur, store in self._durable_stores.items():
             tid = f"{self.definition.id}_{dur.value}"
             rows = exported[dur]
-            if dur in self._rebuild_truncated:
-                # merge ONLY the truncated duration, and re-apply retention
-                # so purge-evicted buckets are not resurrected
+            held = self._unrestored.get(dur)
+            if held:
+                # buckets held back at a capacity-truncated rebuild re-join
+                # the durable set (device rows win on key collisions);
+                # retention uses the STREAM clock — wall time would wrongly
+                # purge playback/external-time apps
                 def _k(r):
                     return (r[AGG_TIMESTAMP],
                             tuple(r[g] for g in self.group_attrs))
-                merged = {_k(r): r for r in store.find(
-                    store.compile_condition(None, tid))}
+                merged = {_k(r): r for r in held}
                 for r in rows:
                     merged[_k(r)] = r
                 rows = list(merged.values())
                 retention = self.retention_ms.get(dur)
                 if retention is not None:
-                    cutoff = int(_time.time() * 1000) - retention
+                    cutoff = (self.ctx.timestamp_generator.current_time()
+                              - retention)
                     rows = [r for r in rows if r[AGG_TIMESTAMP] >= cutoff]
             store.delete(store.compile_condition(None, tid))
             if rows:
@@ -418,6 +421,19 @@ class AggregationRuntime(Receiver):
                 None, f"{self.definition.id}_{dur.value}")))
             if not rows:
                 continue
+            fit = int(0.7 * self.capacity * self.n_shards)
+            if len(rows) > fit:
+                # restore the NEWEST buckets that fit; hold the rest
+                # host-side so flush_durable never erases them
+                rows.sort(key=lambda r: r[AGG_TIMESTAMP], reverse=True)
+                self._unrestored[dur] = rows[fit:]
+                rows = rows[:fit]
+                import warnings
+                warnings.warn(
+                    f"aggregation {self.definition.id!r} [{dur.value}]: "
+                    f"{len(self._unrestored[dur])} durable buckets exceed "
+                    "device capacity on rebuild; oldest held host-side "
+                    "(raise group_capacity)", stacklevel=2)
             n = len(rows)
             bts = np.asarray([r[AGG_TIMESTAMP] for r in rows], np.int64)
             gcols = {}
@@ -437,7 +453,6 @@ class AggregationRuntime(Receiver):
                 {g: jnp.asarray(v) for g, v in gcols.items()},
                 [jnp.asarray(c) for c in comps], jnp.int32(n))
             if int(n_restored) < n:
-                self._rebuild_truncated.add(dur)
                 import warnings
                 warnings.warn(
                     f"aggregation {self.definition.id!r} [{dur.value}]: only "

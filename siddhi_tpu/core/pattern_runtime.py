@@ -297,14 +297,6 @@ class PatternQueryRuntime:
         if plan.is_sequence:
             jset = {leg.stream_id for pos in plan.positions for leg in pos.legs}
             self.merged_mode = len(jset) > 1
-            if self.merged_mode and any(
-                    p.kind == "logical" for p in plan.positions):
-                # the per-leg strict-contiguity kill treats the other leg's
-                # arrival as a sequence breaker; reject loudly rather than
-                # silently never matching
-                raise SiddhiAppCreationError(
-                    "logical (and/or) conditions inside multi-stream "
-                    "sequences are not supported")
 
         # --- junctions / frames / codecs ---
         self.junctions: dict[str, StreamJunction] = {}
@@ -513,6 +505,12 @@ class PatternQueryRuntime:
         scope.ts.setdefault(leg.stream_id, batch.ts[:, None])
         if pend is not None:
             for ref, cols in pend.frames.items():
+                if ref == leg.ref:
+                    # logical positions capture their OWN legs in the pending
+                    # table; the leg's frame here must stay the ARRIVING
+                    # event, not the (possibly empty) capture — otherwise a
+                    # leg filter evaluates against zeros and never matches
+                    continue
                 scope.add_frame(ref, cols, pend.frame_ts[ref],
                                 pend.frame_valid[ref])
         scope.extras["now"] = now
@@ -623,7 +621,40 @@ class PatternQueryRuntime:
                                   batch.ts, arr_seq, batch.ts, m, drop_acc)
                     continue
 
-                for li, leg in enumerate(pos.legs):
+                def _joint_kill(pi=pi, pos=pos):
+                    # strict kill computed JOINTLY over both legs (the next
+                    # arrival may legitimately match EITHER remaining leg);
+                    # re-run before every leg pass so a breaker that becomes
+                    # "next" after an in-batch leg match is still caught
+                    pend = pending[pi - 1]
+                    q_any = jnp.zeros(
+                        (B, pend.valid.shape[0]), bool)
+                    for lj, lg in enumerate(pos.legs):
+                        if not merged and lg.stream_id != junction_sid:
+                            continue
+                        ql = self._leg_cond(lg, self._leg_batch(batch, lg),
+                                            pend, now)
+                        q_any = q_any | (ql & ~pend.leg_done[None, :, lj])
+                    nxt = (arr_seq[:, None] == pend.last_seq[None, :] + 1) \
+                        & batch.valid[:, None]
+                    killed = (nxt & ~q_any).any(axis=0) & pend.valid
+                    pending[pi - 1] = pend._replace(
+                        valid=pend.valid & ~killed)
+
+                #: ordering snapshot for pattern-mode logical legs — sibling
+                #: matches in this batch must not block the other leg's
+                #: earlier arrival (legs complete in either order)
+                pend0 = pending[pi - 1]
+                leg_iters = list(enumerate(pos.legs))
+                if is_seq and pos.kind == "logical":
+                    # two passes: with strict contiguity, the second leg's
+                    # arrival only becomes reachable (last_seq+1) after the
+                    # first leg matched — which may happen later in THIS
+                    # batch when arrivals came in the opposite leg order
+                    leg_iters = leg_iters * 2
+                for li, leg in leg_iters:
+                    if is_seq and pos.kind == "logical":
+                        _joint_kill()
                     if not merged and leg.stream_id != junction_sid:
                         continue
                     pend = pending[pi - 1]
@@ -632,13 +663,15 @@ class PatternQueryRuntime:
                     q = q & pend.valid[None, :]
                     if is_seq:
                         q = q & (arr_seq[:, None] == pend.last_seq[None, :] + 1)
+                    elif pos.kind == "logical":
+                        q = q & (arr_seq[:, None] > pend0.last_seq[None, :])
                     else:
                         q = q & (arr_seq[:, None] > pend.last_seq[None, :])
                     if within is not None:
                         q = q & (batch.ts[:, None] - pend.start_ts[None, :]
                                  <= jnp.int64(within))
 
-                    if is_seq:
+                    if is_seq and pos.kind != "logical":
                         # strict: an arrival at seq == last_seq+1 that does NOT
                         # match kills the entry
                         nxt = (arr_seq[:, None] == pend.last_seq[None, :] + 1) \
@@ -677,8 +710,10 @@ class PatternQueryRuntime:
                             frame_ts=new_fts,
                             leg_done=pend.leg_done.at[:, li].set(
                                 pend.leg_done[:, li] | matched),
-                            last_seq=jnp.where(matched, arr_seq[b_star],
-                                               pend.last_seq))
+                            last_seq=jnp.where(
+                                matched,
+                                jnp.maximum(arr_seq[b_star], pend.last_seq),
+                                pend.last_seq))
                         adv_valid = complete
                         ins_frames = pend.frames
                         ins_fvalid = pend.frame_valid
@@ -705,7 +740,9 @@ class PatternQueryRuntime:
                         pending, out_blocks, pi + 1,
                         ins_frames, ins_fvalid, ins_fts,
                         jnp.where(adv_valid, pend.start_ts, 0),
-                        jnp.where(adv_valid, arr_seq[b_star], pend.last_seq),
+                        jnp.where(adv_valid,
+                                  jnp.maximum(arr_seq[b_star], pend.last_seq),
+                                  pend.last_seq),
                         comp_ts, adv_valid, drop_acc)
 
             # ---- merge output blocks through the selector ----

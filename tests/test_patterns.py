@@ -296,3 +296,116 @@ class TestMultiStreamSequence:
         rt.get_input_handler("S2").send(("B", 26.0))
         rt.flush()
         assert got == [(25.0, 26.0)]
+
+
+class TestLogicalSequences:
+    """Logical (and/or) positions inside sequences — the next TWO events
+    must satisfy the two legs, in either order (reference: query/sequence/
+    LogicalSequenceTestCase)."""
+
+    APP = (TWO +
+           "define stream S3 (symbol string, price float);\n"
+           "from every e1=S1[price > 20.0], e2=S2[price > 30.0] "
+           "and e3=S3[price > 40.0] "
+           "select e1.symbol as s1, e2.symbol as s2, e3.symbol as s3 "
+           "insert into OutStream;")
+
+    def _handlers(self, rt):
+        return (rt.get_input_handler("S1"), rt.get_input_handler("S2"),
+                rt.get_input_handler("S3"))
+
+    def test_and_completes_in_either_order(self):
+        rt, got = make(self.APP)
+        s1, s2, s3 = self._handlers(rt)
+        s1.send(("A", 25.0)); s2.send(("B", 35.0)); s3.send(("C", 45.0))
+        rt.flush()
+        assert got == [("A", "B", "C")]
+        del got[:]
+        s1.send(("D", 25.0)); s3.send(("E", 45.0)); s2.send(("F", 35.0))
+        rt.flush()
+        assert got == [("D", "F", "E")]
+
+    def test_non_matching_intervening_event_kills(self):
+        rt, got = make(self.APP)
+        s1, s2, s3 = self._handlers(rt)
+        s1.send(("A", 25.0))
+        s2.send(("X", 5.0))   # fails BOTH remaining legs: partial killed
+        s2.send(("B", 35.0)); s3.send(("C", 45.0))
+        rt.flush()
+        assert got == []
+
+    def test_or_completes_on_first_matching_leg(self):
+        app = (TWO +
+               "from every e1=S1[price > 20.0], e2=S2[price > 30.0] "
+               "or e3=S1[price > 90.0] "
+               "select e1.symbol as s1, e2.symbol as s2 "
+               "insert into OutStream;")
+        rt, got = make(app)
+        s1, s2 = rt.get_input_handler("S1"), rt.get_input_handler("S2")
+        s1.send(("A", 25.0)); s2.send(("B", 35.0))
+        rt.flush()
+        assert got == [("A", "B")]
+
+
+class TestLogicalPatternWithFilters:
+    def test_and_pattern_leg_filters_evaluate_on_arrivals(self):
+        # regression: logical positions capture their own legs in the pending
+        # table; leg filters must evaluate on the ARRIVING event, not the
+        # (empty) capture
+        app = (TWO +
+               "define stream S3 (symbol string, price float);\n"
+               "from e1=S1[price > 20.0] -> e2=S2[price > 30.0] "
+               "and e3=S3[price > 40.0] "
+               "select e1.symbol as s1, e2.symbol as s2, e3.symbol as s3 "
+               "insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("A", 25.0)); rt.flush()
+        rt.get_input_handler("S2").send(("B", 35.0)); rt.flush()
+        rt.get_input_handler("S3").send(("C", 45.0)); rt.flush()
+        assert got == [("A", "B", "C")]
+
+    def test_and_pattern_filter_rejects(self):
+        app = (TWO +
+               "define stream S3 (symbol string, price float);\n"
+               "from e1=S1[price > 20.0] -> e2=S2[price > 30.0] "
+               "and e3=S3[price > 40.0] "
+               "select e1.symbol as s1 insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("A", 25.0)); rt.flush()
+        rt.get_input_handler("S2").send(("B", 5.0)); rt.flush()   # fails
+        rt.get_input_handler("S3").send(("C", 45.0)); rt.flush()
+        assert got == []
+
+
+class TestLogicalInBatchOrdering:
+    def test_and_pattern_opposite_order_single_batch(self):
+        # both legs inside ONE batch, reversed relative to leg order: the
+        # reference's logical AND accepts the events in either order
+        app = (TWO +
+               "define stream S3 (symbol string, price float);\n"
+               "from e1=S1[price > 20.0] -> e2=S2[price > 30.0] "
+               "and e3=S3[price > 40.0] "
+               "select e2.symbol as s2, e3.symbol as s3 "
+               "insert into OutStream;")
+        rt, got = make(app, batch_size=16)
+        rt.get_input_handler("S1").send(("A", 25.0))
+        rt.get_input_handler("S3").send(("C", 45.0))  # e3 BEFORE e2
+        rt.get_input_handler("S2").send(("B", 35.0))
+        rt.flush()
+        assert got == [("B", "C")]
+
+    def test_sequence_breaker_after_first_leg_same_batch(self):
+        # A, B(matches e2), X(breaker) all in one batch: the partial must die
+        app = (TWO +
+               "define stream S3 (symbol string, price float);\n"
+               "from every e1=S1[price > 20.0], e2=S2[price > 30.0] "
+               "and e3=S3[price > 40.0] "
+               "select e1.symbol as s1 insert into OutStream;")
+        rt, got = make(app, batch_size=16)
+        s1, s2, s3 = (rt.get_input_handler(s) for s in ("S1", "S2", "S3"))
+        s1.send(("A", 25.0))
+        s2.send(("B", 35.0))   # matches e2
+        s2.send(("X", 5.0))    # next arrival fails remaining leg: breaker
+        s3.send(("C", 45.0))
+        rt.flush()
+        assert got == []
