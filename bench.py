@@ -17,14 +17,17 @@ which would measure the tunnel, not the engine). p99 is synchronous per-step.
 Each config's JSON line carries three numbers (VERDICT r02 item 8):
   value                 — pipelined throughput through the jitted step
                           (async dispatch, one barrier per window, best of 3)
-  e2e_events_per_sec    — the PUBLIC path: InputHandler.send() python rows →
-                          host encode/interning → junction dispatch → jitted
-                          step → callback decode (flush per micro-batch).
-                          On the tunneled TPU this is RTT-bound: every flush
-                          pays one synchronous device→host readback for the
-                          callback decode (~100 ms tunnel round trip), so it
-                          measures deployment topology as much as engine —
-                          co-located hosts see orders of magnitude more
+  e2e_events_per_sec    — the PUBLIC path: InputHandler.send_batch(python
+                          rows) → host encode (native C + interning) →
+                          junction dispatch → jitted step → async callback
+                          decode (native Event materialization). The clock
+                          includes runtime.drain(): every output event has
+                          reached the callback before the elapsed is read.
+                          On the tunneled TPU each batch still pays the
+                          device→host readback RTT (pipelined by the async
+                          decoder); e2e_colocated_events_per_sec is the same
+                          measurement with a co-located CPU backend in a
+                          fresh subprocess — engine vs topology, separated
   device_step_ms        — per-step time of the state-chained pipelined loop
                           (the chain serializes device execution, dispatch
                           overlaps: device-bound to first order), vs
@@ -50,10 +53,18 @@ import time
 import numpy as np
 
 BATCH = 8192
+#: e2e micro-batch: the public path amortizes per-batch costs (one device
+#: dispatch + one device→host readback per batch) over more events; through
+#: the tunneled TPU the readback RTT (~100 ms) is the dominant per-batch
+#: cost, so e2e uses a larger compiled batch than the device measure
+E2E_BATCH = int(__import__("os").environ.get("SIDDHI_E2E_BATCH", 131072))
 WARMUP = 3
 STEPS = 40
 LAT_STEPS = 50
 RNG_SEED = 7
+#: --e2e-only: skip device measures, print only the e2e number (used by the
+#: parent process to collect the co-located CPU variant)
+E2E_ONLY = "--e2e-only" in sys.argv
 
 
 #: per-config single-JVM CPU estimates (events/sec), used when BASELINE.json
@@ -127,33 +138,45 @@ def _measure(run_step, events_per_step: int, metric: str, *,
 
 
 def _measure_e2e(rt, out_stream: str, feed_round, events_per_round: int,
-                 *, rounds: int = 6, warmup: int = 2) -> float:
+                 *, rounds: int = 8, warmup: int = 2) -> float:
     """End-to-end throughput through the PUBLIC ingestion path:
-    InputHandler.send(python row) → host encode → junction → jitted step →
-    callback decode. `feed_round(r)` sends one round of rows and flushes."""
+    InputHandler.send_batch(python rows) → host encode (native C, interning)
+    → junction → jitted step → callback decode (async worker; Event objects
+    materialize through native build_events). The clock stops at drain() —
+    every produced event has been decoded and delivered to the callback
+    before elapsed is read, so async decode pipelines the device→host round
+    trips but cannot hide undone work."""
     n_out = [0]
     rt.add_callback(out_stream, lambda evs: n_out.__setitem__(
         0, n_out[0] + len(evs)))
     rt.start()
     for r in range(warmup):
         feed_round(r)
-    t0 = time.perf_counter()
-    for r in range(warmup, warmup + rounds):
-        feed_round(r)
-    elapsed = time.perf_counter() - t0
+    rt.drain()
+    best = 0.0
+    r0 = warmup
+    for _rep in range(3):  # best-of-3: the tunnel's throughput drifts
+        t0 = time.perf_counter()
+        for r in range(r0, r0 + rounds):
+            feed_round(r)
+        rt.drain()
+        elapsed = time.perf_counter() - t0
+        r0 += rounds
+        best = max(best, events_per_round * rounds / elapsed)
     rt.shutdown()
     assert n_out[0] > 0, "e2e run produced no output — not a valid measure"
-    return events_per_round * rounds / elapsed
+    return best
 
 
-def _trade_rows(n_rounds: int, n_keys: int, *, price_hi: float = 100.0):
+def _trade_rows(n_rounds: int, n_keys: int, *, price_hi: float = 100.0,
+                n: int = BATCH):
     """Host python rows (string symbols) for the e2e public-path variant."""
     rng = np.random.default_rng(RNG_SEED + 1)
     rounds = []
     for _ in range(n_rounds):
-        ks = rng.integers(1, n_keys + 1, BATCH)
-        ps = rng.uniform(1.0, price_hi, BATCH)
-        vs = rng.integers(1, 1000, BATCH)
+        ks = rng.integers(1, n_keys + 1, n)
+        ps = rng.uniform(1.0, price_hi, n)
+        vs = rng.integers(1, 1000, n)
         rounds.append([(f"S{int(k)}", float(p), int(v))
                        for k, p, v in zip(ks, ps, vs)])
     return rounds
@@ -200,29 +223,32 @@ def bench_filter() -> dict:
     select symbol, price
     insert into OutStream;
     """
-    rt = SiddhiManager().create_siddhi_app_runtime(app, batch_size=BATCH)
-    qr = rt.query_runtimes["bench"]
-    batches, ts_end = _trade_batches(8, 1000, price_hi=1000.0)
-    state = [qr.state]
+    if E2E_ONLY:
+        res = {"metric": "filter_events_per_sec"}
+    else:
+        rt = SiddhiManager().create_siddhi_app_runtime(app, batch_size=BATCH)
+        qr = rt.query_runtimes["bench"]
+        batches, ts_end = _trade_batches(8, 1000, price_hi=1000.0)
+        state = [qr.state]
 
-    def run(i):
-        state[0], out = qr._step(state[0], batches[i % len(batches)],
-                                 jnp.int64(ts_end))
-        return out
+        def run(i):
+            state[0], out = qr._step(state[0], batches[i % len(batches)],
+                                     jnp.int64(ts_end))
+            return out
 
-    res = _measure(run, BATCH, "filter_events_per_sec")
+        res = _measure(run, BATCH, "filter_events_per_sec")
 
-    rt2 = SiddhiManager().create_siddhi_app_runtime(app, batch_size=BATCH)
-    rows = _trade_rows(8, 1000, price_hi=1000.0)
+    rt2 = SiddhiManager().create_siddhi_app_runtime(
+        app, batch_size=E2E_BATCH, async_callbacks=True)
+    rows = _trade_rows(4, 1000, price_hi=1000.0, n=E2E_BATCH)
+    h = rt2.get_input_handler("TradeStream")
 
     def feed(r):
-        h = rt2.get_input_handler("TradeStream")
-        for row in rows[r % len(rows)]:
-            h.send(row)
+        h.send_batch(rows[r % len(rows)])
         rt2.flush()
 
     res["e2e_events_per_sec"] = round(
-        _measure_e2e(rt2, "OutStream", feed, BATCH), 1)
+        _measure_e2e(rt2, "OutStream", feed, E2E_BATCH), 1)
     return res
 
 
@@ -240,32 +266,35 @@ def bench_groupby() -> dict:
     group by symbol
     insert into SummaryStream;
     """
-    rt = SiddhiManager().create_siddhi_app_runtime(
-        app, batch_size=BATCH, group_capacity=1 << 20)
-    qr = rt.query_runtimes["bench"]
-    batches, ts_end = _trade_batches(8, 1_000_000)
-    state = [qr.state]
+    if E2E_ONLY:
+        res = {"metric": "lengthBatch10k_groupby_1M_keys_events_per_sec"}
+    else:
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            app, batch_size=BATCH, group_capacity=1 << 20)
+        qr = rt.query_runtimes["bench"]
+        batches, ts_end = _trade_batches(8, 1_000_000)
+        state = [qr.state]
 
-    def run(i):
-        state[0], out = qr._step(state[0], batches[i % len(batches)],
-                                 jnp.int64(ts_end))
-        return out
+        def run(i):
+            state[0], out = qr._step(state[0], batches[i % len(batches)],
+                                     jnp.int64(ts_end))
+            return out
 
-    res = _measure(run, BATCH,
-                   "lengthBatch10k_groupby_1M_keys_events_per_sec")
+        res = _measure(run, BATCH,
+                       "lengthBatch10k_groupby_1M_keys_events_per_sec")
 
     rt2 = SiddhiManager().create_siddhi_app_runtime(
-        app, batch_size=BATCH, group_capacity=1 << 20)
-    rows = _trade_rows(8, 1_000_000)
+        app, batch_size=E2E_BATCH, group_capacity=1 << 20,
+        async_callbacks=True)
+    rows = _trade_rows(4, 1_000_000, n=E2E_BATCH)
+    h = rt2.get_input_handler("TradeStream")
 
     def feed(r):
-        h = rt2.get_input_handler("TradeStream")
-        for row in rows[r % len(rows)]:
-            h.send(row)
+        h.send_batch(rows[r % len(rows)])
         rt2.flush()
 
     res["e2e_events_per_sec"] = round(
-        _measure_e2e(rt2, "SummaryStream", feed, BATCH), 1)
+        _measure_e2e(rt2, "SummaryStream", feed, E2E_BATCH), 1)
     return res
 
 
@@ -283,6 +312,9 @@ def bench_distinct() -> dict:
     select distinctCount(symbol) as distinctSymbols
     insert into OutStream;
     """
+    if E2E_ONLY:
+        res = {"metric": "sliding60s_distinctCount_events_per_sec"}
+        return _distinct_e2e(app, res)
     # lifetime-unique values bounded (100k) well under the 1M pair capacity
     rt = SiddhiManager().create_siddhi_app_runtime(
         app, batch_size=BATCH, group_capacity=1 << 20)
@@ -308,23 +340,28 @@ def bench_distinct() -> dict:
         return out
 
     res = _measure(run, BATCH, "sliding60s_distinctCount_events_per_sec")
+    return _distinct_e2e(app, res)
+
+
+def _distinct_e2e(app: str, res: dict) -> dict:
+    from siddhi_tpu import SiddhiManager
 
     rt2 = SiddhiManager().create_siddhi_app_runtime(
-        app, batch_size=BATCH, group_capacity=1 << 20)
-    rows = _trade_rows(8, 100_000)
+        app, batch_size=E2E_BATCH, group_capacity=1 << 20,
+        async_callbacks=True)
+    rows = _trade_rows(4, 100_000, n=E2E_BATCH)
+    h = rt2.get_input_handler("TradeStream")
     ts_ctr = [1]
 
     def feed(r):
-        h = rt2.get_input_handler("TradeStream")
         t = ts_ctr[0]
-        for row in rows[r % len(rows)]:
-            h.send(row, timestamp=t)
-            t += 1
-        ts_ctr[0] = t
+        ts_ctr[0] = t + E2E_BATCH
+        h.send_batch(rows[r % len(rows)],
+                     timestamps=list(range(t, t + E2E_BATCH)))
         rt2.flush()
 
     res["e2e_events_per_sec"] = round(
-        _measure_e2e(rt2, "OutStream", feed, BATCH), 1)
+        _measure_e2e(rt2, "OutStream", feed, E2E_BATCH), 1)
     return res
 
 
@@ -340,61 +377,64 @@ def bench_pattern() -> dict:
     # device NFA time is sub-ms; tunnel dispatch overhead dominates at small
     # batches, so run full-width batches with pending capacity to match
     pb = BATCH
+    app = """
+    define stream StreamA (val int);
+    define stream StreamB (val int);
+    @info(name = 'bench')
+    from every a=StreamA -> b=StreamB[b.val == a.val] within 5 sec
+    select a.val as aVal, b.val as bVal
+    insert into OutStream;
+    """
+    if E2E_ONLY:
+        res = {"metric": "pattern_everyAB_within5s_events_per_sec"}
+    else:
+        prev_cap = dtypes.config.pattern_pending_capacity
+        dtypes.config.pattern_pending_capacity = 4 * pb
+        try:
+            rt = SiddhiManager().create_siddhi_app_runtime(app, batch_size=pb)
+            qr = rt.query_runtimes["bench"]
+        finally:
+            dtypes.config.pattern_pending_capacity = prev_cap
+
+        n_cycles = 4
+        ab = []
+        ts0 = 1
+        for k in range(n_cycles):
+            vals = np.arange(k * pb, (k + 1) * pb, dtype=np.int32)
+            ts_a = np.arange(ts0, ts0 + pb, dtype=np.int64)
+            a = EventBatch.from_numpy(ts_a, {"val": vals}, pb)
+            ts_b = ts_a + pb
+            b = EventBatch.from_numpy(ts_b, {"val": vals}, pb)
+            ts0 += 2 * pb
+            ab.append((a, b, ts0 - 1))
+        state = [qr.state]
+
+        def run(i):
+            a, b, now = ab[i % n_cycles]
+            state[0], _ = qr._steps["StreamA"](state[0], a, jnp.int64(now - pb))
+            state[0], out = qr._steps["StreamB"](state[0], b, jnp.int64(now))
+            return out
+
+        res = _measure(run, 2 * pb, "pattern_everyAB_within5s_events_per_sec")
+
     prev_cap = dtypes.config.pattern_pending_capacity
     dtypes.config.pattern_pending_capacity = 4 * pb
     try:
-        app = """
-        define stream StreamA (val int);
-        define stream StreamB (val int);
-        @info(name = 'bench')
-        from every a=StreamA -> b=StreamB[b.val == a.val] within 5 sec
-        select a.val as aVal, b.val as bVal
-        insert into OutStream;
-        """
-        rt = SiddhiManager().create_siddhi_app_runtime(app, batch_size=pb)
-        qr = rt.query_runtimes["bench"]
+        rt2 = SiddhiManager().create_siddhi_app_runtime(
+            app, batch_size=pb, async_callbacks=True)
     finally:
         dtypes.config.pattern_pending_capacity = prev_cap
-
-    n_cycles = 4
-    ab = []
-    ts0 = 1
-    for k in range(n_cycles):
-        vals = np.arange(k * pb, (k + 1) * pb, dtype=np.int32)
-        ts_a = np.arange(ts0, ts0 + pb, dtype=np.int64)
-        a = EventBatch.from_numpy(ts_a, {"val": vals}, pb)
-        ts_b = ts_a + pb
-        b = EventBatch.from_numpy(ts_b, {"val": vals}, pb)
-        ts0 += 2 * pb
-        ab.append((a, b, ts0 - 1))
-    state = [qr.state]
-
-    def run(i):
-        a, b, now = ab[i % n_cycles]
-        state[0], _ = qr._steps["StreamA"](state[0], a, jnp.int64(now - pb))
-        state[0], out = qr._steps["StreamB"](state[0], b, jnp.int64(now))
-        return out
-
-    res = _measure(run, 2 * pb, "pattern_everyAB_within5s_events_per_sec")
-
-    prev_cap = dtypes.config.pattern_pending_capacity
-    dtypes.config.pattern_pending_capacity = 4 * pb
-    try:
-        rt2 = SiddhiManager().create_siddhi_app_runtime(app, batch_size=pb)
-    finally:
-        dtypes.config.pattern_pending_capacity = prev_cap
+    ha = rt2.get_input_handler("StreamA")
+    hb = rt2.get_input_handler("StreamB")
     val_ctr = [0]
 
     def feed(r):
-        ha = rt2.get_input_handler("StreamA")
-        hb = rt2.get_input_handler("StreamB")
         v0 = val_ctr[0]
         val_ctr[0] += pb
-        for v in range(v0, v0 + pb):
-            ha.send((v,))
+        rows = [(v,) for v in range(v0, v0 + pb)]
+        ha.send_batch(rows)
         rt2.flush()
-        for v in range(v0, v0 + pb):
-            hb.send((v,))
+        hb.send_batch(rows)
         rt2.flush()
 
     res["e2e_events_per_sec"] = round(
@@ -420,32 +460,36 @@ def bench_join() -> dict:
     select a.k as k, a.v as lv, b.v as rv
     insert into OutStream;
     """
-    rt = SiddhiManager().create_siddhi_app_runtime(app, batch_size=BATCH)
-    qr = rt.query_runtimes["bench"]
+    if E2E_ONLY:
+        res = {"metric": "join_100kx100k_events_per_sec"}
+    else:
+        rt = SiddhiManager().create_siddhi_app_runtime(app, batch_size=BATCH)
+        qr = rt.query_runtimes["bench"]
 
-    rng = np.random.default_rng(RNG_SEED)
-    n_distinct = 8
-    lr = []
-    ts0 = 1
-    for _ in range(n_distinct):
-        ts = np.arange(ts0, ts0 + BATCH, dtype=np.int64)
-        ts0 += BATCH
-        mk = lambda: {"k": rng.integers(1, 100_001, BATCH, dtype=np.int32),
-                      "v": rng.uniform(1.0, 100.0, BATCH).astype(np.float32)}
-        lr.append((EventBatch.from_numpy(ts, mk(), BATCH),
-                   EventBatch.from_numpy(ts, mk(), BATCH)))
-    state = [qr.state]
+        rng = np.random.default_rng(RNG_SEED)
+        n_distinct = 8
+        lr = []
+        ts0 = 1
+        for _ in range(n_distinct):
+            ts = np.arange(ts0, ts0 + BATCH, dtype=np.int64)
+            ts0 += BATCH
+            mk = lambda: {"k": rng.integers(1, 100_001, BATCH, dtype=np.int32),
+                          "v": rng.uniform(1.0, 100.0, BATCH).astype(np.float32)}
+            lr.append((EventBatch.from_numpy(ts, mk(), BATCH),
+                       EventBatch.from_numpy(ts, mk(), BATCH)))
+        state = [qr.state]
 
-    def run(i):
-        l, r = lr[i % n_distinct]
-        now = jnp.int64(ts0)
-        state[0], _, _ = qr._step_left(state[0], l, now, None)
-        state[0], out, _ = qr._step_right(state[0], r, now, None)
-        return out
+        def run(i):
+            l, r = lr[i % n_distinct]
+            now = jnp.int64(ts0)
+            state[0], _, _ = qr._step_left(state[0], l, now, None)
+            state[0], out, _ = qr._step_right(state[0], r, now, None)
+            return out
 
-    res = _measure(run, 2 * BATCH, "join_100kx100k_events_per_sec")
+        res = _measure(run, 2 * BATCH, "join_100kx100k_events_per_sec")
 
-    rt2 = SiddhiManager().create_siddhi_app_runtime(app, batch_size=BATCH)
+    rt2 = SiddhiManager().create_siddhi_app_runtime(
+        app, batch_size=BATCH, async_callbacks=True)
     rng2 = np.random.default_rng(RNG_SEED + 1)
     rounds = []
     for _ in range(8):
@@ -453,16 +497,14 @@ def bench_join() -> dict:
             rng2.integers(1, 100_001, BATCH),
             rng2.uniform(1.0, 100.0, BATCH))]
         rounds.append((mk(), mk()))
+    hl = rt2.get_input_handler("LeftStream")
+    hr = rt2.get_input_handler("RightStream")
 
     def feed(r):
         lrows, rrows = rounds[r % len(rounds)]
-        hl = rt2.get_input_handler("LeftStream")
-        hr = rt2.get_input_handler("RightStream")
-        for row in lrows:
-            hl.send(row)
+        hl.send_batch(lrows)
         rt2.flush()
-        for row in rrows:
-            hr.send(row)
+        hr.send_batch(rrows)
         rt2.flush()
 
     res["e2e_events_per_sec"] = round(
@@ -480,33 +522,55 @@ CONFIGS = {
 }
 
 
+def _run_config_subprocess(argv, env=None):
+    """Run one config in a fresh interpreter; return its JSON line or None."""
+    import subprocess
+    try:
+        r = subprocess.run(argv, capture_output=True, text=True, timeout=900,
+                           env=env)
+    except subprocess.TimeoutExpired:
+        return {"error": "timeout after 900s"}
+    lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    if not lines:
+        return {"error": (r.stderr or "no output").strip()[-400:]}
+    try:
+        return json.loads(lines[-1])
+    except json.JSONDecodeError:
+        return {"error": lines[-1][-400:]}
+
+
 def main() -> None:
-    unknown = [n for n in sys.argv[1:] if n not in CONFIGS]
+    import os
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    unknown = [n for n in args if n not in CONFIGS]
     if unknown:
         sys.exit(f"unknown config(s) {unknown}; choose from {list(CONFIGS)}")
-    names = sys.argv[1:] or list(CONFIGS)
-    if len(names) == 1:
+    names = args or list(CONFIGS)
+    if E2E_ONLY or len(names) == 1:
+        if E2E_ONLY and os.environ.get("SIDDHI_BENCH_CPU"):
+            # co-located variant: same engine, CPU backend in-process — no
+            # tunnel between controller and device
+            from siddhi_tpu.util.platform import force_cpu_platform
+            force_cpu_platform(1)
         print(json.dumps(CONFIGS[names[0]]()), flush=True)
         return
     # one subprocess per config: earlier configs' runtimes pin device buffers
     # (1M-key tables, 100k rings) and degrade later configs measurably when
     # sharing a process
-    import subprocess
     for name in names:
-        try:
-            r = subprocess.run([sys.executable, __file__, name],
-                               capture_output=True, text=True, timeout=900)
-        except subprocess.TimeoutExpired:
-            print(json.dumps({"metric": name, "error": "timeout after 900s"}),
-                  flush=True)
+        res = _run_config_subprocess([sys.executable, __file__, name])
+        if "error" in res:
+            print(json.dumps({"metric": name, **res}), flush=True)
             continue
-        line = [l for l in r.stdout.splitlines() if l.startswith("{")]
-        if line:
-            print(line[-1], flush=True)
-        else:
-            print(json.dumps({"metric": name, "error":
-                              (r.stderr or "no output").strip()[-400:]}),
-                  flush=True)
+        # co-located CPU e2e (VERDICT r3 item 1: separate topology from
+        # engine): same public path, CPU backend, fresh subprocess
+        cpu_env = dict(os.environ,
+                       JAX_PLATFORMS="cpu", SIDDHI_BENCH_CPU="1")
+        cpu = _run_config_subprocess(
+            [sys.executable, __file__, name, "--e2e-only"], env=cpu_env)
+        if "e2e_events_per_sec" in cpu:
+            res["e2e_colocated_events_per_sec"] = cpu["e2e_events_per_sec"]
+        print(json.dumps(res), flush=True)
 
 
 if __name__ == "__main__":

@@ -23,9 +23,14 @@
 #include <stdint.h>
 
 /* Intern one string through (to_code: dict, to_str: list); returns code or -1
- * on error. None encodes as 0 (null). */
+ * on error. None encodes as 0 (null). `transient` (may be NULL) is the
+ * StringTable's transient-code dict: a LIVE transient string (a uuid coming
+ * back from a client) must round-trip to its transient code, or device
+ * equality against stored uuid columns would never match — and permanently
+ * interning it would shadow the transient code for every later encode(). */
 static int32_t
-intern_string(PyObject *value, PyObject *to_code, PyObject *to_str)
+intern_string(PyObject *value, PyObject *to_code, PyObject *to_str,
+              PyObject *transient)
 {
     if (value == Py_None)
         return 0;
@@ -34,6 +39,13 @@ intern_string(PyObject *value, PyObject *to_code, PyObject *to_str)
         return (int32_t)PyLong_AsLong(existing);
     if (PyErr_Occurred())
         return -1;
+    if (transient != NULL && transient != Py_None) {
+        existing = PyDict_GetItemWithError(transient, value);
+        if (existing != NULL)
+            return (int32_t)PyLong_AsLong(existing);
+        if (PyErr_Occurred())
+            return -1;
+    }
     Py_ssize_t code = PyList_GET_SIZE(to_str);
     PyObject *code_obj = PyLong_FromSsize_t(code);
     if (code_obj == NULL)
@@ -108,12 +120,15 @@ encode_rows(PyObject *self, PyObject *args)
         }
         if (tc == 's') {
             PyObject *pair = PyTuple_GET_ITEM(tables, acquired);
-            if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2 ||
+            if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) < 2 ||
+                PyTuple_GET_SIZE(pair) > 3 ||
                 !PyDict_Check(PyTuple_GET_ITEM(pair, 0)) ||
-                !PyList_Check(PyTuple_GET_ITEM(pair, 1))) {
+                !PyList_Check(PyTuple_GET_ITEM(pair, 1)) ||
+                (PyTuple_GET_SIZE(pair) == 3 &&
+                 !PyDict_Check(PyTuple_GET_ITEM(pair, 2)))) {
                 PyErr_Format(PyExc_TypeError,
-                             "tables[%zd] must be (dict, list) for a string "
-                             "column", acquired);
+                             "tables[%zd] must be (dict, list[, transient "
+                             "dict]) for a string column", acquired);
                 acquired++;
                 goto done;
             }
@@ -138,7 +153,9 @@ encode_rows(PyObject *self, PyObject *args)
             if (tc == 's') {
                 PyObject *pair = PyTuple_GET_ITEM(tables, c);
                 int32_t code = intern_string(
-                    v, PyTuple_GET_ITEM(pair, 0), PyTuple_GET_ITEM(pair, 1));
+                    v, PyTuple_GET_ITEM(pair, 0), PyTuple_GET_ITEM(pair, 1),
+                    PyTuple_GET_SIZE(pair) == 3 ? PyTuple_GET_ITEM(pair, 2)
+                                                : NULL);
                 if (code < 0 && PyErr_Occurred()) {
                     Py_DECREF(row_fast);
                     goto done;
@@ -241,6 +258,187 @@ fill_ts(PyObject *self, PyObject *args)
     PyBuffer_Release(&buf);
     Py_DECREF(fast);
     return Py_NewRef(Py_None);
+}
+
+/* intern_column(values, out: int32 buffer, to_code: dict, to_str: list,
+ *               transient: dict) — vectorized string interning for one
+ * column (send_columns path); `transient` keeps live uuid codes stable. */
+static PyObject *
+intern_column(PyObject *self, PyObject *args)
+{
+    PyObject *values, *out, *to_code, *to_str, *transient;
+    if (!PyArg_ParseTuple(args, "OOO!O!O!", &values, &out,
+                          &PyDict_Type, &to_code, &PyList_Type, &to_str,
+                          &PyDict_Type, &transient))
+        return NULL;
+    PyObject *fast = PySequence_Fast(values, "values must be a sequence");
+    if (fast == NULL)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    Py_buffer buf;
+    if (PyObject_GetBuffer(out, &buf, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0) {
+        Py_DECREF(fast);
+        return NULL;
+    }
+    if (buf.len < n * (Py_ssize_t)sizeof(int32_t)) {
+        PyErr_SetString(PyExc_ValueError, "intern_column: out buffer too small");
+        PyBuffer_Release(&buf);
+        Py_DECREF(fast);
+        return NULL;
+    }
+    int32_t *data = (int32_t *)buf.buf;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        int32_t code = intern_string(
+            PySequence_Fast_GET_ITEM(fast, i), to_code, to_str, transient);
+        if (code < 0 && PyErr_Occurred()) {
+            PyBuffer_Release(&buf);
+            Py_DECREF(fast);
+            return NULL;
+        }
+        data[i] = code;
+    }
+    PyBuffer_Release(&buf);
+    Py_DECREF(fast);
+    return Py_NewRef(Py_None);
+}
+
+/* map_codes(codes: int32 buffer, to_str: list) -> list[str|None]
+ * — vectorized string-column decode; out-of-range codes map to None (the
+ *   caller pre-screens transient codes and takes the Python path). */
+static PyObject *
+map_codes(PyObject *self, PyObject *args)
+{
+    PyObject *codes, *to_str;
+    if (!PyArg_ParseTuple(args, "OO!", &codes, &PyList_Type, &to_str))
+        return NULL;
+    Py_buffer buf;
+    if (PyObject_GetBuffer(codes, &buf, PyBUF_C_CONTIGUOUS) < 0)
+        return NULL;
+    Py_ssize_t n = buf.len / (Py_ssize_t)sizeof(int32_t);
+    Py_ssize_t table_n = PyList_GET_SIZE(to_str);
+    const int32_t *data = (const int32_t *)buf.buf;
+    PyObject *result = PyList_New(n);
+    if (result == NULL) {
+        PyBuffer_Release(&buf);
+        return NULL;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        int32_t c = data[i];
+        PyObject *v = (c >= 0 && c < table_n) ? PyList_GET_ITEM(to_str, c)
+                                              : Py_None;
+        PyList_SET_ITEM(result, i, Py_NewRef(v));
+    }
+    PyBuffer_Release(&buf);
+    return result;
+}
+
+/* build_events(event_cls, ts: int64 buffer, expired: uint8 buffer,
+ *              cols: tuple[list]) -> list[Event]
+ *
+ * Decode hot loop: allocates Event instances via tp_alloc and fills the
+ * three fields through their (pre-fetched) slot descriptors — bypassing
+ * __init__ cuts per-event cost ~5x, which is the difference between the
+ * public callback path keeping up with the device and not. */
+static PyObject *
+build_events(PyObject *self, PyObject *args)
+{
+    PyObject *cls_obj, *ts_obj, *exp_obj, *cols;
+    if (!PyArg_ParseTuple(args, "OOOO!", &cls_obj, &ts_obj, &exp_obj,
+                          &PyTuple_Type, &cols))
+        return NULL;
+    if (!PyType_Check(cls_obj)) {
+        PyErr_SetString(PyExc_TypeError, "event_cls must be a type");
+        return NULL;
+    }
+    PyTypeObject *cls = (PyTypeObject *)cls_obj;
+    Py_ssize_t n_cols = PyTuple_GET_SIZE(cols);
+
+    Py_buffer ts_buf, exp_buf;
+    if (PyObject_GetBuffer(ts_obj, &ts_buf, PyBUF_C_CONTIGUOUS) < 0)
+        return NULL;
+    if (PyObject_GetBuffer(exp_obj, &exp_buf, PyBUF_C_CONTIGUOUS) < 0) {
+        PyBuffer_Release(&ts_buf);
+        return NULL;
+    }
+    Py_ssize_t n = ts_buf.len / (Py_ssize_t)sizeof(int64_t);
+    PyObject *result = NULL, *d_ts = NULL, *d_data = NULL, *d_exp = NULL;
+    if (exp_buf.len < n) {
+        PyErr_SetString(PyExc_ValueError, "expired buffer shorter than ts");
+        goto fail;
+    }
+    for (Py_ssize_t c = 0; c < n_cols; c++) {
+        PyObject *col = PyTuple_GET_ITEM(cols, c);
+        if (!PyList_Check(col) || PyList_GET_SIZE(col) < n) {
+            PyErr_Format(PyExc_ValueError,
+                         "cols[%zd] must be a list of >= %zd items", c, n);
+            goto fail;
+        }
+    }
+    d_ts = PyObject_GetAttrString(cls_obj, "timestamp");
+    d_data = PyObject_GetAttrString(cls_obj, "data");
+    d_exp = PyObject_GetAttrString(cls_obj, "is_expired");
+    if (!d_ts || !d_data || !d_exp)
+        goto fail;
+    descrsetfunc set_ts = Py_TYPE(d_ts)->tp_descr_set;
+    descrsetfunc set_data = Py_TYPE(d_data)->tp_descr_set;
+    descrsetfunc set_exp = Py_TYPE(d_exp)->tp_descr_set;
+    if (!set_ts || !set_data || !set_exp) {
+        PyErr_SetString(PyExc_TypeError,
+                        "event_cls fields must be slot descriptors");
+        goto fail;
+    }
+    const int64_t *ts_data = (const int64_t *)ts_buf.buf;
+    const uint8_t *exp_data = (const uint8_t *)exp_buf.buf;
+
+    result = PyList_New(n);
+    if (result == NULL)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *data = PyTuple_New(n_cols);
+        if (data == NULL)
+            goto fail_clear;
+        for (Py_ssize_t c = 0; c < n_cols; c++) {
+            PyObject *v = PyList_GET_ITEM(PyTuple_GET_ITEM(cols, c), i);
+            PyTuple_SET_ITEM(data, c, Py_NewRef(v));
+        }
+        PyObject *ev = cls->tp_alloc(cls, 0);
+        if (ev == NULL) {
+            Py_DECREF(data);
+            goto fail_clear;
+        }
+        PyObject *ts_val = PyLong_FromLongLong((long long)ts_data[i]);
+        if (ts_val == NULL ||
+            set_ts(d_ts, ev, ts_val) < 0 ||
+            set_data(d_data, ev, data) < 0 ||
+            set_exp(d_exp, ev, exp_data[i] ? Py_True : Py_False) < 0) {
+            Py_XDECREF(ts_val);
+            Py_DECREF(data);
+            Py_DECREF(ev);
+            goto fail_clear;
+        }
+        Py_DECREF(ts_val);
+        Py_DECREF(data); /* slot holds its own reference */
+        /* untrack from the cyclic GC: events hold only a tuple of scalars /
+         * strings (no cycles possible), and tracking millions of short-lived
+         * objects makes gen-0 collections the decode bottleneck */
+        if (PyObject_GC_IsTracked(data))
+            PyObject_GC_UnTrack(data);
+        if (PyObject_GC_IsTracked(ev))
+            PyObject_GC_UnTrack(ev);
+        PyList_SET_ITEM(result, i, ev);
+    }
+    goto done;
+
+fail_clear:
+    Py_CLEAR(result);
+fail:
+done:
+    Py_XDECREF(d_ts);
+    Py_XDECREF(d_data);
+    Py_XDECREF(d_exp);
+    PyBuffer_Release(&ts_buf);
+    PyBuffer_Release(&exp_buf);
+    return result;
 }
 
 /* ------------------------------------------------------------------------
@@ -405,6 +603,12 @@ static PyMethodDef methods[] = {
      "Encode row tuples into columnar buffers with string interning."},
     {"fill_ts", fill_ts, METH_VARARGS,
      "Fill an int64 timestamp buffer with monotone padding."},
+    {"intern_column", intern_column, METH_VARARGS,
+     "Intern a string column into an int32 code buffer."},
+    {"map_codes", map_codes, METH_VARARGS,
+     "Decode an int32 code buffer through a string table list."},
+    {"build_events", build_events, METH_VARARGS,
+     "Construct a list of Event objects from decoded columns."},
     {"ring_new", ring_new, METH_VARARGS,
      "Create an MPSC staging ring of (ts, row) slots."},
     {"ring_push", ring_push, METH_VARARGS,

@@ -33,7 +33,8 @@ class SiddhiAppRuntime:
     def __init__(self, app: SiddhiApp, registry: Registry,
                  batch_size: int = 0, group_capacity: int = 0,
                  error_store=None, config_manager=None,
-                 mesh=None, partition_capacity: int = 0) -> None:
+                 mesh=None, partition_capacity: int = 0,
+                 async_callbacks: bool = False) -> None:
         self.app = app
         playback_ann = app.annotation("app:playback")
         idle_ms = increment_ms = None
@@ -59,6 +60,7 @@ class SiddhiAppRuntime:
             playback=playback_ann is not None,
         )
         self.ctx.runtime = self
+        self.ctx.async_callbacks = async_callbacks
         self.ctx.error_store = error_store
         self.ctx.config_manager = config_manager
         from .event import StringTable
@@ -280,6 +282,9 @@ class SiddhiAppRuntime:
 
     def start(self) -> None:
         self._started = True
+        if self.ctx.async_callbacks and self.ctx.decoder is None:
+            from .stream import AsyncDecoder
+            self.ctx.decoder = AsyncDecoder()
         for j in self.junctions.values():
             j.start_async()
         for sink in self.sinks:
@@ -296,6 +301,9 @@ class SiddhiAppRuntime:
         self._started = False
         for j in self.junctions.values():
             j.stop_async()
+        if self.ctx.decoder is not None:
+            self.ctx.decoder.stop()
+            self.ctx.decoder = None
         for a in self.aggregations.values():
             if flush_durable:
                 a.flush_durable()  # durable duration tables (restart rebuild)
@@ -426,6 +434,14 @@ class SiddhiAppRuntime:
                 tr.poll(t)
         for j in self.junctions.values():
             j.flush(now)
+
+    def drain(self) -> None:
+        """Flush staged rows AND block until every async callback has fired.
+        The barrier for async_callbacks=True mode (with synchronous
+        callbacks this is equivalent to flush())."""
+        self.flush()
+        if self.ctx.decoder is not None:
+            self.ctx.decoder.drain()
 
     def heartbeat(self, now: Optional[int] = None) -> None:
         """Advance watermarks: flush + deliver empty timer batches to queries

@@ -189,6 +189,14 @@ class SiddhiAppContext:
     #: flush/heartbeat/query serialize device work through this RLock (the
     #: role of the reference's ThreadBarrier + per-query locks)
     controller_lock: object = field(default_factory=threading.RLock)
+    #: async stream-callback decode (create_siddhi_app_runtime(...,
+    #: async_callbacks=True)): device→host readback + Event decode run on a
+    #: dedicated worker so the controller thread never blocks on the
+    #: device→host round trip (~100 ms through a tunneled TPU). Opt-in
+    #: because it changes visible semantics: flush() may return before
+    #: callbacks ran — runtime.drain() is the barrier.
+    async_callbacks: bool = False
+    decoder: object = None
 
     @property
     def effective_batch_size(self) -> int:

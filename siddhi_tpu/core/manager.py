@@ -40,13 +40,15 @@ class SiddhiManager:
         self, app: Union[str, SiddhiApp], *,
         batch_size: int = 0, group_capacity: int = 0,
         mesh=None, partition_capacity: int = 0,
+        async_callbacks: bool = False,
     ) -> SiddhiAppRuntime:
         app = self._parse(app)
         rt = SiddhiAppRuntime(app, self.registry, batch_size=batch_size,
                               group_capacity=group_capacity,
                               error_store=self.error_store,
                               config_manager=self.config_manager,
-                              mesh=mesh, partition_capacity=partition_capacity)
+                              mesh=mesh, partition_capacity=partition_capacity,
+                              async_callbacks=async_callbacks)
         if self.persistence_store is not None:
             rt.persistence_store = self.persistence_store
         self.runtimes[app.name] = rt

@@ -1,0 +1,270 @@
+"""Batched public ingestion: send_batch / send_columns / async callbacks.
+
+Reference parity: InputHandler.java:50 offers send(Event[]) — a batch
+overload of the public ingestion API. Here the batched paths are also the
+performance surface (VERDICT r3 item 1): per-event Python overhead is paid
+once per batch, string interning is vectorized per distinct value, and
+callback decode can run on a background worker (async_callbacks=True).
+Every path must produce byte-identical results to per-row send().
+"""
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+FILTER_APP = """
+define stream TradeStream (symbol string, price double, volume long);
+@info(name = 'q')
+from TradeStream[price > 50.0]
+select symbol, price, volume
+insert into OutStream;
+"""
+
+GROUP_APP = """
+define stream TradeStream (symbol string, price double, volume long);
+@info(name = 'q')
+from TradeStream#window.lengthBatch(8)
+select symbol, sum(price) as total
+group by symbol
+insert into OutStream;
+"""
+
+
+def _rows(n, seed=3):
+    rng = np.random.default_rng(seed)
+    syms = rng.integers(1, 6, n)
+    # prices quantized through float32 so expected-value comparisons are
+    # exact (double columns store float32 on device)
+    ps = rng.uniform(1.0, 100.0, n).astype(np.float32)
+    vs = rng.integers(1, 50, n)
+    return [(f"S{int(k)}", float(p), int(v))
+            for k, p, v in zip(syms, ps, vs)]
+
+
+def _collect(app, feed, *, batch_size=16, **kw):
+    rt = SiddhiManager().create_siddhi_app_runtime(
+        app, batch_size=batch_size, **kw)
+    got = []
+    rt.add_callback("OutStream", lambda evs: got.extend(
+        (e.timestamp, e.data) for e in evs))
+    rt.start()
+    feed(rt)
+    rt.drain()
+    rt.shutdown()
+    return got
+
+
+class TestSendBatch:
+    def test_matches_per_row_send(self):
+        rows = _rows(40)
+
+        def per_row(rt):
+            h = rt.get_input_handler("TradeStream")
+            for i, r in enumerate(rows):
+                h.send(r, timestamp=i + 1)
+            rt.flush()
+
+        def batched(rt):
+            h = rt.get_input_handler("TradeStream")
+            h.send_batch(rows, timestamps=list(range(1, len(rows) + 1)))
+            rt.flush()
+
+        assert _collect(FILTER_APP, per_row) == _collect(FILTER_APP, batched)
+
+    def test_single_timestamp_broadcast(self):
+        rows = _rows(10)
+
+        def batched(rt):
+            rt.get_input_handler("TradeStream").send_batch(rows, timestamps=7)
+            rt.flush()
+
+        got = _collect(FILTER_APP, batched)
+        assert got and all(ts == 7 for ts, _ in got)
+
+    def test_groupby_equivalence(self):
+        rows = _rows(32)
+
+        def per_row(rt):
+            h = rt.get_input_handler("TradeStream")
+            for r in rows:
+                h.send(r, timestamp=5)
+            rt.flush()
+
+        def batched(rt):
+            rt.get_input_handler("TradeStream").send_batch(rows, timestamps=5)
+            rt.flush()
+
+        a, b = _collect(GROUP_APP, per_row), _collect(GROUP_APP, batched)
+        assert a == b and len(a) > 0
+
+    def test_timestamp_arity_mismatch_raises(self):
+        rt = SiddhiManager().create_siddhi_app_runtime(FILTER_APP)
+        h = rt.get_input_handler("TradeStream")
+        with pytest.raises(ValueError, match="timestamps"):
+            h.send_batch(_rows(4), timestamps=[1, 2])
+
+    def test_async_ring_path(self):
+        """@Async stream: send_batch pushes through the native staging ring."""
+        app = FILTER_APP.replace("define stream TradeStream",
+                                 "@async(buffer.size='16')\n"
+                                 "define stream TradeStream")
+        rows = _rows(40)
+
+        def batched(rt):
+            rt.get_input_handler("TradeStream").send_batch(
+                rows, timestamps=list(range(1, len(rows) + 1)))
+            import time
+            time.sleep(0.05)  # let the feeder drain
+            rt.flush()
+
+        got = _collect(app, batched)
+        expect = sorted((i + 1, r) for i, r in enumerate(rows) if r[1] > 50.0)
+        assert sorted(got) == expect
+
+
+class TestSendColumns:
+    def _cols(self, n, seed=3):
+        rows = _rows(n, seed)
+        return {
+            "symbol": np.array([r[0] for r in rows], dtype=object),
+            "price": np.array([r[1] for r in rows]),
+            "volume": np.array([r[2] for r in rows]),
+        }, rows
+
+    def test_matches_row_send(self):
+        cols, rows = self._cols(40)
+        tss = list(range(1, 41))
+
+        def per_row(rt):
+            h = rt.get_input_handler("TradeStream")
+            for i, r in enumerate(rows):
+                h.send(r, timestamp=tss[i])
+            rt.flush()
+
+        def columnar(rt):
+            rt.get_input_handler("TradeStream").send_columns(
+                cols, timestamps=tss)
+            rt.flush()
+
+        assert _collect(FILTER_APP, per_row) == _collect(FILTER_APP, columnar)
+
+    def test_chunking_across_batch_capacity(self):
+        """73 rows through capacity-16 junction: 4 full chunks + padded tail."""
+        cols, rows = self._cols(73)
+
+        def columnar(rt):
+            rt.get_input_handler("TradeStream").send_columns(
+                cols, timestamps=list(range(1, 74)))
+            rt.flush()
+
+        got = _collect(FILTER_APP, columnar, batch_size=16)
+        expect = sorted((i + 1, r) for i, r in enumerate(rows) if r[1] > 50.0)
+        assert sorted(got) == expect
+
+    def test_missing_column_raises(self):
+        rt = SiddhiManager().create_siddhi_app_runtime(FILTER_APP)
+        with pytest.raises(ValueError, match="missing column"):
+            rt.get_input_handler("TradeStream").send_columns(
+                {"symbol": np.array(["A"], dtype=object)})
+
+    def test_groupby_string_interning(self):
+        """Vectorized interning must produce codes consistent with per-row
+        interning (group keys decode back to the right symbols)."""
+        cols, rows = self._cols(32)
+
+        def per_row(rt):
+            h = rt.get_input_handler("TradeStream")
+            for r in rows:
+                h.send(r, timestamp=5)
+            rt.flush()
+
+        def columnar(rt):
+            rt.get_input_handler("TradeStream").send_columns(
+                cols, timestamps=5)
+            rt.flush()
+
+        a = _collect(GROUP_APP, per_row)
+        b = _collect(GROUP_APP, columnar)
+        # vectorized interning assigns codes in sorted-unique order (per-row
+        # assigns first-seen), so groups occupy different key-table slots and
+        # float32 segment sums round differently at ~1e-6 relative — compare
+        # with tolerance, exact on symbols
+        assert len(a) == len(b) > 0
+        for (ta, da), (tb, db) in zip(a, b):
+            assert ta == tb and da[0] == db[0]
+            assert da[1] == pytest.approx(db[1], rel=1e-5)
+
+
+class TestVectorizedInterning:
+    def test_transient_codes_round_trip(self):
+        """A live transient (UUID-ring) string must encode back to its
+        transient code through EVERY encode path — permanent re-interning
+        would break device equality against stored uuid columns and shadow
+        the transient code for later encodes."""
+        from siddhi_tpu.core.event import StringTable
+        tbl = StringTable()
+        t_code = tbl.encode_transient("uuid-abc")
+        assert t_code >= StringTable.TRANSIENT_BASE
+        codes = tbl.encode_array(
+            np.array(["uuid-abc", "plain"], dtype=object))
+        assert codes[0] == t_code
+        assert 0 < codes[1] < StringTable.TRANSIENT_BASE
+        # encode() still sees the transient, not a permanent shadow
+        assert tbl.encode("uuid-abc") == t_code
+
+    def test_ring_detach_does_not_duplicate(self):
+        """send_batch on an @Async stream racing shutdown: rows pushed to
+        the ring before detach must not ALSO be re-staged synchronously."""
+        app = FILTER_APP.replace("define stream TradeStream",
+                                 "@async(buffer.size='8')\n"
+                                 "define stream TradeStream")
+        rows = _rows(64)
+        rt = SiddhiManager().create_siddhi_app_runtime(app, batch_size=8)
+        n = [0]
+        rt.add_callback("OutStream", lambda evs: n.__setitem__(0, n[0] + len(evs)))
+        rt.start()
+        rt.get_input_handler("TradeStream").send_batch(
+            rows, timestamps=list(range(1, 65)))
+        rt.shutdown()  # drains the ring + staged rows exactly once
+        assert n[0] == sum(1 for r in rows if r[1] > 50.0)
+
+
+class TestAsyncCallbacks:
+    def test_results_match_sync(self):
+        rows = _rows(64)
+
+        def feed(rt):
+            rt.get_input_handler("TradeStream").send_batch(
+                rows, timestamps=list(range(1, 65)))
+            rt.flush()
+
+        sync = _collect(FILTER_APP, feed)
+        async_ = _collect(FILTER_APP, feed, async_callbacks=True)
+        assert sync == async_ and len(sync) > 0
+
+    def test_drain_is_barrier(self):
+        rows = _rows(256)
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            FILTER_APP, batch_size=32, async_callbacks=True)
+        n = [0]
+        rt.add_callback("OutStream", lambda evs: n.__setitem__(0, n[0] + len(evs)))
+        rt.start()
+        rt.get_input_handler("TradeStream").send_batch(
+            rows, timestamps=list(range(1, 257)))
+        rt.drain()
+        expect = sum(1 for r in rows if r[1] > 50.0)
+        assert n[0] == expect
+        rt.shutdown()
+
+    def test_shutdown_flushes_decoder(self):
+        rows = _rows(32)
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            FILTER_APP, batch_size=32, async_callbacks=True)
+        n = [0]
+        rt.add_callback("OutStream", lambda evs: n.__setitem__(0, n[0] + len(evs)))
+        rt.start()
+        rt.get_input_handler("TradeStream").send_batch(rows, timestamps=1)
+        rt.flush()
+        rt.shutdown()  # stop() waits for the queue to empty
+        assert n[0] == sum(1 for r in rows if r[1] > 50.0)
