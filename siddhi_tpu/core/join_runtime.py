@@ -175,6 +175,24 @@ class JoinQueryRuntime:
         self.plan_from_right = plan_join(jis.on, self.right.ref, self.left.ref,
                                          self.resolver, registry)
 
+        # --- store-fallback key extraction for cached @store sides ---
+        # (reference: AbstractQueryableRecordTable.java:109,207-238 — the
+        # cache read path falls back to the store on miss). Per table side,
+        # record the simple-attribute equi pairs so on_side_batch can
+        # pre-warm the cache with the batch's keys once the store outgrows it.
+        from ..io.record_table import RecordTableRuntime
+        for t_side, p_side in ((self.left, self.right),
+                               (self.right, self.left)):
+            t_side._fallback_pairs = None
+            if (t_side.is_table and isinstance(t_side.table, RecordTableRuntime)
+                    and t_side.table.cache_policy is not None):
+                pairs = self._simple_equi_pairs(jis.on, p_side, t_side)
+                t_side._fallback_pairs = pairs
+                if pairs:
+                    t_side.table._probe_fallback_ready = True
+                else:
+                    t_side.table._probe_nofallback = True
+
         # --- selector over the pair frames ---
         select_all = [(n, t) for n, t in self.left.attr_types.items()]
         for n, t in self.right.attr_types.items():
@@ -235,6 +253,59 @@ class JoinQueryRuntime:
             for s in (self.left, self.right))
 
     # ------------------------------------------------------------------- plan
+
+    def _simple_equi_pairs(self, on, probe_side, table_side):
+        """(probe_attr, table_attr) pairs from `a.x == T.y` conjuncts —
+        the shapes the host store fallback can key on. Computed-key equi
+        joins (e.g. `f(a.x) == T.y`) get no fallback (documented)."""
+        from ..ops.join import frames_of, split_conjuncts
+        from ..query_api.expression import Compare, CompareOp, Variable
+        pairs = []
+        for conj in split_conjuncts(on):
+            if not (isinstance(conj, Compare) and conj.op == CompareOp.EQUAL):
+                continue
+            l, r = conj.left, conj.right
+            if not (isinstance(l, Variable) and isinstance(r, Variable)):
+                continue
+            lf = frames_of(l, self.resolver)
+            rf = frames_of(r, self.resolver)
+            if lf <= {probe_side.ref} and rf <= {table_side.ref}:
+                pairs.append((l.attribute, r.attribute))
+            elif lf <= {table_side.ref} and rf <= {probe_side.ref}:
+                pairs.append((r.attribute, l.attribute))
+        return pairs or None
+
+    def _maybe_store_fallback(self, build, probe, batch: EventBatch) -> None:
+        """Pre-warm an overflowed probe cache with this batch's join keys
+        (host read-through) so the device probe cannot miss evicted rows.
+        Runs BEFORE the step — outer joins then emit nulls only for true
+        non-matches, and the selector sees one consistent pass."""
+        table = build.table
+        pol = getattr(table, "cache_policy", None)
+        if pol is None or not pol.overflowed:
+            return
+        pairs = build._fallback_pairs
+        if not pairs:
+            return  # non-simple keys: PARITY-documented miss warning applies
+        valid, host = jax.device_get(
+            (batch.valid, {pa: batch.cols[pa] for pa, _ in pairs}))
+        import numpy as np
+        idx = np.nonzero(valid)[0]
+        if idx.size == 0:
+            return
+        key_cols = []
+        for pa, _ta in pairs:
+            arr = host[pa][idx]
+            at = probe.attr_types[pa]
+            if at == AttributeType.STRING:
+                key_cols.append(
+                    probe.codec.string_tables[pa].decode_array(arr.tolist()))
+            elif at == AttributeType.BOOL:
+                key_cols.append(arr.astype(bool).tolist())
+            else:
+                key_cols.append(arr.tolist())
+        table.ensure_cached_for_keys(
+            tuple(ta for _pa, ta in pairs), set(zip(*key_cols)))
 
     def _probe_outer(self, from_left: bool) -> bool:
         if self.join_type == JoinType.FULL_OUTER:
@@ -449,6 +520,8 @@ class JoinQueryRuntime:
                     or (self.trigger == EventTrigger.RIGHT and not from_left))
         step = self._step_left if from_left else self._step_right
         if build.is_table:
+            if getattr(build, "_fallback_pairs", None):
+                self._maybe_store_fallback(build, side, batch)
             tstate = build.table.state
         elif build.is_named_window:
             tstate = build.named_window.state

@@ -132,6 +132,12 @@ class QueryRuntime(Receiver):
         # tables whose `in` conditions carry an index-eligible equality:
         # only these pay the (lazy) sorted-index rebuild per mutated batch
         self._index_tables = _collect_eq_probe_tables(query, self.tables)
+        #: cached @store tables probed by `T.attr == <stream expr>` `in`
+        #: conditions: once the store outgrows the cache, on_batch pre-warms
+        #: the cache with the batch's probe values (store read-through,
+        #: reference AbstractQueryableRecordTable.java:207-238). Populated
+        #: after the resolver exists (see below).
+        self._in_fallbacks: dict = {}
 
         in_stream = query.input_stream
         definition = input_junction.definition
@@ -158,6 +164,13 @@ class QueryRuntime(Receiver):
         for f in self.filters:
             if f.type != AttributeType.BOOL:
                 raise SiddhiAppCreationError("filter must be boolean")
+
+        self._in_fallbacks, in_nofallback = _collect_in_fallbacks(
+            query, self.tables, self.resolver, registry)
+        for tid in self._in_fallbacks:
+            self.tables[tid]._probe_fallback_ready = True
+        for tid in in_nofallback:
+            self.tables[tid]._probe_nofallback = True
 
         # --- stream functions (reference: StreamFunctionProcessor SPI) ---
         # each appends computed columns to the frame; later handlers and the
@@ -224,7 +237,7 @@ class QueryRuntime(Receiver):
         # the removal-capable extrema path (and the grouped-min rejection)
         # applies to it identically
         self.is_sliding_window = wh is not None and type(self.window).__name__ in (
-            "SlidingWindow", "ExpressionWindow")
+            "SlidingWindow", "ExpressionWindow", "GeneralExpressionWindow")
 
         # --- selector ---
         select_all = [(a.name, a.type) for a in definition.attributes
@@ -403,6 +416,39 @@ class QueryRuntime(Receiver):
 
     # -------------------------------------------------------------- runtime
 
+    def _maybe_in_fallback(self, batch: EventBatch, now: int) -> None:
+        """Pre-warm overflowed `in`-probed caches with this batch's probe
+        values (host store read-through before the jitted step) — see
+        RecordTableRuntime.ensure_cached_for_keys."""
+        scope = None
+        for tid, specs in self._in_fallbacks.items():
+            table = self.tables[tid]
+            pol = getattr(table, "cache_policy", None)
+            if pol is None or not pol.overflowed:
+                continue
+            if scope is None:
+                scope = Scope()
+                scope.add_frame(self.frame_ref, batch.cols, batch.ts,
+                                batch.valid, default=True)
+                scope.extras["now"] = jnp.int64(now)
+            for t_attr, sc, stype in specs:
+                try:
+                    vals_dev = sc(scope)
+                except Exception:  # expr needs step-computed columns: skip
+                    continue
+                import numpy as np
+                valid, vals = jax.device_get((batch.valid, vals_dev))
+                sel = np.asarray(vals)[np.nonzero(valid)[0]]
+                if stype == AttributeType.STRING:
+                    keys = table.codec.string_tables[t_attr].decode_array(
+                        sel.tolist())
+                elif stype == AttributeType.BOOL:
+                    keys = sel.astype(bool).tolist()
+                else:
+                    keys = sel.tolist()
+                table.ensure_cached_for_keys((t_attr,),
+                                             {(k,) for k in keys})
+
     def on_batch(self, batch: EventBatch, now: int) -> None:
         t0 = time.perf_counter_ns()
         debugger = getattr(self.ctx, "debugger", None)
@@ -412,6 +458,8 @@ class QueryRuntime(Receiver):
                 debugger.check_break_point(
                     self.name, QueryTerminal.IN,
                     batch.to_host_events(self.codec))
+        if self._in_fallbacks:
+            self._maybe_in_fallback(batch, now)
         tstates = {tid: (self.tables[tid].state,
                          self.tables[tid].probe_indexes()
                          if tid in self._index_tables else {})
@@ -634,6 +682,76 @@ def _collect_eq_probe_tables(query: Query, tables: dict) -> set:
         walk(f)
     walk(query.selector.having)
     return found
+
+
+def _collect_in_fallbacks(query: Query, tables: dict, resolver, registry):
+    """Per cached-@store table id: [(table_attr, compiled_stream_expr, type)]
+    for every `T.attr == <stream expr>` `in` condition — the store-fallback
+    key plans (reference: AbstractQueryableRecordTable.java:207-238).
+    Returns (fallbacks, nofallback_table_ids): the second set lists cached
+    tables probed by at least one `in` condition NO fallback covers (their
+    overflow warning must stay the hard miss warning)."""
+    from ..io.record_table import RecordTableRuntime
+    from ..query_api.expression import Compare, CompareOp, In
+
+    found: dict = {}
+    nofallback: set = set()
+
+    def consider(node: In):
+        t = tables.get(node.source_id)
+        if not (isinstance(t, RecordTableRuntime) and t.cache_policy is not None):
+            return
+        e = node.expression
+        if isinstance(e, Compare) and e.op == CompareOp.EQUAL:
+            for tside, sside in ((e.left, e.right), (e.right, e.left)):
+                if not (isinstance(tside, Variable)
+                        and tside.stream_id == node.source_id):
+                    continue
+                if _references_table_frame(sside, node.source_id):
+                    continue
+                try:
+                    sc = compile_expression(sside, resolver, registry)
+                except SiddhiAppCreationError:
+                    continue
+                found.setdefault(node.source_id, []).append(
+                    (tside.attribute, sc, sc.type))
+                return
+        nofallback.add(node.source_id)
+
+    def walk(node):
+        if node is None or not isinstance(node, Expression):
+            return
+        if isinstance(node, In):
+            consider(node)
+            walk(node.expression)
+            return
+        for attr in ("left", "right", "expression"):
+            sub = getattr(node, attr, None)
+            if isinstance(sub, Expression):
+                walk(sub)
+        for p in getattr(node, "parameters", ()) or ():
+            walk(p)
+
+    for f in query.input_stream.handlers.filters:
+        walk(f)
+    for f in query.input_stream.handlers.post_window_filters:
+        walk(f)
+    for a in query.selector.attributes:
+        walk(a.expression)
+    walk(query.selector.having)
+    return found, nofallback
+
+
+def _references_table_frame(e, frame: str) -> bool:
+    if isinstance(e, Variable):
+        return e.stream_id == frame
+    for attr in ("left", "right", "expression"):
+        sub = getattr(e, attr, None)
+        if isinstance(sub, Expression) and _references_table_frame(sub, frame):
+            return True
+    return any(_references_table_frame(p, frame)
+               for p in getattr(e, "parameters", ()) or ()
+               if isinstance(p, Expression))
 
 
 def _collect_in_sources(query: Query) -> set[str]:

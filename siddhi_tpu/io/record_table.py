@@ -386,6 +386,17 @@ class RecordTableRuntime:
         #: warning when the store outgrows the cache
         self._used_in_probe = False
         self._probe_miss_warned = False
+        #: set by probing runtimes that registered a host read-through
+        #: (ensure_cached_for_keys) — softens the overflow warning from
+        #: "wrong answers" to "slow path"
+        self._probe_fallback_ready = False
+        #: set when ANY probing runtime could NOT register a read-through
+        #: (computed-key / non-equi probes): the hard miss warning must fire
+        #: even if another runtime did register one
+        self._probe_nofallback = False
+        #: keys proven absent from the store — skips repeat store scans in
+        #: the overflow slow path; invalidated by every store write
+        self._absent_probe_keys: set = set()
         if cache_ann is not None:
             copts = {e.key: e.value for e in cache_ann.elements if e.key}
             size = int(copts.get("size", copts.get("max.size", 128)))
@@ -449,19 +460,109 @@ class RecordTableRuntime:
             self.cache_policy.put(self._key(r), r)
         if (self.cache_policy.overflowed and self._used_in_probe
                 and not self._probe_miss_warned):
-            # documented semantics (PARITY.md): in-kernel probes (joins,
-            # `in Table`) read ONLY the device cache; rows the policy
-            # evicted silently miss — the reference's cache-enabled read
-            # path falls back to the store instead
             self._probe_miss_warned = True
             import warnings
-            warnings.warn(
-                f"@store table {self.definition.id!r}: the backing store "
-                f"exceeded @cache(size='{self.cache_policy.size}') and the "
-                "table is probed by joins/`in` — evicted rows will MISS "
-                "those probes; raise the cache size to cover the store",
-                stacklevel=2)
+            if self._probe_fallback_ready and not self._probe_nofallback:
+                # correctness preserved: probing runtimes pre-warm the cache
+                # from the store per batch (ensure_cached_for_keys) — the
+                # reference's cache-miss fallback
+                # (AbstractQueryableRecordTable.java:207-238) — but each
+                # probing batch may now pay a host store read
+                warnings.warn(
+                    f"@store table {self.definition.id!r}: the backing store "
+                    f"exceeded @cache(size='{self.cache_policy.size}') — "
+                    "probes stay correct via per-batch store read-through; "
+                    "raise the cache size to stay on the device fast path",
+                    stacklevel=2)
+            else:
+                # no fallback possible (non-equi / computed-key probe):
+                # evicted rows MISS probes (documented, PARITY.md)
+                warnings.warn(
+                    f"@store table {self.definition.id!r}: the backing store "
+                    f"exceeded @cache(size='{self.cache_policy.size}') and "
+                    "the table is probed without store-fallback-capable "
+                    "equi keys — evicted rows will MISS those probes; raise "
+                    "the cache size to cover the store",
+                    stacklevel=2)
         self._rebuild_cache()
+
+    def ensure_cached_for_keys(self, attr_names: tuple, keys: set) -> bool:
+        """Read-through for in-kernel probes — the TPU shape of the
+        reference's cache-miss store fallback
+        (AbstractQueryableRecordTable.java:109,207-238). A probing runtime
+        calls this BEFORE its jitted step with the batch's distinct join-key
+        tuples (projected on `attr_names`); every store row matching a key
+        that is not cache-resident is loaded into the cache (and the device
+        table rebuilt), so the device probe sees exactly what a store
+        fallback would have returned. Returns True when the device cache
+        changed. Keys proven absent are memoized until the next store write
+        so steady-state probing of absent keys stays scan-free."""
+        if self.cache_policy is None or not keys:
+            return False
+
+        def norm(row):
+            # probe keys arrive round-tripped through DEVICE dtypes (f32
+            # floats); store rows hold full-precision host values — compare
+            # both sides in device space or evicted FLOAT-keyed rows would
+            # never match (and be falsely memoized absent)
+            out = []
+            for a in attr_names:
+                v = row.get(a)
+                dt = self.codec.np_dtypes.get(a)
+                if v is not None and dt is not None and dt.kind == "f":
+                    v = float(dt.type(v))
+                out.append(v)
+            return tuple(out)
+
+        # "key cached => fully cached" only holds when the key tuple
+        # identifies at most ONE store row (primary key subset of the join
+        # attrs); with duplicate-key stores, a cached row must not mask its
+        # evicted siblings — scan for every non-absent probe key instead
+        unique_per_key = bool(self.primary_keys) and \
+            set(self.primary_keys) <= set(attr_names)
+        if unique_per_key:
+            have = {norm(r) for r in self.cache_policy.rows.values()}
+            candidates = keys - have
+        else:
+            candidates = set(keys)
+        # negative memo only for the in-process store: external backends can
+        # gain rows out-of-band, so they re-scan per probing batch (the
+        # reference re-queries the store on every cache miss)
+        memo_ok = type(self.store).__module__.startswith("siddhi_tpu.") and \
+            isinstance(self.store, InMemoryRecordStore)
+        if memo_ok:
+            candidates = {k for k in candidates
+                          if (attr_names, k) not in self._absent_probe_keys}
+        if not candidates:
+            return False
+        match_all = self.compile_condition(None)
+        found = [r for r in self.store.find(match_all)
+                 if norm(r) in candidates]
+        found_keys = {norm(r) for r in found}
+        if memo_ok:
+            for k in candidates - found_keys:
+                self._absent_probe_keys.add((attr_names, k))
+            if len(self._absent_probe_keys) > (1 << 20):  # bounded memo
+                self._absent_probe_keys.clear()
+        if not found:
+            return False
+        if len(found) > self.cache_policy.size:
+            import warnings
+            warnings.warn(
+                f"@store table {self.definition.id!r}: one probing batch "
+                f"needs {len(found)} rows but "
+                f"@cache(size='{self.cache_policy.size}') holds fewer — "
+                "rows evicted mid-warm may still miss; raise the cache size "
+                "above the per-batch distinct-key working set",
+                stacklevel=2)
+        changed = any(self._key(r) not in self.cache_policy.rows
+                      or self.cache_policy.rows[self._key(r)] != r
+                      for r in found)
+        for r in found:
+            self.cache_policy.put(self._key(r), r)
+        if changed:
+            self._rebuild_cache()
+        return changed
 
     def _batch_rows(self, batch) -> list[dict]:
         events = batch.to_host_events(self.codec)
@@ -472,11 +573,13 @@ class RecordTableRuntime:
     def insert_batch(self, batch) -> None:
         rows = self._batch_rows(batch)
         self.store.add(rows)
+        self._absent_probe_keys.clear()
         self._cache_put_rows(rows)
 
     def insert_rows(self, rows, timestamp: int = 0) -> None:
         dicts = [dict(zip(self._attr_names, r)) for r in rows]
         self.store.add(dicts)
+        self._absent_probe_keys.clear()
         self._cache_put_rows(dicts)
 
     def compile_condition(self, expr):
@@ -510,6 +613,7 @@ class RecordTableRuntime:
     def update_where(self, expr, updater) -> int:
         compiled = self.compile_condition(expr)
         n = self.store.update(compiled, updater)
+        self._absent_probe_keys.clear()
         if self.cache_policy is not None:
             if callable(compiled):
                 for k, r in list(self.cache_policy.rows.items()):
@@ -525,6 +629,7 @@ class RecordTableRuntime:
     def update_or_add_where(self, expr, updater, rows) -> int:
         compiled = self.compile_condition(expr)
         n = self.store.update_or_add(compiled, updater, rows)
+        self._absent_probe_keys.clear()
         if self.cache_policy is not None:
             if n and callable(compiled):
                 for k, r in list(self.cache_policy.rows.items()):
