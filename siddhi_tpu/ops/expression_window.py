@@ -203,18 +203,12 @@ class ExpressionWindow(WindowOp):
         dest = jnp.where(p < n_valid32, winlen0 + p, C + B)
         return A.at[dest].set(comp_vals, mode="drop")
 
-    def step(self, state: SlidingState, batch: EventBatch, now: jax.Array):
-        B, E, C = self.B, self.E, self.C
-        comp_mat, n_valid32 = compact_packed(batch, self.layout)
-        n_valid = n_valid32.astype(jnp.int64)
-        comp_cols, comp_ts = _unpack_rows(comp_mat, self.layout)
-        winlen0 = (state.appended - state.expired).astype(jnp.int32)
-
-        # per-arrival expiry frontier s_j (relative to state.expired):
-        # the smallest window start keeping every conjunct true after j
-        p = jnp.arange(B, dtype=jnp.int32)
-        q = winlen0 + p  # arrival j's relative position
-        ring_cols, ring_ts = _unpack_rows(state.ring, self.layout)
+    def _frontiers(self, ring_cols, ring_ts, comp_cols, comp_ts, expired,
+                   winlen0, n_valid32, q):
+        """Per-arrival expiry frontier via binary searches over prefix
+        metrics (the monotone fast path; GeneralExpressionWindow overrides
+        this with the exact sequential pop-loop for arbitrary conditions)."""
+        B, C = self.B, self.C
         s = jnp.zeros((B,), jnp.int32)
         for conj in self.conjuncts:
             if conj.kind == "count":
@@ -225,7 +219,7 @@ class ExpressionWindow(WindowOp):
                 f = q + 1 - jnp.int32(n)
             elif conj.kind == "sum":
                 seq = self._metric_seq(conj, ring_cols, ring_ts, comp_cols,
-                                       comp_ts, state.expired, winlen0,
+                                       comp_ts, expired, winlen0,
                                        n_valid32, 0)
                 # prefix[t] = sum seq[0..t-1]; window [s,q] sum =
                 # prefix[q+1] - prefix[s] REL lim -> smallest s with
@@ -240,7 +234,7 @@ class ExpressionWindow(WindowOp):
                 big = (jnp.iinfo(jnp.int64).max
                        if conj.kind == "ts_span" else jnp.inf)
                 seq = self._metric_seq(conj, ring_cols, ring_ts, comp_cols,
-                                       comp_ts, state.expired, winlen0,
+                                       comp_ts, expired, winlen0,
                                        n_valid32, big)
                 lastv = seq[jnp.clip(q, 0, C + B - 1)]
                 # need seq[s] >= lastv - lim (strict: > lastv - lim)
@@ -248,6 +242,22 @@ class ExpressionWindow(WindowOp):
                 f = searchsorted32(seq, target,
                                    side="right" if conj.strict else "left")
             s = jnp.maximum(s, f)
+        return s
+
+    def step(self, state: SlidingState, batch: EventBatch, now: jax.Array):
+        B, E, C = self.B, self.E, self.C
+        comp_mat, n_valid32 = compact_packed(batch, self.layout)
+        n_valid = n_valid32.astype(jnp.int64)
+        comp_cols, comp_ts = _unpack_rows(comp_mat, self.layout)
+        winlen0 = (state.appended - state.expired).astype(jnp.int32)
+
+        # per-arrival expiry frontier s_j (relative to state.expired):
+        # the smallest window start keeping every conjunct true after j
+        p = jnp.arange(B, dtype=jnp.int32)
+        q = winlen0 + p  # arrival j's relative position
+        ring_cols, ring_ts = _unpack_rows(state.ring, self.layout)
+        s = self._frontiers(ring_cols, ring_ts, comp_cols, comp_ts,
+                            state.expired, winlen0, n_valid32, q)
         # frontiers are cumulative: a later arrival can never re-admit
         # events an earlier one expired
         s = jax.lax.associative_scan(jnp.maximum, s)

@@ -175,36 +175,64 @@ def _make_lossy_frequent(layout, batch_cap, params, expired_on):
 
 
 def _make_expression(layout, batch_cap, params, expired_on):
+    """expression(condition): monotone-suffix conditions take the fully
+    vectorized binary-search path; anything else runs the reference's exact
+    pop-loop sequentially on device (expression_general)."""
+    from .expression_general import GeneralExpressionWindow
     from .expression_window import ExpressionWindow
     if not params or not isinstance(params[0], str):
         raise SiddhiAppCreationError(
             "expression window needs a condition string, e.g. "
             "expression('count() <= 20')")
-    return ExpressionWindow(layout, batch_cap, params[0])
+    try:
+        w = ExpressionWindow(layout, batch_cap, params[0])
+        # the binary-search path is exact only when the metric sequence is
+        # monotone BY CONSTRUCTION: count() and event-timestamp spans
+        # (watermark ordering). sum()/attr-span monotonicity is a data
+        # property — those run the exact sequential path
+        if all(c.kind in ("count", "ts_span") for c in w.conjuncts):
+            return w
+    except SiddhiAppCreationError:
+        pass
+    return GeneralExpressionWindow(layout, batch_cap, params[0])
 
 
 def _make_expression_batch(layout, batch_cap, params, expired_on):
-    """expressionBatch('count() <= N') is exactly lengthBatch(N); other
-    monotone forms segment greedily by running metrics — an inherently
-    sequential recurrence — and are rejected (reference:
-    ExpressionBatchWindowProcessor re-evaluates per event)."""
+    """expressionBatch('count() <= N') is exactly lengthBatch(N); every
+    other condition segments greedily with one device check per arrival
+    (reference: ExpressionBatchWindowProcessor.java:288-347)."""
     from ..compiler import parse_expression
+    from .expression_general import GeneralExpressionBatchWindow
     from .expression_window import plan_expression
     if not params or not isinstance(params[0], str):
         raise SiddhiAppCreationError(
             "expressionBatch window needs a condition string")
-    conjuncts = plan_expression(parse_expression(params[0]), layout)
-    if len(conjuncts) == 1 and conjuncts[0].kind == "count":
+    include_trigger = False
+    if len(params) > 1:
+        if isinstance(params[1], bool):
+            include_trigger = params[1]
+        else:
+            raise SiddhiAppCreationError(
+                "expressionBatch second parameter (includeTriggeringEvent) "
+                "must be a constant bool")
+    if len(params) > 2:
+        raise SiddhiAppCreationError(
+            "expressionBatch stream-input-events mode (3rd parameter) is "
+            "not supported on this engine")
+    try:
+        conjuncts = plan_expression(parse_expression(params[0]), layout)
+    except SiddhiAppCreationError:
+        conjuncts = None
+    if (conjuncts is not None and len(conjuncts) == 1
+            and conjuncts[0].kind == "count" and not include_trigger):
         c = conjuncts[0]
         n = int(c.limit) - (1 if c.strict else 0)
         if n < 1:
             raise SiddhiAppCreationError(
                 "expressionBatch count bound admits no events")
         return LengthBatchWindow(layout, batch_cap, n, expired_on=expired_on)
-    raise SiddhiAppCreationError(
-        "expressionBatch supports only the count() form on this engine "
-        "(greedy batch segmentation by running sums is a sequential "
-        "recurrence); use expression(...) for sliding semantics")
+    return GeneralExpressionBatchWindow(layout, batch_cap, params[0],
+                                        include_trigger=include_trigger)
 
 
 def register_all() -> None:

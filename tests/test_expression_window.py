@@ -67,17 +67,77 @@ class TestExpressionWindow:
         expired = [e[0] for pair in got for e in pair[1]]
         assert expired == ["a", "b"]
 
-    def test_non_monotone_rejected(self):
-        with pytest.raises(SiddhiAppCreationError, match="monotone|bound"):
-            build(S + "@info(name='q') from S"
-                  "#window.expression('count() > 3') "
-                  "select symbol insert into Out;")
+class TestGeneralExpressionWindow:
+    """Arbitrary (non-monotone) conditions: the exact sequential pop-loop
+    (reference: ExpressionWindowProcessor.java:204-234 — append, evaluate
+    over (current, first, last) + running aggregates, pop-from-front while
+    false with `current` rebinding to the popped event)."""
 
-    def test_or_rejected(self):
-        with pytest.raises(SiddhiAppCreationError):
+    def _run(self, condition, events, flush_each=True):
+        rt = build(S + f"@info(name='q') from S#window.expression("
+                   f"'{condition}') "
+                   "select symbol, price insert all events into Out;")
+        got = collect_all(rt)
+        h = rt.get_input_handler("S")
+        for i, (sym, price) in enumerate(events):
+            h.send((sym, price, i), timestamp=i)
+            if flush_each:
+                rt.flush()
+        rt.flush()
+        current = [e[0] for pair in got for e in pair[0]]
+        expired = [e[0] for pair in got for e in pair[1]]
+        return current, expired
+
+    def test_inverted_count_expires_everything(self):
+        # count() > 3 can never become true by adding one event to a window
+        # kept empty: each arrival is popped straight back out
+        current, expired = self._run(
+            "count() > 3", [("a", 1.0), ("b", 2.0), ("c", 3.0)])
+        assert current == ["a", "b", "c"]
+        assert expired == ["a", "b", "c"]
+
+    def test_current_attribute_condition(self):
+        # cheap arrivals purge from the front until a >=10 event pops
+        # (current rebinds to the popped event in the pop loop)
+        current, expired = self._run(
+            "price >= 10.0",
+            [("a", 5.0), ("b", 12.0), ("c", 3.0), ("d", 20.0)])
+        assert current == ["a", "b", "c", "d"]
+        # a pops itself (empty window, still false -> loop ends);
+        # c pops b (12 >= 10 -> stop), then c STAYS; d keeps all
+        assert expired == ["a", "b"]
+
+    def test_or_condition(self):
+        current, expired = self._run(
+            "sum(price) < 10.0 or count() <= 1",
+            [("a", 6.0), ("b", 5.0), ("c", 9.0)])
+        # b: sum 11, count 2 -> pop a -> [b] ok; c: sum 14 -> pop b -> ok
+        assert expired == ["a", "b"]
+
+    def test_avg_condition_empties_window(self):
+        current, expired = self._run(
+            "avg(price) < 5.0", [("a", 4.0), ("b", 8.0), ("c", 2.0)])
+        # b: avg 6 -> pop a (avg 8, false) -> pop b (empty, loop ends);
+        # c: avg 2 ok
+        assert expired == ["a", "b"]
+
+    def test_sum_exact_matches_monotone_shape(self):
+        # the same data as TestExpressionWindow.test_sum_condition — the
+        # general path must agree on monotone-friendly input
+        current, expired = self._run(
+            "sum(price) <= 10.0", [("a", 6.0), ("b", 5.0), ("c", 4.0)],
+            flush_each=False)
+        assert expired == ["a"]
+
+    def test_unsupported_function_rejected(self):
+        with pytest.raises(SiddhiAppCreationError, match="min"):
             build(S + "@info(name='q') from S#window.expression("
-                  "'count() < 3 or sum(price) < 5.0') "
-                  "select symbol insert into Out;")
+                  "'min(price) < 5.0') select symbol insert into Out;")
+
+    def test_string_constant_rejected(self):
+        with pytest.raises(SiddhiAppCreationError, match="string"):
+            build(S + "@info(name='q') from S#window.expression("
+                  "\"symbol == 'IBM'\") select symbol insert into Out;")
 
 
 class TestExpressionBatchWindow:
@@ -93,8 +153,63 @@ class TestExpressionBatchWindow:
         sums = [e[1] for pair in got for e in pair[0]]
         assert sums == [1.0, 3.0, 4.0, 12.0]  # flushes of 2
 
-    def test_non_count_form_rejected(self):
-        with pytest.raises(SiddhiAppCreationError, match="count"):
+    def test_sum_form_segments_greedily(self):
+        """Reference ExpressionBatchWindowProcessor: accumulate while the
+        condition (including the arrival) holds; on break, flush the window
+        and start a new one with the trigger."""
+        rt = build(S + "@info(name='q') from S"
+                   "#window.expressionBatch('sum(price) <= 10.0') "
+                   "select symbol, price insert all events into Out;")
+        got = collect_all(rt)
+        h = rt.get_input_handler("S")
+        for i, (sym, p) in enumerate([("a", 4.0), ("b", 5.0), ("c", 3.0),
+                                      ("d", 8.0), ("e", 1.0)]):
+            h.send((sym, p, i), timestamp=i)
+            rt.flush()
+        # c breaks 4+5+3=12: flush [a,b], window [c]; d breaks 3+8=11:
+        # flush [c] (+ expired [a,b]), window [d]; e accumulates (9 <= 10)
+        current = [e[0] for pair in got for e in pair[0]]
+        expired = [e[0] for pair in got for e in pair[1]]
+        assert current == ["a", "b", "c"]
+        assert expired == ["a", "b"]
+
+    def test_include_triggering_event(self):
+        rt = build(S + "@info(name='q') from S"
+                   "#window.expressionBatch('count() <= 2', true) "
+                   "select symbol insert all events into Out;")
+        got = collect_all(rt)
+        h = rt.get_input_handler("S")
+        for i, sym in enumerate(["a", "b", "c", "d", "e", "f"]):
+            h.send((sym, 1.0, i), timestamp=i)
+            rt.flush()
+        current = [e[0] for pair in got for e in pair[0]]
+        expired = [e[0] for pair in got for e in pair[1]]
+        # count()<=2 with the trigger included: flushes of 3
+        assert current == ["a", "b", "c", "d", "e", "f"]
+        assert expired == ["a", "b", "c"]
+
+    def test_oversized_single_event_passes_through(self):
+        """An arrival that breaks the condition on an EMPTY window flushes
+        itself immediately as [EXPIRED, CURRENT] and leaves no previous
+        batch (reference else-branch, ExpressionBatchWindowProcessor)."""
+        rt = build(S + "@info(name='q') from S"
+                   "#window.expressionBatch('sum(price) <= 10.0') "
+                   "select symbol insert all events into Out;")
+        got = collect_all(rt)
+        h = rt.get_input_handler("S")
+        h.send(("big", 50.0, 0), timestamp=0)
+        rt.flush()
+        h.send(("a", 4.0, 1), timestamp=1)
+        h.send(("b", 9.0, 2), timestamp=2)  # breaks: flush [a], window [b]
+        rt.flush()
+        current = [e[0] for pair in got for e in pair[0]]
+        expired = [e[0] for pair in got for e in pair[1]]
+        assert current == ["big", "a"]
+        # big expires in its own flush; [a]'s flush has no prior batch
+        assert expired == ["big"]
+
+    def test_stream_mode_rejected(self):
+        with pytest.raises(SiddhiAppCreationError, match="3rd parameter"):
             build(S + "@info(name='q') from S"
-                  "#window.expressionBatch('sum(price) <= 10.0') "
+                  "#window.expressionBatch('count() <= 2', false, true) "
                   "select symbol insert into Out;")
