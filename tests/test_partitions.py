@@ -149,3 +149,105 @@ class TestPartitionPersistence:
         rt2.get_input_handler("StockStream").send(("A", 1.0, 1))
         rt2.flush()
         assert [(e.data[0], e.data[1]) for e in got] == [("A", 3)]
+
+
+class TestPartitionedWindowJoins:
+    """Window joins inside a partition block run PER KEY — each key's inner
+    query owns isolated window rings on both sides (reference:
+    GroupingFindableWindowProcessor.java:40 — findable window contents are
+    keyed by the partition flow id). VERDICT r3 item 4."""
+
+    JOIN_APP = (
+        "define stream A (sym string, x int);\n"
+        "define stream B (sym string, y int);\n"
+        "partition with (sym of A, sym of B) begin\n"
+        "@info(name='pj') from A#window.length(5) join B#window.length(5) "
+        "on A.x == B.y "
+        "select A.sym as sym, A.x as x, B.y as y insert into Out;\n"
+        "end;")
+
+    def test_equi_join_is_per_key(self):
+        rt = build(self.JOIN_APP)
+        got = q_callback(rt, "pj")
+        ha, hb = rt.get_input_handler("A"), rt.get_input_handler("B")
+        # same x/y values under DIFFERENT keys must not join
+        ha.send(("k1", 7))
+        ha.send(("k2", 7))
+        rt.flush()
+        hb.send(("k2", 7))
+        rt.flush()
+        assert [tuple(e.data) for e in got] == [("k2", 7, 7)]
+
+    def test_cross_join_windows_isolated(self):
+        app = (
+            "define stream A (sym string, x int);\n"
+            "define stream B (sym string, y int);\n"
+            "partition with (sym of A, sym of B) begin\n"
+            "@info(name='pj') from A#window.length(5) join B#window.length(5) "
+            "on A.x < B.y "
+            "select A.sym as sym, A.x as x, B.y as y insert into Out;\n"
+            "end;")
+        rt = build(app)
+        got = q_callback(rt, "pj")
+        ha, hb = rt.get_input_handler("A"), rt.get_input_handler("B")
+        ha.send(("k1", 1))
+        ha.send(("k2", 10))
+        rt.flush()
+        hb.send(("k1", 5))   # joins k1's window only: 1 < 5
+        hb.send(("k2", 5))   # k2: 10 < 5 fails
+        rt.flush()
+        assert sorted(tuple(e.data) for e in got) == [("k1", 1, 5)]
+
+    def test_broadcast_side_joins_every_key(self):
+        # B is NOT partitioned: its events broadcast into every live key's
+        # inner join (reference PartitionStreamReceiver broadcast path)
+        app = (
+            "define stream A (sym string, x int);\n"
+            "define stream B (y int);\n"
+            "partition with (sym of A) begin\n"
+            "@info(name='pj') from A#window.length(5) join B#window.length(5) "
+            "on A.x == B.y "
+            "select A.sym as sym, B.y as y insert into Out;\n"
+            "end;")
+        rt = build(app)
+        got = q_callback(rt, "pj")
+        ha, hb = rt.get_input_handler("A"), rt.get_input_handler("B")
+        ha.send(("k1", 3))
+        ha.send(("k2", 3))
+        rt.flush()
+        hb.send((3,))
+        rt.flush()
+        assert sorted(tuple(e.data) for e in got) == [("k1", 3), ("k2", 3)]
+
+    def test_per_key_state_survives_snapshot(self):
+        rt = build(self.JOIN_APP)
+        ha, hb = rt.get_input_handler("A"), rt.get_input_handler("B")
+        ha.send(("k1", 42))
+        rt.flush()
+        blob = rt.snapshot()
+        rt2 = build(self.JOIN_APP)
+        rt2.restore(blob)
+        got = q_callback(rt2, "pj")
+        rt2.get_input_handler("B").send(("k1", 42))
+        rt2.flush()
+        assert [tuple(e.data) for e in got] == [("k1", 42, 42)]
+
+    def test_outer_join_per_key(self):
+        app = (
+            "define stream A (sym string, x int);\n"
+            "define stream B (sym string, y int);\n"
+            "partition with (sym of A, sym of B) begin\n"
+            "@info(name='pj') from A#window.length(5) "
+            "left outer join B#window.length(5) on A.x == B.y "
+            "select A.sym as sym, A.x as x, B.y as y insert into Out;\n"
+            "end;")
+        rt = build(app)
+        got = q_callback(rt, "pj")
+        ha, hb = rt.get_input_handler("A"), rt.get_input_handler("B")
+        hb.send(("k1", 8))
+        rt.flush()
+        ha.send(("k1", 8))   # matches k1's B window
+        ha.send(("k2", 8))   # k2 has no B rows: null row (numeric null -> 0)
+        rt.flush()
+        assert sorted(tuple(e.data) for e in got) == [
+            ("k1", 8, 8), ("k2", 8, 0)]
