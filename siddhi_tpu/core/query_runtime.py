@@ -218,11 +218,14 @@ class QueryRuntime(Receiver):
         # must materialize them even for CURRENT-only output. The SAME flag
         # later selects the limiter, so the two decisions cannot diverge.
         from ..query_api.execution import OutputRateType
+        self._selects_aggs = _selects_aggregates(query.selector, registry)
+        # grouped non-aggregated queries snapshot full window contents too
+        # (reference GroupByPerSnapshotOutputRateLimiter emits per-group
+        # event lists — concatenated, that is every window row)
         self._snapshot_full_window = (
             query.output_rate is not None
             and query.output_rate.type == OutputRateType.SNAPSHOT
-            and not query.selector.group_by
-            and not _selects_aggregates(query.selector, registry))
+            and not self._selects_aggs)
         if self._snapshot_full_window:
             expired_on = True
         wh = in_stream.handlers.window
@@ -278,21 +281,33 @@ class QueryRuntime(Receiver):
         out_layout = {n: dtypes.device_dtype(t)
                       for n, t in self.selector.out_types.items()}
         from ..ops.windows import (LengthBatchWindow, SlidingWindow,
-                                   TimeBatchWindow)
+                                   TimeBatchWindow, WindowOp as _WindowOp)
         fifo = isinstance(self.window,
                           (SlidingWindow, LengthBatchWindow, TimeBatchWindow))
+        # non-FIFO windows with a findable surface (sort/session/frequent/
+        # cron/hopping): snapshots read the ring's live set directly
+        findable = type(self.window).contents is not _WindowOp.contents \
+            and not isinstance(self.window, PassThroughWindow)
         self.rate_limiter = make_rate_limiter(
             query.output_rate, out_layout, self.window.chunk_width,
             grouped=bool(query.selector.group_by),
             group_capacity=ctx.effective_group_capacity,
             fifo_window=fifo and self._snapshot_full_window,
-            has_aggregates=not self._snapshot_full_window,
-            window_capacity=getattr(self.window, "C", 0))
-        from ..ops.ratelimit import GroupedSnapshotLimiter
+            has_aggregates=self._selects_aggs,
+            window_capacity=getattr(self.window, "C", 0),
+            contents_window=findable and self._snapshot_full_window)
+        from ..ops.ratelimit import (ContentsSnapshotLimiter,
+                                     GroupedSnapshotLimiter)
         if isinstance(self.rate_limiter, GroupedSnapshotLimiter):
             # the limiter retains one row per group: have the selector ride
             # each lane's group slot on a pseudo-column (set before tracing)
             self.selector.expose_group_slot = True
+        if isinstance(self.rate_limiter, ContentsSnapshotLimiter) and (
+                self.post_window_fns or self.post_filters):
+            raise SiddhiAppCreationError(
+                "`output snapshot` over a non-FIFO window cannot combine "
+                "with post-window functions/filters (snapshots re-project "
+                "the raw window contents); apply them before the window")
 
         # --- the jitted step ---
         self._step = jax.jit(self._make_step(), donate_argnums=(0,))
@@ -408,7 +423,25 @@ class QueryRuntime(Receiver):
                                 eop, args[0](rscope), wstate_pre.expired,
                                 wstate_pre.appended, chunk, args[0](cscope))
             sstate, out = selector.step(sstate, chunk, cscope)
-            rstate, out = limiter.step(rstate, out, now)
+            if getattr(limiter, "needs_window_contents", False):
+                # non-FIFO snapshot: per-arrival output is suppressed; ticks
+                # re-project the window's live contents (post-append state)
+                w_cols, w_ts, w_live = window.contents(wstate, now)
+                s2 = Scope()
+                s2.add_frame(frame_ref, w_cols, w_ts, w_live, default=True)
+                s2.extras["now"] = now
+                proj = {
+                    name: jnp.broadcast_to(
+                        jnp.asarray(ce(s2)), w_ts.shape)
+                    for name, ce in selector.out_exprs}
+                cb = EventBatch(
+                    ts=jnp.broadcast_to(
+                        jnp.asarray(now, dtypes.TS_DTYPE), w_ts.shape),
+                    cols=proj, valid=w_live,
+                    types=jnp.zeros(w_ts.shape, jnp.int8))
+                rstate, out = limiter.step_contents(rstate, cb, now)
+            else:
+                rstate, out = limiter.step(rstate, out, now)
 
             return (wstate, sstate, rstate), out
 

@@ -346,6 +346,48 @@ class WindowedSnapshotLimiter(RateLimiterOp):
         return new_state, emit
 
 
+class ContentsSnapshotState(NamedTuple):
+    bucket: jax.Array  # int64 last observed time bucket
+
+
+class ContentsSnapshotLimiter(RateLimiterOp):
+    """`output snapshot every <t>` on a non-aggregated query over a NON-FIFO
+    window (sort/session/frequent/cron/hopping): per-arrival output is
+    suppressed; each tick re-emits the PROJECTION of the window's live
+    contents, read straight from the ring (the FIFO add/remove tracking of
+    WindowedSnapshotLimiter cannot follow out-of-order expiry, but the
+    window's own findable surface is always exact). Reference:
+    snapshot/WindowedPerSnapshotOutputRateLimiter semantics over any
+    findable window. Snapshot granularity: contents AS OF the watermark
+    that crossed the boundary (batch-granularity, like SnapshotLimiter)."""
+
+    has_time_semantics = True
+    #: the query step must call step_contents with the projected window
+    #: contents instead of step()
+    needs_window_contents = True
+
+    def __init__(self, time_ms: int):
+        self.T = time_ms
+
+    def init_state(self) -> ContentsSnapshotState:
+        return ContentsSnapshotState(bucket=jnp.int64(-1))
+
+    def step(self, state, out, now):  # pragma: no cover — runtime wires
+        raise SiddhiAppCreationError(    # step_contents instead
+            "ContentsSnapshotLimiter needs window contents")
+
+    def step_contents(self, state: ContentsSnapshotState,
+                      contents: EventBatch, now):
+        bucket = now // jnp.int64(self.T)
+        first = state.bucket < 0
+        fire = ~first & (bucket > state.bucket)
+        emit = dataclasses.replace(contents, valid=contents.valid & fire)
+        new_state = ContentsSnapshotState(
+            bucket=jnp.where(first, bucket,
+                             jnp.maximum(state.bucket, bucket)))
+        return new_state, emit
+
+
 class GroupedSnapshotState(NamedTuple):
     rows: dict  # [G] retained last row per group, per column
     present: jax.Array  # bool[G]
@@ -429,26 +471,33 @@ def make_rate_limiter(rate: Optional[OutputRate], layout: dict,
                       group_capacity: int = 1 << 20,
                       fifo_window: bool = False,
                       has_aggregates: bool = False,
-                      window_capacity: int = 0) -> RateLimiterOp:
+                      window_capacity: int = 0,
+                      contents_window: bool = False) -> RateLimiterOp:
     if rate is None:
         return PassThroughLimiter()
     if rate.type == OutputRateType.SNAPSHOT:
         if rate.time_ms is None:
             raise SiddhiAppCreationError(
                 "`output snapshot every ...` needs a time period")
-        if grouped:
-            return GroupedSnapshotLimiter(
-                layout, rate.time_ms, dtypes.config.snapshot_group_capacity,
-                group_capacity)
         if fifo_window and not has_aggregates:
-            # reference WindowedPerSnapshotOutputRateLimiter: re-emit the
-            # FULL window contents each tick. Cap = the window's own
-            # capacity when known (fallback to the config default), but
+            # reference WindowedPerSnapshotOutputRateLimiter (and its
+            # GroupBy sibling — grouped non-aggregated queries snapshot the
+            # same full contents, per-group lists concatenate to all rows):
+            # re-emit the FULL window contents each tick. Cap = the window's
+            # own capacity when known (fallback to the config default), but
             # never below the per-step chunk width — the append slot math
             # wraps at most once, so one step's CURRENT lanes must fit.
             cap = max(window_capacity
                       or dtypes.config.snapshot_window_capacity, out_width)
             return WindowedSnapshotLimiter(layout, rate.time_ms, cap)
+        if contents_window and not has_aggregates:
+            # non-FIFO windows (sort/session/frequent/...): snapshot the
+            # ring's live set via the window's findable surface
+            return ContentsSnapshotLimiter(rate.time_ms)
+        if grouped:
+            return GroupedSnapshotLimiter(
+                layout, rate.time_ms, dtypes.config.snapshot_group_capacity,
+                group_capacity)
         return SnapshotLimiter(layout, rate.time_ms)
     if rate.event_count is not None:
         n = rate.event_count

@@ -285,3 +285,83 @@ class TestWindowedSnapshot:
         rt = build("define table T (sym string, price double);")
         rt.query("select 5.0 as price, 'NEW' as sym insert into T")
         assert rt.tables["T"].all_rows() == [("NEW", 5.0)]
+
+
+class TestNonFifoAndGroupedSnapshots:
+    """VERDICT r3 item 5: full-window snapshots for grouped queries and for
+    non-FIFO windows (reference: snapshot/GroupByPerSnapshotOutputRateLimiter
+    and WindowedPerSnapshotOutputRateLimiter over any findable window)."""
+
+    def test_grouped_non_aggregated_snapshot_emits_window_contents(self):
+        rt = build(S + "@info(name='q') from S#window.length(3) "
+                   "select symbol, price group by symbol "
+                   "output snapshot every 1 sec insert into Out;")
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        for i, (s, p) in enumerate([("a", 1.0), ("b", 2.0), ("a", 3.0),
+                                    ("b", 4.0)]):
+            h.send((s, p), timestamp=100 + i)
+        rt.flush()
+        rt.heartbeat(1_500)
+        # full window contents (last 3 rows), not one retained row per group
+        assert sorted(tuple(e.data) for e in got) == [
+            ("a", 3.0), ("b", 2.0), ("b", 4.0)]
+
+    def test_sort_window_snapshot_shows_live_set(self):
+        rt = build(S + "@info(name='q') from S#window.sort(2, price) "
+                   "select symbol, price "
+                   "output snapshot every 1 sec insert into Out;")
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        h.send(("hi", 5.0), timestamp=100)
+        h.send(("lo", 1.0), timestamp=101)
+        h.send(("mid", 3.0), timestamp=102)
+        rt.flush()
+        rt.heartbeat(1_500)
+        # sort(2, price) keeps the 2 smallest; 5.0 was evicted — a FIFO
+        # tracker would have evicted the OLDEST instead
+        assert sorted(tuple(e.data) for e in got) == [
+            ("lo", 1.0), ("mid", 3.0)]
+        del got[:]
+        rt.heartbeat(2_500)  # repeats while contents unchanged
+        assert sorted(tuple(e.data) for e in got) == [
+            ("lo", 1.0), ("mid", 3.0)]
+
+    def test_frequent_window_snapshot_shows_live_set(self):
+        rt = build(S + "@info(name='q') from S#window.frequent(1, symbol) "
+                   "select symbol, price "
+                   "output snapshot every 1 sec insert into Out;")
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        for i, (s, p) in enumerate([("a", 1.0), ("a", 2.0), ("b", 3.0)]):
+            h.send((s, p), timestamp=100 + i)
+            rt.flush()
+        rt.heartbeat(1_500)
+        # frequent(1): only the dominant symbol's events remain
+        assert all(e.data[0] == "a" for e in got) and got
+
+    def test_session_window_snapshot_tracks_session_expiry(self):
+        rt = build(S + "@info(name='q') from S#window.session(1 sec) "
+                   "select symbol, price "
+                   "output snapshot every 2 sec insert into Out;")
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0), timestamp=100)
+        rt.flush()
+        rt.heartbeat(2_500)  # session closed at ~1100: window empty
+        assert got == []
+
+    def test_grouped_aggregated_snapshot_unchanged(self):
+        # aggregated grouped queries keep per-group retained rows (the
+        # running aggregate IS the reference's per-group snapshot value)
+        rt = build(S + "@info(name='q') from S#window.length(3) "
+                   "select symbol, sum(price) as total group by symbol "
+                   "output snapshot every 1 sec insert into Out;")
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        h.send(("a", 1.0), timestamp=100)
+        h.send(("b", 2.0), timestamp=101)
+        h.send(("a", 3.0), timestamp=102)
+        rt.flush()
+        rt.heartbeat(1_500)
+        assert sorted(tuple(e.data) for e in got) == [("a", 4.0), ("b", 2.0)]
