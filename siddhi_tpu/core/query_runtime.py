@@ -302,12 +302,18 @@ class QueryRuntime(Receiver):
             # the limiter retains one row per group: have the selector ride
             # each lane's group slot on a pseudo-column (set before tracing)
             self.selector.expose_group_slot = True
-        if isinstance(self.rate_limiter, ContentsSnapshotLimiter) and (
-                self.post_window_fns or self.post_filters):
-            raise SiddhiAppCreationError(
-                "`output snapshot` over a non-FIFO window cannot combine "
-                "with post-window functions/filters (snapshots re-project "
-                "the raw window contents); apply them before the window")
+        if isinstance(self.rate_limiter, ContentsSnapshotLimiter):
+            if self.post_window_fns or self.post_filters:
+                raise SiddhiAppCreationError(
+                    "`output snapshot` over a non-FIFO window cannot combine "
+                    "with post-window functions/filters (snapshots re-project "
+                    "the raw window contents); apply them before the window")
+            if query.selector.order_by or query.selector.limit is not None \
+                    or query.selector.offset is not None:
+                raise SiddhiAppCreationError(
+                    "`output snapshot` over a non-FIFO window cannot combine "
+                    "with order by / limit / offset (snapshots re-emit the "
+                    "whole live window set)")
 
         # --- the jitted step ---
         self._step = jax.jit(self._make_step(), donate_argnums=(0,))
@@ -425,7 +431,11 @@ class QueryRuntime(Receiver):
             sstate, out = selector.step(sstate, chunk, cscope)
             if getattr(limiter, "needs_window_contents", False):
                 # non-FIFO snapshot: per-arrival output is suppressed; ticks
-                # re-project the window's live contents (post-append state)
+                # re-project the window's live contents. POST-step state so
+                # time-driven evictions (session close on this watermark)
+                # apply; the limiter then drops rows whose arrival ts is
+                # PAST the fired boundary, so the batch revealing a crossing
+                # cannot leak its later arrivals into that snapshot
                 w_cols, w_ts, w_live = window.contents(wstate, now)
                 s2 = Scope()
                 s2.add_frame(frame_ref, w_cols, w_ts, w_live, default=True)
@@ -434,10 +444,14 @@ class QueryRuntime(Receiver):
                     name: jnp.broadcast_to(
                         jnp.asarray(ce(s2)), w_ts.shape)
                     for name, ce in selector.out_exprs}
-                cb = EventBatch(
-                    ts=jnp.broadcast_to(
-                        jnp.asarray(now, dtypes.TS_DTYPE), w_ts.shape),
-                    cols=proj, valid=w_live,
+                if selector.having is not None:
+                    h2 = Scope()
+                    h2.add_frame(frame_ref, w_cols, w_ts, w_live)
+                    h2.add_frame("__out__", proj, w_ts, w_live, default=True)
+                    h2.extras["now"] = now
+                    w_live = w_live & selector.having(h2)
+                cb = EventBatch(  # ts = ARRIVAL instants (boundary filter)
+                    ts=w_ts, cols=proj, valid=w_live,
                     types=jnp.zeros(w_ts.shape, jnp.int8))
                 rstate, out = limiter.step_contents(rstate, cb, now)
             else:

@@ -378,10 +378,19 @@ class ContentsSnapshotLimiter(RateLimiterOp):
 
     def step_contents(self, state: ContentsSnapshotState,
                       contents: EventBatch, now):
+        """`contents.ts` carries each live row's ARRIVAL instant: rows that
+        arrived past the fired boundary (same-batch late arrivals) are
+        excluded from that boundary's snapshot — exact on arrivals,
+        batch-granular on evictions."""
         bucket = now // jnp.int64(self.T)
         first = state.bucket < 0
         fire = ~first & (bucket > state.bucket)
-        emit = dataclasses.replace(contents, valid=contents.valid & fire)
+        boundary_ts = bucket * jnp.int64(self.T)
+        emit = dataclasses.replace(
+            contents,
+            ts=jnp.broadcast_to(jnp.asarray(now, contents.ts.dtype),
+                                contents.ts.shape),
+            valid=contents.valid & fire & (contents.ts <= boundary_ts))
         new_state = ContentsSnapshotState(
             bucket=jnp.where(first, bucket,
                              jnp.maximum(state.bucket, bucket)))

@@ -365,3 +365,41 @@ class TestNonFifoAndGroupedSnapshots:
         rt.flush()
         rt.heartbeat(1_500)
         assert sorted(tuple(e.data) for e in got) == [("a", 4.0), ("b", 2.0)]
+
+    def test_nonfifo_snapshot_is_pre_batch_at_boundary(self):
+        """The batch that reveals a boundary crossing must not leak its own
+        rows into that boundary's snapshot (SnapshotLimiter semantics)."""
+        rt = build(S + "@info(name='q') from S#window.sort(5, price) "
+                   "select symbol, price "
+                   "output snapshot every 1 sec insert into Out;")
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        h.send(("early", 1.0), timestamp=500)
+        rt.flush()
+        h.send(("late", 2.0), timestamp=1_500)  # crosses the 1000 boundary
+        rt.flush()
+        assert [tuple(e.data) for e in got] == [("early", 1.0)]
+        del got[:]
+        rt.heartbeat(2_500)  # next tick includes both
+        assert sorted(tuple(e.data) for e in got) == [
+            ("early", 1.0), ("late", 2.0)]
+
+    def test_nonfifo_snapshot_honors_having(self):
+        rt = build(S + "@info(name='q') from S#window.sort(5, price) "
+                   "select symbol, price having price > 2.0 "
+                   "output snapshot every 1 sec insert into Out;")
+        got = q_callback(rt)
+        h = rt.get_input_handler("S")
+        h.send(("lo", 1.0), timestamp=100)
+        h.send(("hi", 5.0), timestamp=101)
+        rt.flush()
+        rt.heartbeat(1_500)
+        assert [tuple(e.data) for e in got] == [("hi", 5.0)]
+
+    def test_nonfifo_snapshot_rejects_limit(self):
+        import pytest as _pytest
+        from siddhi_tpu.errors import SiddhiAppCreationError
+        with _pytest.raises(SiddhiAppCreationError, match="limit"):
+            build(S + "@info(name='q') from S#window.sort(5, price) "
+                  "select symbol, price limit 1 "
+                  "output snapshot every 1 sec insert into Out;")
