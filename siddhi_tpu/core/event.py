@@ -397,8 +397,19 @@ class EventBatch:
         then `.tolist()` per column (one C loop producing Python scalars)
         and a single zip-driven Event comprehension — ~10x the per-element
         np scalar indexing it replaces on wide batches."""
-        ts, valid, types, host_cols = jax.device_get(
-            (self.ts, self.valid, self.types, dict(self.cols)))
+        tree = (self.ts, self.valid, self.types, dict(self.cols))
+        if any(getattr(leaf, "is_fully_addressable", True) is False
+               for leaf in jax.tree_util.tree_leaves(tree)):
+            # multi-host: shards of this array live on OTHER processes
+            # (e.g. a shard-merged aggregation find() over a global mesh).
+            # process_allgather is a collective — every process reaches this
+            # decode as part of the same global program (SPMD discipline,
+            # parallel/multihost.py)
+            from jax.experimental import multihost_utils
+            ts, valid, types, host_cols = \
+                multihost_utils.process_allgather(tree, tiled=True)
+        else:
+            ts, valid, types, host_cols = jax.device_get(tree)
         idx = np.nonzero(valid)[0]
         if idx.size == 0:
             return []
