@@ -457,7 +457,7 @@ class AggregationRuntime(Receiver):
                 warnings.warn(
                     f"aggregation {self.definition.id!r} [{dur.value}]: only "
                     f"{int(n_restored)}/{n} durable buckets fit the "
-                    f"{'shard-0 ' if self.mesh is not None else ''}store "
+                    f"{'sharded ' if self.mesh is not None else ''}store "
                     "capacity on rebuild — raise group_capacity",
                     stacklevel=2)
             self._replace_store(d_idx, new_store)
@@ -468,10 +468,9 @@ class AggregationRuntime(Receiver):
         comp_meta = self._comp_meta
         K = self.capacity
         mesh = self.mesh
+        n_shards = self.n_shards
 
-        def restore(store: DurationStore, bts, gcols, comps, n):
-            L = bts.shape[0]
-            valid = jnp.arange(L) < n
+        def restore(store: DurationStore, bts, gcols, comps, valid):
             keyparts = [bts] + [gcols[g] for g in group_attrs]
             key = hash_columns(keyparts)
             kt, ids, kres = key_lookup_or_insert(store.key_table, key, valid)
@@ -488,19 +487,30 @@ class AggregationRuntime(Receiver):
             return DurationStore(kt, new_bucket, new_group,
                                  tuple(new_comps), new_alive), n_ok
 
+        def plain_restore(store, bts, gcols, comps, n):
+            valid = jnp.arange(bts.shape[0]) < n
+            return restore(store, bts, gcols, comps, valid)
+
         if mesh is not None:
-            # restored rows land on shard 0; group-hash re-sharding on next
-            # flush_durable/restart cycle is not load-critical for reads
-            # (merged find() flattens shards)
+            # re-scatter restored rows to their OWNING shard by group hash —
+            # the same ownership rule the sharded ingest uses
+            # (parallel/sharded.shard_owned), so a restored mesh app starts
+            # balanced instead of piling every durable row onto shard 0
             def sharded_restore(store, bts, gcols, comps, n):
-                local = jax.tree_util.tree_map(lambda x: x[0], store)
-                local, n_ok = restore(local, bts, gcols, comps, n)
-                return jax.tree_util.tree_map(
-                    lambda l, s: jnp.concatenate([l[None], s[1:]]),
-                    local, store), n_ok
+                valid = jnp.arange(bts.shape[0]) < n
+                keys = hash_columns([gcols[g] for g in group_attrs])
+                shard_of = keys.astype(jnp.uint32) % jnp.uint32(n_shards)
+
+                def one(local, sidx):
+                    return restore(local, bts, gcols, comps,
+                                   valid & (shard_of == sidx))
+
+                new_store, n_ok = jax.vmap(one, in_axes=(0, 0))(
+                    store, jnp.arange(n_shards, dtype=jnp.uint32))
+                return new_store, jnp.sum(n_ok, dtype=jnp.int32)
 
             return jax.jit(sharded_restore)
-        return jax.jit(restore)
+        return jax.jit(plain_restore)
 
     def _build_steps(self) -> None:
         """(Re)build the jitted ingest/evict for the current capacity —

@@ -532,12 +532,26 @@ class PartitionRuntime:
 
     # --------------------------------------------------------------- snapshot
 
-    def snapshot_states(self):
+    def snapshot_states(self, memo: Optional[dict] = None, prefix: str = ""):
         from ..state.persistence import _to_host
+
+        def fetch(key, state):
+            # identity-memoized device-delta fetch (see SnapshotService):
+            # untouched key instances skip the device readback
+            if memo is None:
+                return _to_host(state)
+            hit = memo.get(key)
+            if hit is not None and hit[0] is state:
+                return hit[1]
+            host = _to_host(state)
+            memo[key] = (state, host)
+            return host
+
         if self._mesh_step is not None:
-            return {"__mesh_states__": _to_host(self._mesh_states),
-                    "__mesh_keys__": _to_host(self._mesh_keys)}
-        return {repr(k): {n: _to_host(s) for n, s in inst.items()}
+            return {"__mesh_states__": fetch(prefix + "ms", self._mesh_states),
+                    "__mesh_keys__": fetch(prefix + "mk", self._mesh_keys)}
+        return {repr(k): {n: fetch(f"{prefix}{k!r}:{n}", s)
+                          for n, s in inst.items()}
                 for k, inst in self.instances.items()}
 
     def restore_states(self, snap) -> None:

@@ -107,3 +107,55 @@ class TestDurableAggregation:
         rt.flush()
         rt.shutdown()  # no durable store: nothing written, no error
         assert DurableStore._tables == {}
+
+
+class TestShardedDurableRebuild:
+    """VERDICT r3 item 7 (second half): durable rebuild on a mesh must
+    RE-SCATTER restored rows by group hash (the sharded ingest's ownership
+    rule), not land everything on shard 0."""
+
+    def setup_method(self):
+        DurableStore._tables = {}
+
+    def _mesh(self, n=8):
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+        return Mesh(np.asarray(jax.devices()[:n]), ("part",))
+
+    def _make(self, mesh):
+        mgr = SiddhiManager()
+        mgr.set_extension("durable", DurableStore)
+        rt = mgr.create_siddhi_app_runtime(
+            APP, batch_size=16, group_capacity=64, mesh=mesh)
+        rt.start()
+        return rt
+
+    def test_rebuild_balances_shards_and_reads_exact(self):
+        import numpy as np
+        rt = self._make(self._mesh())
+        h = rt.get_input_handler("TradeStream")
+        rng = np.random.default_rng(5)
+        for k, p, t in zip(rng.integers(0, 16, 64),
+                           rng.uniform(1, 100, 64),
+                           rng.integers(0, 5000, 64)):
+            h.send((f"S{int(k)}", float(round(p, 2)), int(t)))
+        rt.flush()
+        q = "from TradeAgg within 0, 10000 per 'sec' select symbol, total, n"
+        want = sorted(tuple(e.data) for e in rt.query(q))
+        rt.shutdown()  # flushes durable duration tables
+
+        rt2 = self._make(self._mesh())  # restart: rebuild from durable rows
+        got = sorted(tuple(e.data) for e in rt2.query(q))
+        assert len(got) == len(want) > 0
+        for g, w in zip(got, want):
+            assert g[0] == w[0] and g[2] == w[2]
+            assert g[1] == pytest.approx(w[1], rel=1e-5)
+        # balance: restored rows spread over multiple shards by group hash
+        agg = rt2.aggregations["TradeAgg"]
+        S = agg.n_shards
+        alive = np.asarray(agg.state[0].alive).reshape(S, -1)
+        per_shard = alive.sum(axis=1)
+        assert (per_shard > 0).sum() >= 2, per_shard.tolist()
+        assert per_shard[0] < per_shard.sum(), "all rows on shard 0"
+        rt2.shutdown()
