@@ -581,6 +581,9 @@ class JoinQueryRuntime:
             side._append_fn = jax.jit(fn)
         return side._append_fn(wstate, mmstate, batch, jnp.int64(now))
 
+    def _selector_state(self):
+        return self.state[4]
+
     def _distribute(self, out: EventBatch, now: int) -> None:
         from .query_runtime import QueryRuntime
         QueryRuntime._distribute(self, out, now)

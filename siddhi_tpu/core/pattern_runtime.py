@@ -909,6 +909,9 @@ class PatternQueryRuntime:
         self.state, out = self._heartbeat_step(self.state, empty, jnp.int64(now))
         self._distribute(out, now)
 
+    def _selector_state(self):
+        return self.state.sel_state
+
     def _distribute(self, out: EventBatch, now: int) -> None:
         from .query_runtime import QueryRuntime
         QueryRuntime._distribute(self, out, now)

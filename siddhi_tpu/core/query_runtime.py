@@ -40,7 +40,7 @@ from ..query_api.execution import (
 from ..query_api.expression import Constant, Expression, Variable
 from . import dtypes
 from .context import SiddhiAppContext
-from .event import EventBatch, EventType, StreamCodec
+from .event import Event, EventBatch, EventType, StreamCodec
 from .stream import Receiver, StreamJunction
 
 
@@ -279,7 +279,8 @@ class QueryRuntime(Receiver):
         # --- output rate limiter ---
         from ..ops.ratelimit import make_rate_limiter
         out_layout = {n: dtypes.device_dtype(t)
-                      for n, t in self.selector.out_types.items()}
+                      for n, t in self.selector.out_types.items()
+                      if t != AttributeType.OBJECT}  # host-only slots
         from ..ops.windows import (LengthBatchWindow, SlidingWindow,
                                    TimeBatchWindow, WindowOp as _WindowOp)
         fifo = isinstance(self.window,
@@ -463,6 +464,11 @@ class QueryRuntime(Receiver):
 
     # -------------------------------------------------------------- runtime
 
+    def _selector_state(self):
+        """The selector's slice of this runtime's state tuple (joins keep it
+        at a different index — see JoinQueryRuntime)."""
+        return self.state[1]
+
     def _maybe_in_fallback(self, batch: EventBatch, now: int) -> None:
         """Pre-warm overflowed `in`-probed caches with this batch's probe
         values (host store read-through before the jitted step) — see
@@ -632,17 +638,37 @@ class QueryRuntime(Receiver):
             # outputExpectsExpiredEvents): CURRENT-only queries get no
             # removeEvents regardless of window kind
             events = out.to_host_events(self.output_codec)
+            set_slots = getattr(self.selector, "host_set_slots", None)
+            if set_slots and events:
+                # raw unionSet: materialize the live value set host-side
+                # (reference UnionSetAttributeAggregatorExecutor.java:71 —
+                # every emission carries the SAME accumulating set object;
+                # here each batch's events share one materialized set)
+                names = [a.name for a in self.output_attributes]
+                subs = [(names.index(n),
+                         self.selector.union_set_values(
+                             self._selector_state(), n,
+                             self.ctx.global_strings))
+                        for n in set_slots]
+                for k, e in enumerate(events):
+                    data = list(e.data)
+                    for i, s in subs:
+                        data[i] = s
+                    events[k] = Event(e.timestamp, tuple(data),
+                                      is_expired=e.is_expired)
             if uuid_slots and not forwards and events:
                 # callback-only output: substitute decoded events directly —
                 # no interning, no string-table growth
                 import uuid as _uuid
                 names = [a.name for a in self.output_attributes]
                 idxs = [names.index(s) for s in uuid_slots]
-                for e in events:
+                for k, e in enumerate(events):
                     data = list(e.data)
                     for i in idxs:
                         data[i] = str(_uuid.uuid4())
-                    e.data = tuple(data)
+                    # Event is frozen (GC-untrack safety): rebuild
+                    events[k] = Event(e.timestamp, tuple(data),
+                                      is_expired=e.is_expired)
             in_events = [e for e in events if not e.is_expired] or None
             remove_events = ([e for e in events if e.is_expired] or None
                              if etype != OutputEventType.CURRENT else None)

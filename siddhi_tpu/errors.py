@@ -58,3 +58,9 @@ class CapacityExceededError(SiddhiAppRuntimeError):
     """A fixed-capacity device structure (window ring, NFA slots, key table)
     overflowed. TPU-specific: the reference's unbounded heap structures become
     static-shape device buffers; capacity is configurable per element."""
+
+
+class StaleTransientCodeError(SiddhiAppRuntimeError):
+    """A transient (UUID-ring) string code was decoded after its ring slot
+    recycled: the retained code is older than the ring's capacity allows.
+    Loud by design — silently decoding a NEWER uuid was the alternative."""

@@ -419,7 +419,9 @@ def _make_union_set(arg_types):
     is rewritten to an exact distinctCount at plan time (ops/selector.py
     _rewrite_set_idioms) before this factory would ever run."""
     raise SiddhiAppCreationError(
-        "unionSet() emitting a raw set is not supported on this engine; "
+        "unionSet() inside a larger expression is not supported on this "
+        "engine (raw `select unionSet(x) as s` IS — the set materializes "
+        "host-side at the callback boundary); "
         "use sizeOfSet(unionSet(...)), which compiles to an exact distinct "
         "count on device")
 

@@ -176,10 +176,46 @@ class CompiledSelector:
                 raise SiddhiAppCreationError("select * needs input attribute list")
             from ..query_api.execution import OutputAttribute
             attrs = tuple(OutputAttribute(n, Variable(n)) for n, _ in select_all_attrs)
-        rewritten = [(a.rename,
-                      _rewrite_aggregators(_rewrite_set_idioms(a.expression),
-                                           registry, agg_nodes))
-                     for a in attrs]
+        #: raw-set emission (reference:
+        #: UnionSetAttributeAggregatorExecutor.java:71 returns the live Set
+        #: object): `select unionSet(x) as s` compiles the LIVE-MULTISET
+        #: tracking to an exact distinctCount on device; the query runtime
+        #: materializes the set HOST-SIDE at the callback boundary from the
+        #: per-code pair table. out name -> __agg__ slot (filled below).
+        self.host_set_slots: dict[str, str] = {}
+        pre = []
+        for i, a in enumerate(attrs):
+            e = _rewrite_set_idioms(a.expression)
+            if (isinstance(e, AttributeFunction) and not e.namespace
+                    and e.name == "unionSet" and e.parameters):
+                arg = e.parameters[0]
+                if (isinstance(arg, AttributeFunction) and not arg.namespace
+                        and arg.name == "createSet" and arg.parameters):
+                    arg = arg.parameters[0]
+                if a.rename is None:
+                    raise SiddhiAppCreationError(
+                        "raw unionSet(...) output needs an `as` name")
+                if selector.group_by:
+                    raise SiddhiAppCreationError(
+                        "raw unionSet(...) emission is ungrouped-only on "
+                        "this engine (use sizeOfSet(unionSet(...)) for "
+                        "grouped counts)")
+                if compile_expression(arg, resolver,
+                                      registry).type != AttributeType.STRING:
+                    raise SiddhiAppCreationError(
+                        "raw unionSet(...) emission needs a STRING argument "
+                        "(host materialization reads the dictionary-code "
+                        "table); use sizeOfSet(unionSet(...)) for counts "
+                        "over other types")
+                self.host_set_slots[a.rename] = ""  # agg slot filled below
+                e = AttributeFunction("", "distinctCount", (arg,))
+            pre.append((a.rename, e))
+        rewritten = [(name, _rewrite_aggregators(e, registry, agg_nodes))
+                     for name, e in pre]
+        for name, re_ in rewritten:
+            if name in self.host_set_slots:
+                assert isinstance(re_, Variable)
+                self.host_set_slots[name] = re_.attribute
         #: output slots whose value is generated host-side per event at the
         #: host boundary (UUID — reference UUIDFunctionExecutor); device
         #: lanes carry a placeholder code
@@ -241,6 +277,11 @@ class CompiledSelector:
                     (name, compile_expression(e, self.resolver, registry)))
         self.out_types: dict[str, AttributeType] = {
             name: ce.type for name, ce in self.out_exprs}
+        for name in self.host_set_slots:
+            # the device lane carries the distinct count as a placeholder;
+            # the schema says OBJECT so decode leaves the slot for the
+            # runtime's host-side set substitution
+            self.out_types[name] = AttributeType.OBJECT
 
         # --- group-by key plan ---
         self.group_by = selector.group_by
@@ -287,6 +328,29 @@ class CompiledSelector:
             epoch=jnp.int32(0),
             shared_epoch=jnp.zeros((K,), jnp.int32) if any_fused else None,
         )
+
+    def union_set_values(self, sstate: "SelectorState", out_name: str,
+                         string_table) -> set:
+        """Materialize the LIVE value set behind a raw-unionSet output slot
+        (ungrouped string fast path: per-code pair counts). One batched
+        device fetch; codes decode through the app-global string table."""
+        agg_slot = self.host_set_slots[out_name]
+        off = 0
+        state = None
+        for slot_name, spec, _ in self.agg_specs:
+            if slot_name in self._extrema_slots:
+                continue
+            if slot_name == agg_slot:
+                state = sstate.groups[off]
+                break
+            off += 1 if spec.custom_scan is not None else len(spec.components)
+        assert state is not None, f"no state for set slot {out_name!r}"
+        pair_counts = state[0]  # (pair GroupState[P], distinct GroupState[1])
+        vals, ep, cur = jax.device_get(
+            (pair_counts.values, pair_counts.epoch, sstate.epoch))
+        import numpy as np
+        live = np.nonzero((ep == cur) & (vals > 0))[0]
+        return {string_table.decode(int(c)) for c in live}
 
     # ------------------------------------------------------------------- step
 
