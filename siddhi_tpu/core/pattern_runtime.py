@@ -128,10 +128,14 @@ class _PatternPlan:
             self._add_element(e, ctx)
         if not self.positions:
             raise SiddhiAppCreationError("empty pattern")
-        if self.positions[0].kind == "absent":
+        if self.positions[0].kind == "absent" and self.every:
             raise SiddhiAppCreationError(
-                "absent (`not ... for`) as the first pattern element is not "
-                "yet supported")
+                "`every` with a leading absent (`every not ... for`) is not "
+                "supported in this build; drop `every` or reorder")
+        if self.positions[0].kind == "notand":
+            raise SiddhiAppCreationError(
+                "logical absent (`not X and Y`) as the first pattern element "
+                "is not yet supported")
 
     def _linearize(self, state) -> list:
         if isinstance(state, NextStateElement):
@@ -161,6 +165,36 @@ class _PatternPlan:
                 wait_ms=e.waiting_time_ms))
         elif isinstance(e, LogicalStateElement):
             l, r = e.left, e.right
+            # `not X and Y` (either order): the absence holds until the AND
+            # partner arrives (reference: LogicalAbsentPatternTestCase;
+            # AbsentLogicalPreStateProcessor without a waiting time)
+            absent = next((s for s in (l, r)
+                           if isinstance(s, AbsentStreamStateElement)), None)
+            if absent is not None:
+                partner = r if absent is l else l
+                if not isinstance(partner, StreamStateElement) or \
+                        isinstance(partner, AbsentStreamStateElement):
+                    raise SiddhiAppCreationError(
+                        "logical absent needs exactly one `not` side and one "
+                        "plain stream side")
+                if e.logical_type != "and":
+                    raise SiddhiAppCreationError(
+                        "`not X or Y` is not supported in this build; "
+                        "use `not X and Y` or split the query")
+                if absent.waiting_time_ms is not None:
+                    raise SiddhiAppCreationError(
+                        "timed logical absent (`not X for t and Y`) is not "
+                        "supported in this build; use `X -> not Y for t` "
+                        "shapes or the untimed `not X and Y`")
+                aref = self._ref_of(absent.stream, f"_p{i}a")
+                pref = self._ref_of(partner.stream, f"_p{i}b")
+                self.positions.append(_Position(
+                    i, "notand",
+                    [_Leg(aref, absent.stream.stream_id,
+                          tuple(absent.stream.handlers.filters)),
+                     _Leg(pref, partner.stream.stream_id,
+                          tuple(partner.stream.handlers.filters))]))
+                return
             if not (isinstance(l, StreamStateElement)
                     and isinstance(r, StreamStateElement)):
                 raise SiddhiAppCreationError(
@@ -263,6 +297,9 @@ class PatternState(NamedTuple):
     seq: jax.Array  # int64 global arrival counter
     sel_state: object
     dropped: jax.Array  # int64 lifetime partial matches dropped (table full)
+    #: leading-absent arming instant (runtime build time); -2^62 when the
+    #: pattern does not start with `not ... for`
+    armed0_ts: jax.Array  # int64
 
 
 class PatternQueryRuntime:
@@ -481,12 +518,16 @@ class PatternQueryRuntime:
 
     def _init_state(self) -> PatternState:
         S = len(self.plan.positions)
+        leading_absent = self.plan.positions[0].kind == "absent"
         return PatternState(
             pending=tuple(self._empty_pending(p) for p in range(1, S)),
             active0=jnp.bool_(True),
             seq=jnp.int64(0),
             sel_state=self.selector.init_state(),
             dropped=jnp.int64(0),
+            armed0_ts=jnp.int64(
+                self.ctx.timestamp_generator.current_time()
+                if leading_absent else -(2 ** 62)),
         )
 
     # ------------------------------------------------------------------- step
@@ -593,6 +634,39 @@ class PatternQueryRuntime:
                     pending[pi - 1] = pend
                     continue
 
+                # ---- leading absent: `not S1 for t -> ...` -------------
+                # armed once at runtime build (armed0_ts); a matching
+                # arrival before the deadline kills the arming, the
+                # deadline passing advances an empty-frame entry to
+                # position 1. Granularity: arrivals in the SAME micro-batch
+                # as the elapse may match position 1 regardless of their
+                # intra-batch order (documented batch-granularity).
+                if pos.kind == "absent" and pi == 0:
+                    deadline = state.armed0_ts + jnp.int64(pos.wait_ms)
+                    alive = active0
+                    if junction_sid is not None and (
+                            merged or pos.legs[0].stream_id == junction_sid):
+                        leg0 = pos.legs[0]
+                        km = self._leg_cond(
+                            leg0, self._leg_batch(batch, leg0), None,
+                            now)[:, 0]
+                        alive = alive & ~(km & (batch.ts < deadline)).any()
+                    due = alive & (now >= deadline)
+                    ref = pos.legs[0].ref
+                    ins_valid = jnp.zeros((P,), bool).at[0].set(due)
+                    frames = {ref: {
+                        n: jnp.zeros((P,), dtypes.device_dtype(t))
+                        for n, t in self.ref_types[ref].items()}}
+                    fvalid = {ref: jnp.zeros((P,), bool)}
+                    fts = {ref: jnp.zeros((P,), dtypes.TS_DTYPE)}
+                    self._advance(
+                        pending, out_blocks, 1, frames, fvalid, fts,
+                        jnp.full((P,), deadline),
+                        jnp.full((P,), state.seq - 1),
+                        jnp.full((P,), deadline), ins_valid, drop_acc)
+                    active0 = alive & ~due
+                    continue
+
                 if not feeds:
                     continue
 
@@ -619,6 +693,68 @@ class PatternQueryRuntime:
                     fts = {leg.ref: batch.ts}
                     self._advance(pending, out_blocks, 1, frames, fvalid, fts,
                                   batch.ts, arr_seq, batch.ts, m, drop_acc)
+                    continue
+
+                # ---- logical absent: `not X and Y` ---------------------
+                # the absence holds until the AND partner arrives: an X
+                # earlier than the first qualifying Y kills the entry, a Y
+                # earlier than any X advances it (absent frame rides empty,
+                # reference AbsentLogicalPreStateProcessor without a timer)
+                if pos.kind == "notand":
+                    pend = pending[pi - 1]
+                    Pn = pend.valid.shape[0]
+                    a_leg, p_leg = pos.legs
+                    kseq = jnp.full((Pn,), BIGSEQ)
+                    if merged or a_leg.stream_id == junction_sid:
+                        kq = self._leg_cond(
+                            a_leg, self._leg_batch(batch, a_leg), pend, now)
+                        kq = kq & (arr_seq[:, None] > pend.last_seq[None, :])
+                        kseq = jnp.min(jnp.where(kq, arr_seq[:, None],
+                                                 BIGSEQ), axis=0)
+                    pseq = jnp.full((Pn,), BIGSEQ)
+                    b_star = jnp.zeros((Pn,), jnp.int64)
+                    leg_b = None
+                    if merged or p_leg.stream_id == junction_sid:
+                        leg_b = self._leg_batch(batch, p_leg)
+                        q = self._leg_cond(p_leg, leg_b, pend, now)
+                        q = q & pend.valid[None, :] & (
+                            arr_seq[:, None] > pend.last_seq[None, :])
+                        if within is not None:
+                            q = q & (batch.ts[:, None] - pend.start_ts[None, :]
+                                     <= jnp.int64(within))
+                        qs = jnp.where(q, arr_seq[:, None], BIGSEQ)
+                        b_star = jnp.argmin(qs, axis=0)
+                        pseq = jnp.min(qs, axis=0)
+                    advanced = pend.valid & (pseq < kseq)
+                    killed = pend.valid & (kseq < BIGSEQ) & ~advanced
+                    if leg_b is not None:
+                        cap = {n: v[b_star] for n, v in leg_b.cols.items()}
+                        cap_ts = batch.ts[b_star]
+                        ins_frames = dict(pend.frames)
+                        ins_fvalid = dict(pend.frame_valid)
+                        ins_fts = dict(pend.frame_ts)
+                        ins_frames[p_leg.ref] = cap
+                        ins_fvalid[p_leg.ref] = advanced
+                        ins_fts[p_leg.ref] = cap_ts
+                        ins_frames[a_leg.ref] = {
+                            n: jnp.zeros((Pn,), dtypes.device_dtype(t))
+                            for n, t in self.ref_types[a_leg.ref].items()}
+                        ins_fvalid[a_leg.ref] = jnp.zeros((Pn,), bool)
+                        ins_fts[a_leg.ref] = jnp.zeros((Pn,),
+                                                       dtypes.TS_DTYPE)
+                        pending[pi - 1] = pend._replace(
+                            valid=pend.valid & ~(advanced | killed))
+                        self._advance(
+                            pending, out_blocks, pi + 1,
+                            ins_frames, ins_fvalid, ins_fts,
+                            jnp.where(advanced, pend.start_ts, 0),
+                            jnp.where(advanced,
+                                      jnp.maximum(pseq, pend.last_seq),
+                                      pend.last_seq),
+                            cap_ts, advanced, drop_acc)
+                    else:
+                        pending[pi - 1] = pend._replace(
+                            valid=pend.valid & ~killed)
                     continue
 
                 def _joint_kill(pi=pi, pos=pos):
@@ -753,6 +889,7 @@ class PatternQueryRuntime:
                 seq=state.seq + n_valid,
                 sel_state=new_sel,
                 dropped=state.dropped + drop_acc[0],
+                armed0_ts=state.armed0_ts,
             )
             return new_state, out
 

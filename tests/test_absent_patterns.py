@@ -1,0 +1,208 @@
+"""Absent-pattern matrix (reference: query/pattern/absent/ — 4 test classes,
+AbsentPatternTestCase / EveryAbsentPatternTestCase /
+AbsentWithEveryPatternTestCase / LogicalAbsentPatternTestCase).
+
+Shapes mirrored (reference file:line cited per test): leading/middle/
+trailing `not X for t`, correlated absent filters over earlier captures,
+logical `not A and B` without a timer, and every-variants. VERDICT r3
+item 8 (absent-pattern tranche)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+THREE = ("define stream S1 (symbol string, price float);\n"
+         "define stream S2 (symbol string, price float);\n"
+         "define stream S3 (symbol string, price float);\n")
+
+
+def make(app, batch_size=8):
+    rt = SiddhiManager().create_siddhi_app_runtime(
+        "@app:playback\n" + app, batch_size=batch_size)
+    got = []
+    rt.add_callback("OutStream", lambda evs: got.extend(
+        tuple(e.data) for e in evs))
+    rt.start()
+    return rt, got
+
+
+class TestTrailingAbsent:
+    """`e1 -> not S2 for 1 sec` (AbsentPatternTestCase.java:49-190)."""
+
+    APP = (THREE + "from e1=S1[price>20] -> not S2[price>e1.price] for 1 sec "
+           "select e1.symbol as s insert into OutStream;")
+
+    def test_fires_when_nothing_bigger_arrives(self):
+        rt, got = make(self.APP)
+        rt.get_input_handler("S1").send(("IBM", 25.0), timestamp=1_000)
+        rt.flush()
+        # an S2 BELOW the correlated bound does not kill the absence
+        rt.get_input_handler("S2").send(("LO", 10.0), timestamp=1_400)
+        rt.flush()
+        rt.heartbeat(now=2_500)
+        assert got == [("IBM",)]
+
+    def test_killed_by_correlated_match(self):
+        rt, got = make(self.APP)
+        rt.get_input_handler("S1").send(("IBM", 25.0), timestamp=1_000)
+        rt.flush()
+        rt.get_input_handler("S2").send(("HI", 30.0), timestamp=1_400)
+        rt.flush()
+        rt.heartbeat(now=2_500)
+        assert got == []
+
+    def test_filter_below_threshold_never_arms(self):
+        rt, got = make(self.APP)
+        rt.get_input_handler("S1").send(("IBM", 15.0), timestamp=1_000)
+        rt.flush()
+        rt.heartbeat(now=2_500)
+        assert got == []
+
+    def test_after_chain(self):
+        # e1 -> e2 -> not S3 for 1 sec (AbsentPatternTestCase.java:339-460)
+        app = (THREE +
+               "from e1=S1[price>10] -> e2=S2[price>20] -> "
+               "not S3[price>30] for 1 sec "
+               "select e1.symbol as a, e2.symbol as b insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("A", 15.0), timestamp=1_000)
+        rt.flush()
+        rt.get_input_handler("S2").send(("B", 25.0), timestamp=1_500)
+        rt.flush()
+        rt.heartbeat(now=3_000)
+        assert got == [("A", "B")]
+        # with a killing S3 inside the window instead
+        rt2, got2 = make(app)
+        rt2.get_input_handler("S1").send(("A", 15.0), timestamp=1_000)
+        rt2.flush()
+        rt2.get_input_handler("S2").send(("B", 25.0), timestamp=1_500)
+        rt2.flush()
+        rt2.get_input_handler("S3").send(("C", 35.0), timestamp=2_000)
+        rt2.flush()
+        rt2.heartbeat(now=3_000)
+        assert got2 == []
+
+
+class TestLeadingAbsent:
+    """`not S1 for 1 sec -> e2` (AbsentPatternTestCase.java:193-335)."""
+
+    APP = (THREE + "from not S1[price>20] for 1 sec -> e2=S2[price>30] "
+           "select e2.symbol as s insert into OutStream;")
+
+    def test_fires_after_quiet_period(self):
+        rt, got = make(self.APP)
+        rt.heartbeat(now=1_500)  # quiet 1 sec: absence satisfied
+        rt.get_input_handler("S2").send(("OK", 35.0), timestamp=1_600)
+        rt.flush()
+        assert got == [("OK",)]
+
+    def test_blocked_by_early_event(self):
+        rt, got = make(self.APP)
+        rt.get_input_handler("S1").send(("X", 25.0), timestamp=500)
+        rt.flush()
+        rt.get_input_handler("S2").send(("OK", 35.0), timestamp=1_600)
+        rt.flush()
+        rt.heartbeat(now=3_000)
+        assert got == []
+
+    def test_e2_before_quiet_period_elapses_does_not_match(self):
+        rt, got = make(self.APP)
+        rt.get_input_handler("S2").send(("EARLY", 35.0), timestamp=400)
+        rt.flush()
+        rt.heartbeat(now=3_000)
+        assert got == []
+
+
+class TestMiddleAbsent:
+    """`e1 -> not S2 for 1 sec -> e3` (AbsentPatternTestCase.java:462-580)."""
+
+    APP = (THREE +
+           "from e1=S1[price>10] -> not S2[price>20] for 1 sec -> "
+           "e3=S3[price>30] "
+           "select e1.symbol as a, e3.symbol as c insert into OutStream;")
+
+    def test_fires_when_gap_is_quiet(self):
+        rt, got = make(self.APP)
+        rt.get_input_handler("S1").send(("A", 15.0), timestamp=1_000)
+        rt.flush()
+        rt.heartbeat(now=2_200)  # quiet 1.2 sec
+        rt.get_input_handler("S3").send(("C", 35.0), timestamp=2_300)
+        rt.flush()
+        assert got == [("A", "C")]
+
+    def test_blocked_by_middle_event(self):
+        rt, got = make(self.APP)
+        rt.get_input_handler("S1").send(("A", 15.0), timestamp=1_000)
+        rt.flush()
+        rt.get_input_handler("S2").send(("B", 25.0), timestamp=1_500)
+        rt.flush()
+        rt.heartbeat(now=2_200)
+        rt.get_input_handler("S3").send(("C", 35.0), timestamp=2_300)
+        rt.flush()
+        assert got == []
+
+    def test_e3_too_early_does_not_match(self):
+        rt, got = make(self.APP)
+        rt.get_input_handler("S1").send(("A", 15.0), timestamp=1_000)
+        rt.flush()
+        rt.get_input_handler("S3").send(("C", 35.0), timestamp=1_500)
+        rt.flush()
+        rt.heartbeat(now=3_000)
+        assert got == []
+
+
+class TestLogicalAbsent:
+    """`e1 -> not S2 and e3` — absence valid until the AND partner arrives
+    (LogicalAbsentPatternTestCase.java:56-130)."""
+
+    APP = (THREE +
+           "from e1=S1[price>10] -> not S2[price>20] and e3=S3[price>30] "
+           "select e1.symbol as a, e3.symbol as c insert into OutStream;")
+
+    def test_fires_with_partner_when_quiet(self):
+        rt, got = make(self.APP)
+        rt.get_input_handler("S1").send(("A", 15.0), timestamp=1_000)
+        rt.flush()
+        rt.get_input_handler("S3").send(("C", 35.0), timestamp=1_800)
+        rt.flush()
+        assert got == [("A", "C")]
+
+    def test_blocked_by_absent_stream_event(self):
+        rt, got = make(self.APP)
+        rt.get_input_handler("S1").send(("A", 15.0), timestamp=1_000)
+        rt.flush()
+        rt.get_input_handler("S2").send(("B", 25.0), timestamp=1_400)
+        rt.flush()
+        rt.get_input_handler("S3").send(("C", 35.0), timestamp=1_800)
+        rt.flush()
+        assert got == []
+
+
+class TestEveryAbsent:
+    """every + absent (EveryAbsentPatternTestCase /
+    AbsentWithEveryPatternTestCase): repeated arming, one firing per arm."""
+
+    def test_every_trailing_absent_repeats(self):
+        app = (THREE + "from every e1=S1[price>20] -> not S2 for 1 sec "
+               "select e1.symbol as s insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("A", 25.0), timestamp=1_000)
+        rt.flush()
+        rt.heartbeat(now=2_500)
+        rt.get_input_handler("S1").send(("B", 26.0), timestamp=3_000)
+        rt.flush()
+        rt.heartbeat(now=4_500)
+        assert got == [("A",), ("B",)]
+
+    def test_every_arm_killed_independently(self):
+        app = (THREE + "from every e1=S1[price>20] -> not S2 for 1 sec "
+               "select e1.symbol as s insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("A", 25.0), timestamp=1_000)
+        rt.flush()
+        rt.get_input_handler("S2").send(("K", 1.0), timestamp=1_500)  # kills A
+        rt.flush()
+        rt.get_input_handler("S1").send(("B", 26.0), timestamp=3_000)
+        rt.flush()
+        rt.heartbeat(now=4_500)
+        assert got == [("B",)]

@@ -1,0 +1,230 @@
+"""Table matrix — cache-policy × index × join × set-clause permutations
+(reference: query/table/ block, 44 files: JoinTableTestCase,
+IndexTableTestCase, LogicalTableTestCase, PrimaryKeyTableTestCase,
+set/SetUpdateInMemoryTableTestCase, cache/*; VERDICT r3 item 8)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+S = "define stream S (symbol string, price double, volume long);\n"
+C = "define stream C (symbol string, price double);\n"
+
+
+def build(app, **kw):
+    rt = SiddhiManager().create_siddhi_app_runtime(app, **kw)
+    rt.start()
+    return rt
+
+
+def q_callback(rt, name):
+    got = []
+    rt.add_query_callback(name, lambda ts, i, r: got.extend(
+        tuple(e.data) for e in i or []))
+    return got
+
+
+class TestSetClauseFromStream:
+    """`from S update T set T.x = <stream expr>` (reference:
+    set/SetUpdateInMemoryTableTestCase.java)."""
+
+    def test_set_single_attribute(self):
+        rt = build(S + C +
+                   "define table T (symbol string, price double);\n"
+                   "from S select symbol, price insert into T;\n"
+                   "from C update T set T.price = C.price "
+                   "on T.symbol == C.symbol;")
+        h = rt.get_input_handler("S")
+        h.send(("IBM", 10.0, 1))
+        h.send(("WSO2", 20.0, 1))
+        rt.flush()
+        rt.get_input_handler("C").send(("IBM", 99.0))
+        rt.flush()
+        assert sorted(rt.tables["T"].all_rows()) == [
+            ("IBM", 99.0), ("WSO2", 20.0)]
+
+    def test_set_arithmetic_over_both_frames(self):
+        rt = build(S + C +
+                   "define table T (symbol string, price double);\n"
+                   "from S select symbol, price insert into T;\n"
+                   "from C update T set T.price = T.price + C.price "
+                   "on T.symbol == C.symbol;")
+        rt.get_input_handler("S").send(("IBM", 10.0, 1))
+        rt.flush()
+        # one update per flush: WITHIN a micro-batch updates are last-wins
+        # (documented batch granularity, test_tables.py
+        # test_update_last_event_wins); across batches they compound exactly
+        rt.get_input_handler("C").send(("IBM", 5.0))
+        rt.flush()
+        rt.get_input_handler("C").send(("IBM", 7.0))
+        rt.flush()
+        assert rt.tables["T"].all_rows() == [("IBM", 22.0)]
+
+    def test_update_or_insert_with_set(self):
+        rt = build(C +
+                   "define table T (symbol string, price double);\n"
+                   "from C update or insert into T set T.price = C.price "
+                   "on T.symbol == C.symbol;")
+        h = rt.get_input_handler("C")
+        h.send(("A", 1.0))
+        rt.flush()
+        h.send(("A", 9.0))  # update path
+        h.send(("B", 2.0))  # insert path
+        rt.flush()
+        assert sorted(rt.tables["T"].all_rows()) == [("A", 9.0), ("B", 2.0)]
+
+
+class TestIndexComparisonMatrix:
+    """@Index probes across comparison operators (reference:
+    IndexTableTestCase.java — 63 cases over operator × attr combinations)."""
+
+    APP = (C +
+           "@Index('price')\n"
+           "define table T (symbol string, price double);\n")
+
+    def _table(self, extra_rows=()):
+        rt = build(self.APP +
+                   "define stream Seed (symbol string, price double);\n"
+                   "from Seed select symbol, price insert into T;\n"
+                   "@info(name='j') from C join T on C.price > T.price "
+                   "select C.symbol as probe, T.symbol as hit "
+                   "insert into Out;")
+        h = rt.get_input_handler("Seed")
+        for row in (("p10", 10.0), ("p20", 20.0), ("p30", 30.0)) + tuple(
+                extra_rows):
+            h.send(row)
+        rt.flush()
+        return rt
+
+    def test_range_join_greater_than(self):
+        rt = self._table()
+        got = q_callback(rt, "j")
+        rt.get_input_handler("C").send(("q", 25.0))
+        rt.flush()
+        assert sorted(h for _, h in got) == ["p10", "p20"]
+
+    def test_on_demand_operator_matrix(self):
+        rt = self._table()
+        cases = {
+            "price == 20.0": ["p20"],
+            "price < 20.0": ["p10"],
+            "price <= 20.0": ["p10", "p20"],
+            "price > 20.0": ["p30"],
+            "price >= 20.0": ["p20", "p30"],
+            "price != 20.0": ["p10", "p30"],
+        }
+        for cond, want in cases.items():
+            rows = rt.query(f"from T on {cond} select symbol")
+            assert sorted(r.data[0] for r in rows) == want, cond
+
+
+class TestLogicalTableConditions:
+    """and/or/not conditions against table frames (reference:
+    LogicalTableTestCase.java)."""
+
+    APP = (C +
+           "define table T (symbol string, price double);\n"
+           "define stream Seed (symbol string, price double);\n"
+           "from Seed select symbol, price insert into T;\n")
+
+    def _seed(self, rt):
+        h = rt.get_input_handler("Seed")
+        for row in [("a", 1.0), ("b", 2.0), ("c", 3.0)]:
+            h.send(row)
+        rt.flush()
+
+    def test_delete_with_or(self):
+        rt = build(self.APP + "from C delete T on "
+                   "T.symbol == 'a' or T.price > 2.5;")
+        self._seed(rt)
+        rt.get_input_handler("C").send(("x", 0.0))
+        rt.flush()
+        assert rt.tables["T"].all_rows() == [("b", 2.0)]
+
+    def test_delete_with_and_stream_value(self):
+        rt = build(self.APP + "from C delete T on "
+                   "T.symbol == C.symbol and T.price < C.price;")
+        self._seed(rt)
+        rt.get_input_handler("C").send(("b", 5.0))
+        rt.flush()
+        assert sorted(rt.tables["T"].all_rows()) == [("a", 1.0), ("c", 3.0)]
+
+    def test_update_with_not(self):
+        rt = build(self.APP + "from C update T set T.price = 0.0 on "
+                   "not (T.symbol == C.symbol);")
+        self._seed(rt)
+        rt.get_input_handler("C").send(("b", 5.0))
+        rt.flush()
+        assert sorted(rt.tables["T"].all_rows()) == [
+            ("a", 0.0), ("b", 2.0), ("c", 0.0)]
+
+
+class TestJoinPermutations:
+    """Join sides/windows (reference: JoinTableTestCase.java)."""
+
+    def test_table_on_left_side(self):
+        rt = build(C +
+                   "define table T (symbol string, price double);\n"
+                   "define stream Seed (symbol string, price double);\n"
+                   "from Seed select symbol, price insert into T;\n"
+                   "@info(name='j') from T join C on T.symbol == C.symbol "
+                   "select T.symbol as sym, T.price as tp, C.price as cp "
+                   "insert into Out;")
+        rt.get_input_handler("Seed").send(("IBM", 7.0))
+        rt.flush()
+        got = q_callback(rt, "j")
+        rt.get_input_handler("C").send(("IBM", 8.0))
+        rt.flush()
+        assert got == [("IBM", 7.0, 8.0)]
+
+    def test_windowed_stream_join_table(self):
+        rt = build(C +
+                   "define table T (symbol string, price double);\n"
+                   "define stream Seed (symbol string, price double);\n"
+                   "from Seed select symbol, price insert into T;\n"
+                   "@info(name='j') from C#window.length(2) join T "
+                   "on C.symbol == T.symbol "
+                   "select C.symbol as sym insert into Out;")
+        rt.get_input_handler("Seed").send(("IBM", 7.0))
+        rt.flush()
+        got = q_callback(rt, "j")
+        h = rt.get_input_handler("C")
+        for sym in ("IBM", "x", "y"):  # IBM scrolls out of the window
+            h.send((sym, 1.0))
+            rt.flush()
+        # each arriving batch probes the table; only IBM matches once
+        assert got == [("IBM",)]
+
+
+class TestCachePolicyJoinMatrix:
+    """FIFO/LRU/LFU × join-past-eviction (reference: table/cache/*;
+    FIFO is covered in test_record_table — these close the matrix)."""
+
+    APP = """
+    define stream S (sym string, price double);
+    define stream Q (sym string);
+    @store(type='inMemory')
+    @cache(size='2', policy='{policy}')
+    @PrimaryKey('sym')
+    define table T (sym string, price double);
+    from S select sym, price insert into T;
+    @info(name='j') from Q join T on Q.sym == T.sym
+    select Q.sym as sym, T.price as price insert into Out;
+    """
+
+    @pytest.mark.parametrize("policy", ["LRU", "LFU"])
+    def test_join_correct_past_eviction(self, policy):
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            rt = build(self.APP.format(policy=policy))
+            h = rt.get_input_handler("S")
+            for i, sym in enumerate(["a", "b", "c"]):  # overflow size 2
+                h.send((sym, float(i)))
+                rt.flush()
+            got = q_callback(rt, "j")
+            evicted = next(s for s in ("a", "b", "c")
+                           if (s,) not in rt.tables["T"].cache_policy.rows)
+            rt.get_input_handler("Q").send((evicted,))
+            rt.flush()
+        assert got == [(evicted, float("abc".index(evicted)))]
