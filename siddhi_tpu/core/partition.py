@@ -532,20 +532,13 @@ class PartitionRuntime:
 
     # --------------------------------------------------------------- snapshot
 
-    def snapshot_states(self, memo: Optional[dict] = None, prefix: str = ""):
+    def snapshot_states(self, fetch: Optional[Callable] = None,
+                        prefix: str = ""):
+        """`fetch(key, state)` is SnapshotService's identity-memoized
+        device-delta fetch; standalone callers get a plain host copy."""
         from ..state.persistence import _to_host
-
-        def fetch(key, state):
-            # identity-memoized device-delta fetch (see SnapshotService):
-            # untouched key instances skip the device readback
-            if memo is None:
-                return _to_host(state)
-            hit = memo.get(key)
-            if hit is not None and hit[0] is state:
-                return hit[1]
-            host = _to_host(state)
-            memo[key] = (state, host)
-            return host
+        if fetch is None:
+            fetch = lambda _k, s: _to_host(s)  # noqa: E731
 
         if self._mesh_step is not None:
             return {"__mesh_states__": fetch(prefix + "ms", self._mesh_states),

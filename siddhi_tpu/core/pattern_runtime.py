@@ -136,6 +136,12 @@ class _PatternPlan:
             raise SiddhiAppCreationError(
                 "logical absent (`not X and Y`) as the first pattern element "
                 "is not yet supported")
+        if self.is_sequence and any(p.kind == "notand"
+                                    for p in self.positions):
+            raise SiddhiAppCreationError(
+                "logical absent (`not X and Y`) inside a SEQUENCE is not "
+                "supported (strict contiguity and an open-ended absence "
+                "conflict); use a pattern (`->`) instead")
 
     def _linearize(self, state) -> list:
         if isinstance(state, NextStateElement):
@@ -526,7 +532,8 @@ class PatternQueryRuntime:
             sel_state=self.selector.init_state(),
             dropped=jnp.int64(0),
             armed0_ts=jnp.int64(
-                self.ctx.timestamp_generator.current_time()
+                (-1 if self.ctx.playback
+                 else self.ctx.timestamp_generator.current_time())
                 if leading_absent else -(2 ** 62)),
         )
 
@@ -583,6 +590,7 @@ class PatternQueryRuntime:
             # collected outputs: one block per completion source
             out_blocks = []  # (frames {ref: cols}, fvalid {ref}, fts, ts, valid)
             drop_acc = [jnp.int64(0)]  # pending-table insert overflow
+            armed0_out = [state.armed0_ts]  # leading-absent lazy arming
 
             def expire(pend: PendingTable) -> PendingTable:
                 if within is None:
@@ -642,7 +650,18 @@ class PatternQueryRuntime:
                 # as the elapse may match position 1 regardless of their
                 # intra-batch order (documented batch-granularity).
                 if pos.kind == "absent" and pi == 0:
-                    deadline = state.armed0_ts + jnp.int64(pos.wait_ms)
+                    # playback (virtual time) arms LAZILY at the first
+                    # observed instant — epoch-timestamp replays must not
+                    # measure the quiet period from virtual 0 (which would
+                    # both fire spuriously and disarm the kill); realtime
+                    # arms at runtime build (reference: query start)
+                    first_ts = jnp.min(jnp.where(
+                        batch.valid, batch.ts, jnp.int64(2 ** 62)))
+                    armed0 = jnp.where(
+                        state.armed0_ts >= 0, state.armed0_ts,
+                        jnp.minimum(first_ts, now))
+                    armed0_out[0] = armed0
+                    deadline = armed0 + jnp.int64(pos.wait_ms)
                     alive = active0
                     if junction_sid is not None and (
                             merged or pos.legs[0].stream_id == junction_sid):
@@ -889,7 +908,7 @@ class PatternQueryRuntime:
                 seq=state.seq + n_valid,
                 sel_state=new_sel,
                 dropped=state.dropped + drop_acc[0],
-                armed0_ts=state.armed0_ts,
+                armed0_ts=armed0_out[0],
             )
             return new_state, out
 

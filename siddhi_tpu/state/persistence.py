@@ -261,38 +261,44 @@ class SnapshotService:
         #: granularity).
         self._memo: dict = {}
 
-    def _fetch(self, key: str, state):
-        hit = self._memo.get(key)
-        if hit is not None and hit[0] is state:
-            return hit[1]
-        host = _to_host(state)
-        self._memo[key] = (state, host)
-        return host
-
     def full_snapshot(self) -> bytes:
         rt = self.rt
         rt.flush()  # drain staged rows so the snapshot is a clean cut
+        # entries untouched by THIS pass (e.g. @purge-removed partition
+        # instances) drop with the memo swap — no per-key host leak
+        new_memo: dict = {}
+
+        def fetch(key: str, state):
+            hit = self._memo.get(key)
+            if hit is not None and hit[0] is state:
+                new_memo[key] = hit
+                return hit[1]
+            host = _to_host(state)
+            new_memo[key] = (state, host)
+            return host
+
         snap = {
             "app": rt.app.name,
-            "queries": {name: self._fetch(f"q:{name}", qr.state)
+            "queries": {name: fetch(f"q:{name}", qr.state)
                         for name, qr in rt.query_runtimes.items()
                         if not getattr(qr, "_partitioned", False)},
             # record (@store) tables are external authorities: their rows
             # live in the store, not in device state — skip them (the cache
             # rebuilds from the store/policy on use)
-            "tables": {tid: self._fetch(f"t:{tid}", t.state)
+            "tables": {tid: fetch(f"t:{tid}", t.state)
                        for tid, t in rt.tables.items()
                        if not hasattr(t, "store")},
-            "windows": {wid: self._fetch(f"w:{wid}", w.state)
+            "windows": {wid: fetch(f"w:{wid}", w.state)
                         for wid, w in getattr(rt, "windows", {}).items()},
-            "aggregations": {aid: self._fetch(f"a:{aid}", a.state)
+            "aggregations": {aid: fetch(f"a:{aid}", a.state)
                              for aid, a in getattr(rt, "aggregations", {}).items()},
-            "partitions": {pname: p.snapshot_states(memo=self._memo,
+            "partitions": {pname: p.snapshot_states(fetch=fetch,
                                                     prefix=f"p:{pname}:")
                            for pname, p in getattr(rt, "partitions", {}).items()},
             "strings": rt.ctx.global_strings.snapshot(),
             "last_event_ts": rt.ctx.timestamp_generator._last_event_ts,
         }
+        self._memo = new_memo
         return pickle.dumps(snap, protocol=pickle.HIGHEST_PROTOCOL)
 
     def restore(self, blob: bytes) -> None:

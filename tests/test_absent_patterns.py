@@ -91,10 +91,29 @@ class TestLeadingAbsent:
 
     def test_fires_after_quiet_period(self):
         rt, got = make(self.APP)
-        rt.heartbeat(now=1_500)  # quiet 1 sec: absence satisfied
+        # playback arms the leading absent LAZILY at the first observed
+        # instant (epoch replays must not measure from virtual 0): anchor
+        # the virtual clock, then stay quiet past the waiting time
+        rt.heartbeat(now=100)
+        rt.heartbeat(now=1_500)  # quiet 1 sec from the anchor
         rt.get_input_handler("S2").send(("OK", 35.0), timestamp=1_600)
         rt.flush()
         assert got == [("OK",)]
+
+    def test_playback_epoch_replay_does_not_fire_spuriously(self):
+        # first observed instant is an epoch timestamp with a killing S1 in
+        # the same batch: the arming anchors THERE, so the kill applies and
+        # nothing fires (regression: arming at virtual 0 made the deadline
+        # trivially past and the kill window empty)
+        epoch = 1_700_000_000_000
+        rt, got = make(self.APP)
+        rt.get_input_handler("S1").send(("X", 25.0), timestamp=epoch + 100)
+        rt.flush()
+        rt.get_input_handler("S2").send(("OK", 35.0),
+                                        timestamp=epoch + 1_600)
+        rt.flush()
+        rt.heartbeat(now=epoch + 3_000)
+        assert got == []
 
     def test_blocked_by_early_event(self):
         rt, got = make(self.APP)
