@@ -82,8 +82,9 @@ class SiddhiService:
         with self.lock:
             rt = self.manager.runtimes[app]
             handler = rt.get_input_handler(stream)
-            for row in events:
-                handler.send(tuple(row))
+            # one batched staging call for the whole payload (the REST body
+            # is already a batch) — the engine's fast public path
+            handler.send_batch([tuple(row) for row in events])
             rt.flush()
             return len(events)
 
