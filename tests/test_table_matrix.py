@@ -228,3 +228,85 @@ class TestCachePolicyJoinMatrix:
             rt.get_input_handler("Q").send((evicted,))
             rt.flush()
         assert got == [(evicted, float("abc".index(evicted)))]
+
+
+class TestPrimaryKeyTable:
+    """@PrimaryKey × insert/join/update/delete/in (reference:
+    PrimaryKeyTableTestCase.java — 76 cases; representative matrix here)."""
+
+    BASE = (
+        "define stream StockStream (symbol string, price double, volume long);\n"
+        "define stream CheckStockStream (symbol string, volume long);\n"
+        "define stream UpdateStockStream "
+        "(symbol string, price double, volume long);\n"
+        "@PrimaryKey('symbol')\n"
+        "define table StockTable (symbol string, price double, volume long);\n"
+        "from StockStream insert into StockTable;\n")
+
+    def test_pk_join_returns_latest_row(self):
+        # primaryKeyTableTest1 shape: join on the PK attr
+        rt = build(self.BASE +
+                   "@info(name='q2') from CheckStockStream join StockTable "
+                   "on CheckStockStream.symbol == StockTable.symbol "
+                   "select CheckStockStream.symbol as symbol, "
+                   "StockTable.volume as volume insert into OutStream;")
+        h = rt.get_input_handler("StockStream")
+        h.send(("WSO2", 55.6, 100))
+        h.send(("IBM", 75.6, 10))
+        rt.flush()
+        got = q_callback(rt, "q2")
+        rt.get_input_handler("CheckStockStream").send(("IBM", 0))
+        rt.flush()
+        assert got == [("IBM", 10)]
+
+    def test_pk_duplicate_insert_dropped_and_counted(self):
+        # duplicate-PK inserts are DROPPED (first row wins) and counted —
+        # the reference rejects primary-key violations rather than replace;
+        # update-or-insert is the replace path
+        rt = build(self.BASE)
+        h = rt.get_input_handler("StockStream")
+        h.send(("IBM", 10.0, 1))
+        rt.flush()
+        h.send(("IBM", 20.0, 2))
+        rt.flush()
+        assert rt.tables["StockTable"].all_rows() == [("IBM", 10.0, 1)]
+        assert rt.tables["StockTable"].dropped_duplicates == 1
+
+    def test_pk_update_via_stream(self):
+        rt = build(self.BASE +
+                   "from UpdateStockStream update StockTable "
+                   "set StockTable.price = UpdateStockStream.price, "
+                   "StockTable.volume = UpdateStockStream.volume "
+                   "on StockTable.symbol == UpdateStockStream.symbol;")
+        rt.get_input_handler("StockStream").send(("IBM", 10.0, 1))
+        rt.flush()
+        rt.get_input_handler("UpdateStockStream").send(("IBM", 99.0, 9))
+        rt.flush()
+        assert rt.tables["StockTable"].all_rows() == [("IBM", 99.0, 9)]
+
+    def test_pk_membership_probe(self):
+        rt = build(self.BASE +
+                   "@info(name='chk') from CheckStockStream"
+                   "[CheckStockStream.symbol == StockTable.symbol "
+                   "in StockTable] "
+                   "select symbol insert into OutStream;")
+        rt.get_input_handler("StockStream").send(("IBM", 10.0, 1))
+        rt.flush()
+        got = q_callback(rt, "chk")
+        c = rt.get_input_handler("CheckStockStream")
+        c.send(("IBM", 0))
+        c.send(("MSFT", 0))
+        rt.flush()
+        assert got == [("IBM",)]
+
+    def test_pk_delete_via_stream(self):
+        rt = build(self.BASE +
+                   "from CheckStockStream delete StockTable "
+                   "on StockTable.symbol == CheckStockStream.symbol;")
+        h = rt.get_input_handler("StockStream")
+        h.send(("IBM", 10.0, 1))
+        h.send(("WSO2", 20.0, 2))
+        rt.flush()
+        rt.get_input_handler("CheckStockStream").send(("IBM", 0))
+        rt.flush()
+        assert rt.tables["StockTable"].all_rows() == [("WSO2", 20.0, 2)]
