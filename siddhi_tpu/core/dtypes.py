@@ -61,6 +61,10 @@ class _Config:
     session_key_capacity = 4096
     #: expansion bound for unbounded pattern counts `<m:>`.
     pattern_unbounded_count_extra = 8
+    #: mid-pattern `every` (sticky positions): qualifying arrivals advanced
+    #: per entry per BATCH (leftover counts into `dropped`; cross-batch
+    #: repetition is unbounded/exact)
+    pattern_sticky_passes = 4
     #: HyperLogLog registers per group for hll:distinctCount (power of two;
     #: std error ~1.04/sqrt(m))
     hll_registers = 1024

@@ -91,6 +91,9 @@ class _Position:
     logical_op: Optional[str] = None  # 'and' | 'or'
     wait_ms: Optional[int] = None  # absent
     optional: bool = False  # count occurrences beyond min_count
+    #: mid-pattern `every` (`A -> every B`): matches advance a COPY and the
+    #: entry stays armed (reference: EveryInnerStateRuntime re-arming)
+    sticky: bool = False
 
     @property
     def ref(self) -> str:
@@ -123,8 +126,19 @@ class _PatternPlan:
             chain = self._linearize(inner) + chain[1:]
         for e in chain:
             if isinstance(e, EveryStateElement):
-                raise SiddhiAppCreationError(
-                    "`every` is only supported on the first pattern element")
+                # mid-pattern every: `A -> every B` — the B position becomes
+                # STICKY (matches advance a copy, the entry stays armed)
+                inner_list = self._linearize(_unwrap_chain(e.state))
+                if (len(inner_list) != 1
+                        or not isinstance(inner_list[0], StreamStateElement)):
+                    raise SiddhiAppCreationError(
+                        "mid-pattern `every` supports a single plain stream "
+                        "element (`A -> every B`); grouped (`every (B->C)`) "
+                        "and absent (`every not B`) forms are not supported "
+                        "in this build")
+                self._add_element(inner_list[0], ctx)
+                self.positions[-1].sticky = True
+                continue
             self._add_element(e, ctx)
         if not self.positions:
             raise SiddhiAppCreationError("empty pattern")
@@ -142,6 +156,14 @@ class _PatternPlan:
                 "logical absent (`not X and Y`) inside a SEQUENCE is not "
                 "supported (strict contiguity and an open-ended absence "
                 "conflict); use a pattern (`->`) instead")
+        if self.is_sequence and any(p.sticky for p in self.positions):
+            raise SiddhiAppCreationError(
+                "mid-sequence `every` is not supported (strict contiguity "
+                "and re-arming conflict); use a pattern (`->`) instead")
+        if self.positions[0].sticky:
+            raise SiddhiAppCreationError(
+                "`every` on the first element is the head form — write "
+                "`from every e1=... -> ...`")
 
     def _linearize(self, state) -> list:
         if isinstance(state, NextStateElement):
@@ -302,7 +324,10 @@ class PatternState(NamedTuple):
     active0: jax.Array  # bool — start state armed (non-every consumes it)
     seq: jax.Array  # int64 global arrival counter
     sel_state: object
-    dropped: jax.Array  # int64 lifetime partial matches dropped (table full)
+    #: int64 lifetime partial matches dropped: pending-table overflow
+    #: (raise config.pattern_pending_capacity) AND sticky-position same-batch
+    #: matches past config.pattern_sticky_passes
+    dropped: jax.Array
     #: leading-absent arming instant (runtime build time); -2^62 when the
     #: pattern does not start with `not ... for`
     armed0_ts: jax.Array  # int64
@@ -807,6 +832,12 @@ class PatternQueryRuntime:
                     # first leg matched — which may happen later in THIS
                     # batch when arrivals came in the opposite leg order
                     leg_iters = leg_iters * 2
+                if pos.sticky:
+                    # sticky (mid-pattern every): each pass advances one
+                    # more qualifying arrival per entry; arrivals beyond
+                    # the pass bound in ONE batch are counted into
+                    # `dropped` (monitored; cross-batch repetition is exact)
+                    leg_iters = leg_iters * dtypes.config.pattern_sticky_passes
                 for li, leg in leg_iters:
                     if is_seq and pos.kind == "logical":
                         _joint_kill()
@@ -888,8 +919,18 @@ class PatternQueryRuntime:
                         ins_fts[leg.ref] = cap_ts
                         adv_valid = matched
                         comp_ts = cap_ts
-                        pending[pi - 1] = pend._replace(
-                            valid=pend.valid & ~matched)
+                        if pos.sticky:
+                            # the entry stays armed; bumping last_seq lets
+                            # the next pass advance the NEXT arrival
+                            pending[pi - 1] = pend._replace(
+                                last_seq=jnp.where(
+                                    matched,
+                                    jnp.maximum(arr_seq[b_star],
+                                                pend.last_seq),
+                                    pend.last_seq))
+                        else:
+                            pending[pi - 1] = pend._replace(
+                                valid=pend.valid & ~matched)
 
                     self._advance(
                         pending, out_blocks, pi + 1,
@@ -899,6 +940,26 @@ class PatternQueryRuntime:
                                   jnp.maximum(arr_seq[b_star], pend.last_seq),
                                   pend.last_seq),
                         comp_ts, adv_valid, drop_acc)
+
+                if pos.sticky and (merged or
+                                   pos.legs[0].stream_id == junction_sid):
+                    # qualifying arrivals beyond the per-batch pass bound:
+                    # counted as dropped (monitored truncation; raise
+                    # config.pattern_sticky_passes or shrink batches)
+                    pend = pending[pi - 1]
+                    leg0 = pos.legs[0]
+                    q_left = self._leg_cond(
+                        leg0, self._leg_batch(batch, leg0), pend, now)
+                    q_left = q_left & pend.valid[None, :] & (
+                        arr_seq[:, None] > pend.last_seq[None, :])
+                    if within is not None:
+                        # arrivals outside the within window could never
+                        # match — they are not truncation
+                        q_left = q_left & (
+                            batch.ts[:, None] - pend.start_ts[None, :]
+                            <= jnp.int64(within))
+                    drop_acc[0] = drop_acc[0] + jnp.sum(
+                        q_left, dtype=jnp.int64)
 
             # ---- merge output blocks through the selector ----
             new_sel, out = self._emit(state.sel_state, out_blocks, now)

@@ -225,3 +225,83 @@ class TestEveryAbsent:
         rt.flush()
         rt.heartbeat(now=4_500)
         assert got == [("B",)]
+
+
+class TestMidPatternEvery:
+    """`A -> every B` (reference: EveryPatternTestCase mid-chain shapes):
+    the B position re-arms — every qualifying B fires with the same A."""
+
+    def test_every_second_element_repeats(self):
+        app = (THREE + "from e1=S1[price>10] -> every e2=S2[price>20] "
+               "select e1.symbol as a, e2.symbol as b insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("A", 15.0), timestamp=1_000)
+        rt.flush()
+        for i, sym in enumerate(["X", "Y", "Z"]):
+            rt.get_input_handler("S2").send((sym, 25.0),
+                                            timestamp=1_100 + i)
+            rt.flush()
+        assert got == [("A", "X"), ("A", "Y"), ("A", "Z")]
+
+    def test_multiple_matches_in_one_batch(self):
+        app = (THREE + "from e1=S1[price>10] -> every e2=S2[price>20] "
+               "select e2.symbol as b insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("A", 15.0), timestamp=1_000)
+        rt.flush()
+        h = rt.get_input_handler("S2")
+        for i, sym in enumerate(["X", "Y", "Z"]):  # ONE batch
+            h.send((sym, 25.0), timestamp=1_100 + i)
+        rt.flush()
+        assert sorted(got) == [("X",), ("Y",), ("Z",)]
+
+    def test_head_every_times_mid_every(self):
+        app = (THREE + "from every e1=S1[price>10] -> every e2=S2[price>20] "
+               "select e1.symbol as a, e2.symbol as b insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("A1", 15.0), timestamp=1_000)
+        rt.flush()
+        rt.get_input_handler("S1").send(("A2", 16.0), timestamp=1_001)
+        rt.flush()
+        rt.get_input_handler("S2").send(("B", 25.0), timestamp=1_100)
+        rt.flush()
+        assert sorted(got) == [("A1", "B"), ("A2", "B")]
+
+    def test_within_bounds_the_rearming(self):
+        app = (THREE +
+               "from e1=S1[price>10] -> every e2=S2[price>20] within 1 sec "
+               "select e2.symbol as b insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("A", 15.0), timestamp=1_000)
+        rt.flush()
+        rt.get_input_handler("S2").send(("in", 25.0), timestamp=1_500)
+        rt.flush()
+        rt.get_input_handler("S2").send(("out", 25.0), timestamp=2_500)
+        rt.flush()
+        assert got == [("in",)]
+
+    def test_per_batch_pass_bound_counts_dropped(self):
+        """Same-batch matches past config.pattern_sticky_passes advance up
+        to the bound and count the leftover into `dropped`."""
+        app = (THREE + "from e1=S1[price>10] -> every e2=S2[price>20] "
+               "select e2.symbol as b insert into OutStream;")
+        rt, got = make(app, batch_size=8)
+        rt.get_input_handler("S1").send(("A", 15.0), timestamp=1_000)
+        rt.flush()
+        h = rt.get_input_handler("S2")
+        for i in range(6):  # ONE batch, 6 qualifying arrivals
+            h.send((f"B{i}", 25.0), timestamp=1_100 + i)
+        rt.flush()
+        assert len(got) == 4  # the pass bound
+        qr = next(iter(rt.query_runtimes.values()))
+        assert int(qr.state.dropped) == 2
+        # cross-batch repetition stays exact
+        h.send(("B9", 25.0), timestamp=1_200)
+        rt.flush()
+        assert len(got) == 5
+
+    def test_grouped_every_rejected(self):
+        from siddhi_tpu.errors import SiddhiAppCreationError
+        with pytest.raises(SiddhiAppCreationError, match="grouped"):
+            make(THREE + "from e1=S1 -> every (e2=S2 -> e3=S3) "
+                 "select e1.symbol as a insert into OutStream;")
