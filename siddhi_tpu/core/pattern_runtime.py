@@ -118,7 +118,7 @@ class _PatternPlan:
         #: ref -> (base_ref, occurrence_index) for count groups
         self.count_groups: dict[str, list[str]] = {}
 
-        chain = self._linearize(sis.state)
+        chain = self._linearize(sis.state, top=True)
         first = chain[0]
         if isinstance(first, EveryStateElement):
             self.every = True
@@ -130,22 +130,20 @@ class _PatternPlan:
                 # STICKY (matches advance a copy, the entry stays armed)
                 inner_list = self._linearize(_unwrap_chain(e.state))
                 if (len(inner_list) != 1
-                        or not isinstance(inner_list[0], StreamStateElement)):
+                        or not isinstance(inner_list[0],
+                                          (StreamStateElement,
+                                           AbsentStreamStateElement))):
                     raise SiddhiAppCreationError(
-                        "mid-pattern `every` supports a single plain stream "
-                        "element (`A -> every B`); grouped (`every (B->C)`) "
-                        "and absent (`every not B`) forms are not supported "
-                        "in this build")
+                        "mid-pattern `every` supports a single stream or "
+                        "`not ... for` element (`A -> every B`, `A -> every "
+                        "not B for t`); grouped (`every (B->C)`) forms are "
+                        "not supported in this build")
                 self._add_element(inner_list[0], ctx)
                 self.positions[-1].sticky = True
                 continue
             self._add_element(e, ctx)
         if not self.positions:
             raise SiddhiAppCreationError("empty pattern")
-        if self.positions[0].kind == "absent" and self.every:
-            raise SiddhiAppCreationError(
-                "`every` with a leading absent (`every not ... for`) is not "
-                "supported in this build; drop `every` or reorder")
         if self.positions[0].kind == "notand":
             raise SiddhiAppCreationError(
                 "logical absent (`not X and Y`) as the first pattern element "
@@ -165,9 +163,26 @@ class _PatternPlan:
                 "`every` on the first element is the head form — write "
                 "`from every e1=... -> ...`")
 
-    def _linearize(self, state) -> list:
+    def _linearize(self, state, top: bool = False) -> list:
+        if isinstance(state, tuple) and state and state[0] in ("chain", "seq"):
+            # parenthesized group `( ... ) [within t]`: folding the group's
+            # within into the plan is exact only when the group IS the whole
+            # pattern — partial-scope withins would wrongly constrain the
+            # rest
+            _tag, inner, within_ms = state
+            if within_ms is not None:
+                if not top:
+                    raise SiddhiAppCreationError(
+                        "`within` on a partial pattern group is not "
+                        "supported; apply within to the whole pattern")
+                if self.within_ms is not None and self.within_ms != within_ms:
+                    raise SiddhiAppCreationError(
+                        "conflicting `within` scopes")
+                self.within_ms = within_ms
+            return self._linearize(inner, top=top)
         if isinstance(state, NextStateElement):
-            return self._linearize(state.state) + self._linearize(state.next)
+            return (self._linearize(state.state)
+                    + self._linearize(state.next))
         return [state]
 
     def _ref_of(self, stream: SingleInputStream, fallback: str) -> str:
@@ -663,7 +678,18 @@ class PatternQueryRuntime:
                         comp_frames, comp_fvalid, comp_fts,
                         jnp.where(pend.valid, pend.start_ts, 0),
                         pend.last_seq, comp_ts, due, drop_acc)
-                    pend = pend._replace(valid=pend.valid & ~due)
+                    if pos.sticky:
+                        # `-> every not X for t`: one fire per elapsed quiet
+                        # period — re-arm for the next period (a matching
+                        # arrival consumed the entry above, permanently:
+                        # EveryAbsentPatternTestCase testQueryAbsent4). A
+                        # step crossing several periods fires once and
+                        # catches up on later steps (batch granularity).
+                        pend = pend._replace(armed_ts=jnp.where(
+                            due, pend.armed_ts + jnp.int64(pos.wait_ms),
+                            pend.armed_ts))
+                    else:
+                        pend = pend._replace(valid=pend.valid & ~due)
                     pending[pi - 1] = pend
                     continue
 
@@ -685,17 +711,20 @@ class PatternQueryRuntime:
                     armed0 = jnp.where(
                         state.armed0_ts >= 0, state.armed0_ts,
                         jnp.minimum(first_ts, now))
-                    armed0_out[0] = armed0
                     deadline = armed0 + jnp.int64(pos.wait_ms)
-                    alive = active0
+                    km_any = jnp.bool_(False)
+                    kill_ts = jnp.int64(-(2 ** 62))
                     if junction_sid is not None and (
                             merged or pos.legs[0].stream_id == junction_sid):
                         leg0 = pos.legs[0]
                         km = self._leg_cond(
                             leg0, self._leg_batch(batch, leg0), None,
                             now)[:, 0]
-                        alive = alive & ~(km & (batch.ts < deadline)).any()
-                    due = alive & (now >= deadline)
+                        km = km & (batch.ts < deadline)
+                        km_any = km.any()
+                        kill_ts = jnp.max(jnp.where(
+                            km, batch.ts, jnp.int64(-(2 ** 62))))
+                    due = active0 & ~km_any & (now >= deadline)
                     ref = pos.legs[0].ref
                     ins_valid = jnp.zeros((P,), bool).at[0].set(due)
                     frames = {ref: {
@@ -708,7 +737,18 @@ class PatternQueryRuntime:
                         jnp.full((P,), deadline),
                         jnp.full((P,), state.seq - 1),
                         jnp.full((P,), deadline), ins_valid, drop_acc)
-                    active0 = alive & ~due
+                    if every:
+                        # `every not X for t -> ...`: perpetual quiet-period
+                        # monitor (EveryAbsentPatternTestCase testQueryAbsent5
+                        # — one entry advances per elapsed period) — re-arm
+                        # at each fired boundary; a matching arrival restarts
+                        # measurement from its own timestamp
+                        armed0 = jnp.where(
+                            km_any, kill_ts,
+                            jnp.where(due, deadline, armed0))
+                    else:
+                        active0 = active0 & ~km_any & ~due
+                    armed0_out[0] = armed0
                     continue
 
                 if not feeds:

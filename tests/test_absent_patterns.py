@@ -305,3 +305,81 @@ class TestMidPatternEvery:
         with pytest.raises(SiddhiAppCreationError, match="grouped"):
             make(THREE + "from e1=S1 -> every (e2=S2 -> e3=S3) "
                  "select e1.symbol as a insert into OutStream;")
+
+
+class TestEveryNot:
+    """`every not` — sticky absent positions (reference:
+    EveryAbsentPatternTestCase.java testQueryAbsent1/2/4/5)."""
+
+    def test_trailing_every_not_fires_each_quiet_period(self):
+        # testQueryAbsent1: e1, 3.2s quiet -> 3 fires
+        app = (THREE +
+               "from e1=S1[price>20] -> every not S2[price>e1.price] "
+               "for 1 sec "
+               "select e1.symbol as s insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("WSO2", 55.6), timestamp=1_000)
+        rt.flush()
+        for t in (2_050, 3_050, 4_050):
+            rt.heartbeat(now=t)
+        assert got == [("WSO2",)] * 3
+
+    def test_trailing_every_not_within_caps_periods(self):
+        # testQueryAbsent2: within 2 sec -> only 2 periods fit
+        app = (THREE +
+               "from (e1=S1[price>20] -> every not S2[price>e1.price] "
+               "for 900 millisecond) within 2 sec "
+               "select e1.symbol as s insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("WSO2", 55.6), timestamp=1_000)
+        rt.flush()
+        for t in (2_000, 2_900, 3_800, 4_700):
+            rt.heartbeat(now=t)
+        assert got == [("WSO2",)] * 2
+
+    def test_trailing_every_not_killed_permanently(self):
+        # testQueryAbsent4: 2 fires, then a matching e2 consumes the arming
+        app = (THREE +
+               "from e1=S1[price>20] -> every not S2[price>e1.price] "
+               "for 1 sec "
+               "select e1.symbol as s insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("WSO2", 55.6), timestamp=1_000)
+        rt.flush()
+        rt.heartbeat(now=2_050)
+        rt.heartbeat(now=3_050)
+        assert got == [("WSO2",)] * 2
+        rt.get_input_handler("S2").send(("IBM", 58.7), timestamp=3_100)
+        rt.flush()
+        rt.heartbeat(now=5_000)
+        assert got == [("WSO2",)] * 2  # no further fires
+
+    def test_leading_every_not_entries_accumulate(self):
+        # testQueryAbsent5: quiet 2 periods, then ONE e2 -> 2 outputs
+        app = (THREE +
+               "from every not S1[price>20] for 1 sec -> e2=S2[price>30] "
+               "select e2.symbol as s insert into OutStream;")
+        rt, got = make(app)
+        rt.heartbeat(now=100)    # playback anchor
+        rt.heartbeat(now=1_150)  # period 1 elapses
+        rt.heartbeat(now=2_200)  # period 2 elapses
+        rt.get_input_handler("S2").send(("IBM", 58.7), timestamp=2_300)
+        rt.flush()
+        assert got == [("IBM",)] * 2
+
+    def test_leading_every_not_kill_restarts_measurement(self):
+        app = (THREE +
+               "from every not S1[price>20] for 1 sec -> e2=S2[price>30] "
+               "select e2.symbol as s insert into OutStream;")
+        rt, got = make(app)
+        rt.heartbeat(now=100)
+        rt.get_input_handler("S1").send(("X", 25.0), timestamp=600)
+        rt.flush()  # period broken: restart from 600
+        rt.heartbeat(now=1_200)  # 600ms quiet: not yet a period
+        rt.get_input_handler("S2").send(("EARLY", 35.0), timestamp=1_250)
+        rt.flush()
+        assert got == []
+        rt.heartbeat(now=1_800)  # 1.2s quiet since 600: period elapsed
+        rt.get_input_handler("S2").send(("OK", 35.0), timestamp=1_900)
+        rt.flush()
+        assert got == [("OK",)]
