@@ -101,8 +101,14 @@ class _Position:
 
 
 def _unwrap_chain(elem):
-    """EveryStateElement.state may hold a nested ('chain', state, within)."""
+    """EveryStateElement.state may hold a nested ('chain', state, within).
+    A `within` scoped INSIDE the every-group has no whole-pattern reading —
+    reject it rather than silently dropping the user's time bound."""
     if isinstance(elem, tuple) and elem and elem[0] in ("chain", "seq"):
+        if elem[2] is not None:
+            raise SiddhiAppCreationError(
+                "`within` scoped inside `every (...)` is not supported; "
+                "apply within to the whole pattern")
         return elem[1]
     return elem
 
@@ -650,6 +656,7 @@ class PatternQueryRuntime:
                 if pos.kind == "absent" and pi > 0:
                     due = pend.valid & (now >= pend.armed_ts +
                                         jnp.int64(pos.wait_ms))
+                    killed_late = jnp.zeros_like(pend.valid)
                     if junction_sid is not None and \
                             (merged or pos.legs[0].stream_id == junction_sid):
                         # a matching event kills waiting entries first
@@ -657,9 +664,13 @@ class PatternQueryRuntime:
                             pos.legs[0], self._leg_batch(batch, pos.legs[0]),
                             pend, now)
                         kill = kill & (arr_seq[:, None] > pend.last_seq[None, :])
-                        kill = kill & (batch.ts[:, None] <
-                                       pend.armed_ts[None, :] + jnp.int64(pos.wait_ms))
-                        killed = kill.any(axis=0)
+                        in_period = (batch.ts[:, None] <
+                                     pend.armed_ts[None, :] + jnp.int64(pos.wait_ms))
+                        killed = (kill & in_period).any(axis=0)
+                        # a match PAST the deadline lands in the NEXT (sticky
+                        # re-armed) period: the completed period still fires,
+                        # then the arming is consumed
+                        killed_late = (kill & ~in_period).any(axis=0)
                         pend = pend._replace(valid=pend.valid & ~killed)
                         due = due & ~killed
                     # completions advance with an invalid (absent) frame
@@ -680,14 +691,18 @@ class PatternQueryRuntime:
                         pend.last_seq, comp_ts, due, drop_acc)
                     if pos.sticky:
                         # `-> every not X for t`: one fire per elapsed quiet
-                        # period — re-arm for the next period (a matching
-                        # arrival consumed the entry above, permanently:
-                        # EveryAbsentPatternTestCase testQueryAbsent4). A
-                        # step crossing several periods fires once and
-                        # catches up on later steps (batch granularity).
-                        pend = pend._replace(armed_ts=jnp.where(
-                            due, pend.armed_ts + jnp.int64(pos.wait_ms),
-                            pend.armed_ts))
+                        # period — re-arm for the next period; a matching
+                        # arrival consumes the arming permanently
+                        # (EveryAbsentPatternTestCase testQueryAbsent4),
+                        # whether it landed in the current period (killed
+                        # above) or past its deadline (killed_late). A step
+                        # crossing several periods fires once and catches
+                        # up on later steps (batch granularity).
+                        pend = pend._replace(
+                            armed_ts=jnp.where(
+                                due, pend.armed_ts + jnp.int64(pos.wait_ms),
+                                pend.armed_ts),
+                            valid=pend.valid & ~killed_late)
                     else:
                         pend = pend._replace(valid=pend.valid & ~due)
                     pending[pi - 1] = pend
@@ -713,17 +728,22 @@ class PatternQueryRuntime:
                         jnp.minimum(first_ts, now))
                     deadline = armed0 + jnp.int64(pos.wait_ms)
                     km_any = jnp.bool_(False)
+                    km_late_any = jnp.bool_(False)
                     kill_ts = jnp.int64(-(2 ** 62))
                     if junction_sid is not None and (
                             merged or pos.legs[0].stream_id == junction_sid):
                         leg0 = pos.legs[0]
-                        km = self._leg_cond(
+                        km_all = self._leg_cond(
                             leg0, self._leg_batch(batch, leg0), None,
                             now)[:, 0]
-                        km = km & (batch.ts < deadline)
+                        km = km_all & (batch.ts < deadline)
                         km_any = km.any()
+                        # a match past the deadline breaks the NEXT period
+                        # (the completed one still fires below); measurement
+                        # restarts from the latest matching arrival
+                        km_late_any = (km_all & ~(batch.ts < deadline)).any()
                         kill_ts = jnp.max(jnp.where(
-                            km, batch.ts, jnp.int64(-(2 ** 62))))
+                            km_all, batch.ts, jnp.int64(-(2 ** 62))))
                     due = active0 & ~km_any & (now >= deadline)
                     ref = pos.legs[0].ref
                     ins_valid = jnp.zeros((P,), bool).at[0].set(due)
@@ -741,10 +761,11 @@ class PatternQueryRuntime:
                         # `every not X for t -> ...`: perpetual quiet-period
                         # monitor (EveryAbsentPatternTestCase testQueryAbsent5
                         # — one entry advances per elapsed period) — re-arm
-                        # at each fired boundary; a matching arrival restarts
+                        # at each fired boundary; a matching arrival (in the
+                        # current period OR past its deadline) restarts
                         # measurement from its own timestamp
                         armed0 = jnp.where(
-                            km_any, kill_ts,
+                            km_any | km_late_any, kill_ts,
                             jnp.where(due, deadline, armed0))
                     else:
                         active0 = active0 & ~km_any & ~due

@@ -383,3 +383,44 @@ class TestEveryNot:
         rt.get_input_handler("S2").send(("OK", 35.0), timestamp=1_900)
         rt.flush()
         assert got == [("OK",)]
+
+    def test_trailing_every_not_late_match_consumes_after_fire(self):
+        """A matching X past the current deadline: the completed quiet
+        period still fires, then the arming is consumed permanently."""
+        app = (THREE +
+               "from e1=S1[price>20] -> every not S2[price>e1.price] "
+               "for 1 sec "
+               "select e1.symbol as s insert into OutStream;")
+        rt, got = make(app)
+        rt.get_input_handler("S1").send(("WSO2", 55.6), timestamp=1_000)
+        rt.flush()
+        # no heartbeat: the matching S2 at 2500 is past period 1's deadline
+        rt.get_input_handler("S2").send(("IBM", 58.7), timestamp=2_500)
+        rt.flush()
+        assert got == [("WSO2",)]  # period 1 fired
+        rt.heartbeat(now=3_600)
+        rt.heartbeat(now=4_600)
+        assert got == [("WSO2",)]  # consumed: no further fires
+
+    def test_leading_every_not_late_match_restarts(self):
+        """A matching X past the deadline restarts measurement from its own
+        timestamp (the completed period still advanced one entry)."""
+        app = (THREE +
+               "from every not S1[price>20] for 1 sec -> e2=S2[price>30] "
+               "select e2.symbol as s insert into OutStream;")
+        rt, got = make(app)
+        rt.heartbeat(now=100)
+        rt.get_input_handler("S1").send(("X", 25.0), timestamp=1_500)
+        rt.flush()  # period [100,1100] completed; restart from 1500
+        rt.heartbeat(now=2_000)  # only 500ms quiet since the restart
+        rt.get_input_handler("S2").send(("OK", 35.0), timestamp=2_100)
+        rt.flush()
+        assert got == [("OK",)]  # exactly the one completed period
+
+    def test_within_inside_every_group_rejected(self):
+        from siddhi_tpu.errors import SiddhiAppCreationError
+        with pytest.raises(SiddhiAppCreationError, match="within"):
+            make(THREE +
+                 "from e1=S1[price>20] -> every ((not S2[price>e1.price] "
+                 "for 1 sec) within 2 sec) "
+                 "select e1.symbol as s insert into OutStream;")
