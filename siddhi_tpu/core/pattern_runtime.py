@@ -350,8 +350,10 @@ class PatternState(NamedTuple):
     #: matches past config.pattern_sticky_passes
     dropped: jax.Array
     #: leading-absent arming instant (runtime build time); -2^62 when the
-    #: pattern does not start with `not ... for`
-    armed0_ts: jax.Array  # int64
+    #: pattern does not start with `not ... for`. Defaults to None so
+    #: snapshots pickled before this field existed still unpickle; restore
+    #: fills it from the freshly built runtime state (persistence._to_device)
+    armed0_ts: jax.Array = None  # int64
 
 
 class PatternQueryRuntime:

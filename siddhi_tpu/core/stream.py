@@ -119,6 +119,8 @@ class AsyncDecoder:
         self._pack = (jax.default_backend() not in ("cpu",)
                       and os.environ.get("SIDDHI_WIRE_PACK", "1") != "0")
         self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        #: max decoded-but-undelivered batches held in the reorder buffer
+        self._max_lag = max(maxsize, self.N_FETCH + 1)
         self._seq = 0
         self._deliver_next = 0
         self._buffer: dict = {}
@@ -181,6 +183,16 @@ class AsyncDecoder:
                     host = (payload[1] if isinstance(payload, tuple)
                             else payload)
                 with self._cv:
+                    # backpressure the fetch→deliver stage too: the input
+                    # queue only bounds submit→fetch, so a slow delivery
+                    # thread would otherwise grow _buffer without limit.
+                    # Safe from deadlock: at most N_FETCH seqs are in
+                    # flight, every seq below the smallest in-flight one is
+                    # already buffered/delivered, so delivery always
+                    # progresses and notifies.
+                    while (seq - self._deliver_next > self._max_lag
+                           and not self._stopping):
+                        self._cv.wait(timeout=0.2)
                     self._buffer[seq] = (receiver, host, now, junction)
                     self._cv.notify_all()
             finally:

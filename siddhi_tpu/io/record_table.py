@@ -285,15 +285,25 @@ class CachePolicy:
         #: joins and `in` probes read ONLY the cache, so evicted rows miss
         self.overflowed = False
 
-    def _evict_one(self):
+    def _evict_one(self, protected=frozenset()):
+        # `protected` holds the current probing batch's working set: keys a
+        # read-through warm must NOT evict, or the very probe that triggered
+        # the warm would miss them (see ensure_cached_for_keys). Falls back
+        # to normal policy order if everything is protected (working set >
+        # cache size — separately warned).
         if self.policy == "LFU":
-            victim = min(self.rows, key=lambda k: self.freq.get(k, 0))
+            pool = [k for k in self.rows if k not in protected] or \
+                list(self.rows)
+            victim = min(pool, key=lambda k: self.freq.get(k, 0))
         else:  # FIFO and LRU both evict the head of the ordering
-            victim = next(iter(self.rows))
+            victim = next((k for k in self.rows if k not in protected),
+                          None)
+            if victim is None:
+                victim = next(iter(self.rows))
         del self.rows[victim]
         self.freq.pop(victim, None)
 
-    def put(self, key, row) -> None:
+    def put(self, key, row, protected=frozenset()) -> None:
         if key in self.rows:
             self.rows[key] = row
             if self.policy == "LRU":
@@ -301,7 +311,7 @@ class CachePolicy:
             self.freq[key] = self.freq.get(key, 0) + 1
             return
         while len(self.rows) >= self.size:
-            self._evict_one()
+            self._evict_one(protected)
             self.overflowed = True
         self.rows[key] = row
         self.freq[key] = 1
@@ -546,20 +556,31 @@ class RecordTableRuntime:
                 self._absent_probe_keys.clear()
         if not found:
             return False
-        if len(found) > self.cache_policy.size:
+        # the batch's full store-present working set — BOTH already-resident
+        # probe rows and the freshly loaded ones — must survive the warm:
+        # putting row 'a' must not evict probe key 'b' of the same batch
+        # (e.g. size-2 FIFO {b,c}, batch probes {a,b}) or the device probe
+        # silently misses it despite the read-through
+        resident_probe = {self._key(r)
+                          for r in self.cache_policy.rows.values()
+                          if norm(r) in keys}
+        protected = resident_probe | {self._key(r) for r in found}
+        if len(protected) > self.cache_policy.size:
             import warnings
             warnings.warn(
                 f"@store table {self.definition.id!r}: one probing batch "
-                f"needs {len(found)} rows but "
+                f"needs {len(protected)} rows but "
                 f"@cache(size='{self.cache_policy.size}') holds fewer — "
                 "rows evicted mid-warm may still miss; raise the cache size "
                 "above the per-batch distinct-key working set",
                 stacklevel=2)
+        for k in resident_probe:  # refresh recency so LRU keeps them too
+            self.cache_policy.touch(k)
         changed = any(self._key(r) not in self.cache_policy.rows
                       or self.cache_policy.rows[self._key(r)] != r
                       for r in found)
         for r in found:
-            self.cache_policy.put(self._key(r), r)
+            self.cache_policy.put(self._key(r), r, protected=protected)
         if changed:
             self._rebuild_cache()
         return changed

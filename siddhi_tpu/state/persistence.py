@@ -51,6 +51,19 @@ def _to_device(host_tree, like):
     """Device-put host arrays, casting to the dtypes of the template tree."""
     import jax.numpy as jnp
 
+    # version tolerance: a state NamedTuple that gained a defaulted field
+    # (e.g. PatternState.armed0_ts, round 4) unpickles from older snapshots
+    # with None in that slot — backfill every None-valued field from the
+    # freshly built template of the SAME type (for armed0_ts this re-arms
+    # the leading-absent rule at restore time); mismatched types fall
+    # through to tree_map's structure error, wrapped by the caller
+    if (isinstance(host_tree, tuple) and hasattr(host_tree, "_fields")
+            and type(like) is type(host_tree)
+            and any(v is None for v in host_tree)):
+        host_tree = host_tree._replace(**{
+            f: getattr(like, f)
+            for f, v in zip(host_tree._fields, host_tree) if v is None})
+
     def put(h, l):
         arr = jnp.asarray(h)
         if hasattr(l, "dtype") and arr.dtype != l.dtype:

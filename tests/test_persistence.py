@@ -105,6 +105,39 @@ class TestPersistRestore:
         # final lane is 1+2 (restored) + 4
         assert got2[-1] == (7,)
 
+    def test_pattern_snapshot_without_armed0_ts_restores(self):
+        # round-3 builds pickled PatternState without the armed0_ts field;
+        # restore must tolerate the missing leaf (re-armed from the current
+        # runtime build) instead of failing (advisor round-4 low finding)
+        import pickle
+
+        from siddhi_tpu.core.pattern_runtime import PatternState
+
+        app = ("define stream A (x int); define stream B (x int);\n"
+               "@info(name='p') from e1=A -> e2=B "
+               "select e1.x as ax, e2.x as bx insert into Out;")
+        got = []
+        manager = SiddhiManager()
+        rt = manager.create_siddhi_app_runtime(app, batch_size=4)
+        rt.add_callback("Out", lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        rt.get_input_handler("A").send((1,))
+        rt.flush()
+        blob = rt.snapshot()
+
+        # simulate the old wire format: drop armed0_ts from the pickled state
+        snap = pickle.loads(blob)
+        st = snap["queries"]["p"]
+        assert isinstance(st, PatternState)
+        snap["queries"]["p"] = PatternState(*tuple(st)[:-1])
+        assert snap["queries"]["p"].armed0_ts is None
+        old_blob = pickle.dumps(snap)
+
+        rt.restore(old_blob)
+        rt.get_input_handler("B").send((2,))
+        rt.flush()
+        assert got[-1] == (1, 2)
+
     def test_wrong_app_rejected(self):
         from siddhi_tpu.errors import CannotRestoreStateError
         manager = SiddhiManager()

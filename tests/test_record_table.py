@@ -232,6 +232,29 @@ class TestStoreFallbackOnEviction:
             rt.flush()
         assert sorted(got) == [("a", 0.0), ("c", 2.0)]
 
+    def test_warm_does_not_evict_same_batch_probe_key(self):
+        # size-2 FIFO cache holds {b, c} (head = b); one batch probes
+        # {a, b}. Warming 'a' from the store must NOT evict 'b' — the
+        # working set of the probing batch is protected during the warm
+        # (advisor round-4 high finding)
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            rt = build(self.CACHED.format(policy="FIFO"))
+            self._fill_abc(rt)
+            cp = rt.tables["T"].cache_policy
+            assert [k[0] for k in cp.rows] == ["b", "c"]
+            got = []
+            rt.add_query_callback("j", lambda ts, i, r: got.extend(
+                tuple(e.data) for e in i or []))
+            q = rt.get_input_handler("Q")
+            q.send(("a",))
+            q.send(("b",))
+            rt.flush()
+        assert sorted(got) == [("a", 0.0), ("b", 1.0)]
+        # 'c' (not probed) was the eviction victim, not 'b'
+        assert set(k[0] for k in cp.rows) == {"a", "b"}
+
     def test_outer_join_null_only_for_true_non_matches(self):
         app = """
         define stream S (sym string, price double);
