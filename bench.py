@@ -17,17 +17,23 @@ which would measure the tunnel, not the engine). p99 is synchronous per-step.
 Each config's JSON line carries three numbers (VERDICT r02 item 8):
   value                 — pipelined throughput through the jitted step
                           (async dispatch, one barrier per window, best of 3)
-  e2e_events_per_sec    — the PUBLIC path: InputHandler.send_batch(python
-                          rows) → host encode (native C + interning) →
-                          junction dispatch → jitted step → async callback
-                          decode (native Event materialization). The clock
+  e2e_events_per_sec    — the PUBLIC path: InputHandler.send_columns(numpy
+                          columns; string symbols as Python objects from a
+                          pooled universe, interned per value by the native
+                          encoder) → junction dispatch → jitted step →
+                          async columnar callback (ColumnarBlock — the
+                          batch-level form of the reference's Event[]
+                          callback, StreamCallback.java:38). The clock
                           includes runtime.drain(): every output event has
                           reached the callback before the elapsed is read.
                           On the tunneled TPU each batch still pays the
                           device→host readback RTT (pipelined by the async
                           decoder); e2e_colocated_events_per_sec is the same
                           measurement with a co-located CPU backend in a
-                          fresh subprocess — engine vs topology, separated
+                          fresh subprocess — engine vs topology, separated.
+  e2e_rows_events_per_sec — secondary: the same path fed with per-row
+                          Python tuples (send_batch) and per-Event
+                          callbacks — the row-at-a-time public API
   device_step_ms        — per-step time of the state-chained pipelined loop
                           (the chain serializes device execution, dispatch
                           overlaps: device-bound to first order), vs
@@ -138,17 +144,23 @@ def _measure(run_step, events_per_step: int, metric: str, *,
 
 
 def _measure_e2e(rt, out_stream: str, feed_round, events_per_round: int,
-                 *, rounds: int = 8, warmup: int = 2) -> float:
+                 *, rounds: int = 8, warmup: int = 2,
+                 columnar: bool = True) -> float:
     """End-to-end throughput through the PUBLIC ingestion path:
-    InputHandler.send_batch(python rows) → host encode (native C, interning)
-    → junction → jitted step → callback decode (async worker; Event objects
-    materialize through native build_events). The clock stops at drain() —
-    every produced event has been decoded and delivered to the callback
-    before elapsed is read, so async decode pipelines the device→host round
-    trips but cannot hide undone work."""
+    InputHandler.send_columns (or send_batch for the rows variant) → host
+    encode (native C, interning) → junction → jitted step → async callback
+    delivery. `columnar=True` subscribes a ColumnarBlock callback (the
+    batch-level Event[] analogue); False materializes per-row Event objects.
+    The clock stops at drain() — every produced event has been decoded and
+    delivered to the callback before elapsed is read, so async decode
+    pipelines the device→host round trips but cannot hide undone work."""
     n_out = [0]
-    rt.add_callback(out_stream, lambda evs: n_out.__setitem__(
-        0, n_out[0] + len(evs)))
+    if columnar:
+        rt.add_callback(out_stream, lambda blk: n_out.__setitem__(
+            0, n_out[0] + blk.count), columnar=True)
+    else:
+        rt.add_callback(out_stream, lambda evs: n_out.__setitem__(
+            0, n_out[0] + len(evs)))
     rt.start()
     for r in range(warmup):
         feed_round(r)
@@ -170,7 +182,7 @@ def _measure_e2e(rt, out_stream: str, feed_round, events_per_round: int,
 
 def _trade_rows(n_rounds: int, n_keys: int, *, price_hi: float = 100.0,
                 n: int = BATCH):
-    """Host python rows (string symbols) for the e2e public-path variant."""
+    """Host python rows (string symbols) for the e2e rows-path variant."""
     rng = np.random.default_rng(RNG_SEED + 1)
     rounds = []
     for _ in range(n_rounds):
@@ -179,6 +191,25 @@ def _trade_rows(n_rounds: int, n_keys: int, *, price_hi: float = 100.0,
         vs = rng.integers(1, 1000, n)
         rounds.append([(f"S{int(k)}", float(p), int(v))
                        for k, p, v in zip(ks, ps, vs)])
+    return rounds
+
+
+def _trade_cols(n_rounds: int, n_keys: int, *, price_hi: float = 100.0,
+                n: int = BATCH):
+    """Columnar public-path feed: numpy columns per round. Symbols are
+    Python string objects drawn from a pooled universe — the realistic
+    producer shape (market-data handlers intern their symbol strings), and
+    what the native encoder's pointer-identity memo is built for."""
+    rng = np.random.default_rng(RNG_SEED + 1)
+    pool = np.array([f"S{i}" for i in range(1, n_keys + 1)], dtype=object)
+    rounds = []
+    for _ in range(n_rounds):
+        ks = rng.integers(0, n_keys, n)
+        rounds.append({
+            "symbol": pool[ks],
+            "price": rng.uniform(1.0, price_hi, n),
+            "volume": rng.integers(1, 1000, n),
+        })
     return rounds
 
 
@@ -240,15 +271,29 @@ def bench_filter() -> dict:
 
     rt2 = SiddhiManager().create_siddhi_app_runtime(
         app, batch_size=E2E_BATCH, async_callbacks=True)
-    rows = _trade_rows(4, 1000, price_hi=1000.0, n=E2E_BATCH)
+    cols = _trade_cols(4, 1000, price_hi=1000.0, n=E2E_BATCH)
     h = rt2.get_input_handler("TradeStream")
 
     def feed(r):
-        h.send_batch(rows[r % len(rows)])
+        h.send_columns(cols[r % len(cols)])
         rt2.flush()
 
     res["e2e_events_per_sec"] = round(
         _measure_e2e(rt2, "OutStream", feed, E2E_BATCH), 1)
+
+    if not E2E_ONLY:  # secondary: row-at-a-time public API
+        rt3 = SiddhiManager().create_siddhi_app_runtime(
+            app, batch_size=E2E_BATCH, async_callbacks=True)
+        rows = _trade_rows(4, 1000, price_hi=1000.0, n=E2E_BATCH)
+        h3 = rt3.get_input_handler("TradeStream")
+
+        def feed_rows(r):
+            h3.send_batch(rows[r % len(rows)])
+            rt3.flush()
+
+        res["e2e_rows_events_per_sec"] = round(
+            _measure_e2e(rt3, "OutStream", feed_rows, E2E_BATCH,
+                         columnar=False, rounds=4), 1)
     return res
 
 
@@ -286,15 +331,16 @@ def bench_groupby() -> dict:
     rt2 = SiddhiManager().create_siddhi_app_runtime(
         app, batch_size=E2E_BATCH, group_capacity=1 << 20,
         async_callbacks=True)
-    rows = _trade_rows(4, 1_000_000, n=E2E_BATCH)
+    cols = _trade_cols(4, 1_000_000, n=E2E_BATCH)
     h = rt2.get_input_handler("TradeStream")
 
     def feed(r):
-        h.send_batch(rows[r % len(rows)])
+        h.send_columns(cols[r % len(cols)])
         rt2.flush()
 
     res["e2e_events_per_sec"] = round(
         _measure_e2e(rt2, "SummaryStream", feed, E2E_BATCH), 1)
+
     return res
 
 
@@ -349,15 +395,16 @@ def _distinct_e2e(app: str, res: dict) -> dict:
     rt2 = SiddhiManager().create_siddhi_app_runtime(
         app, batch_size=E2E_BATCH, group_capacity=1 << 20,
         async_callbacks=True)
-    rows = _trade_rows(4, 100_000, n=E2E_BATCH)
+    cols = _trade_cols(4, 100_000, n=E2E_BATCH)
     h = rt2.get_input_handler("TradeStream")
     ts_ctr = [1]
 
     def feed(r):
         t = ts_ctr[0]
         ts_ctr[0] = t + E2E_BATCH
-        h.send_batch(rows[r % len(rows)],
-                     timestamps=list(range(t, t + E2E_BATCH)))
+        h.send_columns(cols[r % len(cols)],
+                       timestamps=np.arange(t, t + E2E_BATCH,
+                                            dtype=np.int64))
         rt2.flush()
 
     res["e2e_events_per_sec"] = round(
@@ -432,10 +479,10 @@ def bench_pattern() -> dict:
     def feed(r):
         v0 = val_ctr[0]
         val_ctr[0] += eb
-        rows = [(v,) for v in range(v0, v0 + eb)]
-        ha.send_batch(rows)
+        vals = np.arange(v0, v0 + eb, dtype=np.int32)
+        ha.send_columns({"val": vals})
         rt2.flush()
-        hb.send_batch(rows)
+        hb.send_columns({"val": vals})
         rt2.flush()
 
     res["e2e_events_per_sec"] = round(
@@ -498,18 +545,17 @@ def bench_join() -> dict:
     rng2 = np.random.default_rng(RNG_SEED + 1)
     rounds = []
     for _ in range(4):
-        mk = lambda: [(int(k), float(v)) for k, v in zip(
-            rng2.integers(1, 100_001, jb),
-            rng2.uniform(1.0, 100.0, jb))]
+        mk = lambda: {"k": rng2.integers(1, 100_001, jb).astype(np.int32),
+                      "v": rng2.uniform(1.0, 100.0, jb)}
         rounds.append((mk(), mk()))
     hl = rt2.get_input_handler("LeftStream")
     hr = rt2.get_input_handler("RightStream")
 
     def feed(r):
-        lrows, rrows = rounds[r % len(rounds)]
-        hl.send_batch(lrows)
+        lcols, rcols = rounds[r % len(rounds)]
+        hl.send_columns(lcols)
         rt2.flush()
-        hr.send_batch(rrows)
+        hr.send_columns(rcols)
         rt2.flush()
 
     res["e2e_events_per_sec"] = round(

@@ -34,6 +34,11 @@ from .core import function as _function  # noqa: E402,F401  (script engines)
 from .ops import stream_functions as _stream_functions  # noqa: E402,F401
 from .core.dtypes import config  # noqa: E402
 from .core.event import Event  # noqa: E402
+from .core.stream import (  # noqa: E402
+    BatchStreamCallback,
+    ColumnarBlock,
+    StreamCallback,
+)
 from .core.manager import SiddhiManager  # noqa: E402
 from .errors import SiddhiError, SiddhiParserError  # noqa: E402
 from .query_api import SiddhiApp  # noqa: E402
@@ -44,6 +49,9 @@ __all__ = [
     "SiddhiManager",
     "SiddhiApp",
     "Event",
+    "ColumnarBlock",
+    "BatchStreamCallback",
+    "StreamCallback",
     "compiler",
     "config",
     "SiddhiError",

@@ -328,14 +328,23 @@ class SiddhiAppRuntime:
             self.input_handlers[stream_id] = InputHandler(junction)
         return self.input_handlers[stream_id]
 
-    def add_callback(self, stream_id: str, callback) -> None:
+    def add_callback(self, stream_id: str, callback,
+                     columnar: bool = False) -> None:
+        """Subscribe to a stream. `columnar=True` delivers ColumnarBlock
+        batches (compacted numpy columns, lazy string decode) instead of
+        materialized Event lists — the high-throughput form of the
+        reference's Event[] callback (StreamCallback.java:38)."""
+        from .stream import BatchStreamCallback, FunctionBatchCallback
         if stream_id.startswith("!"):
             junction = self.fault_junctions.get(stream_id[1:])
         else:
             junction = self.junctions.get(stream_id)
         if junction is None:
             raise DefinitionNotExistError(f"stream {stream_id!r} is not defined")
-        if not isinstance(callback, StreamCallback):
+        if columnar and not isinstance(
+                callback, (BatchStreamCallback, StreamCallback)):
+            callback = FunctionBatchCallback(callback)
+        elif not isinstance(callback, (StreamCallback, BatchStreamCallback)):
             callback = FunctionStreamCallback(callback)
         junction.subscribe(callback)
 

@@ -87,6 +87,9 @@ class StringTable:
         #: (~1024 at the default 1M capacity) — documented bound.
         self._transient_gens: list[int] = []
         self._transient_cap: Optional[int] = None
+        #: native pointer-identity intern memo (capsule); lazily created by
+        #: encode_array, dropped whenever permanent codes are reassigned
+        self._id_memo = None
 
     def encode(self, s: Optional[str]) -> int:
         if s is None:
@@ -162,9 +165,16 @@ class StringTable:
         out = np.empty(n, dtype=np.int32)
         from .. import native as native_mod
         if native_mod.native is not None:
+            if self._id_memo is None and \
+                    hasattr(native_mod.native, "idmemo_new"):
+                # pointer-identity fast path for producers that pool their
+                # string objects (see columnar.c); dropped on restore()
+                # because restore reassigns permanent codes
+                self._id_memo = native_mod.native.idmemo_new()
             native_mod.native.intern_column(values, out, self._to_code,
                                             self._to_str,
-                                            self._transient_code)
+                                            self._transient_code,
+                                            self._id_memo)
             return out
         to_code, to_str = self._to_code, self._to_str
         transient = self._transient_code
@@ -207,6 +217,7 @@ class StringTable:
             snap = {"strings": snap, "transient": [], "transient_next": 0}
         strings = snap["strings"]
         # mutate in place: native encode plans hold references to these
+        self._id_memo = None  # permanent codes reassigned below
         self._to_str[:] = list(strings)
         self._to_code.clear()
         self._to_code.update(

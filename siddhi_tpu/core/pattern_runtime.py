@@ -46,6 +46,7 @@ import jax.numpy as jnp
 from ..errors import DefinitionNotExistError, SiddhiAppCreationError
 from ..extension.registry import Registry
 from ..ops.expr_compile import Scope, TypeResolver, compile_expression
+from ..ops.search import stable_partition_order
 from ..ops.selector import CompiledSelector
 from ..query_api.definition import Attribute, AttributeType, StreamDefinition
 from ..query_api.execution import (
@@ -1068,7 +1069,7 @@ class PatternQueryRuntime:
                         start_ts, last_seq, armed_ts, valid) -> PendingTable:
         """Insert [P]-aligned candidate entries into dst's free slots."""
         P = self.P
-        free_order = jnp.argsort(dst.valid, stable=True)
+        free_order = stable_partition_order(~dst.valid)
         n_free = jnp.sum((~dst.valid).astype(jnp.int32))
         rank = jnp.cumsum(valid.astype(jnp.int32)) - 1
         fits = valid & (rank < n_free)

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..errors import CapacityExceededError, SiddhiAppCreationError
+from ..ops.search import stable_partition_order
 from ..query_api.definition import AttributeType, TableDefinition
 from ..query_api.execution import OutputAction, OutputStream, UpdateSetAttribute
 from ..query_api.expression import Compare, CompareOp, Expression, Variable
@@ -189,7 +190,7 @@ class InMemoryTable:
                 ins = ins & ~dup & ~dup_in_batch
             n_ins = jnp.sum(ins.astype(jnp.int32))
             # free slots in row order: argsort(valid) puts False (free) first
-            free_order = jnp.argsort(tstate.valid, stable=True)
+            free_order = stable_partition_order(~tstate.valid)
             n_free = jnp.sum((~tstate.valid).astype(jnp.int32))
             rank = jnp.cumsum(ins.astype(jnp.int32)) - 1
             fits = ins & (rank < n_free)

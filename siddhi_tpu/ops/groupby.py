@@ -29,7 +29,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .search import searchsorted32
+from .search import searchsorted32, stable_argsort_bounded
 
 
 def invert_permutation(perm: jax.Array) -> jax.Array:
@@ -101,6 +101,9 @@ class _SegmentPlan(NamedTuple):
     safe_slots: jax.Array
     epoch_ok_slots: jax.Array  # s_slots < K (validity of gathers)
     write_slot: jax.Array
+    #: index of each lane's segment start (shared max-scan — carry
+    #: broadcasts become gathers instead of one assoc-scan per component)
+    start_idx: jax.Array
 
 
 def _segment_plan(slots, valid, resets, current_epoch, K) -> _SegmentPlan:
@@ -113,8 +116,9 @@ def _segment_plan(slots, valid, resets, current_epoch, K) -> _SegmentPlan:
     reset_rank = jnp.cumsum(resets.astype(jnp.int32))
     lane_epoch = current_epoch + reset_rank
 
-    # stable sort by (slot, lane) — lane order inside a slot is preserved
-    order = jnp.argsort(slots_v, stable=True)
+    # stable sort by (slot, lane) — lane order inside a slot is preserved.
+    # slots_v is non-negative (< K+1): radix path on CPU, lax sort on TPU
+    order = stable_argsort_bounded(slots_v)
     s_slots = slots_v[order]
     s_epochs = lane_epoch[order]
 
@@ -131,8 +135,13 @@ def _segment_plan(slots, valid, resets, current_epoch, K) -> _SegmentPlan:
     is_slot_end = s_slots != next_slot
     write_slot = jnp.where((s_slots < K) & is_slot_end, s_slots, sentinel)
 
+    L = s_slots.shape[0]
+    idx = jnp.arange(L, dtype=jnp.int32)
+    start_idx = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(seg_start, idx, 0))
+
     return _SegmentPlan(order, s_slots, s_epochs, seg_start, safe_slots,
-                        s_slots < K, write_slot)
+                        s_slots < K, write_slot, start_idx)
 
 
 def _scan_component(values, epoch_table, deltas, valid, plan: _SegmentPlan,
@@ -151,9 +160,7 @@ def _scan_component(values, epoch_table, deltas, valid, plan: _SegmentPlan,
     carry = jnp.where(
         plan.epoch_ok_slots & (stored_epoch == plan.s_epochs), stored_vals,
         jnp.full_like(stored_vals, identity))
-    carry_at_start = jnp.where(plan.seg_start, carry,
-                               jnp.full_like(carry, identity))
-    carry_seg = _segment_broadcast_op(carry_at_start, plan.seg_start, identity)
+    carry_seg = carry[plan.start_idx]  # shared start-index gather
 
     s_out = combine(carry_seg, within)
     new_values = values.at[plan.write_slot].set(
@@ -179,12 +186,19 @@ def grouped_scan_fused(
     Returns (new_values_list, new_shared_epoch, per-lane outputs list)."""
     K = shared_epoch.shape[0]
     plan = _segment_plan(slots, valid, resets, current_epoch, K)
+    stored_epoch = shared_epoch[plan.safe_slots]
+    epoch_live = plan.epoch_ok_slots & (stored_epoch == plan.s_epochs)
+    inv_order = invert_permutation(plan.order)  # ONE scatter, n gathers
     new_values, outs = [], []
     for values, deltas in zip(values_list, deltas_list):
-        nv, s_out = _scan_component(values, shared_epoch, deltas, valid, plan,
-                                    "sum")
-        new_values.append(nv)
-        outs.append(jnp.zeros_like(s_out).at[plan.order].set(s_out))
+        sd = jnp.where(valid, deltas, jnp.zeros((), deltas.dtype))[plan.order]
+        within = _segmented_scan(sd, plan.seg_start, lambda a, b: a + b,
+                                 jnp.zeros((), sd.dtype))
+        stored_vals = values[plan.safe_slots]
+        carry = jnp.where(epoch_live, stored_vals, jnp.zeros_like(stored_vals))
+        s_out = carry[plan.start_idx] + within.astype(values.dtype)
+        new_values.append(values.at[plan.write_slot].set(s_out, mode="drop"))
+        outs.append(s_out[inv_order])
     new_epoch = shared_epoch.at[plan.write_slot].set(
         plan.s_epochs.astype(shared_epoch.dtype), mode="drop")
     return new_values, new_epoch, outs

@@ -28,7 +28,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .search import searchsorted32
+from .search import (
+    searchsorted32,
+    stable_argsort_bounded,
+    stable_partition_order,
+)
 
 from ..core import dtypes
 from ..errors import SiddhiAppCreationError
@@ -212,7 +216,7 @@ def multimap_append(mm: MultimapState, hashes: jax.Array, live: jax.Array,
     H = mm.heads.shape[0]
     B = hashes.shape[0]
     # mirror compact_packed: live rows first, stable → arrival order
-    order = jnp.argsort(~live, stable=True)
+    order = stable_partition_order(live)
     hashes = hashes[order]
     valid = live[order]
     j = jnp.arange(B, dtype=jnp.int32)
@@ -223,7 +227,7 @@ def multimap_append(mm: MultimapState, hashes: jax.Array, live: jax.Array,
     bucket = (hashes & jnp.uint32(H - 1)).astype(jnp.int32)
 
     sortkey = jnp.where(valid, bucket, jnp.int32(H))
-    run = jnp.argsort(sortkey, stable=True)
+    run = stable_argsort_bounded(sortkey)  # bounded non-negative: radix on CPU
     b_s = sortkey[run]
     seq_s = seq[run]
     hash_s = hashes[run]
@@ -317,7 +321,7 @@ def probe_cross(probe_valid: jax.Array, build_valid: jax.Array, k_max: int):
     # rank of each build row among valid rows
     rank = jnp.cumsum(build_valid.astype(jnp.int32)) - 1
     # k-th valid build row index
-    order = jnp.argsort(~build_valid, stable=True)  # valid rows first
+    order = stable_partition_order(build_valid)  # valid rows first
     kth = order[jnp.clip(jnp.arange(k_max), 0, C - 1)]
     n_valid = jnp.sum(build_valid.astype(jnp.int32))
     kv = jnp.arange(k_max) < n_valid

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from ..core import dtypes
 from ..core.event import EventBatch, EventType
 from ..errors import SiddhiAppCreationError
+from .search import stable_partition_order
 from ..query_api.execution import OutputRate, OutputRateType
 
 
@@ -111,7 +112,7 @@ class BufferedLimiter(RateLimiterOp):
     def step(self, state: BufferState, out: EventBatch, now):
         C = self.C
         live = out.valid & (out.types == EventType.CURRENT)
-        order = jnp.argsort(~live, stable=True)
+        order = stable_partition_order(live)
         n_new = jnp.sum(live.astype(jnp.int64))
         B = out.ts.shape[0]
         # int32 lane math relative to one scalar s64 reduction — TPU has no

@@ -17,6 +17,66 @@ import math
 import jax.numpy as jnp
 
 
+def stable_partition_order(live):
+    """Permutation that stably moves live lanes to the front — two prefix
+    sums + one scatter instead of a sort. XLA CPU's comparator sort is
+    ~50x slower than its cumsum at the same width (74 ms vs 1.4 ms at 282k
+    lanes, measured); on TPU the scatter form also beats bitonic argsort.
+    Replaces the `argsort(~live, stable=True)` idiom everywhere."""
+    n = live.shape[0]
+    live_i = live.astype(jnp.int32)
+    pos_live = jnp.cumsum(live_i) - 1
+    n_live = jnp.sum(live_i)
+    pos_dead = n_live + jnp.cumsum(1 - live_i) - 1
+    dest = jnp.where(live, pos_live, pos_dead)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    return jnp.zeros((n,), jnp.int32).at[dest].set(iota)
+
+
+def _host_radix_argsort(a):
+    import numpy as np
+    out = np.empty(a.shape, dtype=np.int32)
+    from .. import native as native_mod
+    nat = native_mod.native
+    if a.ndim == 1:
+        if nat is not None and hasattr(nat, "radix_argsort"):
+            nat.radix_argsort(np.ascontiguousarray(a), out)
+        else:
+            out[...] = np.argsort(a, kind="stable")
+        return out
+    flat = a.reshape(-1, a.shape[-1])
+    oflat = out.reshape(-1, a.shape[-1])
+    for i in range(flat.shape[0]):
+        if nat is not None and hasattr(nat, "radix_argsort"):
+            nat.radix_argsort(np.ascontiguousarray(flat[i]), oflat[i])
+        else:
+            oflat[i] = np.argsort(flat[i], kind="stable")
+    return out
+
+
+def stable_argsort_bounded(x):
+    """Stable argsort of NON-NEGATIVE int32 keys, as int32 positions.
+
+    TPU/other accelerators: native `jnp.argsort` (fast there). CPU backend:
+    an LSD radix argsort in C reached via `jax.pure_callback` — XLA CPU's
+    comparator sort runs ~260 ns/elem (74 ms at 282k lanes, measured) while
+    the radix pass is ~10 ns/elem. The callback is batch-aware (trailing
+    axis) so it stays vmappable."""
+    import jax
+    from jax import lax, pure_callback
+
+    def cpu_fn(v):
+        return pure_callback(
+            _host_radix_argsort,
+            jax.ShapeDtypeStruct(v.shape, jnp.int32), v,
+            vmap_method="broadcast_all")
+
+    def default_fn(v):
+        return jnp.argsort(v, axis=-1, stable=True).astype(jnp.int32)
+
+    return lax.platform_dependent(x, cpu=cpu_fn, default=default_fn)
+
+
 def searchsorted32(a, v, side: str = "left"):
     """Positions where `v` would insert into sorted `a`, as int32.
 

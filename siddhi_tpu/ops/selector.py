@@ -466,7 +466,11 @@ class CompiledSelector:
                 agg_values[slot_name] = spec.finalize(
                     [results[i] for i in comp_gis])
 
-        new_epoch = state.epoch + jnp.sum(is_reset.astype(jnp.int32))
+        # dtype-stable accumulate: a bare jnp.sum promotes int32->int64
+        # under x64, silently changing the state aval between the first and
+        # second step — which retriggers a FULL ~seconds-long XLA recompile
+        new_epoch = state.epoch + jnp.sum(
+            is_reset.astype(jnp.int32), dtype=state.epoch.dtype)
 
         # --- project output attributes ---
         if self.agg_specs:

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import dtypes
-from .search import searchsorted32
+from .search import searchsorted32, stable_partition_order
 from ..core.event import EventBatch, EventType
 from ..errors import SiddhiAppCreationError
 
@@ -57,7 +57,7 @@ def compact(batch: EventBatch) -> tuple[dict, jax.Array, jax.Array, jax.Array]:
     Returns (cols, ts, n_valid, order). Lanes >= n_valid hold garbage.
     """
     live = batch.valid & (batch.types == EventType.CURRENT)
-    order = jnp.argsort(~live, stable=True)
+    order = stable_partition_order(live)
     cols = {k: v[order] for k, v in batch.cols.items()}
     ts = batch.ts[order]
     return cols, ts, jnp.sum(live.astype(jnp.int32)), order
@@ -157,6 +157,67 @@ def _sort_chunk(keys, cols, ts, valid, types, width):
     )
 
 
+def _merge_order(keys, valids):
+    """Global emission permutation for G lane groups whose VALID lanes
+    already carry nondecreasing (hi, lo) keys — true for every window-chunk
+    assembly (currents/RESETs/expireds are generated in emission order).
+
+    Replaces the chunk comparator sort (XLA CPU: ~74 ms at 282k lanes) with
+    per-group stable partitions + cross-group searchsorted rank sums
+    (~2 ms): merged_rank(lane) = local_rank + Σ_h |{k in group h : k < key}|
+    (≤ for groups ordered earlier, < for later — reproducing the stable
+    concatenation order on ties). TPU also wins: no bitonic sort pass.
+    Returns order over the CONCATENATED index space (valid lanes first, in
+    key order; invalid lanes after, in concatenation order)."""
+    G = len(keys)
+    lens = [k[0].shape[0] for k in keys]
+    total = sum(lens)
+    offsets = [sum(lens[:g]) for g in range(G)]
+
+    ck, orders, nvs = [], [], []
+    for (hi, lo), v in zip(keys, valids):
+        og = stable_partition_order(v)
+        nv = jnp.sum(v.astype(jnp.int32))
+        k = (hi.astype(jnp.int64) << 32) | lo.astype(jnp.uint32).astype(jnp.int64)
+        k = k[og]
+        k = jnp.where(jnp.arange(k.shape[0]) < nv, k, jnp.int64(BIG))
+        ck.append(k)
+        orders.append(og)
+        nvs.append(nv)
+    total_valid = sum(nvs)
+
+    order_all = jnp.zeros((total,), jnp.int32)
+    inv_base = total_valid
+    for g in range(G):
+        iota = jnp.arange(lens[g], dtype=jnp.int32)
+        rank = iota
+        for h in range(G):
+            if h == g:
+                continue
+            side = "right" if h < g else "left"
+            rank = rank + searchsorted32(ck[h], ck[g], side=side)
+        is_val = iota < nvs[g]
+        rank = jnp.where(is_val, rank, inv_base + (iota - nvs[g]))
+        inv_base = inv_base + (lens[g] - nvs[g])
+        order_all = order_all.at[rank].set(offsets[g] + orders[g])
+    return order_all
+
+
+def _merge_sorted_chunks(keys, colss, tss, valids, types, width):
+    """Rank-merged chunk assembly (see `_merge_order`)."""
+    order = _merge_order(keys, valids)[:width]
+    all_cols = {k: jnp.concatenate([c[k] for c in colss]) for k in colss[0]}
+    all_ts = jnp.concatenate(tss)
+    all_valid = jnp.concatenate(valids)
+    all_types = jnp.concatenate(types)
+    return EventBatch(
+        ts=all_ts[order],
+        cols={n: v[order] for n, v in all_cols.items()},
+        valid=all_valid[order],
+        types=all_types[order],
+    )
+
+
 def _empty_like_cols(layout: dict, n: int) -> dict:
     return {k: jnp.zeros((n,), dtype=dt) for k, dt in layout.items()}
 
@@ -253,7 +314,7 @@ def compact_packed(batch: EventBatch, layout: dict):
     Lanes >= n_valid hold garbage."""
     live = batch.valid & (batch.types == EventType.CURRENT)
     mat = _pack_rows(batch.cols, batch.ts, layout)
-    order = jnp.argsort(~live, stable=True).astype(jnp.int32)
+    order = stable_partition_order(live)
     return mat[:, order], jnp.sum(live, dtype=jnp.int32)
 
 
@@ -303,21 +364,16 @@ def _fetch_rel_packed(ring: jax.Array, comp_mat: jax.Array, base_idx,
     return jnp.where((offs >= rel0)[None, :], bat, cand)
 
 
-def _sort_chunk_packed(hi, lo, payload_mat, emit_ts, valid, types,
-                       layout: dict, width: int) -> EventBatch:
-    """Emission-order sort applied with ONE packed gather: payload + emit ts
-    + (valid, type) meta ride a single [W+3, L] matrix through the two-key
-    int32 sort's permutation."""
-    L = hi.shape[0]
-    hi = jnp.where(valid, hi, jnp.iinfo(jnp.int32).max)
-    iota = jnp.arange(L, dtype=jnp.int32)
-    _, _, order = jax.lax.sort((hi, lo, iota), num_keys=2, is_stable=True)
+def _gather_chunk_packed(order, payload_mat, emit_ts, valid, types,
+                         layout: dict) -> EventBatch:
+    """Apply an emission permutation with ONE packed gather: payload +
+    emit ts + (valid, type) meta ride a single [W+3, L] matrix."""
     ets = jax.lax.bitcast_convert_type(emit_ts.astype(jnp.int64), jnp.uint32)
     meta = (valid.astype(jnp.uint32)
             | (types.astype(jnp.uint32) << 1))
     W = payload_mat.shape[0]
     full = jnp.concatenate(
-        [payload_mat, ets.T, meta[None, :]], axis=0)[:, order[:width]]
+        [payload_mat, ets.T, meta[None, :]], axis=0)[:, order]
     cols, _stored_ts = _unpack_rows(full[:W], layout)
     emit = jax.lax.bitcast_convert_type(
         jnp.stack([full[W], full[W + 1]], axis=-1), jnp.int64)
@@ -325,6 +381,19 @@ def _sort_chunk_packed(hi, lo, payload_mat, emit_ts, valid, types,
     return EventBatch(ts=emit, cols=cols,
                       valid=(m & 1) != 0,
                       types=(m >> 1).astype(jnp.int8))
+
+
+def _sort_chunk_packed(hi, lo, payload_mat, emit_ts, valid, types,
+                       layout: dict, width: int) -> EventBatch:
+    """Emission-order sort (general, comparator-based) + packed gather.
+    Window paths whose groups emit in key order use `_merge_order` +
+    `_gather_chunk_packed` instead."""
+    L = hi.shape[0]
+    hi = jnp.where(valid, hi, jnp.iinfo(jnp.int32).max)
+    iota = jnp.arange(L, dtype=jnp.int32)
+    _, _, order = jax.lax.sort((hi, lo, iota), num_keys=2, is_stable=True)
+    return _gather_chunk_packed(order[:width], payload_mat, emit_ts, valid,
+                                types, layout)
 
 
 def window_has_time_semantics(window: "WindowOp") -> bool:
@@ -533,10 +602,16 @@ class SlidingWindow(WindowOp):
             # delay; arrivals are swallowed (reference DelayWindowProcessor).
             all_types = jnp.full((E + B,), EventType.CURRENT, jnp.int8)
             all_valid = jnp.concatenate([expires, jnp.zeros((B,), bool)])
+            exp_v, cur_v = expires, jnp.zeros((B,), bool)
+        else:
+            exp_v, cur_v = expires, cur_valid
 
-        chunk = _sort_chunk_packed(all_hi, all_lo, all_mat, all_emit,
-                                   all_valid, all_types, self.layout,
-                                   self.chunk_width)
+        # both groups emit in nondecreasing (hi, lo) order (expiry triggers
+        # follow candidate age; currents follow arrival): rank-merge
+        order = _merge_order([(keys_exp, pe), (keys_cur, p)],
+                             [exp_v, cur_v])[:self.chunk_width]
+        chunk = _gather_chunk_packed(order, all_mat, all_emit, all_valid,
+                                     all_types, self.layout)
 
         # ---- ring update ----
         new_ring = _append_packed(state.ring, comp_mat, state.appended,
@@ -686,15 +761,8 @@ class LengthBatchWindow(WindowOp):
             valids.append(exp_exists)
             types.append(jnp.full((cur_count_max,), EventType.EXPIRED, jnp.int8))
 
-        all_keys = (jnp.concatenate([k[0] for k in keys]),
-                    jnp.concatenate([k[1] for k in keys]))
-        all_cols = {k: jnp.concatenate([c[k] for c in colss]) for k in self.layout}
-        all_ts = jnp.concatenate(tss)
-        all_valid = jnp.concatenate(valids)
-        all_types = jnp.concatenate(types)
-
-        chunk = _sort_chunk(all_keys, all_cols, all_ts, all_valid, all_types,
-                            self.chunk_width)
+        chunk = _merge_sorted_chunks(keys, colss, tss, valids, types,
+                                     self.chunk_width)
 
         new_ring_cols, new_ring_ts = _scatter_append(
             state.ring_cols, state.ring_ts, comp_cols, comp_ts,
@@ -859,14 +927,8 @@ class TimeBatchWindow(WindowOp):
             valids.append(exp_emit)
             types.append(jnp.full((E,), EventType.EXPIRED, jnp.int8))
 
-        all_keys = (jnp.concatenate([k[0] for k in keys]),
-                    jnp.concatenate([k[1] for k in keys]))
-        all_cols = {k: jnp.concatenate([c[k] for c in colss]) for k in self.layout}
-        all_ts = jnp.concatenate(tss)
-        all_valid = jnp.concatenate(valids)
-        all_types = jnp.concatenate(types)
-        chunk = _sort_chunk(all_keys, all_cols, all_ts, all_valid, all_types,
-                            self.chunk_width)
+        chunk = _merge_sorted_chunks(keys, colss, tss, valids, types,
+                                     self.chunk_width)
 
         n_emitted = jnp.sum(cur_emit.astype(jnp.int64))
         new_flushed = state.flushed + n_emitted
