@@ -103,15 +103,26 @@ class _Position:
 
 def _unwrap_chain(elem):
     """EveryStateElement.state may hold a nested ('chain', state, within).
-    A `within` scoped INSIDE the every-group has no whole-pattern reading —
-    reject it rather than silently dropping the user's time bound."""
+    Returns (inner_element, group_within_ms) — a `within` scoped inside the
+    every-group bounds EACH ITERATION (first→last captured event of one
+    group traversal), per the reference's per-state within lists
+    (StreamPreStateProcessor.java:119-136)."""
     if isinstance(elem, tuple) and elem and elem[0] in ("chain", "seq"):
-        if elem[2] is not None:
-            raise SiddhiAppCreationError(
-                "`within` scoped inside `every (...)` is not supported; "
-                "apply within to the whole pattern")
-        return elem[1]
-    return elem
+        return elem[1], elem[2]
+    return elem, None
+
+
+@dataclass
+class _EveryGroup:
+    """A grouped `every ( ... )` — positions [head, end] form one iteration;
+    the NEXT iteration arms only when the current one completes (reference:
+    EveryInnerStateRuntime.java:30 re-arms on inner-runtime completion —
+    see EveryPatternTestCase testQuery5: A A A A B yields 2 matches, the
+    iterations pair up sequentially instead of one per arrival)."""
+
+    head: int
+    end: int
+    within_ms: Optional[int] = None
 
 
 class _PatternPlan:
@@ -124,29 +135,74 @@ class _PatternPlan:
         self.within_ms = sis.within_ms
         #: ref -> (base_ref, occurrence_index) for count groups
         self.count_groups: dict[str, list[str]] = {}
+        #: (start_pos, end_pos) spans of zero-minimum count groups, in order
+        self.zero_min_spans: list[tuple[int, int]] = []
+
+        #: head every-group (None when the head `every` wraps one element)
+        self.head_group: Optional[_EveryGroup] = None
+        #: mid-pattern grouped everys, in position order
+        self.mid_groups: list[_EveryGroup] = []
 
         chain = self._linearize(sis.state, top=True)
         first = chain[0]
         if isinstance(first, EveryStateElement):
             self.every = True
-            inner = _unwrap_chain(first.state)
-            chain = self._linearize(inner) + chain[1:]
+            inner, gw = _unwrap_chain(first.state)
+            inner_list = self._linearize(inner)
+            for e in inner_list:
+                self._add_element(e, ctx)
+            # a GROUP is a multi-element chain (`every (A -> B)`) or a
+            # group-scoped within; a single count element (`every A<2:>`)
+            # expands to several positions but keeps per-arrival re-arming
+            if len(inner_list) > 1 or gw is not None:
+                if self.is_sequence:
+                    raise SiddhiAppCreationError(
+                        "grouped `every ( ... )` inside a SEQUENCE is not "
+                        "supported; use a pattern (`->`) instead")
+                self.head_group = _EveryGroup(
+                    0, len(self.positions) - 1, gw)
+            chain = chain[1:]
         for e in chain:
             if isinstance(e, EveryStateElement):
-                # mid-pattern every: `A -> every B` — the B position becomes
-                # STICKY (matches advance a copy, the entry stays armed)
-                inner_list = self._linearize(_unwrap_chain(e.state))
-                if (len(inner_list) != 1
-                        or not isinstance(inner_list[0],
-                                          (StreamStateElement,
-                                           AbsentStreamStateElement))):
+                inner, gw = _unwrap_chain(e.state)
+                inner_list = self._linearize(inner)
+                if (len(inner_list) == 1 and gw is None
+                        and isinstance(inner_list[0],
+                                       (StreamStateElement,
+                                        AbsentStreamStateElement))):
+                    # mid-pattern every over ONE element: the position
+                    # becomes STICKY (matches advance a copy, the entry
+                    # stays armed)
+                    self._add_element(inner_list[0], ctx)
+                    self.positions[-1].sticky = True
+                    continue
+                # mid-pattern grouped every: `A -> every (B->C) -> D` — the
+                # group's head entry stays armed; one iteration in flight
+                # at a time, re-armed by each completion
+                if gw is not None:
                     raise SiddhiAppCreationError(
-                        "mid-pattern `every` supports a single stream or "
-                        "`not ... for` element (`A -> every B`, `A -> every "
-                        "not B for t`); grouped (`every (B->C)`) forms are "
-                        "not supported in this build")
-                self._add_element(inner_list[0], ctx)
-                self.positions[-1].sticky = True
+                        "`within` scoped inside a MID-pattern `every (...)` "
+                        "is not supported; apply within to the whole "
+                        "pattern")
+                if self.is_sequence:
+                    raise SiddhiAppCreationError(
+                        "mid-sequence `every` is not supported (strict "
+                        "contiguity and re-arming conflict); use a pattern "
+                        "(`->`) instead")
+                head = len(self.positions)
+                if head == 0:
+                    raise SiddhiAppCreationError(
+                        "`every` on the first element is the head form — "
+                        "write `from every ...`")
+                for el in inner_list:
+                    self._add_element(el, ctx)
+                end = len(self.positions) - 1
+                for p in self.positions[head:end + 1]:
+                    if p.kind != "normal" or p.optional:
+                        raise SiddhiAppCreationError(
+                            "mid-pattern `every ( ... )` groups support "
+                            "plain stream elements only in this build")
+                self.mid_groups.append(_EveryGroup(head, end, None))
                 continue
             self._add_element(e, ctx)
         if not self.positions:
@@ -231,19 +287,21 @@ class _PatternPlan:
                     raise SiddhiAppCreationError(
                         "`not X or Y` is not supported in this build; "
                         "use `not X and Y` or split the query")
-                if absent.waiting_time_ms is not None:
-                    raise SiddhiAppCreationError(
-                        "timed logical absent (`not X for t and Y`) is not "
-                        "supported in this build; use `X -> not Y for t` "
-                        "shapes or the untimed `not X and Y`")
                 aref = self._ref_of(absent.stream, f"_p{i}a")
                 pref = self._ref_of(partner.stream, f"_p{i}b")
+                # waiting_time_ms set => timed logical absent
+                # (`not X for t and Y`): X within [armed, armed+t) kills;
+                # the partner may arrive any time; completion fires at
+                # max(armed+t, partner ts) once BOTH hold (reference:
+                # AbsentLogicalPreStateProcessor with a waiting time —
+                # LogicalAbsentPatternTestCase testQueryAbsent5/5_1/6/7/8)
                 self.positions.append(_Position(
                     i, "notand",
                     [_Leg(aref, absent.stream.stream_id,
                           tuple(absent.stream.handlers.filters)),
                      _Leg(pref, partner.stream.stream_id,
-                          tuple(partner.stream.handlers.filters))]))
+                          tuple(partner.stream.handlers.filters))],
+                    wait_ms=absent.waiting_time_ms))
                 return
             if not (isinstance(l, StreamStateElement)
                     and isinstance(r, StreamStateElement)):
@@ -262,19 +320,41 @@ class _PatternPlan:
             lo = e.min_count
             hi = e.max_count
             if hi == CountStateElement.ANY:
+                # UNBOUNDED counts (`A<2:>`, sequence `A+`/`A*`) expand to
+                # lo + config.pattern_unbounded_count_extra positions — a
+                # DOCUMENTED divergence from the reference's unbounded
+                # accumulation (CountPreStateProcessor.java): occurrences
+                # past the cap are not captured. Warn loudly at plan time
+                # (PARITY.md "Known gaps"); raise the config to widen.
                 hi = lo + dtypes.config.pattern_unbounded_count_extra
+                import warnings
+                warnings.warn(
+                    f"unbounded pattern count `{base}<{lo}:>` is expanded "
+                    f"to at most {hi} occurrences "
+                    "(config.pattern_unbounded_count_extra beyond the "
+                    "minimum); occurrences past that are NOT captured — "
+                    "raise siddhi_tpu.config.pattern_unbounded_count_extra "
+                    "if your matches repeat further", stacklevel=2)
             if lo < 0 or hi < max(lo, 1):
                 raise SiddhiAppCreationError(f"bad count range <{lo}:{hi}>")
             refs = []
+            span_start = len(self.positions)
             for k in range(hi):
                 idx = len(self.positions)
                 ref = f"{base}[{k}]"
                 refs.append(ref)
+                # lo == 0 (`A*` / `A?` / `<0:n>`): every position of the
+                # group is optional, so entries epsilon straight through
+                # (zero occurrences) and the step's startable-position scan
+                # lets the pattern BEGIN past the group
                 self.positions.append(_Position(
                     idx, "normal",
                     [_Leg(ref, s.stream_id, tuple(s.handlers.filters))],
-                    optional=k >= max(lo, 1)))
+                    optional=(lo == 0) or k >= lo))
             self.count_groups[base] = refs
+            if lo == 0:
+                self.zero_min_spans.append(
+                    (span_start, len(self.positions) - 1))
         else:
             raise SiddhiAppCreationError(
                 f"unsupported pattern element {type(e).__name__}")
@@ -338,7 +418,15 @@ class PendingTable(NamedTuple):
     last_seq: jax.Array  # int64[P]
     armed_ts: jax.Array  # int64[P]
     valid: jax.Array  # bool[P]
-    leg_done: jax.Array  # bool[P, 2] (logical positions)
+    #: logical positions: per-leg completion. Mid-every GROUP HEAD entries
+    #: reuse lane 0 as the iteration-in-flight latch (cleared when the
+    #: iteration completes past the group end)
+    leg_done: jax.Array  # bool[P, 2]
+    #: slot index (in the group head's table) of the context entry that
+    #: spawned this in-group iteration entry; -1 outside mid-every groups.
+    #: Defaults to None so pre-round-5 snapshots unpickle (restore backfills
+    #: from the template — persistence._to_device)
+    origin: jax.Array = None  # int32[P]
 
 
 class PatternState(NamedTuple):
@@ -355,6 +443,11 @@ class PatternState(NamedTuple):
     #: snapshots pickled before this field existed still unpickle; restore
     #: fills it from the freshly built runtime state (persistence._to_device)
     armed0_ts: jax.Array = None  # int64
+    #: head every-group gate: the next iteration may start only with an
+    #: arrival seq >= gate0_seq (set to completion seq + 1 when an
+    #: iteration finishes — EveryPatternTestCase testQuery5 pairing).
+    #: None-default for pre-round-5 snapshot tolerance
+    gate0_seq: jax.Array = None  # int64
 
 
 class PatternQueryRuntime:
@@ -475,7 +568,11 @@ class PatternQueryRuntime:
         self._heartbeat_step = jax.jit(self._make_step(None), donate_argnums=(0,))
         self.has_time_semantics = (
             plan.within_ms is not None
-            or any(p.kind == "absent" for p in plan.positions))
+            or (plan.head_group is not None
+                and plan.head_group.within_ms is not None)
+            or any(p.kind == "absent" or
+                   (p.kind == "notand" and p.wait_ms is not None)
+                   for p in plan.positions))
 
     # ---------------------------------------------------------- merged stream
 
@@ -544,9 +641,11 @@ class PatternQueryRuntime:
         for pos in self.plan.positions[:pos_index]:
             for leg in pos.legs:
                 refs.append(leg.ref)
-        # logical positions also capture their own legs progressively
+        # logical (and timed logical-absent) positions also capture their
+        # own legs progressively
         pos = self.plan.positions[pos_index]
-        if pos.kind == "logical":
+        if pos.kind == "logical" or (pos.kind == "notand"
+                                     and pos.wait_ms is not None):
             for leg in pos.legs:
                 refs.append(leg.ref)
         return refs
@@ -569,6 +668,7 @@ class PatternQueryRuntime:
             armed_ts=jnp.zeros((P,), dtypes.TS_DTYPE),
             valid=jnp.zeros((P,), bool),
             leg_done=jnp.zeros((P, 2), bool),
+            origin=jnp.full((P,), -1, jnp.int32),
         )
 
     def _init_state(self) -> PatternState:
@@ -584,6 +684,7 @@ class PatternQueryRuntime:
                 (-1 if self.ctx.playback
                  else self.ctx.timestamp_generator.current_time())
                 if leading_absent else -(2 ** 62)),
+            gate0_seq=jnp.int64(0),
         )
 
     # ------------------------------------------------------------------- step
@@ -626,9 +727,28 @@ class PatternQueryRuntime:
         is_seq = plan.is_sequence
         every = plan.every
 
+        hg = plan.head_group
+        mid_heads = {g.head: g for g in plan.mid_groups}
+        # positions where a NEW match may begin: 0, plus the position after
+        # each leading zero-minimum count group (`A*, B`: a B with zero A's
+        # starts the match at B). Groups (every (...)) exclude themselves.
+        startable = {0}
+        _idx = 0
+        for _s0, _e0 in plan.zero_min_spans:
+            if _s0 != _idx:
+                break
+            _idx = _e0 + 1
+            if _idx < S:
+                in_group = (hg is not None and _idx <= hg.end) or any(
+                    g.head <= _idx <= g.end for g in plan.mid_groups)
+                if not in_group:
+                    startable.add(_idx)
+
         def step(state: PatternState, batch: EventBatch, now):
             pending = list(state.pending)
-            active0 = state.active0
+            active0_box = [state.active0]
+            gate0_box = [state.gate0_seq if state.gate0_seq is not None
+                         else jnp.int64(0)]
             B = batch.ts.shape[0]
 
             n_valid = jnp.sum(batch.valid.astype(jnp.int64))
@@ -640,17 +760,85 @@ class PatternQueryRuntime:
             out_blocks = []  # (frames {ref: cols}, fvalid {ref}, fts, ts, valid)
             drop_acc = [jnp.int64(0)]  # pending-table insert overflow
             armed0_out = [state.armed0_ts]  # leading-absent lazy arming
+            gate_ctx = {"active0": active0_box, "gate0": gate0_box}
 
-            def expire(pend: PendingTable) -> PendingTable:
-                if within is None:
+            def expire(pend: PendingTable, pos_index: int) -> PendingTable:
+                gw = (hg.within_ms if hg is not None
+                      and hg.head < pos_index <= hg.end else None)
+                if within is None and gw is None:
                     return pend
-                ok = pend.valid & (now - pend.start_ts <= jnp.int64(within))
+                ok = pend.valid
+                if within is not None:
+                    ok = ok & (now - pend.start_ts <= jnp.int64(within))
+                if gw is not None:
+                    # within scoped INSIDE `every (...)`: bounds each
+                    # ITERATION (start_ts = the iteration's first capture)
+                    ok = ok & (now - pend.start_ts <= jnp.int64(gw))
+                died = pend.valid & ~ok
+                if hg is not None and hg.head < pos_index <= hg.end:
+                    # the in-flight head-group iteration expired: re-arm
+                    # the gate or the every-loop would stall forever
+                    active0_box[0] = active0_box[0] | died.any()
+                for g in plan.mid_groups:
+                    if g.head < pos_index <= g.end:
+                        # clear the origin context entry's busy latch
+                        P_ = pend.valid.shape[0]
+                        o = jnp.where(died & (pend.origin >= 0),
+                                      pend.origin, P_)
+                        head_tbl = pending[g.head - 1]
+                        pending[g.head - 1] = head_tbl._replace(
+                            leg_done=head_tbl.leg_done.at[o, 0].set(
+                                False, mode="drop"))
                 return pend._replace(valid=ok)
 
-            pending = [expire(p) for p in pending]
+            # in place: expire() may clear busy latches on EARLIER tables
+            # (mid-every origins), which a rebinding comprehension would
+            # discard
+            for _i in range(len(pending)):
+                pending[_i] = expire(pending[_i], _i + 1)
 
             merged = junction_sid == MERGED_SID
-            for pi, pos in enumerate(plan.positions):
+
+            def begin_at(pi: int, pos):
+                """Start NEW match entries at position pi (pi=0, or a
+                startable position past leading zero-min optionals): one
+                shared protocol for gate + start-state consumption."""
+                leg = pos.legs[0]
+                leg_b = self._leg_batch(batch, leg)
+                m = self._leg_cond(leg, leg_b, None, now)[:, 0]  # [B]
+                gated = hg is not None and pi == 0
+                if not every or gated:
+                    # non-every: only the first match consumes the start
+                    # state. Grouped head-every: the gate admits ONE
+                    # iteration at a time — the first qualifying arrival
+                    # past the previous completion's seq starts it, and
+                    # the gate re-opens when the iteration leaves the
+                    # group (EveryPatternTestCase testQuery5 pairing)
+                    a0 = active0_box[0]
+                    if gated:
+                        m = m & (arr_seq >= gate0_box[0])
+                    mseq = jnp.where(m, arr_seq, BIGSEQ)
+                    only = jnp.zeros((B,), bool).at[jnp.argmin(mseq)].set(
+                        True)
+                    m = m & only & a0
+                    active0_box[0] = a0 & ~m.any()
+                frames = {leg.ref: dict(leg_b.cols)}
+                fvalid = {leg.ref: m}
+                fts = {leg.ref: batch.ts}
+                for pos_e in plan.positions[:pi]:  # skipped zero-min refs
+                    for lg in pos_e.legs:
+                        frames[lg.ref] = {
+                            n: jnp.zeros((B,), dtypes.device_dtype(t))
+                            for n, t in self.ref_types[lg.ref].items()}
+                        fvalid[lg.ref] = jnp.zeros((B,), bool)
+                        fts[lg.ref] = jnp.zeros((B,), dtypes.TS_DTYPE)
+                self._advance(pending, out_blocks, pi + 1, frames, fvalid,
+                              fts, batch.ts, arr_seq, batch.ts, m, drop_acc,
+                              gate_ctx=gate_ctx)
+
+            def process_position(pi: int):
+                pos = plan.positions[pi]
+                active0 = active0_box[0]
                 pend = pending[pi - 1] if pi > 0 else None
                 feeds = junction_sid is not None and (merged or any(
                     leg.stream_id == junction_sid for leg in pos.legs))
@@ -691,7 +879,8 @@ class PatternQueryRuntime:
                         pending, out_blocks, pi + 1,
                         comp_frames, comp_fvalid, comp_fts,
                         jnp.where(pend.valid, pend.start_ts, 0),
-                        pend.last_seq, comp_ts, due, drop_acc)
+                        pend.last_seq, comp_ts, due, drop_acc,
+                        origin=pend.origin, gate_ctx=gate_ctx)
                     if pos.sticky:
                         # `-> every not X for t`: one fire per elapsed quiet
                         # period — re-arm for the next period; a matching
@@ -709,7 +898,85 @@ class PatternQueryRuntime:
                     else:
                         pend = pend._replace(valid=pend.valid & ~due)
                     pending[pi - 1] = pend
-                    continue
+                    return
+
+                # ---- timed logical absent: `not X for t and Y` ---------
+                # X within [armed, armed+t) kills the entry; the partner Y
+                # may arrive before OR after the deadline (captured either
+                # way); the match fires at max(armed+t, Y ts) once the
+                # period elapses un-killed AND Y is captured (reference:
+                # AbsentLogicalPreStateProcessor with waiting time —
+                # LogicalAbsentPatternTestCase testQueryAbsent5/5_1/6/7/8).
+                # Time-driven completion: runs on every step incl.
+                # heartbeats.
+                if pos.kind == "notand" and pos.wait_ms is not None \
+                        and pi > 0:
+                    a_leg, p_leg = pos.legs
+                    Pn = pend.valid.shape[0]
+                    deadline = pend.armed_ts + jnp.int64(pos.wait_ms)
+                    if junction_sid is not None and (
+                            merged or a_leg.stream_id == junction_sid):
+                        kq = self._leg_cond(
+                            a_leg, self._leg_batch(batch, a_leg), pend, now)
+                        kq = kq & (arr_seq[:, None] > pend.last_seq[None, :])
+                        kq = kq & (batch.ts[:, None] < deadline[None, :])
+                        killed = kq.any(axis=0) & pend.valid
+                        pend = pend._replace(valid=pend.valid & ~killed)
+                    if junction_sid is not None and (
+                            merged or p_leg.stream_id == junction_sid):
+                        leg_b = self._leg_batch(batch, p_leg)
+                        q = self._leg_cond(p_leg, leg_b, pend, now)
+                        q = q & pend.valid[None, :] \
+                            & ~pend.leg_done[:, 1][None, :] \
+                            & (arr_seq[:, None] > pend.last_seq[None, :])
+                        if within is not None:
+                            q = q & (batch.ts[:, None]
+                                     - pend.start_ts[None, :]
+                                     <= jnp.int64(within))
+                        qseq = jnp.where(q, arr_seq[:, None], BIGSEQ)
+                        b_star = jnp.argmin(qseq, axis=0)
+                        matched = q.any(axis=0)
+                        cap = {n: v[b_star] for n, v in leg_b.cols.items()}
+                        cap_ts = batch.ts[b_star]
+                        nf = dict(pend.frames)
+                        nfv = dict(pend.frame_valid)
+                        nft = dict(pend.frame_ts)
+                        nf[p_leg.ref] = {
+                            n: jnp.where(matched, cap[n],
+                                         pend.frames[p_leg.ref][n])
+                            for n in cap}
+                        nfv[p_leg.ref] = pend.frame_valid[p_leg.ref] | matched
+                        nft[p_leg.ref] = jnp.where(
+                            matched, cap_ts, pend.frame_ts[p_leg.ref])
+                        pend = pend._replace(
+                            frames=nf, frame_valid=nfv, frame_ts=nft,
+                            leg_done=pend.leg_done.at[:, 1].set(
+                                pend.leg_done[:, 1] | matched),
+                            last_seq=jnp.where(
+                                matched,
+                                jnp.maximum(arr_seq[b_star], pend.last_seq),
+                                pend.last_seq))
+                    due = pend.valid & pend.leg_done[:, 1] & (now >= deadline)
+                    comp_frames = dict(pend.frames)
+                    comp_fv = dict(pend.frame_valid)
+                    comp_ft = dict(pend.frame_ts)
+                    aref = a_leg.ref
+                    comp_frames[aref] = {
+                        n: jnp.zeros((Pn,), dtypes.device_dtype(t))
+                        for n, t in self.ref_types[aref].items()}
+                    comp_fv[aref] = jnp.zeros((Pn,), bool)
+                    comp_ft[aref] = jnp.zeros((Pn,), dtypes.TS_DTYPE)
+                    comp_ts = jnp.maximum(deadline,
+                                          pend.frame_ts[p_leg.ref])
+                    new_pend = pend._replace(valid=pend.valid & ~due)
+                    pending[pi - 1] = new_pend
+                    self._advance(
+                        pending, out_blocks, pi + 1,
+                        comp_frames, comp_fv, comp_ft,
+                        jnp.where(due, pend.start_ts, 0),
+                        pend.last_seq, comp_ts, due, drop_acc,
+                        origin=pend.origin, gate_ctx=gate_ctx)
+                    return
 
                 # ---- leading absent: `not S1 for t -> ...` -------------
                 # armed once at runtime build (armed0_ts); a matching
@@ -759,7 +1026,8 @@ class PatternQueryRuntime:
                         pending, out_blocks, 1, frames, fvalid, fts,
                         jnp.full((P,), deadline),
                         jnp.full((P,), state.seq - 1),
-                        jnp.full((P,), deadline), ins_valid, drop_acc)
+                        jnp.full((P,), deadline), ins_valid, drop_acc,
+                        gate_ctx=gate_ctx)
                     if every:
                         # `every not X for t -> ...`: perpetual quiet-period
                         # monitor (EveryAbsentPatternTestCase testQueryAbsent5
@@ -771,37 +1039,24 @@ class PatternQueryRuntime:
                             km_any | km_late_any, kill_ts,
                             jnp.where(due, deadline, armed0))
                     else:
-                        active0 = active0 & ~km_any & ~due
+                        active0_box[0] = active0 & ~km_any & ~due
                     armed0_out[0] = armed0
-                    continue
+                    return
 
                 if not feeds:
-                    continue
+                    return
 
                 # ---- normal / logical positions fed by this junction ----
                 if pi == 0:
                     # virtual empty pending: [B,1]
-                    leg = pos.legs[0]
                     if pos.kind == "logical":
                         raise SiddhiAppCreationError(
                             "logical conditions at the first pattern position "
                             "are not yet supported")
-                    if not merged and leg.stream_id != junction_sid:
-                        continue
-                    leg_b = self._leg_batch(batch, leg)
-                    m = self._leg_cond(leg, leg_b, None, now)[:, 0]  # [B]
-                    if not every:
-                        # only the first match consumes the start state
-                        first_lane = jnp.argmax(m)
-                        only = jnp.zeros((B,), bool).at[first_lane].set(True)
-                        m = m & only & active0
-                        active0 = active0 & ~m.any()
-                    frames = {leg.ref: dict(leg_b.cols)}
-                    fvalid = {leg.ref: m}
-                    fts = {leg.ref: batch.ts}
-                    self._advance(pending, out_blocks, 1, frames, fvalid, fts,
-                                  batch.ts, arr_seq, batch.ts, m, drop_acc)
-                    continue
+                    if not merged and pos.legs[0].stream_id != junction_sid:
+                        return
+                    begin_at(pi, pos)
+                    return
 
                 # ---- logical absent: `not X and Y` ---------------------
                 # the absence holds until the AND partner arrives: an X
@@ -859,11 +1114,12 @@ class PatternQueryRuntime:
                             jnp.where(advanced,
                                       jnp.maximum(pseq, pend.last_seq),
                                       pend.last_seq),
-                            cap_ts, advanced, drop_acc)
+                            cap_ts, advanced, drop_acc,
+                            origin=pend.origin, gate_ctx=gate_ctx)
                     else:
                         pending[pi - 1] = pend._replace(
                             valid=pend.valid & ~killed)
-                    continue
+                    return
 
                 def _joint_kill(pi=pi, pos=pos):
                     # strict kill computed JOINTLY over both legs (the next
@@ -888,7 +1144,17 @@ class PatternQueryRuntime:
                 #: ordering snapshot for pattern-mode logical legs — sibling
                 #: matches in this batch must not block the other leg's
                 #: earlier arrival (legs complete in either order)
+                if (pi in startable and pi > 0 and pos.kind == "normal"
+                        and (merged
+                             or pos.legs[0].stream_id == junction_sid)):
+                    # zero-occurrence leading optionals: this arrival may
+                    # BEGIN a match here (skipped refs ride as absent
+                    # frames, like the reference's unsatisfied optional
+                    # count states)
+                    begin_at(pi, pos)
+
                 pend0 = pending[pi - 1]
+                mid_g = mid_heads.get(pi)
                 leg_iters = list(enumerate(pos.legs))
                 if is_seq and pos.kind == "logical":
                     # two passes: with strict contiguity, the second leg's
@@ -911,6 +1177,12 @@ class PatternQueryRuntime:
                     leg_b = self._leg_batch(batch, leg)
                     q = self._leg_cond(leg, leg_b, pend, now)  # [B,P]
                     q = q & pend.valid[None, :]
+                    if mid_g is not None:
+                        # mid-every group head: an entry with an iteration
+                        # in flight (busy latch) does not start another —
+                        # re-armed when the iteration completes past the
+                        # group end (_advance gate hook)
+                        q = q & ~pend.leg_done[:, 0][None, :]
                     if is_seq:
                         q = q & (arr_seq[:, None] == pend.last_seq[None, :] + 1)
                     elif pos.kind == "logical":
@@ -992,10 +1264,24 @@ class PatternQueryRuntime:
                                     jnp.maximum(arr_seq[b_star],
                                                 pend.last_seq),
                                     pend.last_seq))
+                        elif mid_g is not None:
+                            # group-head context entry stays armed but
+                            # busy-latched until this iteration completes
+                            pending[pi - 1] = pend._replace(
+                                leg_done=pend.leg_done.at[:, 0].set(
+                                    pend.leg_done[:, 0] | matched),
+                                last_seq=jnp.where(
+                                    matched,
+                                    jnp.maximum(arr_seq[b_star],
+                                                pend.last_seq),
+                                    pend.last_seq))
                         else:
                             pending[pi - 1] = pend._replace(
                                 valid=pend.valid & ~matched)
 
+                    adv_origin = (
+                        jnp.arange(pend.valid.shape[0], dtype=jnp.int32)
+                        if mid_g is not None else pend.origin)
                     self._advance(
                         pending, out_blocks, pi + 1,
                         ins_frames, ins_fvalid, ins_fts,
@@ -1003,7 +1289,8 @@ class PatternQueryRuntime:
                         jnp.where(adv_valid,
                                   jnp.maximum(arr_seq[b_star], pend.last_seq),
                                   pend.last_seq),
-                        comp_ts, adv_valid, drop_acc)
+                        comp_ts, adv_valid, drop_acc,
+                        origin=adv_origin, gate_ctx=gate_ctx)
 
                 if pos.sticky and (merged or
                                    pos.legs[0].stream_id == junction_sid):
@@ -1025,15 +1312,69 @@ class PatternQueryRuntime:
                     drop_acc[0] = drop_acc[0] + jnp.sum(
                         q_left, dtype=jnp.int64)
 
+            pi = 0
+            while pi < S:
+                g = hg if (hg is not None and pi == hg.head) else \
+                    mid_heads.get(pi)
+                if g is not None:
+                    # every-group: several passes so iterations can chain
+                    # start -> complete -> re-arm -> start within ONE
+                    # micro-batch (bounded by pattern_sticky_passes;
+                    # leftovers land in the `dropped` monitor below)
+                    for _pass in range(dtypes.config.pattern_sticky_passes):
+                        for pj in range(g.head, g.end + 1):
+                            process_position(pj)
+                    # iteration starts beyond the pass bound are LOST for
+                    # this batch (events are not buffered): count them into
+                    # the monitored `dropped` so operators see the
+                    # truncation and can raise pattern_sticky_passes
+                    head_pos = plan.positions[g.head]
+                    leg0 = head_pos.legs[0]
+                    if junction_sid is not None and (
+                            merged or leg0.stream_id == junction_sid):
+                        if g is hg:
+                            m_left = self._leg_cond(
+                                leg0, self._leg_batch(batch, leg0), None,
+                                now)[:, 0]
+                            m_left = m_left & (arr_seq >= gate0_box[0]) \
+                                & batch.valid
+                            cnt = jnp.sum(m_left, dtype=jnp.int64)
+                            # the in-flight iteration's own start event is
+                            # not a leftover (gate closed => one started)
+                            cnt = jnp.maximum(
+                                cnt - jnp.where(active0_box[0],
+                                                jnp.int64(0), jnp.int64(1)),
+                                0)
+                            drop_acc[0] = drop_acc[0] + cnt
+                        else:
+                            pend_h = pending[g.head - 1]
+                            ql = self._leg_cond(
+                                leg0, self._leg_batch(batch, leg0), pend_h,
+                                now)
+                            ql = ql & pend_h.valid[None, :] & (
+                                arr_seq[:, None] > pend_h.last_seq[None, :])
+                            if within is not None:
+                                ql = ql & (
+                                    batch.ts[:, None]
+                                    - pend_h.start_ts[None, :]
+                                    <= jnp.int64(within))
+                            drop_acc[0] = drop_acc[0] + jnp.sum(
+                                ql, dtype=jnp.int64)
+                    pi = g.end + 1
+                else:
+                    process_position(pi)
+                    pi += 1
+
             # ---- merge output blocks through the selector ----
             new_sel, out = self._emit(state.sel_state, out_blocks, now)
             new_state = PatternState(
                 pending=tuple(pending),
-                active0=active0,
+                active0=active0_box[0],
                 seq=state.seq + n_valid,
                 sel_state=new_sel,
                 dropped=state.dropped + drop_acc[0],
                 armed0_ts=armed0_out[0],
+                gate0_seq=gate0_box[0],
             )
             return new_state, out
 
@@ -1043,22 +1384,55 @@ class PatternQueryRuntime:
 
     def _advance(self, pending: list, out_blocks: list, target_pos: int,
                  frames, fvalid, fts, start_ts, last_seq, armed_ts,
-                 valid, drop_acc=None) -> None:
+                 valid, drop_acc=None, origin=None, gate_ctx=None) -> None:
         """Move completed entries to `target_pos` (insert into its waiting
         table, or emit if past the last position). Optional count positions
         add an epsilon edge: entries also advance past them immediately
         (reference: CountPreStateProcessor forwards once min counts are met).
         Note: the epsilon copy and the stay-behind copy are independent
         entries; a documented round-1 divergence is that both may eventually
-        complete (the reference consumes the shared state event once)."""
+        complete (the reference consumes the shared state event once).
+
+        `origin` carries the spawning context slot for mid-every-group
+        iteration entries; `gate_ctx` lets group-boundary crossings re-arm
+        their every-group (head gate scalars / mid busy latches)."""
         S = len(self.plan.positions)
+        P = self.P
+        if origin is None:
+            origin = jnp.full(valid.shape, -1, jnp.int32)
         while True:
+            if gate_ctx is not None:
+                hg = self.plan.head_group
+                if hg is not None and target_pos == hg.end + 1:
+                    # head every-group completion: re-open the gate for
+                    # arrivals past the completing event
+                    # (EveryPatternTestCase testQuery4/5)
+                    any_c = valid.any()
+                    mx = jnp.max(jnp.where(valid, last_seq,
+                                           jnp.int64(-BIGSEQ))) + 1
+                    gate_ctx["active0"][0] = gate_ctx["active0"][0] | any_c
+                    gate_ctx["gate0"][0] = jnp.where(
+                        any_c, jnp.maximum(gate_ctx["gate0"][0], mx),
+                        gate_ctx["gate0"][0])
+                for g in self.plan.mid_groups:
+                    if target_pos == g.end + 1:
+                        # mid every-group completion: clear the origin
+                        # context entry's busy latch and advance its seq
+                        # watermark (testQuery6 sequential iterations)
+                        head_tbl = pending[g.head - 1]
+                        o = jnp.where(valid & (origin >= 0), origin, P)
+                        pending[g.head - 1] = head_tbl._replace(
+                            leg_done=head_tbl.leg_done.at[o, 0].set(
+                                False, mode="drop"),
+                            last_seq=head_tbl.last_seq.at[o].max(
+                                last_seq, mode="drop"))
+                        origin = jnp.full(valid.shape, -1, jnp.int32)
             if target_pos >= S:
                 out_blocks.append((frames, fvalid, fts, armed_ts, valid))
                 return
             pending[target_pos - 1], n_drop = self._insert_entries(
                 pending[target_pos - 1], frames, fvalid, fts,
-                start_ts, last_seq, armed_ts, valid)
+                start_ts, last_seq, armed_ts, valid, origin)
             if drop_acc is not None:
                 drop_acc[0] = drop_acc[0] + n_drop
             if not self.plan.positions[target_pos].optional:
@@ -1066,7 +1440,8 @@ class PatternQueryRuntime:
             target_pos += 1
 
     def _insert_entries(self, dst: PendingTable, frames, fvalid, fts,
-                        start_ts, last_seq, armed_ts, valid) -> PendingTable:
+                        start_ts, last_seq, armed_ts, valid,
+                        origin=None) -> PendingTable:
         """Insert [P]-aligned candidate entries into dst's free slots."""
         P = self.P
         free_order = stable_partition_order(~dst.valid)
@@ -1093,6 +1468,8 @@ class PatternQueryRuntime:
                 fvalid.get(ref, valid), mode="drop")
             new_fts[ref] = dst.frame_ts[ref].at[slot].set(
                 fts.get(ref, jnp.zeros_like(dst.frame_ts[ref])), mode="drop")
+        if origin is None:
+            origin = jnp.full(valid.shape, -1, jnp.int32)
         return PendingTable(
             frames=new_frames, frame_valid=new_fvalid, frame_ts=new_fts,
             start_ts=dst.start_ts.at[slot].set(start_ts, mode="drop"),
@@ -1101,6 +1478,8 @@ class PatternQueryRuntime:
             valid=dst.valid.at[slot].set(valid, mode="drop"),
             leg_done=dst.leg_done.at[slot].set(
                 jnp.zeros((slot.shape[0], 2), bool), mode="drop"),
+            origin=dst.origin.at[slot].set(origin.astype(jnp.int32),
+                                           mode="drop"),
         ), n_drop
 
     # ------------------------------------------------------------------ emit

@@ -52,17 +52,26 @@ def _to_device(host_tree, like):
     import jax.numpy as jnp
 
     # version tolerance: a state NamedTuple that gained a defaulted field
-    # (e.g. PatternState.armed0_ts, round 4) unpickles from older snapshots
-    # with None in that slot — backfill every None-valued field from the
-    # freshly built template of the SAME type (for armed0_ts this re-arms
-    # the leading-absent rule at restore time); mismatched types fall
-    # through to tree_map's structure error, wrapped by the caller
-    if (isinstance(host_tree, tuple) and hasattr(host_tree, "_fields")
-            and type(like) is type(host_tree)
-            and any(v is None for v in host_tree)):
-        host_tree = host_tree._replace(**{
-            f: getattr(like, f)
-            for f, v in zip(host_tree._fields, host_tree) if v is None})
+    # (e.g. PatternState.armed0_ts r4, PendingTable.origin r5) unpickles
+    # from older snapshots with None in that slot — backfill every
+    # None-valued field from the freshly built template of the SAME type
+    # (for armed0_ts this re-arms the leading-absent rule at restore time).
+    # Recurses because the NamedTuples nest (PatternState holds
+    # PendingTables); mismatched types fall through to tree_map's structure
+    # error, wrapped by the caller.
+    def backfill(h, l):
+        if isinstance(h, tuple) and hasattr(h, "_fields") \
+                and type(l) is type(h):
+            return h._replace(**{
+                f: (getattr(l, f) if v is None
+                    else backfill(v, getattr(l, f)))
+                for f, v in zip(h._fields, h)})
+        if isinstance(h, tuple) and type(l) is tuple is type(h) \
+                and len(h) == len(l):
+            return tuple(backfill(a, b) for a, b in zip(h, l))
+        return h
+
+    host_tree = backfill(host_tree, like)
 
     def put(h, l):
         arr = jnp.asarray(h)

@@ -300,11 +300,182 @@ class TestMidPatternEvery:
         rt.flush()
         assert len(got) == 5
 
-    def test_grouped_every_rejected(self):
-        from siddhi_tpu.errors import SiddhiAppCreationError
-        with pytest.raises(SiddhiAppCreationError, match="grouped"):
-            make(THREE + "from e1=S1 -> every (e2=S2 -> e3=S3) "
-                 "select e1.symbol as a insert into OutStream;")
+    def test_mid_grouped_every(self):
+        # EveryPatternTestCase testQuery6 shape: e4 -> every (e1 -> e3) ->
+        # e2. Iterations of the group pair up sequentially (one in flight,
+        # re-armed on completion)
+        rt, got = make(
+            THREE + "from e4=S1[symbol == 'MSFT'] -> "
+            "every (e1=S1[price>20.0] -> e3=S1[price>20.0]) -> "
+            "e2=S2[price>e1.price] "
+            "select e1.price as p1, e3.price as p3, e2.price as p2 "
+            "insert into OutStream;")
+        s1 = rt.get_input_handler("S1")
+        s2 = rt.get_input_handler("S2")
+        for i, (sym, p) in enumerate([("MSFT", 55.6), ("WSO2", 55.7),
+                                      ("GOOG", 54.0), ("WSO2", 53.6),
+                                      ("GOOG", 53.0)]):
+            s1.send((sym, p), timestamp=1000 + i * 100)
+            rt.flush()
+        s2.send(("IBM", 57.7), timestamp=2000)
+        rt.flush()
+        assert [tuple(round(x, 1) for x in r) for r in got] == \
+            [(55.7, 54.0, 57.7), (53.6, 53.0, 57.7)]
+
+
+class TestGroupedHeadEvery:
+    """`every (e1 -> e3) [-> ...]` — the next iteration arms only when the
+    current one completes (reference: EveryPatternTestCase testQuery4/5,
+    EveryInnerStateRuntime.java:30)."""
+
+    APP = (THREE + "from every (e1=S1[price>20.0] -> e3=S1[price>20.0]) -> "
+           "e2=S2[price>e1.price] "
+           "select e1.price as p1, e3.price as p3, e2.price as p2 "
+           "insert into OutStream;")
+
+    def test_single_iteration(self):
+        # testQuery4: A A B -> exactly one match (not the sliding pairs)
+        rt, got = make(self.APP)
+        s1 = rt.get_input_handler("S1")
+        s1.send(("WSO2", 55.6), timestamp=1000)
+        rt.flush()
+        s1.send(("GOOG", 54.0), timestamp=1100)
+        rt.flush()
+        rt.get_input_handler("S2").send(("IBM", 57.7), timestamp=1200)
+        rt.flush()
+        assert [tuple(round(x, 1) for x in r) for r in got] == \
+            [(55.6, 54.0, 57.7)]
+
+    def test_iterations_pair_up(self):
+        # testQuery5: A A A A B -> (A1,A2) and (A3,A4), NOT sliding windows
+        rt, got = make(self.APP)
+        s1 = rt.get_input_handler("S1")
+        for i, p in enumerate([55.6, 54.0, 53.6, 53.0]):
+            s1.send(("X", p), timestamp=1000 + i * 100)
+            rt.flush()
+        rt.get_input_handler("S2").send(("IBM", 57.7), timestamp=2000)
+        rt.flush()
+        assert [tuple(round(x, 1) for x in r) for r in got] == \
+            [(55.6, 54.0, 57.7), (53.6, 53.0, 57.7)]
+
+    def test_iterations_pair_up_single_batch(self):
+        # all four A's in ONE micro-batch: multi-pass chaining still pairs
+        rt, got = make(self.APP)
+        s1 = rt.get_input_handler("S1")
+        for i, p in enumerate([55.6, 54.0, 53.6, 53.0]):
+            s1.send(("X", p), timestamp=1000 + i * 100)
+        rt.flush()
+        rt.get_input_handler("S2").send(("IBM", 57.7), timestamp=2000)
+        rt.flush()
+        assert sorted(tuple(round(x, 1) for x in r) for r in got) == \
+            [(53.6, 53.0, 57.7), (55.6, 54.0, 57.7)]
+
+    def test_bare_group_emits_per_completion(self):
+        # `from every (e1 -> e3) select ...` with nothing after: one output
+        # per completed iteration (EveryPatternTestCase.java:422 shape)
+        rt, got = make(
+            THREE + "from every (e1=S1[price>20.0] -> e3=S1[price>20.0]) "
+            "select e1.price as p1, e3.price as p3 insert into OutStream;")
+        s1 = rt.get_input_handler("S1")
+        for i, p in enumerate([55.6, 54.0, 53.6, 53.0, 52.0]):
+            s1.send(("X", p), timestamp=1000 + i * 100)
+            rt.flush()
+        assert [tuple(round(x, 1) for x in r) for r in got] == \
+            [(55.6, 54.0), (53.6, 53.0)]
+
+    def test_within_inside_every_bounds_each_iteration(self):
+        # `every ((e1 -> e3) within 1 sec)`: the e1->e3 gap is bounded per
+        # iteration; a stale half-open iteration expires and the loop
+        # re-arms (reference: per-state within lists,
+        # StreamPreStateProcessor.java:119-136)
+        rt, got = make(
+            THREE + "from every ((e1=S1[price>20.0] -> "
+            "e3=S1[price>20.0]) within 1 sec) "
+            "select e1.price as p1, e3.price as p3 insert into OutStream;")
+        s1 = rt.get_input_handler("S1")
+        s1.send(("X", 55.6), timestamp=1000)
+        rt.flush()
+        rt.heartbeat(now=2500)  # iteration expires un-completed
+        s1.send(("X", 54.0), timestamp=3000)
+        rt.flush()
+        s1.send(("X", 53.0), timestamp=3500)
+        rt.flush()
+        # 55.6 never pairs (expired); (54.0, 53.0) completes within 1s
+        assert [tuple(round(x, 1) for x in r) for r in got] == [(54.0, 53.0)]
+
+
+class TestTimedNotAnd:
+    """`A -> not X for t and Y` (reference: LogicalAbsentPatternTestCase
+    testQueryAbsent5/5_1/6/7/8 — AbsentLogicalPreStateProcessor with a
+    waiting time)."""
+
+    APP = (THREE + "from e1=S1[price>10.0] -> "
+           "not S2[price>20.0] for 1 sec and e3=S3[price>30.0] "
+           "select e1.symbol as s1, e3.symbol as s3 insert into OutStream;")
+
+    def test_partner_after_period_fires(self):
+        # testQueryAbsent5: A; quiet 1s; Y -> match at Y
+        rt, got = make(self.APP)
+        rt.get_input_handler("S1").send(("WSO2", 15.0), timestamp=1000)
+        rt.flush()
+        rt.get_input_handler("S3").send(("GOOGLE", 35.0), timestamp=2200)
+        rt.flush()
+        assert got == [("WSO2", "GOOGLE")]
+
+    def test_partner_inside_period_fires_at_deadline(self):
+        # testQueryAbsent5_1: A; Y at +0.5s; period completes at +1s -> match
+        rt, got = make(self.APP)
+        rt.get_input_handler("S1").send(("WSO2", 15.0), timestamp=1000)
+        rt.flush()
+        rt.get_input_handler("S3").send(("GOOGLE", 35.0), timestamp=1500)
+        rt.flush()
+        assert got == []  # not before the deadline
+        rt.heartbeat(now=2100)
+        assert got == [("WSO2", "GOOGLE")]
+
+    def test_no_fire_before_deadline(self):
+        # testQueryAbsent6: A; Y at +0.1s; nothing reaches the deadline
+        rt, got = make(self.APP)
+        rt.get_input_handler("S1").send(("WSO2", 15.0), timestamp=1000)
+        rt.flush()
+        rt.get_input_handler("S3").send(("GOOGLE", 35.0), timestamp=1100)
+        rt.flush()
+        assert got == []
+
+    def test_x_inside_period_kills(self):
+        # testQueryAbsent7: A; X at +0.1s; Y at +0.2s -> no match ever
+        rt, got = make(self.APP)
+        rt.get_input_handler("S1").send(("WSO2", 15.0), timestamp=1000)
+        rt.flush()
+        rt.get_input_handler("S2").send(("IBM", 25.0), timestamp=1100)
+        rt.flush()
+        rt.get_input_handler("S3").send(("GOOGLE", 35.0), timestamp=1200)
+        rt.flush()
+        rt.heartbeat(now=3000)
+        assert got == []
+
+    def test_x_after_period_is_ignored(self):
+        # testQueryAbsent8: A; quiet 1s; X at +1.1s; Y at +1.2s -> match
+        rt, got = make(self.APP)
+        rt.get_input_handler("S1").send(("WSO2", 15.0), timestamp=1000)
+        rt.flush()
+        rt.get_input_handler("S2").send(("IBM", 25.0), timestamp=2100)
+        rt.flush()
+        rt.get_input_handler("S3").send(("GOOGLE", 35.0), timestamp=2200)
+        rt.flush()
+        assert got == [("WSO2", "GOOGLE")]
+
+    def test_x_kills_even_after_partner_captured(self):
+        # testQueryAbsent8_2: A; X and Y both inside the period -> no match
+        rt, got = make(self.APP)
+        rt.get_input_handler("S1").send(("WSO2", 15.0), timestamp=1000)
+        rt.flush()
+        rt.get_input_handler("S3").send(("GOOGLE", 35.0), timestamp=1100)
+        rt.flush()
+        rt.get_input_handler("S2").send(("IBM", 25.0), timestamp=1200)
+        rt.flush()
+        rt.heartbeat(now=3000)
+        assert got == []
 
 
 class TestEveryNot:

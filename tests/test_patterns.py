@@ -409,3 +409,78 @@ class TestLogicalInBatchOrdering:
         s3.send(("C", 45.0))
         rt.flush()
         assert got == []
+
+
+class TestUnboundedCounts:
+    """Unbounded counts are expanded to min +
+    config.pattern_unbounded_count_extra positions with a plan-time
+    warning — documented divergence from the reference's unbounded
+    CountPreStateProcessor (PARITY.md "Known gaps")."""
+
+    def test_cap_warns_and_matches_up_to_bound(self):
+        import warnings as _w
+
+        from siddhi_tpu.core import dtypes as _dt
+        app = ("define stream S (k string, v int);\n"
+               "from every e1=S[v > 0]<2:> -> e2=S[v == 0] "
+               "select e1[0].k as k0, e1[last].k as kl, e2.k as k2 "
+               "insert into OutStream;")
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            rt, got = make(app)
+        assert any("unbounded pattern count" in str(r.message) for r in rec)
+        h = rt.get_input_handler("S")
+        n = 2 + _dt.config.pattern_unbounded_count_extra + 10  # 20 events
+        for i in range(n):
+            h.send((f"a{i}", 1)); rt.flush()
+        h.send(("z", 0)); rt.flush()
+        # the capped expansion captured at most lo+extra occurrences:
+        # e1[last] resolves to the newest CAPTURED one, not the 20th
+        assert got, "capped count still matches"
+        cap = 2 + _dt.config.pattern_unbounded_count_extra
+        # the REAL contract: each entry captures at most `cap` consecutive
+        # occurrences, so e1[last] sits within cap of that entry's e1[0]
+        for k0, kl, _ in got:
+            assert int(kl[1:]) - int(k0[1:]) < cap, (k0, kl)
+        # deep captures beyond the minimum ARE used (near the cap)
+        assert any(int(kl[1:]) - int(k0[1:]) >= cap - 2
+                   for k0, kl, _ in got)
+
+    def test_sequence_plus_matches(self):
+        # sequence regex `+`: one-or-more, greedy up to the cap
+        import warnings as _w
+        app = ("define stream S (k string, v int);\n"
+               "from every e1=S[v > 0]+, e2=S[v == 0] "
+               "select e1[0].k as k0, e1[last].k as kl, e2.k as k2 "
+               "insert into OutStream;")
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            rt, got = make(app)
+        h = rt.get_input_handler("S")
+        for row in [("a", 1), ("b", 2), ("z", 0)]:
+            h.send(row); rt.flush()
+        assert ("a", "b", "z") in got
+
+    def test_sequence_star_allows_zero(self):
+        import warnings as _w
+        app = ("define stream S (k string, v int);\n"
+               "from every e1=S[v > 5]*, e2=S[v == 0] "
+               "select e2.k as k2 insert into OutStream;")
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            rt, got = make(app)
+        h = rt.get_input_handler("S")
+        h.send(("z", 0)); rt.flush()  # zero e1 occurrences: still matches
+        assert ("z",) in got
+
+    def test_sequence_question_optional(self):
+        app = ("define stream S (k string, v int);\n"
+               "from every e1=S[v > 5]?, e2=S[v == 0] "
+               "select e2.k as k2 insert into OutStream;")
+        rt, got = make(app)
+        h = rt.get_input_handler("S")
+        h.send(("z", 0)); rt.flush()
+        assert ("z",) in got
+        h.send(("a", 9)); rt.flush()
+        h.send(("y", 0)); rt.flush()
+        assert ("y",) in got

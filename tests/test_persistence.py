@@ -125,12 +125,18 @@ class TestPersistRestore:
         rt.flush()
         blob = rt.snapshot()
 
-        # simulate the old wire format: drop armed0_ts from the pickled state
+        # simulate the round-3 wire format: no armed0_ts / gate0_seq on
+        # PatternState and no origin on the PendingTables
+        from siddhi_tpu.core.pattern_runtime import PendingTable
         snap = pickle.loads(blob)
         st = snap["queries"]["p"]
         assert isinstance(st, PatternState)
-        snap["queries"]["p"] = PatternState(*tuple(st)[:-1])
+        old_pending = tuple(PendingTable(*tuple(p)[:8]) for p in st.pending)
+        snap["queries"]["p"] = PatternState(
+            old_pending, *tuple(st)[1:5])
         assert snap["queries"]["p"].armed0_ts is None
+        assert snap["queries"]["p"].gate0_seq is None
+        assert old_pending[0].origin is None
         old_blob = pickle.dumps(snap)
 
         rt.restore(old_blob)
