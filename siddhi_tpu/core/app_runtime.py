@@ -34,7 +34,8 @@ class SiddhiAppRuntime:
                  batch_size: int = 0, group_capacity: int = 0,
                  error_store=None, config_manager=None,
                  mesh=None, partition_capacity: int = 0,
-                 async_callbacks: bool = False) -> None:
+                 async_callbacks: bool = False,
+                 auto_flush_ms: Optional[float] = None) -> None:
         self.app = app
         playback_ann = app.annotation("app:playback")
         idle_ms = increment_ms = None
@@ -61,6 +62,19 @@ class SiddhiAppRuntime:
         )
         self.ctx.runtime = self
         self.ctx.async_callbacks = async_callbacks
+        # wall-clock auto-flush — the Disruptor's immediate-consumption role
+        # (reference: StreamJunction.java:68 batchSize knob +
+        # core/util/Scheduler.java:48 timer re-entry): staged rows are
+        # flushed within ~auto_flush_ms without the caller polling flush().
+        # Enable per runtime (kwarg) or per app (@app:autoFlush('10 ms')).
+        af_ann = app.annotation("app:autoFlush")
+        if auto_flush_ms is None and af_ann is not None:
+            from .partition import _parse_annotation_time
+            v = af_ann.element("interval") or af_ann.element()
+            auto_flush_ms = _parse_annotation_time(v) if v else 10.0
+        self.auto_flush_ms = auto_flush_ms
+        self._flusher_stop = None
+        self._flusher_thread = None
         self.ctx.error_store = error_store
         self.ctx.config_manager = config_manager
         from .event import StringTable
@@ -296,9 +310,55 @@ class SiddhiAppRuntime:
             for tr in self.triggers.values():
                 tr.start(now)
             self.flush(now)
+        if self.auto_flush_ms:
+            import threading
+            # producers must pair their staged appends under the controller
+            # lock once a flusher thread can swap the lists concurrently
+            self.ctx.autoflush_active = True
+            self._flusher_stop = threading.Event()
+            self._flusher_thread = threading.Thread(
+                target=self._flusher_loop, daemon=True,
+                name=f"siddhi-flusher-{self.app.name}")
+            self._flusher_thread.start()
+
+    def _flusher_loop(self) -> None:
+        """Daemon: bound staged-row latency to ~auto_flush_ms without the
+        caller polling flush() (the Disruptor's immediate consumption).
+        Also drives heartbeats for time-semantic queries in realtime mode
+        so absences/time windows fire on wall clock during idle."""
+        interval = self.auto_flush_ms / 1000.0
+        needs_hb = any(
+            getattr(qr, "has_time_semantics", False)
+            for qr in self.query_runtimes.values()) or any(
+            w.has_time_semantics for w in self.windows.values())
+        while not self._flusher_stop.wait(interval / 2):
+            if not self._started:
+                return
+            try:
+                # async junctions drain via their own feeder threads;
+                # the flusher covers synchronous staging. The whole tick
+                # runs under the controller lock: query steps donate their
+                # state buffers, so a tick racing a user-thread delivery
+                # into the same runtime would double-donate
+                with self.ctx.controller_lock:
+                    staged = any(j._staged_rows
+                                 for j in self.junctions.values())
+                    if staged:
+                        self.flush()
+                    elif needs_hb and not self.ctx.playback:
+                        self.heartbeat()
+            except Exception:  # noqa: BLE001 — flusher must not die
+                import logging
+                logging.getLogger("siddhi_tpu").exception(
+                    "auto-flush tick failed")
 
     def shutdown(self, *, flush_durable: bool = True) -> None:
         self._started = False
+        if self._flusher_stop is not None:
+            self._flusher_stop.set()
+            if self._flusher_thread is not None:
+                self._flusher_thread.join(timeout=5)
+            self._flusher_stop = None
         for j in self.junctions.values():
             j.stop_async()
         if self.ctx.decoder is not None:

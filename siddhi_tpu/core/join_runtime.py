@@ -47,6 +47,66 @@ from .query_runtime import QueryCallback, eval_constant
 from .stream import Receiver, StreamJunction
 
 
+def _qualify_for_store(expr, probe_side, table_side, resolver):
+    """Rewrite a join ON condition for the store walk: table-side variables
+    (by RESOLVER classification — aliases and unqualified attrs included)
+    get the table DEFINITION id (walk_condition's table_id), probe-side
+    variables get the probe ref (the parameter-name prefix). Variables
+    resolving to neither frame raise — no fallback for them."""
+    import dataclasses as _dc
+
+    from ..ops.join import frames_of
+    from ..query_api.expression import Expression, Variable
+    table_id = table_side.table.definition.id
+
+    def walk(e):
+        if isinstance(e, Variable):
+            fr = frames_of(e, resolver)
+            if fr <= {table_side.ref}:
+                return _dc.replace(e, stream_id=table_id)
+            if fr <= {probe_side.ref}:
+                return _dc.replace(e, stream_id=probe_side.ref)
+            raise SiddhiAppCreationError(
+                f"store fallback cannot classify {e.attribute!r}")
+        kw = {}
+        for a in ("left", "right", "expression"):
+            sub = getattr(e, a, None)
+            if isinstance(sub, Expression):
+                kw[a] = walk(sub)
+        if getattr(e, "parameters", None):
+            return _dc.replace(e, parameters=tuple(
+                walk(p) if isinstance(p, Expression) else p
+                for p in e.parameters))
+        if kw:
+            return _dc.replace(e, **kw)
+        return e
+
+    return walk(expr)
+
+
+def _collect_vars(expr):
+    """All Variable leaves of a condition AST (probe-attr discovery for the
+    condition-based store fallback)."""
+    from ..query_api.expression import Expression, Variable
+    out = []
+
+    def walk(e):
+        if isinstance(e, Variable):
+            out.append(e)
+            return
+        for a in ("left", "right", "expression"):
+            sub = getattr(e, a, None)
+            if isinstance(sub, Expression):
+                walk(sub)
+        for p in getattr(e, "parameters", ()) or ():
+            if isinstance(p, Expression):
+                walk(p)
+
+    if expr is not None:
+        walk(expr)
+    return out
+
+
 class _Side:
     """One join side: a stream (junction + window), a table, or a named
     window (probed via its shared contents; its emissions also trigger)."""
@@ -119,6 +179,8 @@ class _Side:
                 factory = registry.require(ExtensionKind.WINDOW, wh.namespace, wh.name)
                 assert isinstance(factory, WindowFactory)
                 params = [eval_constant(p) for p in wh.parameters]
+                registry.validate_params(ExtensionKind.WINDOW, wh.namespace,
+                                         wh.name, params, what="window")
                 self.window = factory.make(layout, batch_cap, params, True)
             else:
                 self.window = PassThroughWindow(layout, batch_cap)
@@ -184,6 +246,7 @@ class JoinQueryRuntime:
         for t_side, p_side in ((self.left, self.right),
                                (self.right, self.left)):
             t_side._fallback_pairs = None
+            t_side._fallback_cond = None
             if (t_side.is_table and isinstance(t_side.table, RecordTableRuntime)
                     and t_side.table.cache_policy is not None):
                 pairs = self._simple_equi_pairs(jis.on, p_side, t_side)
@@ -191,7 +254,25 @@ class JoinQueryRuntime:
                 if pairs:
                     t_side.table._probe_fallback_ready = True
                 else:
-                    t_side.table._probe_nofallback = True
+                    # non-equi / mixed conditions (`S.k < T.k`): compile the
+                    # WHOLE ON condition into a parameterized store
+                    # predicate; each probing batch then warms the cache
+                    # with every store row matching any probe row
+                    # (ensure_cached_for_condition). Conditions the store
+                    # walk cannot express (math/functions over table attrs)
+                    # keep the documented cache-only miss
+                    try:
+                        on_rw = _qualify_for_store(
+                            jis.on, p_side, t_side, self.resolver)
+                        pred = t_side.table.compile_param_condition(on_rw)
+                        probe_attrs = sorted({
+                            v.attribute
+                            for v in _collect_vars(on_rw)
+                            if v.stream_id == p_side.ref})
+                        t_side._fallback_cond = (pred, tuple(probe_attrs))
+                        t_side.table._probe_fallback_ready = True
+                    except SiddhiAppCreationError:
+                        t_side.table._probe_nofallback = True
 
         # --- selector over the pair frames ---
         select_all = [(n, t) for n, t in self.left.attr_types.items()]
@@ -286,7 +367,9 @@ class JoinQueryRuntime:
             return
         pairs = build._fallback_pairs
         if not pairs:
-            return  # non-simple keys: PARITY-documented miss warning applies
+            if build._fallback_cond is not None:
+                self._condition_fallback(build, probe, batch)
+            return  # else: PARITY-documented miss warning applies
         valid, host = jax.device_get(
             (batch.valid, {pa: batch.cols[pa] for pa, _ in pairs}))
         import numpy as np
@@ -306,6 +389,43 @@ class JoinQueryRuntime:
                 key_cols.append(arr.tolist())
         table.ensure_cached_for_keys(
             tuple(ta for _pa, ta in pairs), set(zip(*key_cols)))
+
+    def _condition_fallback(self, build, probe, batch: EventBatch) -> None:
+        """Non-equi / computed probe conditions: warm the cache with every
+        store row matching ANY of this batch's probe rows through the
+        parameterized store predicate (reference:
+        AbstractQueryableRecordTable.java:207-238 — the store is queried
+        with streamVariable parameters on every cache miss)."""
+        import numpy as np
+        pred, probe_attrs = build._fallback_cond
+        valid, host = jax.device_get(
+            (batch.valid, {a: batch.cols[a] for a in probe_attrs}))
+        idx = np.nonzero(valid)[0]
+        if idx.size == 0:
+            return
+        cols = {}
+        for a in probe_attrs:
+            arr = host[a][idx]
+            at = probe.attr_types[a]
+            if at == AttributeType.STRING:
+                cols[a] = probe.codec.string_tables[a].decode_array(
+                    arr.tolist())
+            elif at == AttributeType.BOOL:
+                cols[a] = arr.astype(bool).tolist()
+            else:
+                cols[a] = arr.tolist()
+        # distinct probe parameter rows, keyed the way walk_condition names
+        # stream values ("<probe_ref>.<attr>")
+        seen = set()
+        param_rows = []
+        for i in range(len(idx)):
+            t = tuple(cols[a][i] for a in probe_attrs)
+            if t in seen:
+                continue
+            seen.add(t)
+            param_rows.append({f"{probe.ref}.{a}": v
+                               for a, v in zip(probe_attrs, t)})
+        build.table.ensure_cached_for_condition(pred, param_rows)
 
     def _probe_outer(self, from_left: bool) -> bool:
         if self.join_type == JoinType.FULL_OUTER:
@@ -520,7 +640,8 @@ class JoinQueryRuntime:
                     or (self.trigger == EventTrigger.RIGHT and not from_left))
         step = self._step_left if from_left else self._step_right
         if build.is_table:
-            if getattr(build, "_fallback_pairs", None):
+            if getattr(build, "_fallback_pairs", None) is not None or \
+                    getattr(build, "_fallback_cond", None) is not None:
                 self._maybe_store_fallback(build, side, batch)
             tstate = build.table.state
         elif build.is_named_window:

@@ -41,6 +41,7 @@ class SiddhiManager:
         batch_size: int = 0, group_capacity: int = 0,
         mesh=None, partition_capacity: int = 0,
         async_callbacks: bool = False,
+        auto_flush_ms=None,
     ) -> SiddhiAppRuntime:
         app = self._parse(app)
         rt = SiddhiAppRuntime(app, self.registry, batch_size=batch_size,
@@ -48,7 +49,8 @@ class SiddhiManager:
                               error_store=self.error_store,
                               config_manager=self.config_manager,
                               mesh=mesh, partition_capacity=partition_capacity,
-                              async_callbacks=async_callbacks)
+                              async_callbacks=async_callbacks,
+                              auto_flush_ms=auto_flush_ms)
         if self.persistence_store is not None:
             rt.persistence_store = self.persistence_store
         self.runtimes[app.name] = rt

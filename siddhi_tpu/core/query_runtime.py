@@ -233,6 +233,8 @@ class QueryRuntime(Receiver):
             factory = registry.require(ExtensionKind.WINDOW, wh.namespace, wh.name)
             assert isinstance(factory, WindowFactory)
             params = [eval_constant(p) for p in wh.parameters]
+            registry.validate_params(ExtensionKind.WINDOW, wh.namespace,
+                                     wh.name, params, what="window")
             self.window: WindowOp = factory.make(layout, batch_cap, params, expired_on)
         else:
             self.window = PassThroughWindow(layout, batch_cap)

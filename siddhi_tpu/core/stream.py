@@ -466,8 +466,16 @@ class StreamJunction:
                     return
                 self._feeder_wake.set()
                 time.sleep(0.0002)
-        self._staged_ts.append(ts)
-        self._staged_rows.append(data)
+        if getattr(self.ctx, "autoflush_active", False) \
+                and not self._lock_owned():
+            # an auto-flush daemon may swap the staged lists concurrently:
+            # the ts+row pair must land atomically w.r.t. that swap
+            with self.ctx.controller_lock:
+                self._staged_ts.append(ts)
+                self._staged_rows.append(data)
+        else:
+            self._staged_ts.append(ts)
+            self._staged_rows.append(data)
         self.ctx.timestamp_generator.observe_event_time(ts)
         if len(self._staged_rows) >= self.batch_size:
             self.flush()
@@ -504,8 +512,14 @@ class StreamJunction:
                     break
             else:
                 return
-        self._staged_ts.extend(tss)
-        self._staged_rows.extend(rows)
+        if getattr(self.ctx, "autoflush_active", False) \
+                and not self._lock_owned():
+            with self.ctx.controller_lock:
+                self._staged_ts.extend(tss)
+                self._staged_rows.extend(rows)
+        else:
+            self._staged_ts.extend(tss)
+            self._staged_rows.extend(rows)
         if len(self._staged_rows) >= self.batch_size:
             self.flush()
 

@@ -59,6 +59,8 @@ class NamedWindow:
             assert isinstance(factory, WindowFactory)
             from .query_runtime import eval_constant
             params = [eval_constant(p) for p in wh.parameters]
+            registry.validate_params(ExtensionKind.WINDOW, wh.namespace,
+                                     wh.name, params, what="window")
             self.window: WindowOp = factory.make(layout, batch_cap, params, True)
         else:
             # `define window W (...)` with no spec: pass-through emission, no

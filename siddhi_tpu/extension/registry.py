@@ -31,20 +31,113 @@ class ExtensionKind(enum.Enum):
     DISTRIBUTION_STRATEGY = "distribution_strategy"
 
 
+@dataclass(frozen=True)
+class Parameter:
+    """Declared extension parameter (reference:
+    siddhi-annotations @Parameter — name/type/optional/defaultValue/
+    description, validated by
+    core/util/extension/validator/InputParameterValidator.java)."""
+
+    name: str
+    #: accepted type names: int, long, float, double, bool, string, time
+    #: (int ms from `<n> sec` literals), attribute (a stream attr reference)
+    types: tuple
+    optional: bool = False
+    default: object = None
+    doc: str = ""
+
+
+@dataclass(frozen=True)
+class ExtensionMeta:
+    """@Extension-style metadata: drives parse-time parameter validation
+    and the doc-gen parameter tables."""
+
+    description: str = ""
+    parameters: tuple = ()
+    #: last declared parameter may repeat (varargs-style)
+    repeat_last: bool = False
+
+
+def _param_type_of(value) -> str:
+    if isinstance(value, bool):
+        return "bool"
+    if isinstance(value, int):
+        return "int"
+    if isinstance(value, float):
+        return "double"
+    if isinstance(value, str):
+        return "string"
+    from ..query_api.expression import Variable
+    if isinstance(value, Variable):
+        return "attribute"
+    return type(value).__name__
+
+
+#: type-name compatibility: a literal of row type satisfies a declared col
+_TYPE_OK = {
+    ("int", "int"), ("int", "long"), ("int", "time"), ("int", "double"),
+    ("int", "float"),
+    ("double", "double"), ("double", "float"),
+    ("bool", "bool"),
+    ("string", "string"),
+    ("attribute", "attribute"),
+}
+
+
 @dataclass
 class Registry:
     _entries: dict[tuple[ExtensionKind, str], object] = field(default_factory=dict)
+    _meta: dict[tuple[ExtensionKind, str], "ExtensionMeta"] = field(
+        default_factory=dict)
 
     @staticmethod
     def _key(namespace: str, name: str) -> str:
         return f"{namespace.lower()}:{name.lower()}" if namespace else name.lower()
 
     def register(self, kind: ExtensionKind, namespace: str, name: str, impl: object,
-                 overwrite: bool = True) -> None:
+                 overwrite: bool = True, meta: Optional[ExtensionMeta] = None) -> None:
         k = (kind, self._key(namespace, name))
         if not overwrite and k in self._entries:
             raise ValueError(f"extension {k} already registered")
         self._entries[k] = impl
+        if meta is not None:
+            self._meta[k] = meta
+
+    def meta_of(self, kind: ExtensionKind, namespace: str,
+                name: str) -> Optional[ExtensionMeta]:
+        return self._meta.get((kind, self._key(namespace, name)))
+
+    def validate_params(self, kind: ExtensionKind, namespace: str, name: str,
+                        params, what: str = "extension") -> None:
+        """Parse-time arity/type check against declared Parameter metadata
+        (reference: InputParameterValidator.validateExpressionExecutors).
+        Raises SiddhiAppCreationError NAMING the offending parameter; no-op
+        for extensions without metadata."""
+        meta = self.meta_of(kind, namespace, name)
+        if meta is None:
+            return
+        from ..errors import SiddhiAppCreationError
+        full = f"{namespace}:{name}" if namespace else name
+        decl = list(meta.parameters)
+        n_required = sum(1 for p in decl if not p.optional)
+        if len(params) < n_required:
+            missing = decl[len(params)]
+            raise SiddhiAppCreationError(
+                f"{what} {full!r} needs parameter "
+                f"{len(params) + 1} ({missing.name}: "
+                f"{'|'.join(missing.types)}) — "
+                f"{missing.doc or 'required'}")
+        if len(params) > len(decl) and not meta.repeat_last:
+            raise SiddhiAppCreationError(
+                f"{what} {full!r} takes at most {len(decl)} parameter(s) "
+                f"({', '.join(p.name for p in decl)}), got {len(params)}")
+        for i, v in enumerate(params):
+            p = decl[min(i, len(decl) - 1)]
+            got = _param_type_of(v)
+            if not any((got, t) in _TYPE_OK for t in p.types):
+                raise SiddhiAppCreationError(
+                    f"{what} {full!r} parameter {i + 1} ({p.name}) must be "
+                    f"{'|'.join(p.types)}, got {got} ({v!r})")
 
     def lookup(self, kind: ExtensionKind, namespace: str, name: str) -> Optional[object]:
         return self._entries.get((kind, self._key(namespace, name)))
@@ -62,6 +155,7 @@ class Registry:
     def copy(self) -> "Registry":
         r = Registry()
         r._entries = dict(self._entries)
+        r._meta = dict(self._meta)
         return r
 
 
@@ -70,11 +164,12 @@ class Registry:
 GLOBAL = Registry()
 
 
-def register_global(kind: ExtensionKind, name: str, namespace: str = ""):
+def register_global(kind: ExtensionKind, name: str, namespace: str = "",
+                    meta: Optional[ExtensionMeta] = None):
     """Decorator: @register_global(ExtensionKind.WINDOW, 'length')."""
 
     def deco(obj):
-        GLOBAL.register(kind, namespace, name, obj)
+        GLOBAL.register(kind, namespace, name, obj, meta=meta)
         return obj
 
     return deco

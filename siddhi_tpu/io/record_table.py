@@ -191,6 +191,57 @@ class PredicateVisitor(StoreConditionVisitor):
         return self._stack[0]
 
 
+class ParamPredicateVisitor(PredicateVisitor):
+    """PredicateVisitor variant whose compiled form takes (row, params):
+    stream-value placeholders (`probe.attr`) read from `params` at call
+    time. Backs the GENERAL cache-miss store fallback — computed-key and
+    non-equi probe conditions (reference:
+    AbstractQueryableRecordTable.java:207-238 compiles every condition
+    against the store with streamVariable parameters)."""
+
+    def end_and(self):
+        r, l = self._stack.pop(), self._stack.pop()
+        self._stack.append(lambda row, p, l=l, r=r: l(row, p) and r(row, p))
+
+    def end_or(self):
+        r, l = self._stack.pop(), self._stack.pop()
+        self._stack.append(lambda row, p, l=l, r=r: l(row, p) or r(row, p))
+
+    def end_not(self):
+        e = self._stack.pop()
+        self._stack.append(lambda row, p, e=e: not e(row, p))
+
+    def end_compare(self, op):
+        rhs, lhs = self._stack.pop(), self._stack.pop()
+        fn = self._OPS[op]
+
+        def cmp(row, p, lhs=lhs, rhs=rhs, fn=fn):
+            a, b = lhs(row, p), rhs(row, p)
+            if a is None or b is None:
+                return False
+            return fn(a, b)
+
+        self._stack.append(cmp)
+
+    def visit_constant(self, value, type_name):
+        self._stack.append(lambda row, p, v=value: v)
+
+    def visit_attribute(self, name):
+        self._stack.append(lambda row, p, n=name: row.get(n))
+
+    def visit_stream_value(self, name):
+        self._stack.append(lambda row, p, n=name: p.get(n))
+
+    def visit_is_null(self, name):
+        self._stack.append(lambda row, p, n=name: row.get(n) is None)
+
+    def result(self) -> Callable:
+        if not self._stack:
+            return lambda row, p: True
+        assert len(self._stack) == 1
+        return self._stack[0]
+
+
 # ------------------------------------------------------------------ store SPI
 
 
@@ -576,6 +627,49 @@ class RecordTableRuntime:
                 stacklevel=2)
         for k in resident_probe:  # refresh recency so LRU keeps them too
             self.cache_policy.touch(k)
+        changed = any(self._key(r) not in self.cache_policy.rows
+                      or self.cache_policy.rows[self._key(r)] != r
+                      for r in found)
+        for r in found:
+            self.cache_policy.put(self._key(r), r, protected=protected)
+        if changed:
+            self._rebuild_cache()
+        return changed
+
+    def compile_param_condition(self, expr):
+        """Compile a probe condition with stream-value placeholders into
+        fn(row, params) — the general (computed-key / non-equi) store
+        fallback plan. Raises SiddhiAppCreationError for shapes the store
+        walk cannot express (callers then document the cache-only miss)."""
+        visitor = ParamPredicateVisitor()
+        return walk_condition(expr, visitor, self.definition.id)
+
+    def ensure_cached_for_condition(self, pred, param_rows: list) -> bool:
+        """General read-through for in-kernel probes whose condition is not
+        a simple equi key (`f(S.k) == T.k`, `S.k < T.k`): load every store
+        row matching ANY of the batch's probe parameter rows into the
+        cache, so the device probe sees exactly what a store fallback would
+        return (reference: AbstractQueryableRecordTable.java:207-238).
+        Cost: one host scan of the store × the batch's DISTINCT probe rows
+        — bounded by batch size; the equi-key path (ensure_cached_for_keys)
+        stays the fast path. Returns True when the device cache changed."""
+        if self.cache_policy is None or not param_rows:
+            return False
+        match_all = self.compile_condition(None)
+        found = [r for r in self.store.find(match_all)
+                 if any(pred(r, p) for p in param_rows)]
+        if not found:
+            return False
+        protected = {self._key(r) for r in found}
+        if len(protected) > self.cache_policy.size:
+            import warnings
+            warnings.warn(
+                f"@store table {self.definition.id!r}: one probing batch's "
+                f"condition matches {len(protected)} rows but "
+                f"@cache(size='{self.cache_policy.size}') holds fewer — "
+                "rows evicted mid-warm may still miss; raise the cache "
+                "size above the per-batch matching working set",
+                stacklevel=2)
         changed = any(self._key(r) not in self.cache_policy.rows
                       or self.cache_policy.rows[self._key(r)] != r
                       for r in found)

@@ -477,7 +477,15 @@ class CompiledSelector:
             scope.frames[AGG_FRAME] = agg_values
             scope.valids[AGG_FRAME] = data_valid
             scope.ts[AGG_FRAME] = chunk.ts
-        out_cols = {name: ce(scope) for name, ce in self.out_exprs}
+        # constant-only projections (`select 1.0 as w`) trace to 0-d
+        # scalars: broadcast to lane width so downstream decode/table
+        # inserts see a proper column
+        out_cols = {}
+        for name, ce in self.out_exprs:
+            v = ce(scope)
+            if jnp.ndim(v) == 0:
+                v = jnp.broadcast_to(v, chunk.ts.shape)
+            out_cols[name] = v
         if self.expose_group_slot:
             # grouped snapshot limiters retain one row per group — ride the
             # per-lane group slot through ordering/limit as a pseudo-column

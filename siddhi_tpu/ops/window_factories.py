@@ -236,26 +236,101 @@ def _make_expression_batch(layout, batch_cap, params, expired_on):
 
 
 def register_all() -> None:
-    reg = lambda name, make: GLOBAL.register(  # noqa: E731
-        ExtensionKind.WINDOW, "", name, WindowFactory(make))
-    reg("length", _make_length)
-    reg("expression", _make_expression)
-    reg("expressionBatch", _make_expression_batch)
-    reg("lengthBatch", _make_length_batch)
-    reg("time", _make_time)
-    reg("timeBatch", _make_time_batch)
-    reg("timeLength", _make_time_length)
-    reg("delay", _make_delay)
+    from ..extension.registry import ExtensionMeta, Parameter
+
+    def reg(name, make, desc="", params=(), repeat_last=False):
+        GLOBAL.register(
+            ExtensionKind.WINDOW, "", name, WindowFactory(make),
+            meta=ExtensionMeta(description=desc,
+                               parameters=tuple(params),
+                               repeat_last=repeat_last))
+
+    P = Parameter
+    reg("length", _make_length,
+        "Sliding window holding the last N events.",
+        [P("window.length", ("int",), doc="number of events retained")])
+    reg("expression", _make_expression,
+        "Sliding window retaining events while the expression holds.",
+        [P("expression", ("string", "bool"),
+           doc="retain condition over the window contents")])
+    reg("expressionBatch", _make_expression_batch,
+        "Tumbling window flushing when the expression turns false.",
+        [P("expression", ("string", "bool"),
+           doc="retain condition; flush on violation"),
+         P("include.triggering.event", ("bool",), optional=True,
+           default=False,
+           doc="start the next batch with the violating arrival"),
+         P("stream.current.event", ("bool",), optional=True, default=False,
+           doc="reference stream-mode flag (rejected with guidance)")])
+    reg("lengthBatch", _make_length_batch,
+        "Tumbling window emitting every N events.",
+        [P("window.length", ("int",), doc="events per batch")])
+    reg("time", _make_time,
+        "Sliding window holding events of the last T time units.",
+        [P("window.time", ("time",), doc="retention period")])
+    reg("timeBatch", _make_time_batch,
+        "Tumbling window flushing every T time units.",
+        [P("window.time", ("time",), doc="batch period"),
+         P("start.time", ("int", "time"), optional=True, default=0,
+           doc="bucket epoch offset")])
+    reg("timeLength", _make_time_length,
+        "Sliding window bounded by BOTH time and count.",
+        [P("window.time", ("time",), doc="retention period"),
+         P("window.length", ("int",), doc="max events retained")])
+    reg("delay", _make_delay,
+        "Emits events after a fixed delay.",
+        [P("window.delay", ("time",), doc="delay period")])
     reg("batch", lambda l, b, p, e: PassThroughWindow(l, b) if not p
-        else LengthBatchWindow(l, b, p[0], expired_on=e))
-    reg("externalTime", _make_external_time)
-    reg("externalTimeBatch", _make_external_time_batch)
-    reg("session", _make_session)
-    reg("sort", _make_sort)
-    reg("cron", _make_cron)
-    reg("hopping", _make_hopping)
-    reg("frequent", _make_frequent)
-    reg("lossyFrequent", _make_lossy_frequent)
+        else LengthBatchWindow(l, b, p[0], expired_on=e),
+        "Chunk-boundary tumbling window.",
+        [P("window.length", ("int",), optional=True,
+           doc="events per batch (default: the arrival chunk)")])
+    reg("externalTime", _make_external_time,
+        "Sliding time window over an event-attribute clock.",
+        [P("timestamp", ("attribute",), doc="the time attribute"),
+         P("window.time", ("time",), doc="retention period")])
+    reg("externalTimeBatch", _make_external_time_batch,
+        "Tumbling time window over an event-attribute clock.",
+        [P("timestamp", ("attribute",), doc="the time attribute"),
+         P("window.time", ("time",), doc="batch period"),
+         P("start.time", ("int", "time"), optional=True,
+           doc="first bucket start"),
+         P("timeout", ("time",), optional=True,
+           doc="flush timeout past the bucket end")])
+    reg("session", _make_session,
+        "Session window keyed by a gap of inactivity.",
+        [P("window.session", ("time",), doc="session gap"),
+         P("window.key", ("attribute",), optional=True,
+           doc="per-key sessions"),
+         P("window.allowedlatency", ("time",), optional=True,
+           doc="late-arrival grace period")])
+    reg("sort", _make_sort,
+        "Keeps the top-N events by sort order.",
+        [P("window.length", ("int",), doc="events retained"),
+         P("attribute", ("attribute", "string"), optional=True,
+           doc="sort key(s), each optionally followed by 'asc'/'desc'")],
+        repeat_last=True)
+    reg("cron", _make_cron,
+        "Tumbling window flushing on a cron schedule.",
+        [P("cron.expression", ("string",), doc="quartz-layout cron")])
+    reg("hopping", _make_hopping,
+        "Hopping time window (period, hop).",
+        [P("window.time", ("time",), doc="window span"),
+         P("hop.time", ("time",), doc="hop step")])
+    reg("frequent", _make_frequent,
+        "Retains the most frequent event variants (Misra-Gries).",
+        [P("event.count", ("int",), doc="variants tracked"),
+         P("attribute", ("attribute",), optional=True,
+           doc="key attributes (default: all)")],
+        repeat_last=True)
+    reg("lossyFrequent", _make_lossy_frequent,
+        "Lossy-counting frequent-variant window.",
+        [P("support.threshold", ("double",), doc="min relative frequency"),
+         P("error.bound", ("double",), optional=True,
+           doc="counting error bound"),
+         P("attribute", ("attribute",), optional=True,
+           doc="key attributes (default: all)")],
+        repeat_last=True)
 
 
 register_all()

@@ -58,8 +58,27 @@ def generate_markdown(registry: Registry = GLOBAL) -> str:
         for key, impl in entries:
             lines.append(f"### `{key}`")
             lines.append("")
-            lines.append(_describe(impl))
+            meta = registry._meta.get((kind, key))
+            if meta is not None and meta.description:
+                lines.append(meta.description)
+            else:
+                lines.append(_describe(impl))
             lines.append("")
+            if meta is not None and meta.parameters:
+                # @Parameter tables, like the reference doc-gen renders
+                lines.append("| Parameter | Type | Optional | Default |"
+                             " Description |")
+                lines.append("|---|---|---|---|---|")
+                for p in meta.parameters:
+                    dflt = "" if p.default is None else repr(p.default)
+                    lines.append(
+                        f"| `{p.name}` | {' / '.join(p.types)} | "
+                        f"{'yes' if p.optional else 'no'} | {dflt} | "
+                        f"{p.doc} |")
+                if meta.repeat_last:
+                    lines.append("")
+                    lines.append("_The last parameter may repeat._")
+                lines.append("")
     return "\n".join(lines)
 
 

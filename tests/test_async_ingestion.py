@@ -95,3 +95,39 @@ class TestAsyncIngestion:
         rt.get_input_handler("S").send((1,))
         rt.flush()
         assert [e.data[0] for e in got] == [1]
+
+
+class TestAutoFlush:
+    """Wall-clock auto-flush (the Disruptor's immediate-consumption role,
+    reference StreamJunction.java:68 + Scheduler.java:48): staged rows
+    deliver within ~auto_flush_ms with no flush() from the caller."""
+
+    def test_staged_rows_flush_without_caller(self):
+        import time
+
+        from siddhi_tpu import SiddhiManager
+        app = ("define stream S (v double);\n"
+               "from S[v > 0.0] select v insert into Out;")
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            app, batch_size=256, auto_flush_ms=10)
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        rt.get_input_handler("S").send((1.0,))
+        t0 = time.perf_counter()
+        while not got and time.perf_counter() - t0 < 10:
+            time.sleep(0.005)
+        rt.shutdown()
+        assert got == [(1.0,)]
+
+    def test_annotation_enables_flusher(self):
+        from siddhi_tpu import SiddhiManager
+        app = ("@app:autoFlush(interval='25 ms')\n"
+               "define stream S (v double);\n"
+               "from S select v insert into Out;")
+        rt = SiddhiManager().create_siddhi_app_runtime(app)
+        assert rt.auto_flush_ms == 25
+        rt.start()
+        assert rt._flusher_thread is not None
+        rt.shutdown()
+        assert rt._flusher_stop is None

@@ -238,3 +238,47 @@ class TestRawUnionSet:
             h.send((sym, 1.0), timestamp=i + 1)
         rt.flush()
         assert [r[0] for r in got] == [1, 2, 2]
+
+
+class TestExtensionParameterMetadata:
+    """@Extension-style parameter metadata: parse-time validation naming
+    the parameter (reference: siddhi-annotations @Parameter +
+    InputParameterValidator) and doc-gen parameter tables."""
+
+    def test_wrong_type_names_parameter(self):
+        import pytest as _pytest
+
+        from siddhi_tpu import SiddhiManager
+        from siddhi_tpu.errors import SiddhiAppCreationError
+        with _pytest.raises(SiddhiAppCreationError,
+                            match=r"window.length.*must be int"):
+            SiddhiManager().create_siddhi_app_runtime(
+                "define stream S (v double);\n"
+                "from S#window.lengthBatch('ten') select v insert into O;")
+
+    def test_missing_parameter_names_it(self):
+        import pytest as _pytest
+
+        from siddhi_tpu import SiddhiManager
+        from siddhi_tpu.errors import SiddhiAppCreationError
+        with _pytest.raises(SiddhiAppCreationError,
+                            match=r"needs parameter 1 \(window.time"):
+            SiddhiManager().create_siddhi_app_runtime(
+                "define stream S (v double);\n"
+                "from S#window.time() select v insert into O;")
+
+    def test_excess_parameter_rejected(self):
+        import pytest as _pytest
+
+        from siddhi_tpu import SiddhiManager
+        from siddhi_tpu.errors import SiddhiAppCreationError
+        with _pytest.raises(SiddhiAppCreationError, match="at most"):
+            SiddhiManager().create_siddhi_app_runtime(
+                "define stream S (v double);\n"
+                "from S#window.length(5, 6) select v insert into O;")
+
+    def test_docgen_renders_parameter_tables(self):
+        from siddhi_tpu.util.docgen import generate_markdown
+        md = generate_markdown()
+        assert "| Parameter | Type | Optional | Default | Description |" in md
+        assert "`window.length`" in md and "`cron.expression`" in md

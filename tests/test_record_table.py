@@ -255,6 +255,41 @@ class TestStoreFallbackOnEviction:
         # 'c' (not probed) was the eviction victim, not 'b'
         assert set(k[0] for k in cp.rows) == {"a", "b"}
 
+    def test_non_equi_probe_exact_past_eviction(self):
+        # S.k > T.k (non-equi): the condition-based store fallback
+        # (ensure_cached_for_condition) must reload the EVICTED matching
+        # row before the device probe (reference:
+        # AbstractQueryableRecordTable.java:207-238 queries the store with
+        # streamVariable parameters on every cache miss)
+        import warnings as _w
+        app = """
+        define stream S (v int);
+        define stream Q (v int);
+        @store(type='inMemory') @cache(size='2', policy='FIFO')
+        @PrimaryKey('k')
+        define table T (k int, w double);
+        from S select v as k, 1.0 as w insert into T;
+        @info(name='j') from Q join T on Q.v > T.k
+        select Q.v as qv, T.k as tk insert into Out;
+        """
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            rt = build(app)
+            h = rt.get_input_handler("S")
+            for k in (10, 20, 30):  # size-2 cache: 10 evicted
+                h.send((k,))
+                rt.flush()
+            got = []
+            rt.add_query_callback("j", lambda ts, i, r: got.extend(
+                tuple(e.data) for e in i or []))
+            rt.get_input_handler("Q").send((15,))  # matches ONLY evicted 10
+            rt.flush()
+            assert sorted(got) == [(15, 10)], got
+            got.clear()
+            rt.get_input_handler("Q").send((25,))  # matches 10 and 20
+            rt.flush()
+            assert sorted(got) == [(25, 10), (25, 20)], got
+
     def test_outer_join_null_only_for_true_non_matches(self):
         app = """
         define stream S (sym string, price double);
