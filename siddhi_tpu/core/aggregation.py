@@ -544,7 +544,38 @@ class AggregationRuntime(Receiver):
                       in_specs=(P(axis), P(), P()), out_specs=P(axis),
                       **_SHARD_KW),
             donate_argnums=(0,))
+
+        def shard_ingest_lanes(state, batch: EventBatch, now):
+            # per-host sharded ingestion: the batch arrives LANE-SHARDED
+            # (each shard holds only its own pre-routed rows —
+            # parallel/multihost.global_lane_batch). shard_owned stays as a
+            # guard: mis-routed rows are dropped, never double-counted.
+            from ..parallel.sharded import shard_owned
+
+            local = jax.tree_util.tree_map(lambda x: x[0], state)
+            mine = shard_owned(batch, [batch.cols[g] for g in group_attrs],
+                               axis, n_shards)
+            local = ingest(local, mine, now)
+            return jax.tree_util.tree_map(lambda x: x[None], local)
+
+        self._ingest_lanes = jax.jit(
+            shard_map(shard_ingest_lanes, mesh=mesh,
+                      in_specs=(P(axis), P(axis), P()), out_specs=P(axis),
+                      **_SHARD_KW),
+            donate_argnums=(0,))
         self._evict = jax.jit(jax.vmap(self._make_evict(), in_axes=(0, 0)))
+
+    def ingest_global(self, batch: EventBatch, now: int) -> None:
+        """Ingest a LANE-SHARDED global EventBatch (per-host sharded
+        ingestion over a multi-host mesh: every process calls this with the
+        same global program; each contributed only its own rows —
+        parallel/multihost.global_lane_batch). Requires mesh mode."""
+        import jax.numpy as jnp
+        if self.mesh is None:
+            raise SiddhiAppCreationError(
+                "ingest_global needs a mesh-enabled aggregation "
+                "(create the runtime with mesh=...)")
+        self.state = self._ingest_lanes(self.state, batch, jnp.int64(now))
 
     @staticmethod
     def _parse_retention(definition) -> dict:

@@ -341,7 +341,7 @@ class SiddhiAppRuntime:
                 # state buffers, so a tick racing a user-thread delivery
                 # into the same runtime would double-donate
                 with self.ctx.controller_lock:
-                    staged = any(j._staged_rows
+                    staged = any(j._staged_rows or j._tap_queue
                                  for j in self.junctions.values())
                     if staged:
                         self.flush()

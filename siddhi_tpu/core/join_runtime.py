@@ -24,9 +24,9 @@ import jax.numpy as jnp
 from ..errors import DefinitionNotExistError, SiddhiAppCreationError
 from ..extension.registry import ExtensionKind, Registry
 from ..ops.expr_compile import Scope, TypeResolver, compile_expression
-from ..ops.join import (JoinPlan, _hash_exprs, compact_pairs, multimap_append,
-                        multimap_buckets, multimap_init, plan_join,
-                        probe_cross, probe_equi, probe_equi_mm)
+from ..ops.join import (JoinPlan, _hash_exprs, collect_vars, compact_pairs,
+                        multimap_append, multimap_buckets, multimap_init,
+                        plan_join, probe_cross, probe_equi, probe_equi_mm)
 from ..ops.selector import CompiledSelector
 from ..ops.window_factories import WindowFactory
 from ..ops.windows import (PassThroughWindow, SlidingWindow, WindowOp,
@@ -59,7 +59,19 @@ def _qualify_for_store(expr, probe_side, table_side, resolver):
     from ..query_api.expression import Expression, Variable
     table_id = table_side.table.definition.id
 
+    from ..query_api.expression import IsNull
+
     def walk(e):
+        if isinstance(e, IsNull) and isinstance(e.expression, Variable):
+            fr = frames_of(e.expression, resolver)
+            if not fr <= {table_side.ref}:
+                # walk_condition's isNull compiles against the TABLE row
+                # only — a probe-side null test would silently evaluate the
+                # wrong column; no fallback for those conditions
+                raise SiddhiAppCreationError(
+                    "store fallback cannot express probe-side isNull")
+            return _dc.replace(e, expression=_dc.replace(
+                e.expression, stream_id=table_id))
         if isinstance(e, Variable):
             fr = frames_of(e, resolver)
             if fr <= {table_side.ref}:
@@ -82,29 +94,6 @@ def _qualify_for_store(expr, probe_side, table_side, resolver):
         return e
 
     return walk(expr)
-
-
-def _collect_vars(expr):
-    """All Variable leaves of a condition AST (probe-attr discovery for the
-    condition-based store fallback)."""
-    from ..query_api.expression import Expression, Variable
-    out = []
-
-    def walk(e):
-        if isinstance(e, Variable):
-            out.append(e)
-            return
-        for a in ("left", "right", "expression"):
-            sub = getattr(e, a, None)
-            if isinstance(sub, Expression):
-                walk(sub)
-        for p in getattr(e, "parameters", ()) or ():
-            if isinstance(p, Expression):
-                walk(p)
-
-    if expr is not None:
-        walk(expr)
-    return out
 
 
 class _Side:
@@ -267,7 +256,7 @@ class JoinQueryRuntime:
                         pred = t_side.table.compile_param_condition(on_rw)
                         probe_attrs = sorted({
                             v.attribute
-                            for v in _collect_vars(on_rw)
+                            for v in collect_vars(on_rw)
                             if v.stream_id == p_side.ref})
                         t_side._fallback_cond = (pred, tuple(probe_attrs))
                         t_side.table._probe_fallback_ready = True

@@ -655,9 +655,23 @@ class RecordTableRuntime:
         stays the fast path. Returns True when the device cache changed."""
         if self.cache_policy is None or not param_rows:
             return False
+
+        def dev_norm(row):
+            # store rows hold full-precision host values; probe params are
+            # device-roundtripped (f32) — evaluate the predicate with BOTH
+            # sides in device space or float comparisons never line up
+            # (same rule as ensure_cached_for_keys' norm())
+            out = {}
+            for k, v in row.items():
+                dt = self.codec.np_dtypes.get(k)
+                if v is not None and dt is not None and dt.kind == "f":
+                    v = float(dt.type(v))
+                out[k] = v
+            return out
+
         match_all = self.compile_condition(None)
         found = [r for r in self.store.find(match_all)
-                 if any(pred(r, p) for p in param_rows)]
+                 if any(pred(dev_norm(r), p) for p in param_rows)]
         if not found:
             return False
         protected = {self._key(r) for r in found}

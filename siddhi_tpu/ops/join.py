@@ -52,6 +52,28 @@ def split_conjuncts(expr: Optional[Expression]) -> list[Expression]:
     return [expr]
 
 
+def collect_vars(expr):
+    """All Variable leaves of a condition AST — ONE walker shared by the
+    join planner and the condition-based store fallback."""
+    out = []
+
+    def walk(e):
+        if isinstance(e, Variable):
+            out.append(e)
+            return
+        for a in ("left", "right", "expression"):
+            sub = getattr(e, a, None)
+            if isinstance(sub, Expression):
+                walk(sub)
+        for p in getattr(e, "parameters", ()) or ():
+            if isinstance(p, Expression):
+                walk(p)
+
+    if expr is not None:
+        walk(expr)
+    return out
+
+
 def frames_of(expr: Expression, resolver: TypeResolver) -> set:
     """Frame refs referenced by an expression (resolving unqualified vars)."""
     out: set = set()

@@ -278,10 +278,15 @@ class CompiledSelector:
         self.out_types: dict[str, AttributeType] = {
             name: ce.type for name, ce in self.out_exprs}
         for name in self.host_set_slots:
-            # the device lane carries the distinct count as a placeholder;
-            # the schema says OBJECT so decode leaves the slot for the
-            # runtime's host-side set substitution
-            self.out_types[name] = AttributeType.OBJECT
+            # the device lane carries the EXACT distinct count: downstream
+            # consumers (insert into T, chained queries) receive the
+            # set-size projection as LONG — `sizeOfSet(T.s)` reads it
+            # directly (reference forwards the live Set object,
+            # UnionSetAttributeAggregatorExecutor.java:71; the size-at-
+            # emission projection is the documented divergence,
+            # docs/PARITY.md). Query callbacks still substitute the
+            # MATERIALIZED host set at the boundary (union_set_values)
+            self.out_types[name] = AttributeType.LONG
 
         # --- group-by key plan ---
         self.group_by = selector.group_by

@@ -326,8 +326,10 @@ def register_all() -> None:
     reg("lossyFrequent", _make_lossy_frequent,
         "Lossy-counting frequent-variant window.",
         [P("support.threshold", ("double",), doc="min relative frequency"),
-         P("error.bound", ("double",), optional=True,
-           doc="counting error bound"),
+         # position 2 is either the error bound OR already an attribute
+         # (the factory detects which — error.bound is optional-positional)
+         P("error.bound", ("double", "attribute"), optional=True,
+           doc="counting error bound, or the first key attribute"),
          P("attribute", ("attribute",), optional=True,
            doc="key attributes (default: all)")],
         repeat_last=True)

@@ -51,6 +51,29 @@ from ..ops.groupby import (DenseKeyTable, dense_key_lookup_or_insert,
                            hash_columns, init_dense_key_table)
 
 
+def np_shard_of(key_cols, n_shards: int):
+    """HOST-side (numpy) mirror of `shard_owned`'s key-hash ownership —
+    per-host sharded ingestion routes rows to their owning shard BEFORE
+    device_put, so the device mask is a no-op guard. Must stay bit-exact
+    with ops/groupby.hash_columns."""
+    import numpy as np
+    with np.errstate(over="ignore"):
+        h = np.uint64(0xCBF29CE484222325)
+        h = np.broadcast_to(h, np.shape(key_cols[0])).copy()
+        for c in key_cols:
+            c = np.asarray(c)
+            if c.dtype.kind == "f":
+                bits = c.view(np.int32 if c.dtype == np.float32
+                              else np.int64)
+                x = bits.astype(np.int64).astype(np.uint64)
+            else:
+                x = c.astype(np.int64).astype(np.uint64)
+            h = (h ^ x) * np.uint64(0x100000001B3)
+            h = h ^ (h >> np.uint64(29))
+        keys = h.astype(np.int64)
+        return keys.astype(np.uint32) % np.uint32(n_shards)
+
+
 def shard_owned(batch: EventBatch, key_cols, axis_name: str,
                 n_shards: int) -> EventBatch:
     """Mask a replicated batch down to the lanes THIS shard owns by key-hash

@@ -9,3 +9,10 @@ of JAX_PLATFORMS in some images.
 from siddhi_tpu.util.platform import force_cpu_platform
 
 force_cpu_platform(8)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "smoke: fast representative tier — `pytest -m smoke` finishes in "
+        "~2-3 min on one core (full suite needs tens of minutes there)")

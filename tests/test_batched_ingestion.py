@@ -13,6 +13,9 @@ import pytest
 
 from siddhi_tpu import SiddhiManager
 
+
+pytestmark = pytest.mark.smoke
+
 FILTER_APP = """
 define stream TradeStream (symbol string, price double, volume long);
 @info(name = 'q')

@@ -10,6 +10,9 @@ import pytest
 from siddhi_tpu import SiddhiManager
 from siddhi_tpu.errors import SiddhiAppCreationError
 
+
+pytestmark = pytest.mark.smoke
+
 S = "define stream S (symbol string, price double);\n"
 
 

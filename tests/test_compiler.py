@@ -33,6 +33,9 @@ from siddhi_tpu.query_api import (
 )
 
 
+
+pytestmark = pytest.mark.smoke
+
 class TestDefinitions:
     def test_stream_definition(self):
         d = parse_stream_definition(

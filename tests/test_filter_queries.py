@@ -7,6 +7,9 @@ import pytest
 from siddhi_tpu import SiddhiManager
 
 
+
+pytestmark = pytest.mark.smoke
+
 @pytest.fixture
 def mgr():
     m = SiddhiManager()

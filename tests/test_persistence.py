@@ -13,6 +13,9 @@ from siddhi_tpu.state.persistence import (
     InMemoryPersistenceStore,
 )
 
+
+pytestmark = pytest.mark.smoke
+
 APP = ("@app:name('PersistApp')\n"
        "define stream S (symbol string, price float);\n"
        "@info(name = 'q1')\n"

@@ -9,6 +9,9 @@ from siddhi_tpu.errors import SiddhiAppCreationError
 from siddhi_tpu.extension.registry import ExtensionKind
 from siddhi_tpu.io.record_table import InMemoryRecordStore, RecordStore
 
+
+pytestmark = pytest.mark.smoke
+
 APP = """
 define stream S (sym string, price double);
 @store(type='inMemory')
@@ -489,3 +492,72 @@ class TestRecordStoreOnDemandQueries:
                         "select sym, price")
         assert sorted(r.data for r in rows) == [
             ("IBM", 75.0), ("WSO2", 57.0), ("WSO2", 63.0)]
+
+
+class TestCachePolicyMatrix:
+    """FIFO/LRU/LFU x join / `in` / on-demand probes past eviction
+    (reference: the query/table cache suite's policy matrix)."""
+
+    APP = """
+    define stream S (sym string, price double);
+    define stream Q (sym string);
+    @store(type='inMemory')
+    @cache(size='2', policy='{policy}')
+    @PrimaryKey('sym')
+    define table T (sym string, price double);
+    from S select sym, price insert into T;
+    @info(name='j') from Q join T on Q.sym == T.sym
+    select Q.sym as sym, T.price as price insert into OutJ;
+    @info(name='i') from Q[Q.sym in T] select sym insert into OutI;
+    """
+
+    def _fill(self, rt):
+        h = rt.get_input_handler("S")
+        for i, sym in enumerate(["a", "b", "c"]):  # size-2: one evicted
+            h.send((sym, float(i)))
+            rt.flush()
+
+    @pytest.mark.parametrize("policy", ["FIFO", "LRU", "LFU"])
+    def test_join_probe_exact_past_eviction(self, policy):
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            rt = build(self.APP.format(policy=policy))
+            self._fill(rt)
+            got = []
+            rt.add_query_callback("j", lambda ts, i, r: got.extend(
+                tuple(e.data) for e in i or []))
+            q = rt.get_input_handler("Q")
+            # per-batch working set stays within the cache size (the
+            # documented guarantee); each batch's probe keys re-warm
+            for sym in ("a", "b", "c"):
+                q.send((sym,))
+                rt.flush()
+        assert sorted(got) == [("a", 0.0), ("b", 1.0), ("c", 2.0)]
+
+    @pytest.mark.parametrize("policy", ["FIFO", "LRU", "LFU"])
+    def test_in_probe_exact_past_eviction(self, policy):
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            rt = build(self.APP.format(policy=policy))
+            self._fill(rt)
+            got = []
+            rt.add_query_callback("i", lambda ts, i, r: got.extend(
+                tuple(e.data) for e in i or []))
+            q = rt.get_input_handler("Q")
+            for sym in ("a", "zz", "c"):
+                q.send((sym,))
+                rt.flush()
+        assert sorted(got) == [("a",), ("c",)]
+
+    @pytest.mark.parametrize("policy", ["FIFO", "LRU", "LFU"])
+    def test_ondemand_reads_store_past_eviction(self, policy):
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            rt = build(self.APP.format(policy=policy))
+            self._fill(rt)
+        rows = rt.query("from T select sym, price")
+        assert sorted(r.data for r in rows) == [
+            ("a", 0.0), ("b", 1.0), ("c", 2.0)]

@@ -7,6 +7,9 @@ from siddhi_tpu import SiddhiManager
 from siddhi_tpu.errors import SiddhiAppCreationError, SiddhiError
 
 
+
+pytestmark = pytest.mark.smoke
+
 class TestValidate:
     def test_valid_app_passes(self):
         SiddhiManager().validate_siddhi_app(

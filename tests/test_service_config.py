@@ -13,6 +13,9 @@ from siddhi_tpu.service import SiddhiService
 from siddhi_tpu.util.config import InMemoryConfigManager, YAMLConfigManager
 from siddhi_tpu.util.docgen import generate_markdown
 
+
+pytestmark = pytest.mark.smoke
+
 APP = """@app:name('svc')
 define stream S (symbol string, price float);
 define table T (symbol string, price float);

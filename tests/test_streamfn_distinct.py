@@ -151,3 +151,31 @@ class TestDistinctPairEviction:
                 rt.flush()
         # final wave: running distinct within the window is 1..8
         assert got[-8:] == [1, 2, 3, 4, 5, 6, 7, 8]
+
+
+class TestUnionSetForwarding:
+    """Forwarded raw unionSet: downstream consumers get the LONG set-size
+    projection; sizeOfSet reads it exactly (docs/PARITY.md divergence
+    note; reference UnionSetAttributeAggregatorExecutor.java:71)."""
+
+    def test_insert_into_table_then_size_of_set(self):
+        from siddhi_tpu import SiddhiManager
+        app = ("define stream S (sym string);\n"
+               "define table T (s long);\n"
+               "@info(name='fw') from S select unionSet(sym) as s "
+               "insert into T;")
+        rt = SiddhiManager().create_siddhi_app_runtime(app, batch_size=8)
+        rt.start()
+        h = rt.get_input_handler("S")
+        for x in ("a", "b", "a", "c"):
+            h.send((x,))
+            rt.flush()
+        rows = rt.query("from T select sizeOfSet(s) as n")
+        assert [r.data for r in rows] == [(1,), (2,), (2,), (3,)]
+        # callback boundary still materializes the REAL set
+        got = []
+        rt.add_query_callback(
+            "fw", lambda ts, i, r: got.extend(e.data for e in i or []))
+        h.send(("d",))
+        rt.flush()
+        assert got[-1][0] == {"a", "b", "c", "d"}

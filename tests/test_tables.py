@@ -12,6 +12,9 @@ import pytest
 
 from siddhi_tpu import SiddhiManager
 
+
+pytestmark = pytest.mark.smoke
+
 STOCK = "define stream StockStream (symbol string, price float, volume long);\n"
 
 
