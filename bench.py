@@ -47,13 +47,28 @@ figures for single-JVM CPU Siddhi, chosen HIGH (favoring the reference) so
 ratios are conservative. Measured numbers added to BASELINE.json under
 published[<metric key>] take precedence.
 
-Usage: python bench.py [config ...]   (default: all five, headline last)
+WATCHDOG DISCIPLINE (round 6 — BENCH_r05 produced ZERO numbers because the
+first config hung >=900 s under the TPU driver): the bench can no longer go
+dark. Every config runs in its own subprocess under a hard parent-side
+deadline; the child emits `#partial {json}` checkpoints after each measured
+sub-metric AND arms a best-effort SIGALRM, so when the parent kills a wedged
+config it still merges the partials into a numeric JSON line tagged
+"partial": true. A `--max-seconds` total budget bounds the whole run;
+heartbeat progress lines go to stderr every 10 s. Steady-state numbers
+exclude compilation: e2e runtimes start with AOT warmup
+(SiddhiAppRuntime.warmup — the shape-bucket ladder compiles before the
+clock starts).
+
+Usage: python bench.py [config ...] [--max-seconds=N] [--config-seconds=N]
+       (default: all five configs, headline last; N defaults 850 / 240)
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -62,8 +77,30 @@ BATCH = 8192
 #: e2e micro-batch: the public path amortizes per-batch costs (one device
 #: dispatch + one device→host readback per batch) over more events; through
 #: the tunneled TPU the readback RTT (~100 ms) is the dominant per-batch
-#: cost, so e2e uses a larger compiled batch than the device measure
-E2E_BATCH = int(__import__("os").environ.get("SIDDHI_E2E_BATCH", 131072))
+#: cost, so e2e uses a larger compiled batch than the device measure.
+#: BACKEND-AWARE (round 6): on a co-located CPU there is no tunnel to
+#: amortize, and XLA's compile time for a 128k-lane aggregation step grows
+#: into minutes on small hosts — CPU runs use 16384 so every config fits
+#: its watchdog budget. SIDDHI_E2E_BATCH overrides either way; resolved
+#: lazily in the child (after the backend is forced) via _resolve_e2e_batch.
+E2E_BATCH = int(os.environ.get("SIDDHI_E2E_BATCH", 0)) or None
+
+
+def _is_cpu() -> bool:
+    # importing siddhi_tpu FIRST matters: its __init__ disables XLA:CPU
+    # async dispatch (pure_callback deadlock guard), and the flag only
+    # takes effect if set before jax creates its CPU client — which
+    # jax.default_backend() does
+    import siddhi_tpu  # noqa: F401
+    import jax
+    return jax.default_backend() == "cpu"
+
+
+def _resolve_e2e_batch() -> int:
+    global E2E_BATCH
+    if E2E_BATCH is None:
+        E2E_BATCH = 16384 if _is_cpu() else 131072
+    return E2E_BATCH
 WARMUP = 3
 STEPS = 40
 LAT_STEPS = 50
@@ -71,6 +108,65 @@ RNG_SEED = 7
 #: --e2e-only: skip device measures, print only the e2e number (used by the
 #: parent process to collect the co-located CPU variant)
 E2E_ONLY = "--e2e-only" in sys.argv
+T0 = time.monotonic()
+
+
+def _flag(name: str, default: float) -> float:
+    for a in sys.argv[1:]:
+        if a.startswith(f"--{name}="):
+            return float(a.split("=", 1)[1])
+    return default
+
+
+#: total wall budget for the whole run (parent mode) — chosen under the
+#: driver's observed 900 s per-command ceiling
+MAX_SECONDS = _flag("max-seconds", 850.0)
+#: per-config watchdog: the parent kills a config subprocess at this bound
+#: (clamped to the remaining total budget) and emits its partials
+CONFIG_SECONDS = _flag("config-seconds", 240.0)
+
+#: child-mode partial results: every measured sub-metric lands here AND is
+#: echoed as a `#partial {json}` stdout line, so a killed child still
+#: yields numbers for whatever finished
+PARTIAL: dict = {}
+_PHASE = ["init"]
+
+
+def _phase(name: str) -> None:
+    _PHASE[0] = name
+    print(f"[bench] t={time.monotonic() - T0:.0f}s phase={name}",
+          file=sys.stderr, flush=True)
+
+
+def _partial(res: dict) -> None:
+    PARTIAL.update(res)
+    print("#partial " + json.dumps(res), flush=True)
+
+
+class BenchTimeout(Exception):
+    """Raised by the child's SIGALRM handler (best-effort in-process bound;
+    the parent's kill is the hard one)."""
+
+
+def _arm_child_watchdog(seconds: float) -> None:
+    """SIGALRM -> BenchTimeout, plus a stderr heartbeat thread. The alarm
+    fires only when the main thread executes Python bytecode — a hang
+    inside one XLA compile outlives it, which is why the parent holds the
+    authoritative deadline."""
+    import signal
+    if seconds > 0 and hasattr(signal, "SIGALRM"):
+        def _on_alarm(_sig, _frm):
+            raise BenchTimeout(f"alarm after {seconds:.0f}s")
+        signal.signal(signal.SIGALRM, _on_alarm)
+        signal.alarm(max(int(seconds), 1))
+
+    def _beat():
+        while True:
+            time.sleep(10)
+            print(f"[bench] t={time.monotonic() - T0:.0f}s "
+                  f"phase={_PHASE[0]} alive", file=sys.stderr, flush=True)
+
+    threading.Thread(target=_beat, daemon=True, name="bench-heartbeat").start()
 
 
 #: per-config single-JVM CPU estimates (events/sec), used when BASELINE.json
@@ -108,12 +204,26 @@ def _baseline_for(key: str) -> float:
 
 def _measure(run_step, events_per_step: int, metric: str, *,
              warmup: int = WARMUP, steps: int = STEPS) -> dict:
-    """run_step(i) -> device out; pipelined best-of-3 + synchronous p99."""
+    """run_step(i) -> device out; pipelined best-of-3 + synchronous p99.
+    Warmup is BOUNDED: it stops early once it has burned half the child's
+    remaining alarm budget (first-compile pathologies then surface as a
+    `warmup_truncated` partial instead of a silent hang)."""
     import jax
 
+    _phase(f"{metric}:warmup")
+    w0 = time.monotonic()
+    w_budget = max(CONFIG_SECONDS / 2, 30.0)
+    done = 0
+    out = None
     for i in range(warmup):
         out = run_step(i)
-    jax.block_until_ready(out)
+        jax.block_until_ready(out)
+        done += 1
+        if time.monotonic() - w0 > w_budget:
+            _partial({"warmup_truncated": done})
+            break
+    _partial({"warmup_s": round(time.monotonic() - w0, 2)})
+    _phase(f"{metric}:throughput")
 
     events_per_sec = 0.0
     for _rep in range(3):
@@ -124,16 +234,24 @@ def _measure(run_step, events_per_step: int, metric: str, *,
         elapsed = time.perf_counter() - t0
         events_per_sec = max(events_per_sec, events_per_step * steps / elapsed)
 
+    _phase(f"{metric}:p99")
     lat = []
+    n_lat = LAT_STEPS
     for i in range(LAT_STEPS):
         t0 = time.perf_counter()
         out = run_step(i)
         jax.block_until_ready(out)
         lat.append(time.perf_counter() - t0)
+        if i == 0 and lat[0] > 0.2:
+            # slow-host guard: 50 synchronous 500 ms steps would eat the
+            # watchdog budget; a >=10-sample p99 still bounds the tail
+            n_lat = max(10, LAT_STEPS // 5)
+        if i + 1 >= n_lat:
+            break
     p99_ms = float(np.percentile(np.array(lat), 99) * 1e3)
 
     baseline = _baseline_for(metric)
-    return {
+    res = {
         "metric": metric,
         "value": round(events_per_sec, 1),
         "unit": "events/sec",
@@ -141,6 +259,8 @@ def _measure(run_step, events_per_step: int, metric: str, *,
         "device_step_ms": round(events_per_step * 1e3 / events_per_sec, 4),
         "p99_batch_latency_ms": round(p99_ms, 3),
     }
+    _partial(res)
+    return res
 
 
 def _measure_e2e(rt, out_stream: str, feed_round, events_per_round: int,
@@ -161,7 +281,18 @@ def _measure_e2e(rt, out_stream: str, feed_round, events_per_round: int,
     else:
         rt.add_callback(out_stream, lambda evs: n_out.__setitem__(
             0, n_out[0] + len(evs)))
+    _phase(f"e2e:{out_stream}:aot_warmup")
+    t_w = time.monotonic()
     rt.start()
+    # AOT-warm the FULL-WIDTH bucket only: the e2e feed sends exact
+    # full-capacity batches (no auto-flush, no heartbeats), so batch_size
+    # is the single shape this run dispatches — warming more rungs of a
+    # 1M-group aggregation step repeats its dominant (group-capacity)
+    # compile cost for shapes never hit
+    caps = {j.batch_size for j in rt.junctions.values()}
+    rt.warmup(tuple(sorted(caps)))
+    _partial({"aot_warmup_s": round(time.monotonic() - t_w, 2)})
+    _phase(f"e2e:{out_stream}:feed")
     for r in range(warmup):
         feed_round(r)
     rt.drain()
@@ -188,7 +319,7 @@ def _measure_autoflush_p99(app: str, *, rate_hz: float = 1000.0,
     from siddhi_tpu import SiddhiManager
 
     rt = SiddhiManager().create_siddhi_app_runtime(
-        app, batch_size=256, auto_flush_ms=10)
+        app, batch_size=256, auto_flush_ms=10, aot_warmup=True)
     lat: list = []
     pend: dict = {}
 
@@ -322,11 +453,14 @@ def bench_filter() -> dict:
 
     res["e2e_events_per_sec"] = round(
         _measure_e2e(rt2, "OutStream", feed, E2E_BATCH), 1)
+    _partial({"e2e_events_per_sec": res["e2e_events_per_sec"]})
 
     # auto-flush latency at LOW rate (1k ev/s, no flush() from the caller):
     # the wall-clock flusher bounds staged latency (VERDICT r04 item 5;
     # reference role: the Disruptor's immediate consumption)
+    _phase("filter:autoflush_p99")
     res["p99_autoflush_latency_ms"] = _measure_autoflush_p99(app)
+    _partial({"p99_autoflush_latency_ms": res["p99_autoflush_latency_ms"]})
 
     if not E2E_ONLY:  # secondary: row-at-a-time public API
         rt3 = SiddhiManager().create_siddhi_app_runtime(
@@ -341,6 +475,7 @@ def bench_filter() -> dict:
         res["e2e_rows_events_per_sec"] = round(
             _measure_e2e(rt3, "OutStream", feed_rows, E2E_BATCH,
                          columnar=False, rounds=4), 1)
+        _partial({"e2e_rows_events_per_sec": res["e2e_rows_events_per_sec"]})
     return res
 
 
@@ -387,6 +522,7 @@ def bench_groupby() -> dict:
 
     res["e2e_events_per_sec"] = round(
         _measure_e2e(rt2, "SummaryStream", feed, E2E_BATCH), 1)
+    _partial({"e2e_events_per_sec": res["e2e_events_per_sec"]})
 
     return res
 
@@ -456,6 +592,7 @@ def _distinct_e2e(app: str, res: dict) -> dict:
 
     res["e2e_events_per_sec"] = round(
         _measure_e2e(rt2, "OutStream", feed, E2E_BATCH), 1)
+    _partial({"e2e_events_per_sec": res["e2e_events_per_sec"]})
     return res
 
 
@@ -470,7 +607,11 @@ def bench_pattern() -> dict:
 
     # device NFA time is sub-ms; tunnel dispatch overhead dominates at small
     # batches, so run full-width batches with pending capacity to match
-    pb = BATCH
+    # device NFA width: full batch through the tunnel; on CPU both the
+    # compile and the per-step cost of the 4x-pending NFA grow with width —
+    # a narrower batch keeps the config inside its watchdog budget on
+    # small hosts (same engine path)
+    pb = BATCH if not _is_cpu() else 512
     app = """
     define stream StreamA (val int);
     define stream StreamB (val int);
@@ -511,7 +652,9 @@ def bench_pattern() -> dict:
 
         res = _measure(run, 2 * pb, "pattern_everyAB_within5s_events_per_sec")
 
-    eb = 32768  # e2e batch: amortizes the per-batch readback round trips
+    # e2e batch: amortizes the per-batch readback round trips (tunnel);
+    # CPU shrinks with the device width (no tunnel, cheaper steps)
+    eb = 32768 if not _is_cpu() else 2048
     prev_cap = dtypes.config.pattern_pending_capacity
     dtypes.config.pattern_pending_capacity = 4 * eb
     try:
@@ -534,6 +677,7 @@ def bench_pattern() -> dict:
 
     res["e2e_events_per_sec"] = round(
         _measure_e2e(rt2, "OutStream", feed, 2 * eb), 1)
+    _partial({"e2e_events_per_sec": res["e2e_events_per_sec"]})
     return res
 
 
@@ -607,7 +751,23 @@ def bench_join() -> dict:
 
     res["e2e_events_per_sec"] = round(
         _measure_e2e(rt2, "OutStream", feed, 2 * jb), 1)
+    _partial({"e2e_events_per_sec": res["e2e_events_per_sec"]})
     return res
+
+
+def bench_hang() -> dict:
+    """HIDDEN config (`python bench.py _hang`): deliberately wedges before
+    importing anything heavy AND swallows the in-process alarm — the
+    watchdog unit test proves the PARENT deadline bounds even a config the
+    child-side alarm cannot stop, while the partials still yield a JSON
+    line."""
+    _partial({"metric": "hang_test", "stage_one": 1.0})
+    _phase("_hang:sleeping")
+    while True:
+        try:
+            time.sleep(3600)
+        except BenchTimeout:
+            pass  # simulate a hang no Python-level bound can interrupt
 
 
 CONFIGS = {
@@ -618,59 +778,133 @@ CONFIGS = {
     "groupby": bench_groupby,  # headline: keep last so drivers that parse
     # only the final line keep tracking the round-1 metric
 }
+#: not part of the default run; reachable by explicit name only
+HIDDEN_CONFIGS = {"_hang": bench_hang}
 
 
-def _run_config_subprocess(argv, env=None):
-    """Run one config in a fresh interpreter; return its JSON line or None."""
+def _run_config_subprocess(argv, env=None, timeout: float = 900.0):
+    """Run one config in a fresh interpreter under a HARD parent deadline.
+    The child's stdout is streamed live: `#partial {json}` checkpoint lines
+    accumulate so a killed child still yields numbers for every sub-metric
+    that finished (merged under "partial": true). stderr (heartbeats)
+    passes straight through to our stderr."""
     import subprocess
+    t0 = time.monotonic()
+    proc = subprocess.Popen(argv, stdout=subprocess.PIPE, stderr=None,
+                            text=True, env=env)
+    partial: dict = {}
+    final: list = []
+
+    def _reader():
+        for line in proc.stdout:
+            line = line.strip()
+            if line.startswith("#partial "):
+                try:
+                    partial.update(json.loads(line[len("#partial "):]))
+                except json.JSONDecodeError:
+                    pass
+            elif line.startswith("{"):
+                final.append(line)
+
+    rd = threading.Thread(target=_reader, daemon=True)
+    rd.start()
     try:
-        r = subprocess.run(argv, capture_output=True, text=True, timeout=900,
-                           env=env)
+        proc.wait(timeout=timeout)
     except subprocess.TimeoutExpired:
-        return {"error": "timeout after 900s"}
-    lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
-    if not lines:
-        return {"error": (r.stderr or "no output").strip()[-400:]}
+        proc.kill()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:  # pragma: no cover — kill -9'd
+            pass
+        rd.join(timeout=5)
+        elapsed = time.monotonic() - t0
+        return {**partial, "partial": True,
+                "error": f"timeout after {elapsed:.0f}s"}
+    rd.join(timeout=10)
+    if not final:
+        if partial:  # child died mid-run (alarm/OOM) but checkpointed
+            return {**partial, "partial": True,
+                    "error": f"config exited rc={proc.returncode} "
+                             "before the final line"}
+        return {"error": f"no output (rc={proc.returncode})"}
     try:
-        return json.loads(lines[-1])
+        return json.loads(final[-1])
     except json.JSONDecodeError:
-        return {"error": lines[-1][-400:]}
+        return {"error": final[-1][-400:]}
+
+
+def _run_child(name: str) -> None:
+    """Child mode: one config, best-effort SIGALRM + heartbeat, partial
+    JSON on expiry. The parent's kill is the hard bound; the alarm lets a
+    Python-visible stall report its own partials first."""
+    fn = {**CONFIGS, **HIDDEN_CONFIGS}[name]
+    _arm_child_watchdog(max(CONFIG_SECONDS - 5.0, 1.0))
+    try:
+        if name != "_hang":  # _hang must stay import-free
+            _resolve_e2e_batch()
+        res = fn()
+    except BenchTimeout as e:
+        res = {**PARTIAL, "partial": True, "error": str(e)}
+        res.setdefault("metric", name)
+    print(json.dumps(res), flush=True)
 
 
 def main() -> None:
-    import os
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
-    unknown = [n for n in args if n not in CONFIGS]
+    known = {**CONFIGS, **HIDDEN_CONFIGS}
+    unknown = [n for n in args if n not in known]
     if unknown:
         sys.exit(f"unknown config(s) {unknown}; choose from {list(CONFIGS)}")
     names = args or list(CONFIGS)
-    if E2E_ONLY or len(names) == 1:
+    # child mode is EXPLICIT (--child / --e2e-only): a bare single-config
+    # invocation still gets the parent-side watchdog
+    if E2E_ONLY or "--child" in sys.argv:
         if E2E_ONLY and os.environ.get("SIDDHI_BENCH_CPU"):
             # co-located variant: same engine, CPU backend in-process — no
             # tunnel between controller and device
             from siddhi_tpu.util.platform import force_cpu_platform
             force_cpu_platform(1)
-        print(json.dumps(CONFIGS[names[0]]()), flush=True)
+        _run_child(names[0])
         return
     # one subprocess per config: earlier configs' runtimes pin device buffers
     # (1M-key tables, 100k rings) and degrade later configs measurably when
-    # sharing a process
-    for name in names:
-        res = _run_config_subprocess([sys.executable, __file__, name])
-        if "error" in res:
-            print(json.dumps({"metric": name, **res}), flush=True)
+    # sharing a process. Per-config deadline = min(CONFIG_SECONDS, remaining
+    # total budget) — the driver can kill nothing without still getting a
+    # JSON line for every config that got to run.
+    for i, name in enumerate(names):
+        remaining = MAX_SECONDS - (time.monotonic() - T0)
+        if remaining < 20:
+            print(json.dumps({
+                "metric": name, "error": "skipped: --max-seconds budget "
+                f"exhausted ({MAX_SECONDS:.0f}s)"}), flush=True)
+            continue
+        budget = min(CONFIG_SECONDS, remaining)
+        print(f"[bench] t={time.monotonic() - T0:.0f}s config={name} "
+              f"({i + 1}/{len(names)}) budget={budget:.0f}s",
+              file=sys.stderr, flush=True)
+        res = _run_config_subprocess(
+            [sys.executable, __file__, name, "--child",
+             f"--config-seconds={budget:.0f}"],
+            timeout=budget)
+        res.setdefault("metric", name)
+        if "error" in res and not res.get("partial"):
+            print(json.dumps(res), flush=True)
             continue
         # co-located CPU e2e (VERDICT r3 item 1: separate topology from
         # engine): same public path, CPU backend, fresh subprocess
-        cpu_env = dict(os.environ,
-                       JAX_PLATFORMS="cpu", SIDDHI_BENCH_CPU="1")
-        cpu = _run_config_subprocess(
-            [sys.executable, __file__, name, "--e2e-only"], env=cpu_env)
-        if "e2e_events_per_sec" in cpu:
-            res["e2e_colocated_events_per_sec"] = cpu["e2e_events_per_sec"]
-        if "p99_autoflush_latency_ms" in cpu:
-            res["p99_autoflush_latency_ms_colocated"] = \
-                cpu["p99_autoflush_latency_ms"]
+        remaining = MAX_SECONDS - (time.monotonic() - T0)
+        if remaining > 30 and "error" not in res:
+            cpu_env = dict(os.environ,
+                           JAX_PLATFORMS="cpu", SIDDHI_BENCH_CPU="1")
+            cpu = _run_config_subprocess(
+                [sys.executable, __file__, name, "--e2e-only",
+                 f"--config-seconds={min(CONFIG_SECONDS, remaining):.0f}"],
+                env=cpu_env, timeout=min(CONFIG_SECONDS, remaining))
+            if "e2e_events_per_sec" in cpu:
+                res["e2e_colocated_events_per_sec"] = cpu["e2e_events_per_sec"]
+            if "p99_autoflush_latency_ms" in cpu:
+                res["p99_autoflush_latency_ms_colocated"] = \
+                    cpu["p99_autoflush_latency_ms"]
         print(json.dumps(res), flush=True)
 
 

@@ -775,6 +775,11 @@ class AggregationRuntime(Receiver):
     # ---------------------------------------------------------------- runtime
 
     def on_batch(self, batch: EventBatch, now: int) -> None:
+        cap = self.junction.batch_size
+        if batch.capacity < cap:
+            # the jitted ingest is traced at the junction capacity; widen
+            # shape-bucketed deliveries back (new lanes invalid)
+            batch = batch.pad_to(cap)
         self.state = self._ingest(self.state, batch, jnp.int64(now))
         self._batches_since_check += 1
         if self._batches_since_check >= 32:

@@ -35,8 +35,15 @@ class SiddhiAppRuntime:
                  error_store=None, config_manager=None,
                  mesh=None, partition_capacity: int = 0,
                  async_callbacks: bool = False,
-                 auto_flush_ms: Optional[float] = None) -> None:
+                 auto_flush_ms: Optional[float] = None,
+                 aot_warmup: bool = False) -> None:
         self.app = app
+        #: AOT-compile every query's step ladder at start() (also
+        #: SIDDHI_AOT_WARMUP=1) so the first real batch never pays
+        #: first-compile latency — see warmup()
+        import os as _os
+        self.aot_warmup = aot_warmup or \
+            _os.environ.get("SIDDHI_AOT_WARMUP", "") not in ("", "0")
         playback_ann = app.annotation("app:playback")
         idle_ms = increment_ms = None
         if playback_ann is not None:
@@ -260,7 +267,18 @@ class SiddhiAppRuntime:
             return
         if out.action == OutputAction.INSERT and out.target_id:
             if out.target_id in self.tables:
-                qr.output_junction = _TableJunctionAdapter(self.tables[out.target_id])
+                table = self.tables[out.target_id]
+                # unionSet-projection provenance flows into the table: the
+                # inserted column carries the set-size projection, so
+                # downstream sizeOfSet(T.attr) stays readable (and ordinary
+                # LONG columns stay rejected)
+                marks = {n for n in getattr(qr.selector, "host_set_slots", {})
+                         if n in table.attr_types}
+                if marks:
+                    table.set_projection_attrs = (
+                        set(getattr(table, "set_projection_attrs", ()) or ())
+                        | marks)
+                qr.output_junction = _TableJunctionAdapter(table)
             elif out.target_id in self.windows:
                 from .window import WindowJunctionAdapter
                 qr.output_junction = WindowJunctionAdapter(
@@ -296,6 +314,8 @@ class SiddhiAppRuntime:
 
     def start(self) -> None:
         self._started = True
+        if self.aot_warmup:
+            self.warmup()
         if self.ctx.async_callbacks and self.ctx.decoder is None:
             from .stream import AsyncDecoder
             self.ctx.decoder = AsyncDecoder()
@@ -352,6 +372,29 @@ class SiddhiAppRuntime:
                 logging.getLogger("siddhi_tpu").exception(
                     "auto-flush tick failed")
 
+    def warmup(self, buckets=None) -> dict:
+        """AOT-compile every query runtime's jitted step for its lane-bucket
+        ladder (shape-bucketed queries: min_bucket..batch_size; shape-baked
+        ones: the single full capacity), so steady-state traffic — and
+        benchmark measurement windows — never absorb first-compile latency.
+        Each step executes once per bucket on a throwaway state copy with an
+        all-invalid batch; live state is untouched. Returns
+        {query_name: fresh_compile_count}; failures are logged, never
+        raised (warmup is an optimization, not a correctness step)."""
+        import logging
+        out: dict[str, int] = {}
+        with self.ctx.controller_lock:
+            for name, qr in self.query_runtimes.items():
+                fn = getattr(qr, "warmup", None)
+                if fn is None:
+                    continue
+                try:
+                    out[name] = fn(buckets)
+                except Exception:  # noqa: BLE001 — advisory only
+                    logging.getLogger("siddhi_tpu").exception(
+                        "AOT warmup failed for query %r", name)
+        return out
+
     def shutdown(self, *, flush_durable: bool = True) -> None:
         self._started = False
         if self._flusher_stop is not None:
@@ -359,6 +402,10 @@ class SiddhiAppRuntime:
             if self._flusher_thread is not None:
                 self._flusher_thread.join(timeout=5)
             self._flusher_stop = None
+            # producers pair staged appends under the controller lock only
+            # while a flusher can swap the lists — post-shutdown send()s
+            # must not keep taking it for a flusher that is gone
+            self.ctx.autoflush_active = False
         for j in self.junctions.values():
             j.stop_async()
         if self.ctx.decoder is not None:

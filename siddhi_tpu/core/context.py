@@ -76,6 +76,19 @@ class Statistics:
     events_out: dict = field(default_factory=dict)
     batches: dict = field(default_factory=dict)
     query_latency_ns: dict = field(default_factory=dict)  # query -> (total, count)
+    #: per-query XLA compile counter (query -> count) and the batch lane
+    #: widths that triggered each trace (query -> [width, ...]). Tracked
+    #: REGARDLESS of level: a recompile storm (unbounded shapes hitting a
+    #: jitted step) stalls the pipeline for seconds per compile — it must be
+    #: a visible metric, not a silent hang. Incremented at TRACE time from
+    #: inside each runtime's step closure, so the count is exact per
+    #: (query, shape-signature) executable.
+    compiles: dict = field(default_factory=dict)
+    compile_widths: dict = field(default_factory=dict)
+    #: per-query step wall-time histogram: query -> {bucket_us: count} with
+    #: power-of-two microsecond buckets (key = inclusive upper bound in us).
+    #: DETAIL only — one bit_length per step.
+    step_hist: dict = field(default_factory=dict)
     started_at: float = field(default_factory=time.time)
     #: capacity-overflow counters ("<runtime>.<structure>" -> lifetime rows
     #: dropped/overwritten/unresolved). Tracked regardless of level — silent
@@ -107,6 +120,16 @@ class Statistics:
         if self.detail:
             t, c = self.query_latency_ns.get(query, (0, 0))
             self.query_latency_ns[query] = (t + ns, c + 1)
+            bucket = 1 << max(ns // 1000, 1).bit_length()  # us, power of two
+            h = self.step_hist.setdefault(query, {})
+            h[bucket] = h.get(bucket, 0) + 1
+
+    def track_compile(self, query: str, width: int) -> None:
+        """One jitted-step TRACE (== one XLA compile) for `query` on a batch
+        of `width` lanes. Called from inside the traced function body, so it
+        fires exactly once per cached executable."""
+        self.compiles[query] = self.compiles.get(query, 0) + 1
+        self.compile_widths.setdefault(query, []).append(int(width))
 
     def record_overflow(self, name: str, n: int) -> None:
         """Register a lifetime overflow counter reading; warns ONCE per
@@ -130,6 +153,9 @@ class Statistics:
         self.events_out.clear()
         self.batches.clear()
         self.query_latency_ns.clear()
+        self.compiles.clear()
+        self.compile_widths.clear()
+        self.step_hist.clear()
         self.overflow.clear()
         self.started_at = time.time()
 
@@ -143,11 +169,18 @@ class Statistics:
             "batches": dict(self.batches),
             "throughput_eps": {s: n / elapsed for s, n in self.events_in.items()},
             "overflow": dict(self.overflow),
+            # always reported: a growing count under a steady workload is
+            # the recompile-storm signature (see track_compile)
+            "compiles": dict(self.compiles),
+            "compile_widths": {q: list(w)
+                               for q, w in self.compile_widths.items()},
         }
         if self.detail:
             out["query_latency_ms"] = {
                 q: (t / c / 1e6 if c else 0.0)
                 for q, (t, c) in self.query_latency_ns.items()}
+            out["step_time_hist_us"] = {
+                q: dict(sorted(h.items())) for q, h in self.step_hist.items()}
             if runtime is not None:
                 out["state_memory_bytes"] = {
                     name: _pytree_nbytes(qr.state)

@@ -70,9 +70,56 @@ class _Config:
     hll_registers = 1024
     #: max groups tracked by hll:distinctCount (each holds hll_registers)
     hll_group_capacity = 4096
+    #: shape-bucketed dispatch: junctions pad partial micro-batches to the
+    #: smallest power-of-two lane bucket >= the staged row count (instead of
+    #: always the full batch capacity), so each shape-polymorphic query step
+    #: compiles at most log2(batch_size / min_bucket) + 1 executables while
+    #: small/heartbeat batches run kernels sized to their data. Disabled
+    #: automatically for mesh-sharded apps (bucket widths must stay aligned
+    #: with the device mesh).
+    shape_buckets = True
+    #: smallest bucket capacity in the ladder (power of two)
+    min_bucket = 16
+    #: debug-mode invariant checks inside jitted steps (also enabled by
+    #: SIDDHI_DEBUG_CHECKS=1): currently the windows' nondecreasing
+    #: emission-key check before rank-merge scatters (ops/windows.py
+    #: _merge_order). Trace-time gated — zero cost when off.
+    debug_checks = False
 
 
 config = _Config()
+
+import os as _os
+
+if _os.environ.get("SIDDHI_DEBUG_CHECKS", "") not in ("", "0"):
+    config.debug_checks = True
+if _os.environ.get("SIDDHI_SHAPE_BUCKETS", "") == "0":
+    config.shape_buckets = False
+
+
+def bucket_ladder(cap: int) -> tuple[int, ...]:
+    """Ascending power-of-two lane-bucket ladder for one junction capacity:
+    (min_bucket, 2*min_bucket, ..., cap). `cap` itself is always the top
+    rung even when it is not a power of two, so full batches never pad."""
+    mb = max(int(config.min_bucket), 1)
+    out = []
+    b = mb
+    while b < cap:
+        out.append(b)
+        b <<= 1
+    out.append(cap)
+    return tuple(out)
+
+
+def bucket_capacity(n: int, cap: int) -> int:
+    """Smallest ladder bucket holding `n` valid rows (n == 0 -> min bucket,
+    n >= cap -> cap)."""
+    if n >= cap:
+        return cap
+    b = max(int(config.min_bucket), 1)
+    while b < n:
+        b <<= 1
+    return min(b, cap)
 
 
 def device_dtype(t: AttributeType):

@@ -429,6 +429,22 @@ class EventBatch:
     def where_valid(self, mask: jax.Array) -> "EventBatch":
         return dataclasses.replace(self, valid=self.valid & mask)
 
+    def pad_to(self, capacity: int) -> "EventBatch":
+        """Widen to `capacity` lanes: new lanes are invalid, columns zero,
+        timestamps extended with the last value (monotone — searchsorted
+        over raw batch ts stays correct). Runtimes whose compiled step is
+        NOT shape-polymorphic use this to restore their traced capacity
+        when a shape-bucketed junction hands them a narrower batch."""
+        n = capacity - self.capacity
+        if n <= 0:
+            return self
+        return EventBatch(
+            ts=jnp.pad(self.ts, (0, n), mode="edge"),
+            cols={k: jnp.pad(v, (0, n)) for k, v in self.cols.items()},
+            valid=jnp.pad(self.valid, (0, n)),
+            types=jnp.pad(self.types, (0, n)),
+        )
+
     def count(self) -> jax.Array:
         return jnp.sum(self.valid.astype(jnp.int32))
 

@@ -214,7 +214,24 @@ class JoinQueryRuntime:
         frames = {self.left.ref: self.left.attr_types,
                   self.right.ref: self.right.attr_types}
         codecs = {self.left.ref: self.left.codec, self.right.ref: self.right.codec}
-        self.resolver = TypeResolver(frames, self.left.ref, codecs)
+
+        def _sp(side):
+            # unionSet-projection provenance: junction-fed sides read the
+            # upstream output definition's markers; table sides the marker
+            # set at wiring time (app_runtime._wire_output)
+            if side.is_table:
+                return set(getattr(side.table, "set_projection_attrs", ())
+                           or ())
+            if side.junction is not None:
+                return {a.name for a in side.junction.definition.attributes
+                        if getattr(a, "set_projection", False)}
+            return set()
+
+        set_projections = {ref: sp for ref, sp in
+                           ((self.left.ref, _sp(self.left)),
+                            (self.right.ref, _sp(self.right))) if sp}
+        self.resolver = TypeResolver(frames, self.left.ref, codecs,
+                                     set_projections)
 
         for side in (self.left, self.right):
             side.filters = [compile_expression(f, self.resolver, registry)
@@ -274,7 +291,9 @@ class JoinQueryRuntime:
             select_all_attrs=select_all)
 
         self.output_attributes = tuple(
-            Attribute(n, t) for n, t in self.selector.out_types.items())
+            Attribute(n, t,
+                      set_projection=n in self.selector.host_set_slots)
+            for n, t in self.selector.out_types.items())
         self.output_definition = StreamDefinition(
             id=query.output_stream.target_id or f"{name}_out",
             attributes=self.output_attributes)
@@ -407,14 +426,39 @@ class JoinQueryRuntime:
         # stream values ("<probe_ref>.<attr>")
         seen = set()
         param_rows = []
+        keys = []
         for i in range(len(idx)):
             t = tuple(cols[a][i] for a in probe_attrs)
             if t in seen:
                 continue
             seen.add(t)
+            keys.append(t)
             param_rows.append({f"{probe.ref}.{a}": v
                                for a, v in zip(probe_attrs, t)})
-        build.table.ensure_cached_for_condition(pred, param_rows)
+        # skip the quadratic store scan for parameter rows already warmed
+        # while BOTH the store (rev) and the cache residency (evictions)
+        # were unchanged — steady-state probing of a quiet store then costs
+        # zero host scans (ADVICE r5); any store write or cache eviction
+        # invalidates the memo, falling back to the per-batch scan
+        epoch = (build.table._store_rev, build.table.cache_policy.evictions)
+        warmed = getattr(build, "_cond_warmed", None)
+        if warmed is None or warmed[0] != epoch:
+            warmed = (epoch, set())
+        fresh = [(t, p) for t, p in zip(keys, param_rows)
+                 if t not in warmed[1]]
+        if not fresh:
+            build._cond_warmed = warmed
+            return
+        build.table.ensure_cached_for_condition(pred, [p for _, p in fresh])
+        # the warm itself may evict (counter moved): re-key so the NEXT
+        # batch revalidates; the fresh keys stay memoized under the new
+        # epoch only if nothing was displaced
+        epoch2 = (build.table._store_rev, build.table.cache_policy.evictions)
+        memo = warmed[1] if epoch2 == epoch else set()
+        memo.update(t for t, _ in fresh)
+        if len(memo) > (1 << 16):  # bounded memo
+            memo.clear()
+        build._cond_warmed = (epoch2, memo)
 
     def _probe_outer(self, from_left: bool) -> bool:
         if self.join_type == JoinType.FULL_OUTER:
@@ -439,8 +483,12 @@ class JoinQueryRuntime:
                   and not (build_side.is_table or build_side.is_named_window
                            or build_side.is_aggregation)
                   and bool(plan.probe_keys))
+        stats = self.ctx.statistics
+        qname = self.name
 
         def step(state, batch: EventBatch, now, build_tstate=None):
+            # trace-time: per-query compile counter (see Statistics)
+            stats.track_compile(qname, batch.ts.shape[0])
             wl, wr, mml, mmr, sel = state
             w_probe, w_build = (wl, wr) if from_left else (wr, wl)
             mm_probe, mm_build = (mml, mmr) if from_left else (mmr, mml)
@@ -621,9 +669,47 @@ class JoinQueryRuntime:
 
     # ---------------------------------------------------------------- runtime
 
+    def warmup(self, buckets=None) -> int:
+        """AOT-compile both probe directions at their planned batch capacity
+        (join steps always receive full-capacity batches — on_side_batch
+        pads bucketed deliveries back up) without executing them
+        (query_runtime.aot_warm). Returns fresh compiles triggered."""
+        from .query_runtime import aot_warm
+        n0 = self.ctx.statistics.compiles.get(self.name, 0)
+        now = jnp.int64(self.ctx.timestamp_generator.current_time())
+        for from_left in (True, False):
+            side = self.left if from_left else self.right
+            build = self.right if from_left else self.left
+            if side.junction is None:
+                continue
+            triggers = (self.trigger == EventTrigger.ALL
+                        or (self.trigger == EventTrigger.LEFT and from_left)
+                        or (self.trigger == EventTrigger.RIGHT
+                            and not from_left))
+            if not triggers:
+                continue
+            if build.is_table:
+                tstate = build.table.state
+            elif build.is_named_window:
+                tstate = build.named_window.state
+            elif build.is_aggregation:
+                tstate = build.agg_view.state
+            else:
+                tstate = None
+            step = self._step_left if from_left else self._step_right
+            batch = EventBatch.empty(side.junction.definition,
+                                     side.junction.batch_size)
+            aot_warm(step, self.state, batch, now, tstate)
+        return self.ctx.statistics.compiles.get(self.name, 0) - n0
+
     def on_side_batch(self, from_left: bool, batch: EventBatch, now: int) -> None:
         side = self.left if from_left else self.right
         build = self.right if from_left else self.left
+        if side.junction is not None and \
+                batch.capacity < side.junction.batch_size:
+            # join steps are traced at the side's full batch capacity;
+            # bucketed junction deliveries widen back (invalid lanes)
+            batch = batch.pad_to(side.junction.batch_size)
         triggers = (self.trigger == EventTrigger.ALL
                     or (self.trigger == EventTrigger.LEFT and from_left)
                     or (self.trigger == EventTrigger.RIGHT and not from_left))

@@ -41,7 +41,7 @@ class SiddhiManager:
         batch_size: int = 0, group_capacity: int = 0,
         mesh=None, partition_capacity: int = 0,
         async_callbacks: bool = False,
-        auto_flush_ms=None,
+        auto_flush_ms=None, aot_warmup: bool = False,
     ) -> SiddhiAppRuntime:
         app = self._parse(app)
         rt = SiddhiAppRuntime(app, self.registry, batch_size=batch_size,
@@ -50,7 +50,8 @@ class SiddhiManager:
                               config_manager=self.config_manager,
                               mesh=mesh, partition_capacity=partition_capacity,
                               async_callbacks=async_callbacks,
-                              auto_flush_ms=auto_flush_ms)
+                              auto_flush_ms=auto_flush_ms,
+                              aot_warmup=aot_warmup)
         if self.persistence_store is not None:
             rt.persistence_store = self.persistence_store
         self.runtimes[app.name] = rt

@@ -39,7 +39,9 @@ class OnDemandQueryRuntime:
         tid = table.definition.id
 
         frames = {tid: dict(table.attr_types)}
-        resolver = TypeResolver(frames, tid, {tid: table.codec})
+        tsp = set(getattr(table, "set_projection_attrs", ()) or ())
+        resolver = TypeResolver(frames, tid, {tid: table.codec},
+                                {tid: tsp} if tsp else None)
 
         self.cond = None
         if odq.on_condition is not None:

@@ -517,13 +517,24 @@ class PatternQueryRuntime:
                             codecs[sid] = codecs[leg.ref]
 
         rewriter = _RefRewriter(plan.count_groups)
-        self.resolver = TypeResolver(frames, plan.positions[0].legs[0].ref, codecs)
+        # unionSet-projection provenance per leg frame (see expr_compile)
+        set_projections = {}
+        for pos in plan.positions:
+            for leg in pos.legs:
+                j = self.junctions[leg.stream_id]
+                sp = {a.name for a in j.definition.attributes
+                      if getattr(a, "set_projection", False)}
+                if sp:
+                    set_projections[leg.ref] = sp
+        self.resolver = TypeResolver(frames, plan.positions[0].legs[0].ref,
+                                     codecs, set_projections)
 
         # --- compile per-leg conditions (unqualified attrs resolve to the
         # leg's own arrival frame, like the reference's per-state meta) ---
         for pos in plan.positions:
             for leg in pos.legs:
-                leg_resolver = TypeResolver(frames, leg.ref, codecs)
+                leg_resolver = TypeResolver(frames, leg.ref, codecs,
+                                            set_projections)
                 leg.compiled = [
                     compile_expression(rewriter.rewrite(f), leg_resolver, registry)
                     for f in leg.filters]
@@ -549,7 +560,9 @@ class PatternQueryRuntime:
             plan.positions[0].legs[0].ref, select_all_attrs=select_all)
 
         self.output_attributes = tuple(
-            Attribute(n, t) for n, t in self.selector.out_types.items())
+            Attribute(n, t,
+                      set_projection=n in self.selector.host_set_slots)
+            for n, t in self.selector.out_types.items())
         self.output_definition = StreamDefinition(
             id=query.output_stream.target_id or f"{name}_out",
             attributes=self.output_attributes)
@@ -721,6 +734,8 @@ class PatternQueryRuntime:
     def _make_step(self, junction_sid: Optional[str]):
         plan = self.plan
         selector = self.selector
+        stats = self.ctx.statistics
+        qname = self.name
         S = len(plan.positions)
         P = self.P
         within = plan.within_ms
@@ -745,6 +760,8 @@ class PatternQueryRuntime:
                     startable.add(_idx)
 
         def step(state: PatternState, batch: EventBatch, now):
+            # trace-time: per-query compile counter (see Statistics)
+            stats.track_compile(qname, batch.ts.shape[0])
             pending = list(state.pending)
             active0_box = [state.active0]
             gate0_box = [state.gate0_seq if state.gate0_seq is not None
@@ -1557,9 +1574,35 @@ class PatternQueryRuntime:
 
     # ---------------------------------------------------------------- runtime
 
+    def _feed_junction(self, sid: str) -> StreamJunction:
+        return (self.merged_junction if sid == MERGED_SID
+                else self.junctions[sid])
+
     def on_junction_batch(self, sid: str, batch: EventBatch, now: int) -> None:
+        cap = self._feed_junction(sid).batch_size
+        if batch.capacity < cap:
+            # pattern steps bake lane math on the planned capacity; widen
+            # bucketed deliveries back (new lanes invalid)
+            batch = batch.pad_to(cap)
         self.state, out = self._steps[sid](self.state, batch, jnp.int64(now))
         self._distribute(out, now)
+
+    def warmup(self, buckets=None) -> int:
+        """AOT-compile every per-junction step (+ the heartbeat step when
+        time semantics need it) at the planned capacity without executing
+        (query_runtime.aot_warm)."""
+        from .query_runtime import aot_warm
+        n0 = self.ctx.statistics.compiles.get(self.name, 0)
+        now = jnp.int64(self.ctx.timestamp_generator.current_time())
+        for sid, step in self._steps.items():
+            j = self._feed_junction(sid)
+            empty = EventBatch.empty(j.definition, j.batch_size)
+            aot_warm(step, self.state, empty, now)
+        if self.has_time_semantics:
+            any_j = next(iter(self.junctions.values()))
+            empty = EventBatch.empty(any_j.definition, any_j.batch_size)
+            aot_warm(self._heartbeat_step, self.state, empty, now)
+        return self.ctx.statistics.compiles.get(self.name, 0) - n0
 
     def heartbeat(self, now: int) -> None:
         if not self.has_time_semantics:

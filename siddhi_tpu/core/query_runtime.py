@@ -78,6 +78,19 @@ class QueryPlanInputs:
     frame_ref: str
 
 
+def aot_warm(jit_fn, *args) -> None:
+    """Populate `jit_fn`'s dispatch cache for `args`' shape signature
+    WITHOUT executing it — jax (>= 0.4.31) shares `lower().compile()`
+    executables with the normal call path, so the next real call is a pure
+    cache hit. Warmup therefore has no step side effects, cannot touch live
+    state, and never runs host callbacks (executing a step during warmup
+    can deadlock jax's CPU pure_callback path on small hosts)."""
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)),
+        args)
+    jit_fn.lower(*abstract).compile()
+
+
 def _selects_aggregates(selector, registry) -> bool:
     """True if any select item contains an aggregator call — the same
     detection CompiledSelector performs, needed BEFORE the window is built
@@ -156,7 +169,21 @@ class QueryRuntime(Receiver):
         for tid in self.dep_tables:
             frames[tid] = dict(self.tables[tid].attr_types)
             codecs[tid] = self.tables[tid].codec
-        self.resolver = TypeResolver(frames, self.frame_ref, codecs)
+        # unionSet-projection provenance (Attribute.set_projection markers on
+        # upstream auto-defined outputs; table markers set at wiring time):
+        # the only columns sizeOfSet() accepts downstream
+        sp = {a.name for a in definition.attributes
+              if getattr(a, "set_projection", False)}
+        set_projections = {}
+        if sp:
+            set_projections[self.frame_ref] = sp
+            set_projections[definition.id] = sp
+        for tid in self.dep_tables:
+            tsp = getattr(self.tables[tid], "set_projection_attrs", None)
+            if tsp:
+                set_projections[tid] = set(tsp)
+        self.resolver = TypeResolver(frames, self.frame_ref, codecs,
+                                     set_projections)
 
         # --- filters ---
         self.filters = [compile_expression(f, self.resolver, registry)
@@ -271,8 +298,12 @@ class QueryRuntime(Receiver):
                     "(delay re-emits expired lanes as arrivals)")
 
         # --- output stream definition ---
+        # forwarded raw-unionSet slots carry the set-size projection with a
+        # provenance marker so ONLY they satisfy downstream sizeOfSet()
         self.output_attributes = tuple(
-            Attribute(name, t) for name, t in self.selector.out_types.items())
+            Attribute(name, t,
+                      set_projection=name in self.selector.host_set_slots)
+            for name, t in self.selector.out_types.items())
         self.output_definition = StreamDefinition(
             id=query.output_stream.target_id or f"{self.name}_out",
             attributes=self.output_attributes)
@@ -318,6 +349,16 @@ class QueryRuntime(Receiver):
                     "with order by / limit / offset (snapshots re-emit the "
                     "whole live window set)")
 
+        # --- shape-bucketed dispatch eligibility ---
+        # the junction pads partial batches to power-of-two lane buckets;
+        # a query whose whole step derives lane counts from the batch
+        # (shape-polymorphic window, no ring-vs-chunk extrema coupling)
+        # consumes them directly, compiling once per ladder rung. Everything
+        # else pads back to the planned capacity in on_batch (one compile).
+        self._batch_cap = input_junction.batch_size
+        self._bucket_ok = (self.window.shape_polymorphic
+                          and not self.selector.extrema_plan)
+
         # --- the jitted step ---
         self._step = jax.jit(self._make_step(), donate_argnums=(0,))
         self.state = self._init_state()
@@ -362,6 +403,8 @@ class QueryRuntime(Receiver):
                 self.tables[tid]._used_in_probe = True  # cache-miss monitor
 
         limiter = self.rate_limiter
+        stats = self.ctx.statistics
+        qname = self.name
 
         def apply_fns(fns, batch, scope):
             for spec, arg_ex in fns:
@@ -377,6 +420,9 @@ class QueryRuntime(Receiver):
             return batch
 
         def step(state, batch: EventBatch, now, table_states=None):
+            # trace-time side effect: fires once per compiled executable —
+            # the per-query compile counter (recompile-storm observability)
+            stats.track_compile(qname, batch.capacity)
             wstate, sstate, rstate = state
 
             scope = Scope()
@@ -504,8 +550,35 @@ class QueryRuntime(Receiver):
                 table.ensure_cached_for_keys((t_attr,),
                                              {(k,) for k in keys})
 
+    def _table_states(self) -> dict:
+        return {tid: (self.tables[tid].state,
+                      self.tables[tid].probe_indexes()
+                      if tid in self._index_tables else {})
+                for tid in self.dep_tables}
+
+    def warmup(self, buckets=None) -> int:
+        """AOT-compile the jitted step for each lane bucket (ahead of time,
+        WITHOUT executing — see aot_warm), so first-batch compile time never
+        pollutes steady-state latency/throughput. Returns the number of
+        fresh compiles this triggered."""
+        if buckets is None:
+            buckets = (dtypes.bucket_ladder(self._batch_cap)
+                       if self._bucket_ok and dtypes.config.shape_buckets
+                       and self.ctx.mesh is None else (self._batch_cap,))
+        n0 = self.ctx.statistics.compiles.get(self.name, 0)
+        now = jnp.int64(self.ctx.timestamp_generator.current_time())
+        for cap in buckets:
+            batch = EventBatch.empty(self.input_junction.definition, cap)
+            aot_warm(self._step, self.state, batch, now,
+                     self._table_states())
+        return self.ctx.statistics.compiles.get(self.name, 0) - n0
+
     def on_batch(self, batch: EventBatch, now: int) -> None:
         t0 = time.perf_counter_ns()
+        if batch.capacity < self._batch_cap and not self._bucket_ok:
+            # shape-baked step: restore the traced capacity (bucketed or
+            # upstream-chunked batches widen; new lanes are invalid)
+            batch = batch.pad_to(self._batch_cap)
         debugger = getattr(self.ctx, "debugger", None)
         if debugger is not None:
             from .debugger import QueryTerminal
@@ -515,11 +588,8 @@ class QueryRuntime(Receiver):
                     batch.to_host_events(self.codec))
         if self._in_fallbacks:
             self._maybe_in_fallback(batch, now)
-        tstates = {tid: (self.tables[tid].state,
-                         self.tables[tid].probe_indexes()
-                         if tid in self._index_tables else {})
-                   for tid in self.dep_tables}
-        self.state, out = self._step(self.state, batch, jnp.int64(now), tstates)
+        self.state, out = self._step(self.state, batch, jnp.int64(now),
+                                     self._table_states())
         self._distribute(out, now)
         self.ctx.statistics.track_latency(self.name, time.perf_counter_ns() - t0)
         self._batches_seen += 1

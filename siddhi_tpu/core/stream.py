@@ -426,6 +426,17 @@ class StreamJunction:
         #: action=STREAM; schema = this stream's attrs + _error string
         self.fault_junction: Optional["StreamJunction"] = None
 
+    def _pad_cap(self, m: int) -> int:
+        """Delivery capacity for `m` staged rows: the smallest power-of-two
+        lane bucket holding them (shape-bucketed dispatch — each query step
+        then compiles at most one executable per ladder rung instead of
+        paying the full-capacity kernel for near-empty batches), or the full
+        batch size when bucketing is off / the app runs on a device mesh
+        (bucket widths must stay mesh-aligned)."""
+        if dtypes.config.shape_buckets and self.ctx.mesh is None:
+            return dtypes.bucket_capacity(m, self.batch_size)
+        return self.batch_size
+
     # ------------------------------------------------------------- subscribe
 
     def subscribe(self, receiver: Receiver) -> None:
@@ -541,12 +552,13 @@ class StreamJunction:
                     ts_c = ts_arr[start:start + cap]
                     cols_c = {k: v[start:start + cap] for k, v in cols.items()}
                 else:
-                    ts_c = np.empty(cap, dtype=np.int64)
+                    pcap = self._pad_cap(m)
+                    ts_c = np.empty(pcap, dtype=np.int64)
                     ts_c[:m] = ts_arr[start:start + m]
                     ts_c[m:] = ts_arr[start + m - 1]  # monotone pad
                     cols_c = {}
                     for k, v in cols.items():
-                        pad = np.zeros(cap, dtype=v.dtype)
+                        pad = np.zeros(pcap, dtype=v.dtype)
                         pad[:m] = v[start:start + m]
                         cols_c[k] = pad
                 self._deliver(EventBatch.from_numpy(ts_c, cols_c, m), now)
@@ -696,12 +708,13 @@ class StreamJunction:
             chunk_rows = rows[start:start + cap]
             chunk_ts = tss[start:start + cap]
             m = len(chunk_rows)
-            ts_arr = np.zeros(cap, dtype=np.int64)
+            pad = self._pad_cap(m)  # power-of-two lane bucket for partials
+            ts_arr = np.zeros(pad, dtype=np.int64)
             ts_arr[:m] = chunk_ts
             # pad timestamps monotonically so searchsorted stays correct
-            if m < cap and m > 0:
+            if m < pad and m > 0:
                 ts_arr[m:] = chunk_ts[-1]
-            cols = self.codec.rows_to_columns(chunk_rows, n_pad=cap)
+            cols = self.codec.rows_to_columns(chunk_rows, n_pad=pad)
             batch = EventBatch.from_numpy(ts_arr, cols, m)
             self._deliver(batch, now if now is not None else
                           self.ctx.timestamp_generator.current_time())
@@ -735,7 +748,9 @@ class StreamJunction:
         reference's Scheduler TIMER events, core/util/Scheduler.java:48)."""
         with self.ctx.controller_lock:
             self.flush(now)
-            empty = EventBatch.empty(self.definition, self.batch_size)
+            # timer batches carry no rows: the smallest lane bucket keeps
+            # idle heartbeats off the full-capacity kernel
+            empty = EventBatch.empty(self.definition, self._pad_cap(0))
             self._deliver(empty, now)
 
     def _deliver(self, batch: EventBatch, now: int) -> None:

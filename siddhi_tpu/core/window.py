@@ -83,14 +83,22 @@ class NamedWindow:
     def append(self, batch: EventBatch, now: int) -> None:
         """Insert arrivals (CURRENT lanes of `batch`) and publish the window's
         emissions downstream."""
+        cap = self.ctx.effective_batch_size
+        if batch.capacity < cap and not self.window.shape_polymorphic:
+            # shape-baked window op: widen narrower (bucketed / producer-
+            # chunked) inserts to the traced capacity
+            batch = batch.pad_to(cap)
         self.state, chunk = self._append(self.state, batch, jnp.int64(now))
         chunk = self._apply_output_event_type(chunk)
         self.output_junction.publish_batch(chunk, now)
 
     def heartbeat(self, now: int) -> None:
         """Advance time with no data so time-driven expirations emit."""
-        empty = EventBatch.empty(self.stream_definition,
-                                 self.ctx.effective_batch_size)
+        cap = self.ctx.effective_batch_size
+        if self.window.shape_polymorphic and dtypes.config.shape_buckets \
+                and self.ctx.mesh is None:
+            cap = dtypes.bucket_capacity(0, cap)  # timer batch: min bucket
+        empty = EventBatch.empty(self.stream_definition, cap)
         self.append(empty, now)
 
     def _apply_output_event_type(self, chunk: EventBatch) -> EventBatch:

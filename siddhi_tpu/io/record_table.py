@@ -335,6 +335,10 @@ class CachePolicy:
         #: True once the backing store has held more rows than the cache —
         #: joins and `in` probes read ONLY the cache, so evicted rows miss
         self.overflowed = False
+        #: lifetime eviction counter: read-through warm memos (join
+        #: condition fallback) are valid only while the rows they loaded
+        #: stay resident — any eviction invalidates them
+        self.evictions = 0
 
     def _evict_one(self, protected=frozenset()):
         # `protected` holds the current probing batch's working set: keys a
@@ -353,6 +357,7 @@ class CachePolicy:
                 victim = next(iter(self.rows))
         del self.rows[victim]
         self.freq.pop(victim, None)
+        self.evictions += 1
 
     def put(self, key, row, protected=frozenset()) -> None:
         if key in self.rows:
@@ -387,6 +392,7 @@ class CachePolicy:
         evaluate host-side)."""
         self.rows.clear()
         self.freq.clear()
+        self.evictions += 1
 
 
 # ----------------------------------------------------------------- runtime
@@ -458,6 +464,11 @@ class RecordTableRuntime:
         #: keys proven absent from the store — skips repeat store scans in
         #: the overflow slow path; invalidated by every store write
         self._absent_probe_keys: set = set()
+        #: store mutation counter: read-through warm memos (join condition
+        #: fallback — JoinQueryRuntime._condition_fallback) are valid only
+        #: while the backing store is unchanged; bumped by every path that
+        #: can ADD or REWRITE store rows
+        self._store_rev = 0
         if cache_ann is not None:
             copts = {e.key: e.value for e in cache_ann.elements if e.key}
             size = int(copts.get("size", copts.get("max.size", 128)))
@@ -703,12 +714,14 @@ class RecordTableRuntime:
         rows = self._batch_rows(batch)
         self.store.add(rows)
         self._absent_probe_keys.clear()
+        self._store_rev += 1
         self._cache_put_rows(rows)
 
     def insert_rows(self, rows, timestamp: int = 0) -> None:
         dicts = [dict(zip(self._attr_names, r)) for r in rows]
         self.store.add(dicts)
         self._absent_probe_keys.clear()
+        self._store_rev += 1
         self._cache_put_rows(dicts)
 
     def compile_condition(self, expr):
@@ -743,6 +756,7 @@ class RecordTableRuntime:
         compiled = self.compile_condition(expr)
         n = self.store.update(compiled, updater)
         self._absent_probe_keys.clear()
+        self._store_rev += 1
         if self.cache_policy is not None:
             if callable(compiled):
                 for k, r in list(self.cache_policy.rows.items()):
@@ -759,6 +773,7 @@ class RecordTableRuntime:
         compiled = self.compile_condition(expr)
         n = self.store.update_or_add(compiled, updater, rows)
         self._absent_probe_keys.clear()
+        self._store_rev += 1
         if self.cache_policy is not None:
             if n and callable(compiled):
                 for k, r in list(self.cache_policy.rows.items()):

@@ -153,18 +153,19 @@ def _make_create_set(arg_types):
 
 
 def _make_size_of_set(arg_types):
-    # A FORWARDED raw-unionSet slot rides downstream as its exact distinct
-    # count (LONG set-size projection — see ops/selector.py host_set_slots
-    # and docs/PARITY.md): sizeOfSet over it reads that count directly.
-    # (sizeOfSet(unionSet(...)) in one query compiles via the distinctCount
-    # rewrite and never reaches this factory.)
-    if arg_types and arg_types[0] == _T.LONG:
-        return (lambda a: a.astype(dtypes.device_dtype(_T.LONG))), _T.LONG
+    # Forwarded raw-unionSet columns are handled by the PLANNER
+    # (expr_compile._compile_function): it verifies unionSet provenance via
+    # Attribute.set_projection before reading the LONG set-size projection.
+    # Reaching this factory means the argument is NOT a provenance-marked
+    # attribute — raising here (instead of accepting any LONG, pre-r6
+    # behavior) stops sizeOfSet(ordinaryLongAttr) from silently forwarding
+    # the attribute value (ADVICE r5).
     raise SiddhiAppCreationError(
-        "sizeOfSet() over an arbitrary set attribute is not supported; "
+        "sizeOfSet() over an arbitrary expression is not supported; "
         "sizeOfSet(unionSet(...)) compiles to an exact distinct count, and "
-        "a forwarded `select unionSet(x) as s` column carries the set-size "
-        "projection (LONG) that sizeOfSet reads directly")
+        "a forwarded `select unionSet(x) as s` column carries a "
+        "provenance-marked set-size projection (LONG) that sizeOfSet reads "
+        "directly")
 
 
 def register_all() -> None:

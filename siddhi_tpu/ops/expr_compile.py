@@ -109,10 +109,19 @@ class TypeResolver:
 
     def __init__(self, frames: dict[str, dict[str, AttributeType]],
                  default_frame: Optional[str] = None,
-                 codecs: Optional[dict[str, StreamCodec]] = None) -> None:
+                 codecs: Optional[dict[str, StreamCodec]] = None,
+                 set_projections: Optional[dict[str, set]] = None) -> None:
         self.frames = frames
         self.default_frame = default_frame or (next(iter(frames)) if frames else None)
         self.codecs = codecs or {}
+        #: frame_ref -> attr names carrying a forwarded unionSet SET-SIZE
+        #: projection (Attribute.set_projection provenance) — the only
+        #: columns sizeOfSet() accepts downstream
+        self.set_projections = set_projections or {}
+
+    def is_set_projection(self, frame_ref: Optional[str], attr: str) -> bool:
+        ref = frame_ref or self.default_frame
+        return attr in self.set_projections.get(ref, ())
 
     def resolve(self, v: Variable) -> tuple[Optional[str], str, AttributeType]:
         if v.stream_id is not None:
@@ -401,6 +410,29 @@ def _compile_function(expr: AttributeFunction, resolver: TypeResolver,
         return CompiledExpr(
             lambda s: jnp.broadcast_to(s.extras["now"], s.ts[s.default_frame].shape),
             AttributeType.LONG)
+    # sizeOfSet over a FORWARDED raw-unionSet column: the lane already
+    # carries the exact distinct count (LONG set-size projection). Accepted
+    # ONLY with unionSet provenance (Attribute.set_projection riding the
+    # producing query's output definition / table marker) — an ordinary
+    # LONG column raises instead of silently forwarding its value
+    # (ADVICE r5; sizeOfSet(unionSet(...)) in ONE query rewrites to
+    # distinctCount in the selector and never reaches here).
+    if (not expr.namespace and expr.name == "sizeOfSet"
+            and len(expr.parameters) == 1
+            and isinstance(expr.parameters[0], Variable)):
+        v = expr.parameters[0]
+        ref, attr, t = resolver.resolve(v)
+        if t == AttributeType.LONG and resolver.is_set_projection(ref, attr):
+            dt = dtypes.device_dtype(AttributeType.LONG)
+            return CompiledExpr(
+                lambda s, r=ref, a=attr, d=dt: s.col(r, a).astype(d),
+                AttributeType.LONG)
+        raise SiddhiAppCreationError(
+            f"sizeOfSet({v.attribute}): the column does not carry a "
+            "unionSet set-size projection — only a forwarded `select "
+            "unionSet(x) as s` output (auto-defined stream or insert-into "
+            "table) is readable by sizeOfSet downstream; an ordinary "
+            f"{t.value} attribute would silently forward its value")
 
     args = tuple(compile_expression(p, resolver, registry) for p in expr.parameters)
     impl = registry.lookup(ExtensionKind.FUNCTION, expr.namespace, expr.name)

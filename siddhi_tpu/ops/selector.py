@@ -261,7 +261,9 @@ class CompiledSelector:
         frames = dict(resolver.frames)
         frames[AGG_FRAME] = {slot: spec.return_type
                              for slot, spec, _ in self.agg_specs}
-        self.resolver = TypeResolver(frames, resolver.default_frame, resolver.codecs)
+        self.resolver = TypeResolver(frames, resolver.default_frame,
+                                     resolver.codecs,
+                                     resolver.set_projections)
 
         self.out_exprs: list[tuple[str, CompiledExpr]] = []
         for name, e in rewritten:
@@ -298,7 +300,8 @@ class CompiledSelector:
         # --- having / order by compiled against the output frame ---
         out_frames = dict(frames)
         out_frames["__out__"] = dict(self.out_types)
-        out_resolver = TypeResolver(out_frames, "__out__", resolver.codecs)
+        out_resolver = TypeResolver(out_frames, "__out__", resolver.codecs,
+                                    resolver.set_projections)
         self.having = (compile_expression(selector.having, out_resolver, registry)
                        if selector.having is not None else None)
         self.order_by = [(out_resolver.resolve(ob.variable), ob.order)

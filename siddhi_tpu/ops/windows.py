@@ -162,6 +162,17 @@ def _merge_order(keys, valids):
     already carry nondecreasing (hi, lo) keys — true for every window-chunk
     assembly (currents/RESETs/expireds are generated in emission order).
 
+    INVARIANT (monotone-timestamp ingress): each group's valid-lane keys
+    must be nondecreasing in lane order. Window emission keys derive from
+    event timestamps/arrival order, and every ingress path guarantees
+    monotone timestamps per junction (flush pads ts with the last value;
+    the watermark never regresses — core/stream.py). Feeding a window
+    out-of-order timestamps (e.g. externalTime over a disordered attribute
+    clock) breaks the premise: the rank-merge scatter would silently
+    drop/duplicate lanes where a comparator sort merely mis-ordered output.
+    With `dtypes.config.debug_checks` (or SIDDHI_DEBUG_CHECKS=1) each
+    group's key order is verified per step and violations warn loudly.
+
     Replaces the chunk comparator sort (XLA CPU: ~74 ms at 282k lanes) with
     per-group stable partitions + cross-group searchsorted rank sums
     (~2 ms): merged_rank(lane) = local_rank + Σ_h |{k in group h : k < key}|
@@ -186,6 +197,15 @@ def _merge_order(keys, valids):
         nvs.append(nv)
     total_valid = sum(nvs)
 
+    if dtypes.config.debug_checks:
+        # partitioned keys end with a BIG suffix, so one pairwise compare
+        # per group covers exactly the valid prefix
+        ok = jnp.bool_(True)
+        for k in ck:
+            if k.shape[0] > 1:
+                ok = ok & jnp.all(k[1:] >= k[:-1])
+        jax.debug.callback(_warn_nonmonotone_keys, ok)
+
     order_all = jnp.zeros((total,), jnp.int32)
     inv_base = total_valid
     for g in range(G):
@@ -201,6 +221,19 @@ def _merge_order(keys, valids):
         inv_base = inv_base + (lens[g] - nvs[g])
         order_all = order_all.at[rank].set(offsets[g] + orders[g])
     return order_all
+
+
+def _warn_nonmonotone_keys(ok) -> None:
+    """Debug-checks callback: fires host-side per step (see _merge_order)."""
+    if not bool(ok):
+        import warnings
+        warnings.warn(
+            "window rank-merge received a group whose valid-lane emission "
+            "keys are NOT nondecreasing — the monotone-timestamp ingress "
+            "invariant is broken (out-of-order event/attribute clocks?); "
+            "the scatter may drop or duplicate lanes. Fix the ingress "
+            "ordering (docs/PARITY.md 'monotone-timestamp invariant')",
+            stacklevel=2)
 
 
 def _merge_sorted_chunks(keys, colss, tss, valids, types, width):
@@ -411,8 +444,14 @@ class WindowOp:
     """Base window operator. Subclasses define init_state/step; both must be
     traceable (called inside the query's jitted step)."""
 
-    #: chunk width produced per step (static)
+    #: chunk width produced per step for a FULL-capacity batch (static upper
+    #: bound — rate limiters size their rings from it)
     chunk_width: int
+    #: True when step() derives the lane count from the incoming batch
+    #: instead of the planned batch capacity — the window then accepts
+    #: shape-bucketed (narrower) batches directly; runtimes pad batches
+    #: back to full capacity for windows that bake their B
+    shape_polymorphic = False
 
     def init_state(self):
         raise NotImplementedError
@@ -461,6 +500,8 @@ class SlidingWindow(WindowOp):
     advances with each batch / heartbeat and flushes due expirations).
     """
 
+    shape_polymorphic = True  # step() reads B from the batch (bucketing)
+
     def __init__(self, layout: dict, batch_cap: int, *,
                  length: Optional[int] = None,
                  time_ms: Optional[int] = None,
@@ -501,7 +542,10 @@ class SlidingWindow(WindowOp):
         )
 
     def step(self, state: SlidingState, batch: EventBatch, now: jax.Array):
-        B, E, C = self.B, self.E, self.C
+        # B is the INCOMING batch capacity (<= self.B under shape-bucketed
+        # dispatch): every lane-count shape below derives from it, so one
+        # window instance serves the whole bucket ladder (one trace per rung)
+        B, E, C = batch.capacity, self.E, self.C
         comp_mat, n_valid32 = compact_packed(batch, self.layout)
         n_valid = n_valid32.astype(jnp.int64)
 
@@ -609,7 +653,7 @@ class SlidingWindow(WindowOp):
         # both groups emit in nondecreasing (hi, lo) order (expiry triggers
         # follow candidate age; currents follow arrival): rank-merge
         order = _merge_order([(keys_exp, pe), (keys_cur, p)],
-                             [exp_v, cur_v])[:self.chunk_width]
+                             [exp_v, cur_v])[:B + E]
         chunk = _gather_chunk_packed(order, all_mat, all_emit, all_valid,
                                      all_types, self.layout)
 
@@ -960,6 +1004,8 @@ class TimeBatchWindow(WindowOp):
 class PassThroughWindow(WindowOp):
     """No window: batch lanes flow through as CURRENT (the query still gets
     chunk semantics so the selector path is uniform)."""
+
+    shape_polymorphic = True  # step() is the identity — any lane count
 
     def __init__(self, layout: dict, batch_cap: int):
         self.layout = layout

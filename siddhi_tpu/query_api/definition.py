@@ -44,6 +44,11 @@ class AttributeType(enum.Enum):
 class Attribute:
     name: str
     type: AttributeType
+    #: provenance marker: this LONG column is a forwarded raw-unionSet
+    #: SET-SIZE projection (ops/selector.py host_set_slots) — the ONLY
+    #: columns sizeOfSet() may read downstream. Rides auto-defined output
+    #: stream definitions; never user-declarable.
+    set_projection: bool = False
 
     def __repr__(self) -> str:
         return f"{self.name} {self.type.value}"

@@ -16,3 +16,7 @@ def pytest_configure(config):
         "markers",
         "smoke: fast representative tier — `pytest -m smoke` finishes in "
         "~2-3 min on one core (full suite needs tens of minutes there)")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from tier-1 (`-m 'not slow'`) — bounded bench runs "
+        "and other multi-minute cases")
