@@ -274,6 +274,14 @@ def _measure_e2e(rt, out_stream: str, feed_round, events_per_round: int,
     The clock stops at drain() — every produced event has been decoded and
     delivered to the callback before elapsed is read, so async decode
     pipelines the device→host round trips but cannot hide undone work."""
+    # bench-time chaos soak: SIDDHI_FAULT_SPEC (e.g. "sink:p=0.01,seed=7")
+    # injects seeded faults into the runtime's transports so sustained
+    # throughput is measured THROUGH the retry/dead-letter paths, not only
+    # on the sunny day (siddhi_tpu/util/faults.py documents the grammar)
+    fault_plans = {}
+    if os.environ.get("SIDDHI_FAULT_SPEC"):
+        from siddhi_tpu.util.faults import apply_fault_spec
+        fault_plans = apply_fault_spec(rt)
     n_out = [0]
     if columnar:
         rt.add_callback(out_stream, lambda blk: n_out.__setitem__(
@@ -307,6 +315,10 @@ def _measure_e2e(rt, out_stream: str, feed_round, events_per_round: int,
         r0 += rounds
         best = max(best, events_per_round * rounds / elapsed)
     rt.shutdown()
+    if fault_plans:
+        _partial({"fault_injection": {
+            t: {"calls": p.calls, "fired": p.fired}
+            for t, p in fault_plans.items()}})
     assert n_out[0] > 0, "e2e run produced no output — not a valid measure"
     return best
 

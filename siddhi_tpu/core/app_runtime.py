@@ -36,7 +36,9 @@ class SiddhiAppRuntime:
                  mesh=None, partition_capacity: int = 0,
                  async_callbacks: bool = False,
                  auto_flush_ms: Optional[float] = None,
-                 aot_warmup: bool = False) -> None:
+                 aot_warmup: bool = False,
+                 wal_dir: Optional[str] = None,
+                 persistence_interval_s: Optional[float] = None) -> None:
         self.app = app
         #: AOT-compile every query's step ladder at start() (also
         #: SIDDHI_AOT_WARMUP=1) so the first real batch never pays
@@ -82,6 +84,27 @@ class SiddhiAppRuntime:
         self.auto_flush_ms = auto_flush_ms
         self._flusher_stop = None
         self._flusher_thread = None
+        # crash recovery: @app:persist(interval='30 sec', wal.dir='/var/wal')
+        # or the wal_dir / persistence_interval_s kwargs — a periodic
+        # persistence scheduler plus a write-ahead ingress journal so
+        # recover() = restore_last_revision() + WAL replay (state/wal.py)
+        persist_ann = app.annotation("app:persist")
+        if persist_ann is not None:
+            from .partition import _parse_annotation_time
+            iv = persist_ann.element("interval") or persist_ann.element()
+            if persistence_interval_s is None and iv:
+                persistence_interval_s = _parse_annotation_time(iv) / 1000.0
+            wd = persist_ann.element("wal.dir")
+            if wal_dir is None and wd:
+                wal_dir = wd
+        self.persistence_interval_s = persistence_interval_s
+        self._persist_stop = None
+        self._persist_thread = None
+        self._recovering = False
+        self.wal = None
+        if wal_dir:
+            from ..state.wal import WriteAheadLog
+            self.wal = WriteAheadLog(wal_dir, app.name)
         self.ctx.error_store = error_store
         self.ctx.config_manager = config_manager
         from .event import StringTable
@@ -112,6 +135,13 @@ class SiddhiAppRuntime:
         self._started = False
 
         self._build()
+
+        if self.wal is not None:
+            # journal INGRESS junctions only: user-defined streams take rows
+            # from outside the engine; derived/trigger/fault streams are
+            # reproducible from their inputs
+            for sid in app.stream_definitions:
+                self.junctions[sid].wal = self.wal
 
     # ------------------------------------------------------------------ build
 
@@ -340,6 +370,32 @@ class SiddhiAppRuntime:
                 target=self._flusher_loop, daemon=True,
                 name=f"siddhi-flusher-{self.app.name}")
             self._flusher_thread.start()
+        if self.persistence_interval_s and self.persistence_store is not None:
+            import threading
+            self._persist_stop = threading.Event()
+            self._persist_thread = threading.Thread(
+                target=self._persist_loop, daemon=True,
+                name=f"siddhi-persist-{self.app.name}")
+            self._persist_thread.start()
+
+    def _persist_loop(self) -> None:
+        """Daemon: bound data-at-risk to ~persistence_interval_s without the
+        caller ever invoking persist() (reference: the operator-driven
+        SiddhiManager.persist on a cron; here it is built in). A failed
+        persist is logged and retried next tick — the WAL still covers the
+        window."""
+        import logging
+        interval = float(self.persistence_interval_s)
+        while not self._persist_stop.wait(interval):
+            if not self._started:
+                return
+            if self._recovering:  # recover() owns the journal right now
+                continue
+            try:
+                self.persist()
+            except Exception:  # noqa: BLE001 — scheduler must not die
+                logging.getLogger("siddhi_tpu").exception(
+                    "periodic persist failed (will retry next interval)")
 
     def _flusher_loop(self) -> None:
         """Daemon: bound staged-row latency to ~auto_flush_ms without the
@@ -395,8 +451,14 @@ class SiddhiAppRuntime:
                         "AOT warmup failed for query %r", name)
         return out
 
-    def shutdown(self, *, flush_durable: bool = True) -> None:
+    def shutdown(self, *, flush_durable: bool = True,
+                 drain: bool = True) -> None:
         self._started = False
+        if self._persist_stop is not None:
+            self._persist_stop.set()
+            if self._persist_thread is not None:
+                self._persist_thread.join(timeout=10)
+            self._persist_stop = self._persist_thread = None
         if self._flusher_stop is not None:
             self._flusher_stop.set()
             if self._flusher_thread is not None:
@@ -406,6 +468,34 @@ class SiddhiAppRuntime:
             # while a flusher can swap the lists — post-shutdown send()s
             # must not keep taking it for a flusher that is gone
             self.ctx.autoflush_active = False
+        # rows accepted by send() must not vanish silently on stop: drain
+        # the pre-staging/staging buffers through the pipeline; whatever a
+        # failing drain leaves behind is counted and reported, not dropped
+        # on the floor unrecorded
+        def _staged() -> int:
+            return sum(len(j._staged_rows) + len(j._tap_queue)
+                       for j in self.junctions.values())
+        n0, drain_failed = _staged(), False
+        if drain and n0:
+            import logging
+            try:
+                self.drain()
+            except Exception:  # noqa: BLE001 — shutdown must complete
+                drain_failed = True
+                logging.getLogger("siddhi_tpu").exception(
+                    "draining staged rows at shutdown failed")
+        remaining = _staged()
+        if drain_failed:
+            # flush() swaps the staged lists before delivering, so rows that
+            # died mid-drain are no longer countable — report the pre-drain
+            # depth as the (upper-bound) loss instead of pretending zero
+            remaining = max(remaining, n0)
+        if remaining:
+            import logging
+            self.ctx.statistics.track_shutdown_discard(remaining)
+            logging.getLogger("siddhi_tpu").warning(
+                "shutdown discarded %d staged row(s) (see statistics "
+                "recovery.shutdown_discarded)", remaining)
         for j in self.junctions.values():
             j.stop_async()
         if self.ctx.decoder is not None:
@@ -424,6 +514,8 @@ class SiddhiAppRuntime:
             source.disconnect()
         for sink in self.sinks:
             sink.disconnect()
+        if self.wal is not None:
+            self.wal.close()
 
     # ------------------------------------------------------------------- I/O
 
@@ -635,9 +727,21 @@ class SiddhiAppRuntime:
         ms = max(ms, last + 1)
         self._last_rev_ms = ms
         revision = f"{ms}_{self.app.name}"
-        store.save(self.app.name, revision, self.snapshot())
-        for a in self.aggregations.values():
-            a.flush_durable()  # write-through the durable duration tables
+        # snapshot→save→rotate is ONE critical section under the controller
+        # lock (the reference's world-stopping ThreadBarrier): WAL-journaled
+        # sends take the same lock, so every journaled row is either flushed
+        # into this snapshot (its record is safely rotated away) or staged
+        # after the rotation (its record lands in the new segment) — never
+        # journaled-then-lost in between
+        with self.ctx.controller_lock:
+            store.save(self.app.name, revision, self.snapshot())
+            for a in self.aggregations.values():
+                a.flush_durable()  # write-through durable duration tables
+            if self.wal is not None:
+                # rotate AFTER the store accepted the snapshot
+                # (save-then-rotate: a crash between the two duplicates the
+                # suffix on recover, never loses it)
+                self.wal.rotate(revision)
         return revision
 
     def restore_revision(self, revision: str) -> None:
@@ -660,6 +764,27 @@ class SiddhiAppRuntime:
         if rev is not None:
             self.restore_revision(rev)
         return rev
+
+    def recover(self) -> dict:
+        """Crash recovery: restore the last persisted revision (when a
+        persistence store is configured) then replay the write-ahead journal
+        with the events' original timestamps — at-least-once restart
+        semantics. Safe on a clean state too (no revision, empty WAL = a
+        no-op). Returns {"revision", "wal_replayed"}; counts surface in
+        statistics_report()["recovery"]."""
+        rev = None
+        self._recovering = True  # the periodic persist scheduler stands down
+        try:
+            if self.persistence_store is not None:
+                rev = self.restore_last_revision()
+            replayed = 0
+            if self.wal is not None:
+                replayed = self.wal.replay(self)
+            self.flush()
+        finally:
+            self._recovering = False
+        self.ctx.statistics.track_recovery(replayed)
+        return {"revision": rev, "wal_replayed": replayed}
 
     # -------------------------------------------------------------- statistics
 

@@ -96,6 +96,16 @@ class Statistics:
     #: "overflow-to-host escape hatches"). Each counter warns once.
     overflow: dict = field(default_factory=dict)
     _overflow_warned: set = field(default_factory=set)
+    #: fault-tolerance counters — tracked regardless of level, like overflow:
+    #: a retried/dead-lettered/dropped event is a correctness signal operators
+    #: must see without opting into metrics. sink_* keyed by stream id.
+    sink_retries: dict = field(default_factory=dict)
+    sink_dead_letters: dict = field(default_factory=dict)  # events stored
+    sink_dropped: dict = field(default_factory=dict)  # events dropped (LOG)
+    source_retries: dict = field(default_factory=dict)  # reconnect attempts
+    recoveries: int = 0  # recover() completions
+    wal_replayed: int = 0  # lifetime events re-sent by recover()
+    shutdown_discarded: int = 0  # staged rows lost at shutdown()
 
     @property
     def detail(self) -> bool:
@@ -131,6 +141,28 @@ class Statistics:
         self.compiles[query] = self.compiles.get(query, 0) + 1
         self.compile_widths.setdefault(query, []).append(int(width))
 
+    def track_sink_retry(self, stream_id: str) -> None:
+        self.sink_retries[stream_id] = self.sink_retries.get(stream_id, 0) + 1
+
+    def track_source_retry(self, stream_id: str) -> None:
+        self.source_retries[stream_id] = \
+            self.source_retries.get(stream_id, 0) + 1
+
+    def track_dead_letter(self, stream_id: str, n: int) -> None:
+        self.sink_dead_letters[stream_id] = \
+            self.sink_dead_letters.get(stream_id, 0) + n
+
+    def track_sink_drop(self, stream_id: str, n: int) -> None:
+        self.sink_dropped[stream_id] = \
+            self.sink_dropped.get(stream_id, 0) + n
+
+    def track_recovery(self, replayed: int) -> None:
+        self.recoveries += 1
+        self.wal_replayed += replayed
+
+    def track_shutdown_discard(self, n: int) -> None:
+        self.shutdown_discarded += n
+
     def record_overflow(self, name: str, n: int) -> None:
         """Register a lifetime overflow counter reading; warns ONCE per
         counter the first time it goes positive (an @OnError-style signal —
@@ -157,6 +189,13 @@ class Statistics:
         self.compile_widths.clear()
         self.step_hist.clear()
         self.overflow.clear()
+        self.sink_retries.clear()
+        self.sink_dead_letters.clear()
+        self.sink_dropped.clear()
+        self.source_retries.clear()
+        self.recoveries = 0
+        self.wal_replayed = 0
+        self.shutdown_discarded = 0
         self.started_at = time.time()
 
     def report(self, runtime=None) -> dict:
@@ -174,7 +213,30 @@ class Statistics:
             "compiles": dict(self.compiles),
             "compile_widths": {q: list(w)
                                for q, w in self.compile_widths.items()},
+            # fault-tolerance counters (always, like overflow: silent loss
+            # is a correctness signal, not a metric)
+            "sink_retries": dict(self.sink_retries),
+            "sink_dead_letters": dict(self.sink_dead_letters),
+            "sink_dropped": dict(self.sink_dropped),
+            "source_retries": dict(self.source_retries),
+            "recovery": {
+                "recoveries": self.recoveries,
+                "wal_replayed": self.wal_replayed,
+                "shutdown_discarded": self.shutdown_discarded,
+            },
         }
+        if runtime is not None:
+            wal = getattr(runtime, "wal", None)
+            if wal is not None:
+                out["recovery"]["wal_appended"] = wal.appended_events
+                out["recovery"]["wal_records"] = wal.appended_records
+            es = getattr(runtime.ctx, "error_store", None)
+            if es is not None and hasattr(es, "dropped_count"):
+                out["error_store"] = {
+                    "entries": len(es.load(runtime.app.name)),
+                    "dropped_error_entries":
+                        es.dropped_count(runtime.app.name),
+                }
         if self.detail:
             out["query_latency_ms"] = {
                 q: (t / c / 1e6 if c else 0.0)

@@ -42,6 +42,7 @@ class SiddhiManager:
         mesh=None, partition_capacity: int = 0,
         async_callbacks: bool = False,
         auto_flush_ms=None, aot_warmup: bool = False,
+        wal_dir=None, persistence_interval_s=None,
     ) -> SiddhiAppRuntime:
         app = self._parse(app)
         rt = SiddhiAppRuntime(app, self.registry, batch_size=batch_size,
@@ -51,7 +52,9 @@ class SiddhiManager:
                               mesh=mesh, partition_capacity=partition_capacity,
                               async_callbacks=async_callbacks,
                               auto_flush_ms=auto_flush_ms,
-                              aot_warmup=aot_warmup)
+                              aot_warmup=aot_warmup,
+                              wal_dir=wal_dir,
+                              persistence_interval_s=persistence_interval_s)
         if self.persistence_store is not None:
             rt.persistence_store = self.persistence_store
         self.runtimes[app.name] = rt
@@ -119,6 +122,11 @@ class SiddhiManager:
     def restore_last_state(self) -> None:
         for rt in self.runtimes.values():
             rt.restore_last_revision()
+
+    def recover(self) -> dict:
+        """Crash-recover every app: restore the last revision + replay each
+        app's write-ahead journal (SiddhiAppRuntime.recover)."""
+        return {name: rt.recover() for name, rt in self.runtimes.items()}
 
     def get_siddhi_app_runtime(self, name: str) -> Optional[SiddhiAppRuntime]:
         return self.runtimes.get(name)

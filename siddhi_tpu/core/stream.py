@@ -410,6 +410,12 @@ class StreamJunction:
         self._tap_queue: list = []
         self._tap_lock = _t.Lock()
         self.on_error: Optional[Callable] = None
+        #: write-ahead event journal (state/wal.py) — attached by the app
+        #: runtime to INGRESS junctions only (user-defined streams). Rows
+        #: are journaled before they enter the staging buffers; derived
+        #: streams chain on device via publish_batch and are reproducible
+        #: from their inputs, so they never journal.
+        self.wal = None
         # per-THREAD re-entrancy guards (flushing during callbacks; drain
         # nesting): shared booleans would make one thread's activity no-op
         # another thread's barrier
@@ -459,6 +465,18 @@ class StreamJunction:
             self.flush()
 
     def send_row(self, ts: int, data: Sequence) -> None:
+        if self.wal is not None and not self._lock_owned():
+            # journal+stage must be ONE atomic step w.r.t. persist()'s
+            # snapshot+rotate critical section: interleaving there would
+            # journal the row into the pre-snapshot segment, stage it after
+            # the snapshot, and rotate its record away — lost on the next
+            # crash. The controller lock is that atomicity (persist holds
+            # it); durability mode trades the lock-free @Async ring for it
+            # (_lock_owned() skips the ring path below).
+            with self.ctx.controller_lock:
+                return self.send_row(ts, data)
+        if self.wal is not None:  # write-AHEAD: journal before acceptance
+            self.wal.append_rows(self.definition.id, (ts,), (tuple(data),))
         for tap in self.taps:
             tap(ts, data)
         if self._ring is not None and not self._lock_owned():
@@ -499,8 +517,13 @@ class StreamJunction:
             return
         if self.taps:  # sequence taps need true per-row send order
             for ts, row in zip(tss, rows):
-                self.send_row(ts, row)
+                self.send_row(ts, row)  # journals per row when WAL is on
             return
+        if self.wal is not None and not self._lock_owned():
+            with self.ctx.controller_lock:  # see send_row: atomic vs persist
+                return self.send_rows(tss, rows)
+        if self.wal is not None:  # one journal record for the whole batch
+            self.wal.append_rows(self.definition.id, tss, rows)
         self.ctx.timestamp_generator.observe_event_time(int(max(tss)))
         if self._ring is not None and not self._lock_owned():
             push = self._ring_push
@@ -859,5 +882,13 @@ class InputHandler:
         # lock (RLock — send_column_batch re-enters it) so the Python-loop
         # fallback cannot race the async feeder's locked encode path
         with j.ctx.controller_lock:
+            if j.wal is not None:
+                # inside the lock (atomic vs persist's snapshot+rotate —
+                # see send_row), journaling the ORIGINAL pre-interning
+                # values: dictionary codes are process-local and would not
+                # survive a restart
+                j.wal.append_columns(
+                    j.definition.id, ts_arr[:n].tolist(),
+                    {k: np.asarray(v)[:n] for k, v in columns.items()})
             cols = j.codec.encode_columns(columns, n)
             j.send_column_batch(ts_arr, cols, n)

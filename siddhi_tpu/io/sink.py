@@ -12,6 +12,7 @@ from __future__ import annotations
 import json as _json
 import logging
 import re
+import time
 import zlib
 from typing import Optional
 
@@ -65,13 +66,50 @@ class TextSinkMapper(SinkMapper):
 
 
 class Sink:
-    """Transport SPI (reference: Sink.java:62)."""
+    """Transport SPI (reference: Sink.java:62 — publish with
+    ConnectionUnavailableException retry via BackoffRetryCounter).
+
+    Egress fault policy (`@sink(..., on.error='WAIT')`, reference
+    OnErrorAction + the junction's @OnError matrix):
+
+      LOG     log the failed event, count it as dropped, continue (default)
+      WAIT    on ConnectionUnavailableException: buffer the in-flight rest
+              of the batch, reconnect with exponential backoff, re-publish;
+              after `max.retries` reconnects dead-letter the remainder to
+              the ErrorStore (never a silent drop)
+      STREAM  route the failed event + error message into the stream's
+              `!fault` stream (requires @OnError(action='STREAM'))
+      STORE   dead-letter the failed event to the ErrorStore for replay
+
+    A mid-batch failure no longer discards the rest of the batch: every row
+    is individually published, retried, routed, or dead-lettered, and the
+    counts surface in statistics_report() (sink_retries / sink_dead_letters
+    / sink_dropped)."""
+
+    ON_ERROR_ACTIONS = ("LOG", "WAIT", "STREAM", "STORE")
 
     def init(self, stream_definition, options: dict, mapper: SinkMapper, ctx) -> None:
         self.definition = stream_definition
         self.options = options
         self.mapper = mapper
         self.ctx = ctx
+        self.on_error = (options.get("on.error") or "LOG").upper()
+        if self.on_error not in self.ON_ERROR_ACTIONS:
+            raise SiddhiAppCreationError(
+                f"@sink on.error must be one of {self.ON_ERROR_ACTIONS}, "
+                f"got {self.on_error!r}")
+        try:
+            self.max_retries = int(options.get("max.retries", 5))
+        except (TypeError, ValueError):
+            raise SiddhiAppCreationError(
+                f"@sink max.retries must be an int, "
+                f"got {options.get('max.retries')!r}") from None
+        self._retry_counter = BackoffRetryCounter()
+        #: injectable for tests / fault harnesses (virtual clocks)
+        self._sleep = time.sleep
+        #: the stream junction this sink subscribes to (set by io/wiring.py;
+        #: carries the `!fault` junction for on.error=STREAM routing)
+        self._junction = None
 
     def connect(self) -> None:
         pass
@@ -82,9 +120,100 @@ class Sink:
     def publish(self, payload) -> None:
         raise NotImplementedError
 
-    def publish_rows(self, rows: list[tuple]) -> None:
-        for row in rows:
-            self.publish(self.mapper.map(row))
+    # -- robust batch publication -------------------------------------------
+
+    def _map_and_publish(self, row: tuple) -> None:
+        self.publish(self.mapper.map(row))
+
+    def publish_rows(self, rows: list[tuple], timestamps=None) -> None:
+        """Publish a batch row-by-row under the sink's on.error policy.
+        `timestamps` (parallel to rows) ride into dead-letter entries and
+        fault-stream events; None falls back to the current time."""
+        for i, row in enumerate(rows):
+            try:
+                self._map_and_publish(row)
+            except ConnectionUnavailableException as e:
+                if self.on_error == "WAIT":
+                    if not self._retry_publish(row):
+                        # reconnects exhausted: dead-letter the in-flight
+                        # remainder (this row and everything after it)
+                        self._dead_letter(rows[i:], timestamps, i, e)
+                        return
+                else:
+                    self._handle_error(row, self._ts(timestamps, i), e)
+            except Exception as e:  # noqa: BLE001 — policy decides
+                self._handle_error(row, self._ts(timestamps, i), e)
+
+    def _retry_publish(self, row: tuple) -> bool:
+        """Reconnect-with-backoff loop for one row (reference:
+        Sink.connectWithRetry / publish retry on connection loss). Bounded
+        by max.retries; the reference retries forever on a scheduler."""
+        counter = self._retry_counter
+        for _attempt in range(self.max_retries):
+            self.ctx.statistics.track_sink_retry(self.definition.id)
+            self._sleep(counter.get_time_interval_ms() / 1000.0)
+            counter.increment()
+            try:
+                self.disconnect()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+            try:
+                self.connect()
+                self._map_and_publish(row)
+                counter.reset()
+                return True
+            except Exception:  # noqa: BLE001 — keep backing off
+                continue
+        return False
+
+    def _ts(self, timestamps, i: int) -> int:
+        if timestamps is not None and i < len(timestamps):
+            return int(timestamps[i])
+        return self.ctx.timestamp_generator.current_time()
+
+    def _handle_error(self, row: tuple, ts: int, e: Exception) -> None:
+        """One failed row under LOG / STREAM / STORE (WAIT handles
+        connection loss before getting here and degrades to STORE for
+        non-connection errors — never a silent drop)."""
+        sid = self.definition.id
+        action = self.on_error
+        if action == "STREAM":
+            fj = getattr(self._junction, "fault_junction", None)
+            if fj is not None:
+                fj.send_row(ts, tuple(row) + (str(e),))
+                fj.flush()
+                return
+            log.error("@sink(on.error='STREAM') on %r but the stream has no "
+                      "fault stream (add @OnError(action='STREAM')); "
+                      "dead-lettering instead", sid)
+        if action in ("STREAM", "STORE", "WAIT"):
+            store = getattr(self.ctx, "error_store", None)
+            if store is not None:
+                store.save(self.ctx.name, sid, [(ts, tuple(row))], str(e))
+                self.ctx.statistics.track_dead_letter(sid, 1)
+                return
+            log.error("@sink(on.error=%r) on %r but no error store is "
+                      "configured; logging instead", action, sid)
+        self.ctx.statistics.track_sink_drop(sid, 1)
+        log.exception("sink %r failed to publish event %r: %s", sid, row, e)
+
+    def _dead_letter(self, rows: list, timestamps, offset: int,
+                     e: Exception) -> None:
+        """Dead-letter a whole exhausted batch remainder as ONE ErrorStore
+        entry (replayable via ErrorStore.replay)."""
+        sid = self.definition.id
+        events = [(self._ts(timestamps, offset + k), tuple(r))
+                  for k, r in enumerate(rows)]
+        store = getattr(self.ctx, "error_store", None)
+        if store is not None:
+            store.save(self.ctx.name, sid, events, str(e))
+            self.ctx.statistics.track_dead_letter(sid, len(events))
+            log.warning("sink %r: retries exhausted; dead-lettered %d "
+                        "event(s) to the error store", sid, len(events))
+            return
+        self.ctx.statistics.track_sink_drop(sid, len(events))
+        log.error("sink %r: retries exhausted and no error store configured; "
+                  "dropped %d event(s): %s", sid, len(events), e)
 
 
 class InMemorySink(Sink):
@@ -195,14 +324,17 @@ class DistributedSink(Sink):
         self.destinations = destinations
         self.strategy = strategy
 
-    def publish_rows(self, rows: list[tuple]) -> None:
-        for row in rows:
-            payload, payload_mapper = None, None
-            for d in self.strategy.destinations(row):
-                sink = self.destinations[d]
-                if sink.mapper is not payload_mapper:
-                    payload, payload_mapper = sink.mapper.map(row), sink.mapper
-                sink.publish(payload)
+    def _map_and_publish(self, row: tuple) -> None:
+        # retry/on.error handling rides the base publish_rows: a failing
+        # destination surfaces here and the whole fan-out for the row is
+        # retried after reconnect (destinations are idempotent transports
+        # in the reference's multi-client model)
+        payload, payload_mapper = None, None
+        for d in self.strategy.destinations(row):
+            sink = self.destinations[d]
+            if sink.mapper is not payload_mapper:
+                payload, payload_mapper = sink.mapper.map(row), sink.mapper
+            sink.publish(payload)
 
     def connect(self) -> None:
         for d in self.destinations:

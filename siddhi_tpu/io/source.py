@@ -155,6 +155,9 @@ class Source:
                 return
             except ConnectionUnavailableException:
                 attempt += 1
+                stats = getattr(getattr(self, "ctx", None), "statistics", None)
+                if stats is not None:  # operators see flapping transports
+                    stats.track_source_retry(self.definition.id)
                 if attempt >= max_attempts:
                     raise
                 sleep(counter.get_time_interval_ms() / 1000.0)

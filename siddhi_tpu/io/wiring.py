@@ -151,9 +151,14 @@ def build_sink(ann: Annotation, junction, ctx) -> Sink:
 
     from ..core.stream import StreamCallback
 
+    # fault routing / dead-letter entries need the stream's junction and
+    # the events' original timestamps (Sink.publish_rows on.error policies)
+    sink._junction = junction
+
     class _SinkCallback(StreamCallback):
         def receive(self, events) -> None:
-            sink.publish_rows([tuple(e.data) for e in events])
+            sink.publish_rows([tuple(e.data) for e in events],
+                              timestamps=[e.timestamp for e in events])
 
     junction.subscribe(_SinkCallback())
     return sink

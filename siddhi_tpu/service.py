@@ -10,6 +10,8 @@ Endpoints (JSON):
   DELETE /siddhi-apps/<name>          → shutdown + undeploy
   POST   /siddhi-apps/<name>/streams/<stream>  body = {"events": [[...], ...]}
   POST   /siddhi-apps/<name>/query    body = {"query": "from T select ..."}
+  POST   /siddhi-apps/<name>/persist  → {"revision": "..."}
+  POST   /siddhi-apps/<name>/recover  → {"revision": ..., "wal_replayed": n}
   GET    /siddhi-apps/<name>/statistics
 
 Usage:  python -m siddhi_tpu.service [port]
@@ -97,6 +99,16 @@ class SiddhiService:
         with self.lock:
             return self.manager.runtimes[app].statistics_report()
 
+    def persist(self, app: str) -> str:
+        with self.lock:
+            return self.manager.runtimes[app].persist()
+
+    def recover(self, app: str) -> dict:
+        """Restore the last revision + replay the app's WAL (crash
+        recovery over the deployment surface)."""
+        with self.lock:
+            return self.manager.runtimes[app].recover()
+
     # ---------------------------------------------------------------- server
 
     def make_server(self, port: int = 9090,
@@ -164,6 +176,13 @@ class SiddhiService:
                         data = json.loads(self._body())
                         rows = service.query(parts[1], data["query"])
                         self._reply(200, {"records": rows})
+                    elif (len(parts) == 3 and parts[0] == "siddhi-apps"
+                          and parts[2] == "persist"):
+                        self._reply(200,
+                                    {"revision": service.persist(parts[1])})
+                    elif (len(parts) == 3 and parts[0] == "siddhi-apps"
+                          and parts[2] == "recover"):
+                        self._reply(200, service.recover(parts[1]))
                     else:
                         self._reply(404, {"error": "not found"})
                 except KeyError as e:

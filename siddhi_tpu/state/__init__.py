@@ -1,0 +1,12 @@
+"""State plane: checkpoint/restore stores, the error store, and the
+write-ahead event journal."""
+
+from .error_store import ErrorEntry, ErrorStore, InMemoryErrorStore  # noqa: F401
+from .persistence import (  # noqa: F401
+    FileSystemPersistenceStore,
+    InMemoryPersistenceStore,
+    IncrementalFileSystemPersistenceStore,
+    PersistenceStore,
+    SnapshotService,
+)
+from .wal import WriteAheadLog  # noqa: F401

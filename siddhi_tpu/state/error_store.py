@@ -42,9 +42,19 @@ class ErrorStore:
 
 
 class InMemoryErrorStore(ErrorStore):
-    def __init__(self) -> None:
+    """Bounded in-memory store: `max_entries` caps host memory (an @OnError
+    STORE storm must not OOM the controller) with drop-OLDEST eviction; the
+    per-app eviction count surfaces as `dropped_error_entries` in
+    statistics_report()."""
+
+    def __init__(self, max_entries: int = 10_000) -> None:
+        if max_entries < 1:
+            raise ValueError("max_entries must be >= 1")
         self._entries: dict[int, ErrorEntry] = {}
         self._ids = itertools.count(1)
+        self.max_entries = max_entries
+        #: app name -> entries evicted before the user could replay them
+        self.dropped: dict[str, int] = {}
 
     def save(self, app_name, stream_name, events, cause) -> ErrorEntry:
         entry = ErrorEntry(
@@ -52,7 +62,15 @@ class InMemoryErrorStore(ErrorStore):
             app_name=app_name, stream_name=stream_name,
             events=list(events), cause=cause)
         self._entries[entry.id] = entry
+        while len(self._entries) > self.max_entries:
+            # dict preserves insertion order: the first key is the oldest
+            oldest = self._entries.pop(next(iter(self._entries)))
+            self.dropped[oldest.app_name] = \
+                self.dropped.get(oldest.app_name, 0) + 1
         return entry
+
+    def dropped_count(self, app_name: str) -> int:
+        return self.dropped.get(app_name, 0)
 
     def load(self, app_name, stream_name=None) -> list:
         return [e for e in self._entries.values()
@@ -65,8 +83,12 @@ class InMemoryErrorStore(ErrorStore):
     def replay(self, entry: ErrorEntry, app_runtime) -> None:
         """Re-send a stored entry's rows into its original stream — with their
         ORIGINAL timestamps, so windows/aggregations bucket them correctly —
-        and drop it (reference: replay via ReplayableTableRecord)."""
+        and drop it (reference: replay via ReplayableTableRecord). All rows go
+        in ONE batched staging call and the entry is discarded only after
+        every row was accepted: an exception mid-replay leaves the whole entry
+        in the store instead of half-losing it."""
         handler = app_runtime.get_input_handler(entry.stream_name)
-        for ts, row in entry.events:
-            handler.send(row, timestamp=ts)
+        tss = [ts for ts, _row in entry.events]
+        rows = [row for _ts, row in entry.events]
+        handler.send_batch(rows, timestamps=tss)
         self.discard(entry.id)

@@ -133,10 +133,25 @@ class FileSystemPersistenceStore(PersistenceStore):
     def save(self, app_name, revision, snapshot) -> None:
         d = self._dir(app_name)
         os.makedirs(d, exist_ok=True)
+        # crash-consistent: fsync the tmp BEFORE the rename (otherwise the
+        # rename can land while the data is still page-cache-only and a
+        # power cut leaves a whole-looking but torn revision), then fsync
+        # the directory so the rename itself is durable. get_last_revision
+        # skips dot-prefixed files, so an abandoned tmp is never picked.
         tmp = os.path.join(d, f".{revision}.tmp")
         with open(tmp, "wb") as f:
             f.write(snapshot)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, os.path.join(d, revision))
+        try:
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:  # pragma: no cover — platform without dir fsync
+            pass
 
     def load(self, app_name, revision):
         path = os.path.join(self._dir(app_name), revision)

@@ -1,0 +1,243 @@
+"""Write-ahead event journal — crash-consistent ingress durability.
+
+Reference analogue: the Java engine has no ingress journal (durability there
+comes from replayable transports like Kafka); the TPU build is fed through
+`InputHandler`, so a `kill -9` between persists would lose every event since
+the last snapshot. The WAL closes that hole: every row accepted by an ingress
+junction is appended to a revision-tagged segment BEFORE it enters the staging
+buffers, and `SiddhiAppRuntime.recover()` = restore_last_revision() + replay
+of the surviving segments with the events' ORIGINAL timestamps — at-least-once
+restart semantics (exactly-once for the common crash points: `persist()`
+flushes all staged rows into the snapshot and then rotates the journal, so the
+replayed set is exactly the post-snapshot suffix unless the crash lands inside
+persist() itself).
+
+Format: one append-only segment file at a time, named `<seq>_<tag>.wal` where
+`seq` is a monotonically increasing integer and `tag` is the persistence
+revision the segment FOLLOWS ("0" before any persist). Each record is
+
+    <u32 payload_len> <u32 crc32(payload)> <payload = pickle>
+
+with payload one of
+    ("rows", stream_id, [ts, ...], [row_tuple, ...])
+    ("cols", stream_id, [ts, ...], {attr: numpy_host_array})
+
+A torn tail (crash mid-append) fails the length/CRC check and cleanly ends
+replay at the last whole record; re-opening a torn segment truncates it back
+to its last whole record before appending. Columnar records journal the
+ORIGINAL (pre-interning) column values: dictionary string codes are
+process-local and would not survive a restart.
+
+Durability knob: `fsync=True` (default) fsyncs after every append call (one
+call may carry a whole batch — `send_batch`/`send_columns` amortize it);
+`fsync=False` (or SIDDHI_WAL_FSYNC=0) leaves records in the OS page cache,
+which still survives `kill -9` but not power loss.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import struct
+import threading
+import zlib
+from typing import Optional
+
+log = logging.getLogger("siddhi_tpu")
+
+_HEADER = struct.Struct("<II")  # payload length, crc32(payload)
+
+
+class WriteAheadLog:
+    """One app's ingress journal under `<base_dir>/<app_name>/`."""
+
+    def __init__(self, base_dir: str, app_name: str,
+                 fsync: Optional[bool] = None) -> None:
+        self.dir = os.path.join(base_dir, app_name)
+        os.makedirs(self.dir, exist_ok=True)
+        if fsync is None:
+            fsync = os.environ.get("SIDDHI_WAL_FSYNC", "1") != "0"
+        self.fsync = fsync
+        # one lock serializes appends/rotation; producers on arbitrary
+        # threads (async sources, user threads) share the journal
+        self._lock = threading.RLock()
+        #: lifetime records appended / events journaled (statistics_report)
+        self.appended_records = 0
+        self.appended_events = 0
+        self.replayed_events = 0
+        self._file = None
+        segs = self._segments()
+        if segs:
+            seq, tag, path = segs[-1]
+            self._seq, self._tag = seq, tag
+            self._resume_segment(path)
+        else:
+            self._seq, self._tag = 0, "0"
+            self._open_segment()
+
+    # ------------------------------------------------------------- segments
+
+    def _segments(self) -> list:
+        """[(seq, tag, path)] sorted by seq."""
+        out = []
+        for f in os.listdir(self.dir):
+            if not f.endswith(".wal") or f.startswith("."):
+                continue
+            seq_s, _, tag = f[:-4].partition("_")
+            try:
+                out.append((int(seq_s), tag, os.path.join(self.dir, f)))
+            except ValueError:
+                log.warning("ignoring unrecognized WAL file %r", f)
+        out.sort()
+        return out
+
+    def _path(self) -> str:
+        return os.path.join(self.dir, f"{self._seq:08d}_{self._tag}.wal")
+
+    def _open_segment(self) -> None:
+        self._file = open(self._path(), "ab")
+
+    def _resume_segment(self, path: str) -> None:
+        """Re-open an existing segment for append, truncating a torn tail
+        first so new records stay reachable by replay."""
+        good = 0
+        with open(path, "rb") as f:
+            for _payload, end in self._iter_payloads(f, path):
+                good = end
+        self._file = open(path, "ab")
+        if self._file.tell() != good:
+            log.warning("WAL %s: truncating torn tail (%d -> %d bytes)",
+                        path, self._file.tell(), good)
+            self._file.truncate(good)
+            self._file.seek(good)
+
+    # --------------------------------------------------------------- append
+
+    def _append(self, payload_obj) -> None:
+        payload = pickle.dumps(payload_obj, protocol=pickle.HIGHEST_PROTOCOL)
+        rec = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+        with self._lock:
+            if self._file is None:  # closed (shutdown): drop loudly
+                log.error("WAL append after close; record dropped")
+                return
+            self._file.write(rec)
+            self._file.flush()
+            if self.fsync:
+                os.fsync(self._file.fileno())
+            self.appended_records += 1
+
+    def append_rows(self, stream_id: str, tss, rows) -> None:
+        """Journal one batch of host rows (ts-parallel lists)."""
+        self._append(("rows", stream_id, [int(t) for t in tss],
+                      [tuple(r) for r in rows]))
+        self.appended_events += len(rows)
+
+    def append_columns(self, stream_id: str, tss, cols: dict) -> None:
+        """Journal one columnar batch with its ORIGINAL column values."""
+        self._append(("cols", stream_id, [int(t) for t in tss], dict(cols)))
+        self.appended_events += len(tss)
+
+    # --------------------------------------------------------------- rotate
+
+    def rotate(self, revision: str) -> None:
+        """Start a fresh segment tagged `revision` and delete the older
+        segments — persist() flushed every journaled row into the snapshot
+        that `revision` names, so they are subsumed. Called AFTER the store
+        accepted the snapshot (save-then-rotate = at-least-once: a crash
+        between the two replays a suffix twice, never loses it)."""
+        with self._lock:
+            old = [p for _s, _t, p in self._segments()]
+            if self._file is not None:
+                self._file.close()
+            self._seq += 1
+            self._tag = revision
+            self._open_segment()
+            for p in old:
+                try:
+                    os.remove(p)
+                except OSError:  # pragma: no cover — concurrent cleanup
+                    pass
+
+    # --------------------------------------------------------------- replay
+
+    @staticmethod
+    def _iter_payloads(f, path: str):
+        """Yield (payload_bytes, end_offset) for every WHOLE record; stop at
+        the first torn/corrupt one."""
+        pos = 0
+        while True:
+            head = f.read(_HEADER.size)
+            if len(head) < _HEADER.size:
+                if head:
+                    log.warning("WAL %s: torn record header at %d; "
+                                "replay stops here", path, pos)
+                return
+            length, crc = _HEADER.unpack(head)
+            payload = f.read(length)
+            if len(payload) < length or zlib.crc32(payload) != crc:
+                log.warning("WAL %s: torn/corrupt record at %d; "
+                            "replay stops here", path, pos)
+                return
+            pos += _HEADER.size + length
+            yield payload, pos
+
+    def records(self) -> list:
+        """All whole records across segments, in append order (for tests
+        and inspection)."""
+        out = []
+        with self._lock:
+            segs = self._segments()
+            if self._file is not None:
+                self._file.flush()
+        for _seq, _tag, path in segs:
+            with open(path, "rb") as f:
+                for payload, _end in self._iter_payloads(f, path):
+                    out.append(pickle.loads(payload))
+        return out
+
+    def replay(self, runtime) -> int:
+        """Re-send every journaled event into `runtime` with its original
+        timestamp. The journal first rotates to a fresh segment so the
+        replayed sends re-journal themselves (they are state not yet covered
+        by any snapshot — a crash DURING recovery must still recover); the
+        consumed segments are deleted only after the replay fully succeeds.
+        Returns the number of events replayed."""
+        import numpy as np
+        with self._lock:
+            old = self._segments()
+            if self._file is not None:
+                self._file.close()
+            self._seq = (old[-1][0] if old else self._seq) + 1
+            self._open_segment()
+        n = 0
+        for _seq, _tag, path in old:
+            with open(path, "rb") as f:
+                for payload, _end in self._iter_payloads(f, path):
+                    kind, sid, tss, data = pickle.loads(payload)
+                    handler = runtime.get_input_handler(sid)
+                    if kind == "rows":
+                        handler.send_batch(data, timestamps=tss)
+                        n += len(data)
+                    else:  # "cols"
+                        handler.send_columns(
+                            data, timestamps=np.asarray(tss, dtype=np.int64))
+                        n += len(tss)
+        runtime.flush()
+        with self._lock:
+            for _seq, _tag, path in old:
+                try:
+                    os.remove(path)
+                except OSError:  # pragma: no cover
+                    pass
+        self.replayed_events += n
+        return n
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
+                if self.fsync:
+                    os.fsync(self._file.fileno())
+                self._file.close()
+                self._file = None

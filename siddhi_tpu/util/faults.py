@@ -1,0 +1,199 @@
+"""Deterministic fault injection — the chaos half of the fault-tolerance layer.
+
+Nothing in a recovery path is trustworthy until a fault has actually been
+injected through it; this module makes faults *schedulable and seeded* so
+tests (and bench-time soak runs) exercise retry/dead-letter/recovery code
+deterministically:
+
+    plan = FaultPlan(nth=(3, 5), exc=ConnectionUnavailableException)
+    inject(rt.sinks[0], "publish", plan)     # 3rd and 5th publish raise
+
+Failure schedules compose (any may fire on a given call):
+
+  nth=(3, 7)            fail exactly the 3rd and 7th call (1-based)
+  after=10, for_s=0.5   fail every call in the 0.5 s window that opens at
+                        the first call after call #10 (fail-for-duration;
+                        pass `clock=` for a virtual clock)
+  p=0.02, seed=7        fail each call with probability p from a FIXED seed
+                        (same seed = same schedule, run to run)
+
+`inject()` wraps a bound method on one INSTANCE (sinks, sources, persistence
+stores, tables — anything), so wiring stays untouched. `apply_fault_spec()`
+applies a compact spec string to a whole runtime and is wired to the
+SIDDHI_FAULT_SPEC environment variable for bench soak runs:
+
+    SIDDHI_FAULT_SPEC="sink:nth=100+200,exc=connection;store:p=0.01,seed=7"
+
+Grammar:  spec   := clause (';' clause)*
+          clause := target ':' param (',' param)*
+          target := sink | source | store | table
+          param  := nth=N[+N...] | after=N | for=SECONDS | p=PROB
+                    | seed=N | exc=(connection|error)
+
+Targets map to: every Sink.publish, every Source.on_payload, the runtime's
+PersistenceStore.save, every table's insert_batch.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import random
+import time
+from typing import Callable, Optional
+
+from ..io.source import ConnectionUnavailableException
+
+
+class InjectedFault(Exception):
+    """Default non-connection injected failure."""
+
+
+_EXC_BY_NAME = {
+    "connection": ConnectionUnavailableException,
+    "error": InjectedFault,
+}
+
+
+class FaultPlan:
+    """A deterministic failure schedule for one wrapped call site."""
+
+    def __init__(self, *, nth=(), after: Optional[int] = None,
+                 for_s: Optional[float] = None, p: float = 0.0,
+                 seed: int = 0, exc=ConnectionUnavailableException,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.nth = frozenset(int(n) for n in nth)
+        self.after = int(after) if after is not None else None
+        self.for_s = float(for_s) if for_s is not None else None
+        self.p = float(p)
+        self._rng = random.Random(seed)
+        self.exc = exc
+        self.clock = clock
+        #: total calls seen / faults raised (assertable in tests)
+        self.calls = 0
+        self.fired = 0
+        self._window_start: Optional[float] = None
+
+    def _due(self) -> bool:
+        if self.calls in self.nth:
+            return True
+        if self.for_s is not None and self.calls > (self.after or 0):
+            if self._window_start is None:
+                self._window_start = self.clock()
+            if self.clock() - self._window_start < self.for_s:
+                return True
+        if self.p and self._rng.random() < self.p:
+            return True
+        return False
+
+    def check(self, op: str = "") -> None:
+        """Count one call; raise `self.exc` when the schedule says so."""
+        self.calls += 1
+        if self._due():
+            self.fired += 1
+            raise self.exc(
+                f"injected fault on call #{self.calls}"
+                + (f" of {op}" if op else ""))
+
+
+def inject(obj, method_name: str, plan: FaultPlan) -> FaultPlan:
+    """Wrap `obj.method_name` so every call first consults `plan`. Instance-
+    level: only this object is affected; `restore(obj, method_name)` undoes
+    it. Returns the plan for assertion convenience."""
+    orig = getattr(obj, method_name)
+
+    @functools.wraps(orig)
+    def faulty(*args, **kwargs):
+        plan.check(f"{type(obj).__name__}.{method_name}")
+        return orig(*args, **kwargs)
+
+    faulty.__wrapped_original__ = orig
+    setattr(obj, method_name, faulty)
+    return plan
+
+
+def restore(obj, method_name: str) -> None:
+    """Remove an injected wrapper (no-op if none present)."""
+    fn = getattr(obj, method_name, None)
+    orig = getattr(fn, "__wrapped_original__", None)
+    if orig is not None:
+        setattr(obj, method_name, orig)
+
+
+# --------------------------------------------------------------------------- #
+# spec grammar (SIDDHI_FAULT_SPEC)
+# --------------------------------------------------------------------------- #
+
+_TARGETS = ("sink", "source", "store", "table")
+
+
+def parse_fault_spec(spec: str) -> dict:
+    """`"sink:nth=3+7;store:p=0.01,seed=7"` → {target: FaultPlan}."""
+    plans: dict[str, FaultPlan] = {}
+    for clause in filter(None, (c.strip() for c in spec.split(";"))):
+        target, sep, body = clause.partition(":")
+        target = target.strip().lower()
+        if not sep or target not in _TARGETS:
+            raise ValueError(
+                f"bad fault spec clause {clause!r}: want "
+                f"<target>:<param>,... with target in {_TARGETS}")
+        kw: dict = {}
+        for param in filter(None, (p.strip() for p in body.split(","))):
+            key, sep2, val = param.partition("=")
+            if not sep2:
+                raise ValueError(f"bad fault spec param {param!r}")
+            key = key.strip().lower()
+            val = val.strip()
+            if key == "nth":
+                kw["nth"] = tuple(int(v) for v in val.split("+"))
+            elif key == "after":
+                kw["after"] = int(val)
+            elif key == "for":
+                kw["for_s"] = float(val)
+            elif key == "p":
+                kw["p"] = float(val)
+            elif key == "seed":
+                kw["seed"] = int(val)
+            elif key == "exc":
+                try:
+                    kw["exc"] = _EXC_BY_NAME[val.lower()]
+                except KeyError:
+                    raise ValueError(
+                        f"bad fault spec exc {val!r}: want one of "
+                        f"{tuple(_EXC_BY_NAME)}") from None
+            else:
+                raise ValueError(f"unknown fault spec param {key!r}")
+        plans[target] = FaultPlan(**kw)
+    return plans
+
+
+def apply_fault_spec(runtime, spec: Optional[str] = None) -> dict:
+    """Inject a parsed spec into a built runtime: sinks' publish, sources'
+    on_payload, the persistence store's save, tables' insert_batch. `spec`
+    defaults to $SIDDHI_FAULT_SPEC; returns the {target: FaultPlan} map
+    ({} when no spec is set) so callers can assert on .calls/.fired.
+
+    Apply BEFORE runtime.start() when targeting sources: transports capture
+    the on_payload callback at connect time, so a wrapper injected after
+    start() never sees the traffic."""
+    if spec is None:
+        spec = os.environ.get("SIDDHI_FAULT_SPEC", "")
+    if not spec:
+        return {}
+    plans = parse_fault_spec(spec)
+    for target, plan in plans.items():
+        if target == "sink":
+            for sink in runtime.sinks:
+                inject(sink, "publish", plan)
+        elif target == "source":
+            for source in runtime.sources:
+                inject(source, "on_payload", plan)
+        elif target == "store":
+            store = runtime.persistence_store
+            if store is not None:
+                inject(store, "save", plan)
+        elif target == "table":
+            for table in runtime.tables.values():
+                if hasattr(table, "insert_batch"):
+                    inject(table, "insert_batch", plan)
+    return plans

@@ -1,0 +1,81 @@
+"""Subprocess worker for tests/test_crash_recovery.py.
+
+Driven line-by-line over stdin so the parent controls EXACTLY which events
+were accepted before it delivers SIGKILL: the worker acknowledges every
+command and then blocks on the next read, so a kill issued after "OK n" can
+never race an in-flight send. Commands:
+
+    send <i>    send event i (deterministic value, original timestamp
+                1000+i), flush, reply "OK <i>"
+    persist     persist to the filesystem store, reply "PERSISTED <rev>"
+    recover     restore last revision + WAL replay, reply
+                "RECOVERED <rev> <n_replayed>"
+    result      flush, reply "RESULT <count> <sum>" (last Out emission)
+    stats       reply "STATS <recoveries> <wal_replayed>"
+    exit        clean shutdown, reply "BYE"
+"""
+
+import os
+import sys
+
+
+def value(i: int) -> int:
+    return (i * 7 + 3) % 101
+
+
+WINDOW = 8
+
+APP = ("@app:name('CrashApp')\n"
+       "define stream S (k string, v long);\n"
+       "@info(name='q') from S#window.length(8) "
+       "select count() as c, sum(v) as s insert into Out;")
+
+
+def main() -> None:
+    base = sys.argv[1]
+    # env-var platform overrides are not enough in some images (see
+    # tests/conftest.py) — force CPU through jax.config like the suite does
+    from siddhi_tpu.util.platform import force_cpu_platform
+    force_cpu_platform(1)
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.state.persistence import FileSystemPersistenceStore
+
+    mgr = SiddhiManager()
+    mgr.set_persistence_store(
+        FileSystemPersistenceStore(os.path.join(base, "snap")))
+    rt = mgr.create_siddhi_app_runtime(
+        APP, batch_size=4, wal_dir=os.path.join(base, "wal"))
+    out = []
+    rt.add_callback("Out", lambda evs: out.extend(tuple(e.data) for e in evs))
+    rt.start()
+    h = rt.get_input_handler("S")
+    print("READY", flush=True)
+    for line in sys.stdin:
+        cmd, *args = line.split()
+        if cmd == "send":
+            i = int(args[0])
+            h.send(("k", value(i)), timestamp=1_000 + i)
+            rt.flush()
+            print(f"OK {i}", flush=True)
+        elif cmd == "persist":
+            print(f"PERSISTED {rt.persist()}", flush=True)
+        elif cmd == "recover":
+            res = rt.recover()
+            print(f"RECOVERED {res['revision']} {res['wal_replayed']}",
+                  flush=True)
+        elif cmd == "result":
+            rt.flush()
+            c, s = out[-1]
+            print(f"RESULT {c} {s}", flush=True)
+        elif cmd == "stats":
+            rep = rt.statistics_report()["recovery"]
+            print(f"STATS {rep['recoveries']} {rep['wal_replayed']}",
+                  flush=True)
+        elif cmd == "exit":
+            rt.shutdown()
+            print("BYE", flush=True)
+            return
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,295 @@
+"""Crash-injection proof: SIGKILL a running app at seeded points mid-stream,
+recover (restore last revision + WAL replay), and the output of a windowed
+counting query must match a no-crash oracle exactly.
+
+The worker (tests/crash_worker.py) is driven over stdin so the accepted-event
+set at each kill point is deterministic: it acknowledges every command and
+blocks on the next read, so SIGKILL lands while the engine is idle with a
+known set of accepted (journaled) events. Three seeded kill points cover the
+interesting recovery shapes:
+
+  kill #1  after a persist + more sends      → restore + WAL suffix replay
+  kill #2  after a recovery with NO persist  → replay relies on the WAL
+                                               re-journaling its own replay
+  kill #3  after another persist + sends     → rotation pruned the journal;
+                                               restore + short suffix
+
+Exactness (not just at-least-once) holds because persist() flushes staged
+rows into the snapshot BEFORE rotating the journal, and no kill lands inside
+persist() itself — so the replayed set is exactly the post-snapshot suffix.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.state.wal import WriteAheadLog
+
+pytestmark = pytest.mark.smoke
+
+WORKER = os.path.join(os.path.dirname(__file__), "crash_worker.py")
+EVENTS = 40
+
+
+def _value(i: int) -> int:
+    return (i * 7 + 3) % 101
+
+
+class _Worker:
+    """One engine subprocess with a watchdog so a wedged child fails the
+    test instead of hanging the suite."""
+
+    def __init__(self, base: str, timeout_s: float = 240.0):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "PYTHONPATH": repo + os.pathsep
+               + os.environ.get("PYTHONPATH", "")}
+        self.proc = subprocess.Popen(
+            [sys.executable, WORKER, base],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, bufsize=1, env=env)
+        self._watchdog = threading.Timer(timeout_s, self.proc.kill)
+        self._watchdog.daemon = True
+        self._watchdog.start()
+        self.expect("READY")
+
+    def expect(self, prefix: str) -> str:
+        while True:
+            line = self.proc.stdout.readline()
+            if not line:
+                raise AssertionError(
+                    f"worker died waiting for {prefix!r} "
+                    f"(rc={self.proc.poll()})")
+            if line.startswith(prefix):
+                return line.strip()
+
+    def cmd(self, line: str, reply_prefix: str) -> str:
+        self.proc.stdin.write(line + "\n")
+        self.proc.stdin.flush()
+        return self.expect(reply_prefix)
+
+    def send_range(self, lo: int, hi: int) -> None:
+        for i in range(lo, hi):
+            self.cmd(f"send {i}", f"OK {i}")
+
+    def kill9(self) -> None:
+        self._watchdog.cancel()
+        self.proc.kill()  # SIGKILL — no atexit, no flush, no disconnect
+        self.proc.wait()
+
+    def close(self) -> None:
+        self._watchdog.cancel()
+        try:
+            self.cmd("exit", "BYE")
+        finally:
+            self.proc.wait(timeout=30)
+
+
+def test_sigkill_recovery_matches_no_crash_oracle(tmp_path):
+    # ---- no-crash oracle: same engine, same events, zero faults
+    w = _Worker(str(tmp_path / "oracle"))
+    w.send_range(0, EVENTS)
+    oracle = w.cmd("result", "RESULT")
+    w.close()
+
+    # the engine's sliding-window answer must itself be arithmetically right,
+    # or the crash/no-crash comparison could pass on a shared wrong answer
+    vals = [_value(i) for i in range(EVENTS)]
+    assert oracle == f"RESULT 8 {sum(vals[-8:])}"
+
+    base = str(tmp_path / "crash")
+    # ---- phase 1: persist mid-stream, keep sending, then SIGKILL
+    w = _Worker(base)
+    w.send_range(0, 10)
+    w.cmd("persist", "PERSISTED")
+    w.send_range(10, 15)
+    w.kill9()
+
+    # ---- phase 2: recover (restore + replay 10..14), send, SIGKILL again
+    # with NO persist in between — recovery #3 then leans on the WAL having
+    # re-journaled its own replay
+    w = _Worker(base)
+    rec = w.cmd("recover", "RECOVERED").split()
+    assert rec[1] != "None", "phase-2 recover should restore a revision"
+    assert int(rec[2]) == 5  # events 10..14 came back from the journal
+    w.send_range(15, 25)
+    w.kill9()
+
+    # ---- phase 3: recover (pure WAL for 10..24), persist, send, SIGKILL
+    w = _Worker(base)
+    rec = w.cmd("recover", "RECOVERED").split()
+    assert int(rec[2]) == 15  # replayed 10..24: replay re-journals itself
+    w.cmd("persist", "PERSISTED")
+    w.send_range(25, 32)
+    w.kill9()
+
+    # ---- phase 4: final recovery, finish the stream, compare to oracle
+    w = _Worker(base)
+    rec = w.cmd("recover", "RECOVERED").split()
+    assert int(rec[2]) == 7  # rotation pruned everything before the persist
+    w.send_range(32, EVENTS)
+    got = w.cmd("result", "RESULT")
+    stats = w.cmd("stats", "STATS")
+    w.close()
+
+    assert got == oracle
+    assert stats == "STATS 1 7"  # this process: one recovery, 7 replayed
+
+
+# --------------------------------------------------------------------------- #
+# WAL unit behavior (no subprocess)
+# --------------------------------------------------------------------------- #
+
+
+class TestWriteAheadLog:
+    def test_torn_tail_stops_replay_cleanly(self, tmp_path):
+        wal = WriteAheadLog(str(tmp_path), "App", fsync=False)
+        wal.append_rows("S", [1, 2], [("a",), ("b",)])
+        wal.append_rows("S", [3], [("c",)])
+        wal.close()
+        # crash mid-append: half a record at the tail
+        seg = [f for f in os.listdir(tmp_path / "App") if f.endswith(".wal")]
+        with open(tmp_path / "App" / seg[0], "ab") as f:
+            f.write(b"\x40\x00\x00\x00\xde\xad\xbe\xefTORN")
+        wal2 = WriteAheadLog(str(tmp_path), "App", fsync=False)
+        recs = wal2.records()
+        assert [r[2] for r in recs] == [[1, 2], [3]]  # whole records only
+        # resuming truncated the tear: appends stay reachable
+        wal2.append_rows("S", [4], [("d",)])
+        assert [r[2] for r in wal2.records()] == [[1, 2], [3], [4]]
+        wal2.close()
+
+    def test_rotate_prunes_subsumed_segments(self, tmp_path):
+        wal = WriteAheadLog(str(tmp_path), "App", fsync=False)
+        wal.append_rows("S", [1], [("a",)])
+        wal.rotate("100_App")
+        wal.append_rows("S", [2], [("b",)])
+        segs = sorted(os.listdir(tmp_path / "App"))
+        assert segs == ["00000001_100_App.wal"]
+        assert [r[2] for r in wal.records()] == [[2]]
+        wal.close()
+
+    def test_replay_restores_original_timestamps_and_rejournals(
+            self, tmp_path):
+        app = ("@app:name('WApp')\n"
+               "define stream S (v long);\n"
+               "@info(name='q') from S select v insert into Out;")
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            app, batch_size=4, wal_dir=str(tmp_path))
+        rt.start()
+        rt.get_input_handler("S").send((1,), timestamp=111)
+        rt.get_input_handler("S").send((2,), timestamp=222)
+        rt.flush()
+        # fresh runtime over the same journal (simulated restart)
+        rt2 = SiddhiManager().create_siddhi_app_runtime(
+            app, batch_size=4, wal_dir=str(tmp_path))
+        got = []
+        rt2.add_callback("Out", lambda evs: got.extend(
+            (e.timestamp, tuple(e.data)) for e in evs))
+        rt2.start()
+        res = rt2.recover()
+        assert res == {"revision": None, "wal_replayed": 2}
+        assert got == [(111, (1,)), (222, (2,))]
+        # replay re-journaled itself (record-for-record): a crash DURING
+        # recovery still recovers
+        assert [r[2] for r in rt2.wal.records()] == [[111], [222]]
+        rt2.shutdown()
+
+    def test_columnar_sends_journal_original_values(self, tmp_path):
+        import numpy as np
+        app = ("@app:name('CApp')\n"
+               "define stream S (sym string, v long);\n"
+               "@info(name='q') from S select sym, v insert into Out;")
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            app, batch_size=4, wal_dir=str(tmp_path))
+        rt.start()
+        rt.get_input_handler("S").send_columns(
+            {"sym": np.array(["x", "y"], dtype=object),
+             "v": np.array([5, 6])},
+            timestamps=np.array([10, 11], dtype=np.int64))
+        kind, sid, tss, cols = rt.wal.records()[-1]
+        assert (kind, sid, tss) == ("cols", "S", [10, 11])
+        assert list(cols["sym"]) == ["x", "y"]  # strings, not dict codes
+        # a fresh process replays the columnar record
+        rt2 = SiddhiManager().create_siddhi_app_runtime(
+            app, batch_size=4, wal_dir=str(tmp_path))
+        got = []
+        rt2.add_callback("Out", lambda evs: got.extend(
+            tuple(e.data) for e in evs))
+        rt2.start()
+        assert rt2.recover()["wal_replayed"] == 2
+        assert got == [("x", 5), ("y", 6)]
+        rt2.shutdown()
+
+    def test_periodic_persistence_scheduler(self, tmp_path):
+        import time
+        from siddhi_tpu.state.persistence import InMemoryPersistenceStore
+        mgr = SiddhiManager()
+        store = InMemoryPersistenceStore()
+        mgr.set_persistence_store(store)
+        rt = mgr.create_siddhi_app_runtime(
+            "@app:name('PApp')\n"
+            "define stream S (v long);\n"
+            "from S select sum(v) as s insert into Out;",
+            batch_size=4, persistence_interval_s=0.05)
+        rt.start()
+        rt.get_input_handler("S").send((1,))
+        rt.flush()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline \
+                and store.get_last_revision("PApp") is None:
+            time.sleep(0.02)
+        assert store.get_last_revision("PApp") is not None
+        rt.shutdown()
+        assert rt._persist_thread is None  # scheduler stopped with the app
+
+    def test_shutdown_drains_staged_rows(self):
+        """Rows accepted by send() but still below the batch threshold must
+        flow at shutdown, not silently vanish (core/stream.py staging)."""
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            "@app:name('DrainApp')\n"
+            "define stream S (v long);\n"
+            "from S select v insert into Out;", batch_size=100)
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(e.data for e in evs))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(3):
+            h.send((i,))  # staged: 3 < batch_size, no flush
+        rt.shutdown()
+        assert [g[0] for g in got] == [0, 1, 2]
+        assert rt.statistics_report()["recovery"]["shutdown_discarded"] == 0
+
+    def test_shutdown_counts_undrainable_rows(self):
+        """When the drain itself fails (a raising subscriber, no @OnError),
+        the loss is counted and reported — never a silent zero."""
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            "@app:name('DrainApp2')\n"
+            "define stream S (v long);\n"
+            "from S select v insert into Out;", batch_size=100)
+
+        def boom(evs):
+            raise RuntimeError("subscriber down")
+
+        rt.add_callback("Out", boom)
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(3):
+            h.send((i,))
+        rt.shutdown()  # must not raise
+        assert rt.statistics_report()["recovery"]["shutdown_discarded"] == 3
+
+    def test_persist_annotation_parses_interval_and_wal_dir(self, tmp_path):
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            "@app:name('AnnApp')\n"
+            f"@app:persist(interval='2 sec', wal.dir='{tmp_path}')\n"
+            "define stream S (v long);\n"
+            "from S select v insert into Out;")
+        assert rt.persistence_interval_s == 2.0
+        assert rt.wal is not None
+        assert os.path.isdir(os.path.join(str(tmp_path), "AnnApp"))
+        rt.shutdown()

@@ -101,3 +101,114 @@ class TestErrorStore:
         rt.flush()
         assert [tuple(e.data) for e in got] == [("IBM", pytest.approx(75.0))]
         assert store.load("errapp") == []
+
+    def test_store_replay_roundtrip_under_injected_junction_faults(self):
+        """@OnError(action='STORE') under a seeded junction fault: every
+        event the faulty subscriber rejected round-trips store → replay →
+        delivery once the fault schedule clears. Nothing is lost, nothing
+        is double-stored."""
+        from siddhi_tpu.core.stream import FunctionStreamCallback
+        from siddhi_tpu.util.faults import FaultPlan, inject
+
+        manager = SiddhiManager()
+        store = InMemoryErrorStore()
+        manager.set_error_store(store)
+        rt = manager.create_siddhi_app_runtime(
+            "@app:name('jfault')\n" + APP_BASE.format(action="STORE"),
+            batch_size=1)  # one event per delivery: per-event fault schedule
+        rt.start()
+        got = []
+        cb = FunctionStreamCallback(
+            lambda events: got.extend(tuple(e.data) for e in events))
+        rt.add_callback("Out", cb)
+        # receive 2 and 4 fail (then the schedule is exhausted)
+        inject(cb, "receive", FaultPlan(nth=(2, 4), exc=_Boom))
+        h = rt.get_input_handler("S")
+        for i in range(5):
+            h.send((f"S{i}", float(i)))
+            rt.flush()
+        entries = store.load("jfault", "Out")
+        assert [row for e in entries for _ts, row in e.events] == \
+            [("S1", 1.0), ("S3", 3.0)]
+        assert sorted(r[0] for r in got) == ["S0", "S2", "S4"]
+        for e in list(entries):
+            store.replay(e, rt)
+        rt.flush()
+        assert sorted(r[0] for r in got) == [f"S{i}" for i in range(5)]
+        assert store.load("jfault") == []
+        rt.shutdown()
+
+    def test_replay_keeps_entry_when_send_fails(self):
+        """Atomic-ish replay: an exception mid-replay leaves the WHOLE entry
+        in the store (all-or-nothing discard), so no half-loss."""
+        from siddhi_tpu.util.faults import FaultPlan, InjectedFault, inject
+
+        manager = SiddhiManager()
+        store = InMemoryErrorStore()
+        manager.set_error_store(store)
+        rt = manager.create_siddhi_app_runtime(
+            "@app:name('replayapp')\n" + APP_BASE.format(action="STORE"))
+        rt.start()
+        entry = store.save("replayapp", "S",
+                           [(1, ("A", 1.0)), (2, ("B", 2.0))], "boom")
+        h = rt.get_input_handler("S")
+        inject(h, "send_batch", FaultPlan(nth=(1,), exc=InjectedFault))
+        with pytest.raises(InjectedFault):
+            store.replay(entry, rt)
+        assert [e.id for e in store.load("replayapp")] == [entry.id]
+        store.replay(entry, rt)  # schedule exhausted: succeeds
+        assert store.load("replayapp") == []
+        rt.shutdown()
+
+    def test_replay_uses_one_batched_send(self):
+        """Replay stages all rows in ONE send_batch call with their original
+        timestamps (not N per-row sends)."""
+        calls = []
+        manager = SiddhiManager()
+        store = InMemoryErrorStore()
+        rt = manager.create_siddhi_app_runtime(
+            "@app:name('batchapp')\n" + APP_BASE.format(action="STORE"))
+        rt.start()
+        entry = store.save("batchapp", "S",
+                           [(10, ("A", 1.0)), (20, ("B", 2.0))], "x")
+        h = rt.get_input_handler("S")
+        orig = h.send_batch
+        h.send_batch = lambda rows, timestamps=None: (
+            calls.append((list(rows), list(timestamps))),
+            orig(rows, timestamps=timestamps))[1]
+        store.replay(entry, rt)
+        assert calls == [([("A", 1.0), ("B", 2.0)], [10, 20])]
+        rt.shutdown()
+
+
+class TestBoundedErrorStore:
+    def test_drop_oldest_eviction_and_counter(self):
+        store = InMemoryErrorStore(max_entries=2)
+        e1 = store.save("app", "S", [(1, ("a",))], "c1")
+        e2 = store.save("app", "S", [(2, ("b",))], "c2")
+        e3 = store.save("app", "S", [(3, ("c",))], "c3")
+        assert [e.id for e in store.load("app")] == [e2.id, e3.id]
+        assert store.dropped_count("app") == 1
+        assert store.dropped_count("other") == 0
+        assert e1.id not in {e.id for e in store.load("app")}
+
+    def test_dropped_counter_surfaces_in_statistics(self):
+        manager = SiddhiManager()
+        store = InMemoryErrorStore(max_entries=1)
+        manager.set_error_store(store)
+        rt = manager.create_siddhi_app_runtime(
+            "@app:name('boundapp')\n" + APP_BASE.format(action="STORE"))
+        rt.start()
+        rt.add_callback("Out", _raising_callback)
+        h = rt.get_input_handler("S")
+        for i in range(3):
+            h.send((f"S{i}", float(i)))
+            rt.flush()
+        rep = rt.statistics_report()
+        assert rep["error_store"]["dropped_error_entries"] == 2
+        assert rep["error_store"]["entries"] == 1
+        rt.shutdown()
+
+    def test_rejects_nonpositive_bound(self):
+        with pytest.raises(ValueError):
+            InMemoryErrorStore(max_entries=0)

@@ -1,0 +1,305 @@
+"""Fault-injection harness + sink robustness tests.
+
+The acceptance bar: a seeded flaky sink (fail-Nth + fail-for-duration)
+delivers every event via retry or dead-letters it to the ErrorStore — ZERO
+silent drops — with retry/dead-letter counts visible in statistics_report().
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.extension.registry import ExtensionKind
+from siddhi_tpu.io.sink import Sink
+from siddhi_tpu.io.source import ConnectionUnavailableException
+from siddhi_tpu.state.error_store import InMemoryErrorStore
+from siddhi_tpu.util.faults import (
+    FaultPlan,
+    InjectedFault,
+    apply_fault_spec,
+    inject,
+    parse_fault_spec,
+    restore,
+)
+
+pytestmark = pytest.mark.smoke
+
+
+class CaptureSink(Sink):
+    """Test transport: records every published payload on the class."""
+
+    def init(self, stream_definition, options, mapper, ctx) -> None:
+        super().init(stream_definition, options, mapper, ctx)
+        self.captured = []
+
+    def publish(self, payload) -> None:
+        self.captured.append(payload)
+
+
+def _build(app_body, *, max_retries="3", on_error="WAIT"):
+    mgr = SiddhiManager()
+    mgr.set_error_store(InMemoryErrorStore())
+    mgr.registry.register(ExtensionKind.SINK, "", "capture", CaptureSink)
+    app = ("@app:name('FaultApp')\n"
+           "define stream S (v long);\n"
+           f"@sink(type='capture', on.error='{on_error}', "
+           f"max.retries='{max_retries}')\n"
+           "define stream Out (v long);\n" + app_body)
+    rt = mgr.create_siddhi_app_runtime(app, batch_size=4)
+    rt.start()
+    sink = rt.sinks[0]
+    # virtual clock: backoff sleeps advance it instead of wall time
+    clk = {"t": 0.0}
+    sink._sleep = lambda s: clk.__setitem__("t", clk["t"] + s)
+    return mgr, rt, sink, clk
+
+
+# --------------------------------------------------------------------------- #
+# FaultPlan scheduling
+# --------------------------------------------------------------------------- #
+
+
+class TestFaultPlan:
+    def test_fail_nth(self):
+        plan = FaultPlan(nth=(2, 4), exc=InjectedFault)
+        hits = []
+        for i in range(5):
+            try:
+                plan.check()
+                hits.append(i)
+            except InjectedFault:
+                pass
+        assert hits == [0, 2, 4]  # calls 2 and 4 (1-based) failed
+        assert plan.fired == 2 and plan.calls == 5
+
+    def test_fail_for_duration_virtual_clock(self):
+        clk = {"t": 0.0}
+        plan = FaultPlan(after=2, for_s=1.0, exc=InjectedFault,
+                         clock=lambda: clk["t"])
+        plan.check()
+        plan.check()  # calls 1-2 fine
+        with pytest.raises(InjectedFault):
+            plan.check()  # window opens at call 3
+        clk["t"] = 0.5
+        with pytest.raises(InjectedFault):
+            plan.check()  # still inside the window
+        clk["t"] = 1.5
+        plan.check()  # window expired
+
+    def test_probability_is_seeded_deterministic(self):
+        def run(seed):
+            plan = FaultPlan(p=0.3, seed=seed, exc=InjectedFault)
+            out = []
+            for _ in range(50):
+                try:
+                    plan.check()
+                    out.append(0)
+                except InjectedFault:
+                    out.append(1)
+            return out
+
+        a, b = run(7), run(7)
+        assert a == b and sum(a) > 0  # same seed -> identical schedule
+        assert run(8) != a  # different seed -> different schedule
+
+    def test_inject_and_restore(self):
+        store = InMemoryErrorStore()
+        plan = inject(store, "discard", FaultPlan(nth=(1,),
+                                                  exc=InjectedFault))
+        with pytest.raises(InjectedFault):
+            store.discard(1)
+        store.discard(1)  # call 2 passes through
+        restore(store, "discard")
+        store.discard(1)
+        assert plan.calls == 2  # restored method no longer consults the plan
+
+
+# --------------------------------------------------------------------------- #
+# spec grammar
+# --------------------------------------------------------------------------- #
+
+
+class TestFaultSpec:
+    def test_parse(self):
+        plans = parse_fault_spec(
+            "sink:nth=3+7,exc=connection;store:p=0.01,seed=7;"
+            "source:after=10,for=0.5")
+        assert plans["sink"].nth == frozenset({3, 7})
+        assert plans["sink"].exc is ConnectionUnavailableException
+        assert plans["store"].p == 0.01
+        assert plans["source"].after == 10 and plans["source"].for_s == 0.5
+
+    @pytest.mark.parametrize("bad", [
+        "gateway:nth=1",          # unknown target
+        "sink:nth",               # param without value
+        "sink:warp=9",            # unknown param
+        "sink:exc=kaboom",        # unknown exception name
+    ])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            parse_fault_spec(bad)
+
+    def test_apply_to_runtime_via_env(self, monkeypatch):
+        mgr, rt, sink, _clk = _build("from S select v insert into Out;")
+        monkeypatch.setenv("SIDDHI_FAULT_SPEC", "sink:nth=1,exc=error")
+        plans = apply_fault_spec(rt)
+        rt.get_input_handler("S").send((1,))
+        rt.flush()  # injected failure -> LOG? no: WAIT + non-connection
+        assert plans["sink"].fired == 1
+        rt.shutdown()
+
+    def test_no_spec_is_noop(self, monkeypatch):
+        monkeypatch.delenv("SIDDHI_FAULT_SPEC", raising=False)
+        mgr, rt, _sink, _clk = _build("from S select v insert into Out;")
+        assert apply_fault_spec(rt) == {}
+        rt.shutdown()
+
+
+# --------------------------------------------------------------------------- #
+# flaky sink: the acceptance-criterion scenario
+# --------------------------------------------------------------------------- #
+
+
+class TestFlakySink:
+    def test_fail_nth_and_duration_zero_silent_drops(self):
+        """Seeded fail-Nth + fail-for-duration on a WAIT sink: every event
+        is delivered via retry or dead-lettered — none vanish."""
+        mgr, rt, sink, clk = _build("from S select v insert into Out;")
+        plan = inject(sink, "publish", FaultPlan(
+            nth=(2,), after=6, for_s=0.04,
+            exc=ConnectionUnavailableException, clock=lambda: clk["t"]))
+        h = rt.get_input_handler("S")
+        n = 12
+        for i in range(n):
+            h.send((i,))
+            rt.flush()
+        rep = rt.statistics_report()
+        delivered = {p[0] for p in sink.captured}
+        dead = {row[0] for e in mgr.error_store.load("FaultApp")
+                for _ts, row in e.events}
+        assert delivered | dead == set(range(n))  # zero silent drops
+        assert rep["sink_retries"]["Out"] > 0
+        assert rep["sink_dropped"] == {}
+        assert plan.fired > 0
+        rt.shutdown()
+
+    def test_exhausted_retries_dead_letter_then_replay(self):
+        """A fault outlasting every backoff retry dead-letters the in-flight
+        remainder as ONE replayable entry; replay after the fault clears
+        delivers everything."""
+        mgr, rt, sink, clk = _build("from S select v insert into Out;",
+                                    max_retries="2")
+        inject(sink, "publish", FaultPlan(
+            for_s=1e9, exc=ConnectionUnavailableException,
+            clock=lambda: clk["t"]))
+        h = rt.get_input_handler("S")
+        h.send_batch([(i,) for i in range(4)])  # one delivery batch
+        rt.flush()
+        rep = rt.statistics_report()
+        assert sink.captured == []
+        assert rep["sink_dead_letters"]["Out"] == 4
+        assert rep["sink_retries"]["Out"] == 2  # max.retries, then give up
+        entries = mgr.error_store.load("FaultApp", "Out")
+        assert len(entries) == 1  # the whole exhausted batch, one entry
+        assert [row for _ts, row in entries[0].events] == \
+            [(i,) for i in range(4)]
+
+        restore(sink, "publish")  # fault clears
+        mgr.error_store.replay(entries[0], rt)
+        rt.flush()
+        assert sorted(p[0] for p in sink.captured) == list(range(4))
+        assert mgr.error_store.load("FaultApp") == []
+        rt.shutdown()
+
+    def test_on_error_log_counts_drops(self):
+        """Default LOG policy: a non-connection failure logs + counts the
+        drop and the REST of the batch still publishes (no mid-batch
+        abandonment)."""
+        mgr, rt, sink, _clk = _build("from S select v insert into Out;",
+                                     on_error="LOG")
+        inject(sink, "publish", FaultPlan(nth=(2,), exc=InjectedFault))
+        h = rt.get_input_handler("S")
+        h.send_batch([(i,) for i in range(4)])
+        rt.flush()
+        assert sorted(p[0] for p in sink.captured) == [0, 2, 3]
+        assert rt.statistics_report()["sink_dropped"]["Out"] == 1
+        rt.shutdown()
+
+    def test_on_error_stream_routes_to_fault_stream(self):
+        """on.error=STREAM: the failed event + error message lands on the
+        stream's `!fault` stream (requires @OnError(action='STREAM'))."""
+        mgr = SiddhiManager()
+        mgr.registry.register(ExtensionKind.SINK, "", "capture", CaptureSink)
+        app = ("@app:name('FaultApp2')\n"
+               "define stream S (v long);\n"
+               "@sink(type='capture', on.error='STREAM')\n"
+               "@OnError(action='STREAM')\n"
+               "define stream Out (v long);\n"
+               "from S select v insert into Out;")
+        rt = mgr.create_siddhi_app_runtime(app, batch_size=4)
+        rt.start()
+        sink = rt.sinks[0]
+        inject(sink, "publish", FaultPlan(nth=(1,), exc=InjectedFault))
+        faulted = []
+        rt.add_callback("!Out", lambda evs: faulted.extend(evs))
+        rt.get_input_handler("S").send((7,))
+        rt.flush()
+        assert [p[0] for p in sink.captured] == []
+        assert len(faulted) == 1
+        assert faulted[0].data[0] == 7
+        assert "injected fault" in faulted[0].data[1]
+        rt.shutdown()
+
+    def test_bad_on_error_rejected(self):
+        from siddhi_tpu.errors import SiddhiAppCreationError
+        mgr = SiddhiManager()
+        mgr.registry.register(ExtensionKind.SINK, "", "capture", CaptureSink)
+        with pytest.raises(SiddhiAppCreationError):
+            mgr.create_siddhi_app_runtime(
+                "define stream S (v long);\n"
+                "@sink(type='capture', on.error='EXPLODE')\n"
+                "define stream Out (v long);\n"
+                "from S select v insert into Out;")
+
+
+class TestSourceFaults:
+    def test_injected_source_fault_then_recovers(self):
+        """Faults inject into Source.on_payload: the scheduled call raises
+        to the transport, later payloads flow normally."""
+        from siddhi_tpu.io.broker import InMemoryBroker
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            "@app:name('SrcApp')\n"
+            "@source(type='inMemory', topic='ft')\n"
+            "define stream S (v long);\n"
+            "from S select v insert into Out;")
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(e.data for e in evs))
+        # inject BEFORE start(): transports capture the on_payload callback
+        # when they connect (apply_fault_spec documents the same ordering)
+        plan = inject(rt.sources[0], "on_payload",
+                      FaultPlan(nth=(1,), exc=InjectedFault))
+        rt.start()
+        with pytest.raises(InjectedFault):
+            InMemoryBroker.publish("ft", (1,))
+        InMemoryBroker.publish("ft", (2,))
+        rt.flush()
+        assert got == [(2,)]
+        assert plan.fired == 1
+        rt.shutdown()
+
+    def test_connect_retries_are_counted(self):
+        """A flapping transport's reconnect attempts surface as
+        source_retries in statistics_report()."""
+        from siddhi_tpu.io.source import ConnectionUnavailableException
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            "@app:name('SrcApp2')\n"
+            "@source(type='inMemory', topic='ft2')\n"
+            "define stream S (v long);\n"
+            "from S select v insert into Out;")
+        source = rt.sources[0]
+        inject(source, "connect", FaultPlan(
+            nth=(1, 2), exc=ConnectionUnavailableException))
+        source.connect_with_retry(sleep=lambda _s: None)  # 3rd attempt wins
+        assert rt.statistics_report()["source_retries"]["S"] == 2
+        rt.shutdown()

@@ -147,6 +147,41 @@ class TestPersistRestore:
         rt.flush()
         assert got[-1] == (1, 2)
 
+    def test_last_revision_after_multiple_persists_and_torn_tmp(
+            self, tmp_path):
+        """restore_last_revision must pick the NEWEST whole revision even
+        when a crash left a torn tmp file behind (FileSystemPersistenceStore
+        writes fsync'd tmp+rename; an abandoned `.tmp` is never a
+        candidate)."""
+        store = FileSystemPersistenceStore(str(tmp_path))
+        got1 = []
+        rt1 = build(store, got1)
+        h = rt1.get_input_handler("S")
+        h.send(("IBM", 10.0))
+        rt1.flush()
+        rev1 = rt1.persist()
+        h.send(("IBM", 20.0))
+        rt1.flush()
+        rev2 = rt1.persist()
+        assert rev2 > rev1
+        # simulate a crash mid-save AFTER rev2: a torn tmp with a name that
+        # would sort last if it were ever considered
+        d = tmp_path / "PersistApp"
+        (d / ".9999999999999_PersistApp.tmp").write_bytes(b"half a snap")
+        got2 = []
+        rt2 = build(store, got2)
+        assert rt2.restore_last_revision() == rev2
+        rt2.get_input_handler("S").send(("IBM", 5.0))
+        rt2.flush()
+        assert got2[-1] == ("IBM", 35.0)  # rev2's 30.0 + 5.0
+
+    def test_save_replaces_tmp_atomically(self, tmp_path):
+        store = FileSystemPersistenceStore(str(tmp_path))
+        store.save("A", "1_A", b"snap")
+        assert sorted(f for f in (tmp_path / "A").iterdir()) == \
+            [tmp_path / "A" / "1_A"]  # no tmp residue
+        assert store.load("A", "1_A") == b"snap"
+
     def test_wrong_app_rejected(self):
         from siddhi_tpu.errors import CannotRestoreStateError
         manager = SiddhiManager()

@@ -96,6 +96,31 @@ class TestService:
             assert e.code == 400
         assert raised
 
+    def test_persist_and_recover_endpoints(self, server, tmp_path):
+        from siddhi_tpu.state.persistence import FileSystemPersistenceStore
+        base, svc = server
+        svc.manager.set_persistence_store(
+            FileSystemPersistenceStore(str(tmp_path)))
+        _req(f"{base}/siddhi-apps", "POST", APP)
+        _req(f"{base}/siddhi-apps/svc/streams/S", "POST",
+             json.dumps({"events": [["IBM", 75.0]]}))
+        status, out = _req(f"{base}/siddhi-apps/svc/persist", "POST", "")
+        assert status == 200 and out["revision"].endswith("_svc")
+        status, out = _req(f"{base}/siddhi-apps/svc/recover", "POST", "")
+        assert status == 200
+        assert out == {"revision": out["revision"], "wal_replayed": 0}
+        assert out["revision"].endswith("_svc")
+
+    def test_persist_without_store_returns_400(self, server):
+        import urllib.error
+        base, svc = server
+        _req(f"{base}/siddhi-apps", "POST",
+             "@app:name('nostore')\ndefine stream S (v long);\n"
+             "from S select v insert into Out;")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(f"{base}/siddhi-apps/nostore/persist", "POST", "")
+        assert ei.value.code == 400
+
     def test_script_functions_rejected_by_default(self, server):
         base, _svc = server
         app = ("@app:name('scripted')\n"
