@@ -161,7 +161,9 @@ class SiddhiAppRuntime:
             self.junctions[sd.id] = junction
             if junction.on_error_action == "stream":
                 # `!stream` fault junction: original attrs + _error message
-                # (reference: StreamJunction fault streams :371-463)
+                # (reference: StreamJunction fault streams :371-463); the
+                # `fault` overflow policy and breaker diverts route through
+                # it too when the stream declares @OnError(action='STREAM')
                 fd = StreamDefinition(
                     id=f"!{sd.id}",
                     attributes=tuple(sd.attributes)
@@ -253,6 +255,11 @@ class SiddhiAppRuntime:
         else:
             raise SiddhiAppCreationError(
                 f"{type(query.input_stream).__name__} queries are not yet supported")
+        if getattr(qr, "breaker", None) is None:
+            # join/pattern runtimes don't build one themselves; single-input
+            # QueryRuntime already did (core/breaker.py)
+            from .breaker import breaker_from_annotations
+            qr.breaker = breaker_from_annotations(query, name=name)
         self.query_runtimes[name] = qr
 
         self._wire_output(qr, query)
@@ -785,6 +792,40 @@ class SiddhiAppRuntime:
             self._recovering = False
         self.ctx.statistics.track_recovery(replayed)
         return {"revision": rev, "wal_replayed": replayed}
+
+    # ------------------------------------------------------------------ health
+
+    def health(self) -> dict:
+        """Readiness view of one app (served by `/ready` in service.py):
+        overall state (running | degraded | recovering | stopped — degraded
+        = at least one circuit breaker not closed), per-query breaker
+        snapshots, and staged-queue depth vs. capacity for every bounded
+        junction (with its backpressure-paused flag)."""
+        breakers = {}
+        degraded = False
+        for name, qr in self.query_runtimes.items():
+            br = getattr(qr, "breaker", None)
+            if br is None:
+                continue
+            breakers[name] = br.snapshot()
+            if br.state != "closed":
+                degraded = True
+        queues = {}
+        for sid, j in self.junctions.items():
+            if j.capacity is None:
+                continue
+            depth = j._staged_depth()
+            queues[sid] = {"depth": depth, "capacity": j.capacity,
+                           "paused": j._bp_paused}
+        if self._recovering:
+            state = "recovering"
+        elif not self._started:
+            state = "stopped"
+        elif degraded:
+            state = "degraded"
+        else:
+            state = "running"
+        return {"state": state, "breakers": breakers, "queues": queues}
 
     # -------------------------------------------------------------- statistics
 

@@ -1,0 +1,136 @@
+"""Per-query circuit breakers — overload protection for the execution plane.
+
+Reference analogue: the stream-level OnErrorAction (StreamJunction.java:371-463)
+decides what happens to a FAILED event; a breaker decides whether a repeatedly
+failing query step should keep receiving events at all. A query whose step
+throws `threshold` times within `window` trips OPEN: its input batches are
+diverted (fault stream / ErrorStore) instead of executed, so one poisoned
+query cannot take sibling queries — or the whole app — down with it. After
+`cooldown` the breaker goes HALF_OPEN and admits one probe batch; a probe
+success closes the breaker, a probe failure re-opens it.
+
+Configured per query:
+
+    @breaker(threshold='5', window='60 sec', cooldown='30 sec')
+    from S select ... insert into Out;
+
+State transitions and divert counts surface in statistics_report()["breakers"]
+and in SiddhiAppRuntime.health() (an OPEN breaker marks the app "degraded",
+which /ready reports as 503).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, Optional
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+#: transition history kept per breaker (ops forensics, bounded)
+_MAX_TRANSITIONS = 64
+
+
+class CircuitBreaker:
+    """Failure-rate gate for one query runtime. Single-controller discipline:
+    allow()/record_* are called under the junction's controller lock, so no
+    internal locking is needed and the HALF_OPEN probe is naturally serial."""
+
+    def __init__(self, *, threshold: int = 5, window_s: float = 60.0,
+                 cooldown_s: float = 30.0, owner: str = "",
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if threshold < 1:
+            raise ValueError("breaker threshold must be >= 1")
+        self.threshold = int(threshold)
+        self.window_s = float(window_s)
+        self.cooldown_s = float(cooldown_s)
+        self.owner = owner
+        #: swap for a virtual clock in tests (all time reads go through it)
+        self.clock = clock
+        self.state = CLOSED
+        self.opens = 0
+        self.closes = 0
+        #: (state, at) pairs, newest last, bounded
+        self.transitions: deque = deque(maxlen=_MAX_TRANSITIONS)
+        self._failures: deque = deque()  # failure instants inside the window
+        self._opened_at: float = 0.0
+
+    def _transition(self, state: str) -> None:
+        self.state = state
+        self.transitions.append((state, self.clock()))
+
+    def allow(self) -> bool:
+        """May the next batch be dispatched? OPEN past its cooldown admits
+        exactly one probe (HALF_OPEN); the probe's record_success/
+        record_failure decides what happens next."""
+        if self.state == OPEN:
+            if self.clock() - self._opened_at >= self.cooldown_s:
+                self._transition(HALF_OPEN)
+                return True
+            return False
+        return True
+
+    def record_success(self) -> None:
+        if self.state == HALF_OPEN:
+            self._transition(CLOSED)
+            self.closes += 1
+            self._failures.clear()
+
+    def record_failure(self) -> bool:
+        """Count one step failure. Returns True when THIS failure tripped the
+        breaker OPEN (callers use it to count opens exactly once)."""
+        now = self.clock()
+        if self.state == HALF_OPEN:  # failed probe: straight back to OPEN
+            self._opened_at = now
+            self._transition(OPEN)
+            self.opens += 1
+            return True
+        self._failures.append(now)
+        while self._failures and now - self._failures[0] > self.window_s:
+            self._failures.popleft()
+        if self.state == CLOSED and len(self._failures) >= self.threshold:
+            self._opened_at = now
+            self._transition(OPEN)
+            self.opens += 1
+            self._failures.clear()
+            return True
+        return False
+
+    def snapshot(self) -> dict:
+        """Health/statistics view (JSON-safe)."""
+        return {
+            "state": self.state,
+            "opens": self.opens,
+            "closes": self.closes,
+            "failures_in_window": len(self._failures),
+            "threshold": self.threshold,
+        }
+
+
+def breaker_from_annotations(query, name: str = "",
+                             clock: Callable[[], float] = time.monotonic,
+                             ) -> Optional[CircuitBreaker]:
+    """Build a CircuitBreaker from a query's `@breaker(...)` annotation, or
+    None when the query carries none. Elements: threshold (count), window /
+    cooldown (time literals like '10 sec')."""
+    ann = next((a for a in (query.annotations or ())
+                if a.name.lower() == "breaker"), None)
+    if ann is None:
+        return None
+    from ..errors import SiddhiAppCreationError
+    from .partition import _parse_annotation_time
+    try:
+        threshold = int(ann.element("threshold") or 5)
+        window = ann.element("window")
+        cooldown = ann.element("cooldown")
+        return CircuitBreaker(
+            threshold=threshold,
+            window_s=(_parse_annotation_time(window) / 1000.0
+                      if window else 60.0),
+            cooldown_s=(_parse_annotation_time(cooldown) / 1000.0
+                        if cooldown else 30.0),
+            owner=name, clock=clock)
+    except ValueError as e:
+        raise SiddhiAppCreationError(f"bad @breaker annotation: {e}") from e

@@ -106,6 +106,19 @@ class Statistics:
     recoveries: int = 0  # recover() completions
     wal_replayed: int = 0  # lifetime events re-sent by recover()
     shutdown_discarded: int = 0  # staged rows lost at shutdown()
+    #: overload-protection counters — tracked regardless of level, like the
+    #: sink_* family: a dropped/diverted/paused event is a correctness signal.
+    #: ingress_dropped is keyed stream -> {policy: rows} where policy is one
+    #: of drop.new | drop.old | fault | block.timeout | source.pending.
+    ingress_dropped: dict = field(default_factory=dict)
+    bp_pauses: dict = field(default_factory=dict)  # stream -> pause() calls
+    bp_resumes: dict = field(default_factory=dict)  # stream -> resume() calls
+    queue_hwm: dict = field(default_factory=dict)  # stream -> max staged depth
+    #: circuit-breaker counters, keyed by query name (state itself lives on
+    #: the runtime's CircuitBreaker; report(runtime) merges both views)
+    breaker_opens: dict = field(default_factory=dict)
+    breaker_failures: dict = field(default_factory=dict)
+    breaker_diverted: dict = field(default_factory=dict)  # rows diverted
 
     @property
     def detail(self) -> bool:
@@ -156,6 +169,32 @@ class Statistics:
         self.sink_dropped[stream_id] = \
             self.sink_dropped.get(stream_id, 0) + n
 
+    def track_ingress_drop(self, stream_id: str, policy: str, n: int) -> None:
+        """Rows shed/diverted by a bounded junction (or a paused source's
+        pending buffer) under `policy`. Exact by construction: every admission
+        decision increments exactly one policy counter."""
+        per = self.ingress_dropped.setdefault(stream_id, {})
+        per[policy] = per.get(policy, 0) + n
+
+    def track_pause(self, stream_id: str) -> None:
+        self.bp_pauses[stream_id] = self.bp_pauses.get(stream_id, 0) + 1
+
+    def track_resume(self, stream_id: str) -> None:
+        self.bp_resumes[stream_id] = self.bp_resumes.get(stream_id, 0) + 1
+
+    def track_queue_depth(self, stream_id: str, depth: int) -> None:
+        if depth > self.queue_hwm.get(stream_id, 0):
+            self.queue_hwm[stream_id] = depth
+
+    def track_breaker_failure(self, query: str) -> None:
+        self.breaker_failures[query] = self.breaker_failures.get(query, 0) + 1
+
+    def track_breaker_open(self, query: str) -> None:
+        self.breaker_opens[query] = self.breaker_opens.get(query, 0) + 1
+
+    def track_breaker_divert(self, query: str, n: int) -> None:
+        self.breaker_diverted[query] = self.breaker_diverted.get(query, 0) + n
+
     def track_recovery(self, replayed: int) -> None:
         self.recoveries += 1
         self.wal_replayed += replayed
@@ -193,6 +232,13 @@ class Statistics:
         self.sink_dead_letters.clear()
         self.sink_dropped.clear()
         self.source_retries.clear()
+        self.ingress_dropped.clear()
+        self.bp_pauses.clear()
+        self.bp_resumes.clear()
+        self.queue_hwm.clear()
+        self.breaker_opens.clear()
+        self.breaker_failures.clear()
+        self.breaker_diverted.clear()
         self.recoveries = 0
         self.wal_replayed = 0
         self.shutdown_discarded = 0
@@ -219,6 +265,15 @@ class Statistics:
             "sink_dead_letters": dict(self.sink_dead_letters),
             "sink_dropped": dict(self.sink_dropped),
             "source_retries": dict(self.source_retries),
+            # overload protection (always, same rationale): drops by policy,
+            # backpressure pause/resume counts, staged-depth high-watermarks
+            "ingress_dropped": {s: dict(d)
+                                for s, d in self.ingress_dropped.items()},
+            "backpressure": {
+                "pauses": dict(self.bp_pauses),
+                "resumes": dict(self.bp_resumes),
+                "queue_hwm": dict(self.queue_hwm),
+            },
             "recovery": {
                 "recoveries": self.recoveries,
                 "wal_replayed": self.wal_replayed,
@@ -237,6 +292,18 @@ class Statistics:
                     "dropped_error_entries":
                         es.dropped_count(runtime.app.name),
                 }
+            breakers = {}
+            for name, qr in runtime.query_runtimes.items():
+                br = getattr(qr, "breaker", None)
+                if br is None:
+                    continue
+                breakers[name] = {
+                    **br.snapshot(),
+                    "failures": self.breaker_failures.get(name, 0),
+                    "diverted_rows": self.breaker_diverted.get(name, 0),
+                }
+            if breakers:
+                out["breakers"] = breakers
         if self.detail:
             out["query_latency_ms"] = {
                 q: (t / c / 1e6 if c else 0.0)
@@ -248,7 +315,7 @@ class Statistics:
                     name: _pytree_nbytes(qr.state)
                     for name, qr in runtime.query_runtimes.items()}
                 out["buffered_events"] = {
-                    sid: len(j._staged_rows)
+                    sid: len(j._staged_rows) + len(j._tap_queue)
                     for sid, j in runtime.junctions.items()}
         return out
 

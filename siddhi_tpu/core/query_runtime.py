@@ -134,6 +134,12 @@ class QueryRuntime(Receiver):
         self.name = name or query.name or f"query_{id(self)}"
         self.registry = registry
         self.input_junction = input_junction
+        # per-query circuit breaker (@breaker(threshold=..., window=...,
+        # cooldown=...)) — the input junction consults it around every
+        # on_batch dispatch (core/breaker.py); None = failures propagate
+        # per @OnError exactly as before
+        from .breaker import breaker_from_annotations
+        self.breaker = breaker_from_annotations(query, name=self.name)
         self.callbacks: list[QueryCallback] = []
         self.output_junction: Optional[StreamJunction] = None
         self.table_executor = None  # set by app runtime for table CRUD outputs

@@ -23,7 +23,7 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
-from ..errors import SiddhiAppRuntimeError
+from ..errors import SiddhiAppCreationError, SiddhiAppRuntimeError
 from ..query_api.definition import AttributeType, StreamDefinition
 from . import dtypes
 from .context import SiddhiAppContext
@@ -393,6 +393,55 @@ class StreamJunction:
             if bs:
                 self.batch_size = int(bs)
             self._ring_cap = max(4 * self.batch_size, 1024)
+        # --- overload protection (bounded ingress + backpressure signal) ---
+        # @Async(buffer.size=N, overflow.policy=..., max.staged=...,
+        #        block.timeout='1 sec', high.watermark=0.8, low.watermark=0.2)
+        # caps staged rows with a pluggable policy for what a full buffer
+        # sheds (reference: the Disruptor ring IS the bound; OverflowPolicy
+        # here generalizes its blocking wait strategy):
+        #   block     producers wait for room (MPSC ring path; default) —
+        #             block.timeout bounds the wait, expiry drops + counts
+        #   drop.new  shed the arriving row
+        #   drop.old  evict the oldest staged row to admit the new one
+        #   fault     divert the arriving row to the `!stream` fault stream
+        #             or the ErrorStore (replayable), like @OnError
+        # Watermarks pace attached sources: staged depth >= high*capacity
+        # calls pause() on every attached Source, <= low*capacity resumes.
+        self.capacity: Optional[int] = None
+        self.overflow_policy = "block"
+        self.block_timeout_s: Optional[float] = None
+        self.high_watermark = 0.8
+        self.low_watermark = 0.2
+        #: sources feeding this junction (wiring registers them) — the
+        #: pause()/resume() backpressure targets
+        self.attached_sources: list = []
+        self._bp_paused = False
+        if ann is not None:
+            pol = (ann.element("overflow.policy") or "block").lower()
+            if pol not in ("block", "drop.new", "drop.old", "fault"):
+                raise SiddhiAppCreationError(
+                    f"@Async on {definition.id!r}: overflow.policy {pol!r} "
+                    "must be block | drop.new | drop.old | fault")
+            self.overflow_policy = pol
+            ms = ann.element("max.staged")
+            self.capacity = int(ms) if ms else self._ring_cap
+            if self.capacity < self.batch_size and pol != "block":
+                raise SiddhiAppCreationError(
+                    f"@Async on {definition.id!r}: max.staged "
+                    f"({self.capacity}) must be >= buffer.size "
+                    f"({self.batch_size})")
+            bt = ann.element("block.timeout")
+            if bt:
+                from .partition import _parse_annotation_time
+                self.block_timeout_s = _parse_annotation_time(bt) / 1000.0
+            hw = ann.element("high.watermark")
+            lw = ann.element("low.watermark")
+            self.high_watermark = float(hw) if hw else 0.8
+            self.low_watermark = float(lw) if lw else 0.2
+            if not 0.0 <= self.low_watermark < self.high_watermark <= 1.0:
+                raise SiddhiAppCreationError(
+                    f"@Async on {definition.id!r}: need "
+                    "0 <= low.watermark < high.watermark <= 1")
         self._staged_rows: list = []
         self._staged_ts: list[int] = []
         #: send-order interceptors fn(ts, data) — multi-stream sequence
@@ -479,6 +528,10 @@ class StreamJunction:
             self.wal.append_rows(self.definition.id, (ts,), (tuple(data),))
         for tap in self.taps:
             tap(ts, data)
+        if self._bounded_mode() and not self._lock_owned():
+            self.ctx.timestamp_generator.observe_event_time(ts)
+            self._stage_bounded(((ts, tuple(data)),))
+            return
         if self._ring is not None and not self._lock_owned():
             self.ctx.timestamp_generator.observe_event_time(ts)
             # blocking backpressure when the ring is full, like the
@@ -486,12 +539,21 @@ class StreamJunction:
             # feeder polls at 1 ms, and an Event.set() per row costs more
             # than the stage itself. Re-read the ring each spin: shutdown
             # detaches it, and late sends must fall back to the sync path.
+            # block.timeout bounds the wait; expiry sheds the row, counted.
             push = self._ring_push
+            deadline = (None if self.block_timeout_s is None
+                        else time.monotonic() + self.block_timeout_s)
             while True:
                 ring = self._ring
                 if ring is None:
                     break
                 if push(ring, ts, tuple(data)):
+                    if self.attached_sources and not self._bp_paused:
+                        self._check_pause(self._ring_size(ring))
+                    return
+                if deadline is not None and time.monotonic() >= deadline:
+                    self.ctx.statistics.track_ingress_drop(
+                        self.definition.id, "block.timeout", 1)
                     return
                 self._feeder_wake.set()
                 time.sleep(0.0002)
@@ -525,6 +587,10 @@ class StreamJunction:
         if self.wal is not None:  # one journal record for the whole batch
             self.wal.append_rows(self.definition.id, tss, rows)
         self.ctx.timestamp_generator.observe_event_time(int(max(tss)))
+        if self._bounded_mode() and not self._lock_owned():
+            self._stage_bounded((ts, tuple(row))
+                                for ts, row in zip(tss, rows))
+            return
         if self._ring is not None and not self._lock_owned():
             push = self._ring_push
             for i, (ts, row) in enumerate(zip(tss, rows)):
@@ -599,11 +665,139 @@ class StreamJunction:
             return getattr(self._reentry, "flushing", False) or \
                 getattr(self._reentry, "draining", False)
 
+    # ------------------------------------------------- bounded ingress (drop)
+
+    def _bounded_mode(self) -> bool:
+        """True when this junction runs producer-side admission control: a
+        capacity with a non-block policy. Rows then enter the thread-safe
+        pre-staging queue only (no inline flush, no MPSC ring — the ring's
+        blocking push IS the block policy), and delivery is pull-driven by
+        the feeder / auto-flusher / explicit flush(), so the bound — not
+        delivery speed — caps host memory."""
+        return self.capacity is not None and self.overflow_policy != "block"
+
+    def _stage_bounded(self, items) -> None:
+        """Admission control for drop/fault policies: each (ts, row) either
+        enters the pre-staging queue or is shed per the policy, with every
+        decision counted — the drop counters are exact by construction."""
+        stats = self.ctx.statistics
+        cap = self.capacity
+        policy = self.overflow_policy
+        diverted: list = []  # fault policy: routed outside the lock
+        with self._tap_lock:
+            q = self._tap_queue
+            for ts, row in items:
+                if len(q) < cap:
+                    q.append((ts, row))
+                elif policy == "drop.old":
+                    q.pop(0)
+                    q.append((ts, row))
+                    stats.track_ingress_drop(self.definition.id, "drop.old", 1)
+                elif policy == "drop.new":
+                    stats.track_ingress_drop(self.definition.id, "drop.new", 1)
+                else:  # fault
+                    diverted.append((ts, row))
+            depth = len(q)
+        stats.track_queue_depth(self.definition.id, depth)
+        if diverted:
+            stats.track_ingress_drop(self.definition.id, "fault",
+                                     len(diverted))
+            self._divert_overflow(diverted)
+        self._check_pause(depth)
+        if self._feeder_wake is not None:
+            self._feeder_wake.set()
+
+    def _divert_overflow(self, rows: list) -> None:
+        """`overflow.policy='fault'`: overflow rows leave through the same
+        doors failed events do — the `!stream` fault junction when one
+        exists, else the ErrorStore (replayable), else the log. Never
+        silent: the `fault` drop counter is bumped by the caller either way."""
+        msg = (f"ingress overflow: {self.definition.id!r} staging buffer "
+               f"full (capacity={self.capacity})")
+        if self.fault_junction is not None:
+            for ts, row in rows:
+                self.fault_junction.send_row(ts, tuple(row) + (msg,))
+            self.fault_junction.flush()
+            return
+        store = getattr(self.ctx, "error_store", None)
+        if store is not None:
+            store.save(self.ctx.name, self.definition.id,
+                       [(ts, tuple(row)) for ts, row in rows], msg,
+                       kind="overflow")
+            return
+        logging.getLogger("siddhi_tpu").warning(
+            "%s; %d row(s) dropped (no fault stream or error store to "
+            "divert to)", msg, len(rows))
+
+    # ------------------------------------------- backpressure (pause/resume)
+
+    def _check_pause(self, depth: int) -> None:
+        """High-watermark crossing pauses every attached source (reference:
+        Source.pause:113-153 — the transport stops/pausing its consumer).
+        Idempotent until the matching low-watermark resume."""
+        if (self._bp_paused or not self.attached_sources
+                or self.capacity is None):
+            return
+        if depth >= self.high_watermark * self.capacity:
+            with self._tap_lock:  # exact pause/resume counts under races
+                if self._bp_paused:
+                    return
+                self._bp_paused = True
+            self.ctx.statistics.track_pause(self.definition.id)
+            for s in self.attached_sources:
+                try:
+                    s.pause()
+                except Exception:  # pragma: no cover — transport hiccup
+                    logging.getLogger("siddhi_tpu").exception(
+                        "pause() failed on source of %r", self.definition.id)
+
+    def _staged_depth(self) -> int:
+        depth = len(self._tap_queue) + len(self._staged_rows)
+        ring = self._ring
+        if ring is not None:
+            depth += self._ring_size(ring)
+        return depth
+
+    def _ring_size(self, ring) -> int:
+        from .. import native as native_mod
+        return native_mod.native.ring_size(ring)
+
+    def _maybe_resume(self) -> None:
+        """Low-watermark crossing resumes paused sources (their buffered
+        payloads re-deliver through on_payload, re-entering admission).
+        Called after every flush — the only place depth shrinks."""
+        if not self._bp_paused or self.capacity is None:
+            return
+        if self._staged_depth() <= self.low_watermark * self.capacity:
+            with self._tap_lock:  # pair of _check_pause's guarded flip
+                if not self._bp_paused:
+                    return
+                self._bp_paused = False
+            self.ctx.statistics.track_resume(self.definition.id)
+            for s in self.attached_sources:
+                try:
+                    s.resume()
+                except Exception:  # pragma: no cover — transport hiccup
+                    logging.getLogger("siddhi_tpu").exception(
+                        "resume() failed on source of %r", self.definition.id)
+
     def start_async(self) -> None:
         """Spin up the staging ring + feeder thread (app start; reference:
         StreamJunction.startProcessing starting the Disruptor)."""
         from .. import native as native_mod
         if not self.is_async or self._feeder is not None:
+            return
+        if self._bounded_mode():
+            # drop/fault policies: producer-side accounting must stay exact,
+            # so no MPSC ring — a plain feeder drains the bounded pre-staging
+            # queue (the ring's blocking push is the block policy's engine)
+            import threading
+            self._feeder_stop = threading.Event()
+            self._feeder_wake = threading.Event()
+            self._feeder = threading.Thread(
+                target=self._bounded_feed_loop, daemon=True,
+                name=f"siddhi-feeder-{self.definition.id}")
+            self._feeder.start()
             return
         if native_mod.native is None:
             logging.getLogger("siddhi_tpu").info(
@@ -659,6 +853,22 @@ class StreamJunction:
             except Exception:  # pragma: no cover — surfaced via @OnError/log
                 logging.getLogger("siddhi_tpu").exception(
                     "async feeder error on %r", self.definition.id)
+
+    def _bounded_feed_loop(self) -> None:
+        """Drainer for bounded (drop/fault-policy) junctions: flush whenever
+        the pre-staging queue holds rows. Overload shows up as the queue
+        pinned at capacity with the policy counters climbing — never as
+        unbounded host memory."""
+        while not self._feeder_stop.is_set():
+            if not self._tap_queue:
+                self._feeder_wake.wait(timeout=0.001)
+                self._feeder_wake.clear()
+                continue
+            try:
+                self.flush()
+            except Exception:  # pragma: no cover — surfaced via @OnError/log
+                logging.getLogger("siddhi_tpu").exception(
+                    "bounded feeder error on %r", self.definition.id)
 
     def _drain_ring(self, max_batches: Optional[int] = None,
                     ring=None) -> None:
@@ -718,11 +928,13 @@ class StreamJunction:
                 for ts, row in q:
                     self._staged_ts.append(ts)
                     self._staged_rows.append(row)
-            if not self._staged_rows:
-                return
-            rows, tss = self._staged_rows, self._staged_ts
-            self._staged_rows, self._staged_ts = [], []
-            self._flush_rows(rows, tss, now)
+            if self._staged_rows:
+                rows, tss = self._staged_rows, self._staged_ts
+                self._staged_rows, self._staged_ts = [], []
+                self._flush_rows(rows, tss, now)
+        # flush is where staged depth shrinks: check the low watermark and
+        # resume paused sources (their buffered payloads re-enter admission)
+        self._maybe_resume()
 
     def _flush_rows(self, rows, tss, now) -> None:
         cap = self.batch_size
@@ -765,6 +977,35 @@ class StreamJunction:
         logging.getLogger("siddhi_tpu").exception(
             "error processing %r events: %s", self.definition.id, e)
 
+    def _divert_breaker(self, br, batch: EventBatch, now: int,
+                        err: Optional[Exception]) -> None:
+        """Route a failed/blocked query's input batch to the fault stream or
+        ErrorStore instead of executing it (reference intent: OnErrorAction,
+        applied at query granularity). Empty batches (heartbeats) divert
+        nothing — an open breaker must not spam the store with timer ticks."""
+        qname = br.owner or "?"
+        msg = (f"circuit breaker open for query {qname!r}" if err is None
+               else f"query {qname!r} failed: {err}")
+        events = batch.to_host_events(self.codec)
+        if not events:
+            return
+        self.ctx.statistics.track_breaker_divert(qname, len(events))
+        if self.fault_junction is not None:
+            for ev in events:
+                self.fault_junction.send_row(ev.timestamp,
+                                             tuple(ev.data) + (msg,))
+            self.fault_junction.flush(now)
+            return
+        store = getattr(self.ctx, "error_store", None)
+        if store is not None:
+            store.save(self.ctx.name, self.definition.id,
+                       [(ev.timestamp, tuple(ev.data)) for ev in events],
+                       msg, kind="breaker")
+            return
+        logging.getLogger("siddhi_tpu").error(
+            "%s; %d event(s) dropped (no fault stream or error store)",
+            msg, len(events))
+
     def heartbeat(self, now: int) -> None:
         """Advance time with no data: flush staged rows then deliver an empty
         batch so time-window expirations fire (the watermark analogue of the
@@ -784,14 +1025,33 @@ class StreamJunction:
             self.ctx.statistics.track_batch(self.definition.id)
             decoder = self.ctx.decoder
             for r in self.receivers:
+                br = (getattr(r, "breaker", None)
+                      or getattr(getattr(r, "runtime", None), "breaker", None))
+                if br is not None and not br.allow():
+                    # OPEN breaker inside its cooldown: divert without
+                    # dispatching — the poisoned query stops seeing traffic,
+                    # siblings on this junction keep running
+                    self._divert_breaker(br, batch, now, None)
+                    continue
                 try:
                     if decoder is not None and isinstance(
                             r, (StreamCallback, BatchStreamCallback)):
                         decoder.submit(r, batch, now, junction=self)
                     else:
                         r.on_batch(batch, now)
+                    if br is not None:
+                        br.record_success()
                 except Exception as e:  # noqa: BLE001
-                    if self.on_error is not None:
+                    if br is not None:
+                        # breaker-guarded receivers never kill the app: the
+                        # failure counts toward the trip and the failed
+                        # batch leaves through the divert path
+                        qname = br.owner or getattr(r, "name", "?")
+                        self.ctx.statistics.track_breaker_failure(qname)
+                        if br.record_failure():
+                            self.ctx.statistics.track_breaker_open(qname)
+                        self._divert_breaker(br, batch, now, e)
+                    elif self.on_error is not None:
                         self.on_error(e, batch)
                     elif self.on_error_action is not None:
                         self._handle_error(e, batch, now)

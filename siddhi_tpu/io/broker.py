@@ -60,7 +60,14 @@ class InMemoryBroker:
 
     @classmethod
     def publish(cls, topic: str, msg) -> None:
-        for sub in list(cls._topics.get(topic, [])):
+        # snapshot the subscriber list UNDER the lock: a concurrent
+        # subscribe/unsubscribe mutates the same list, and an unlocked
+        # list() copy can race the mutation mid-iteration. Delivery happens
+        # outside the lock — a slow (or paused/backpressured) subscriber
+        # must not serialize every other topic's publishes.
+        with cls._lock:
+            subs = tuple(cls._topics.get(topic, ()))
+        for sub in subs:
             sub.on_message(msg)
 
     @classmethod

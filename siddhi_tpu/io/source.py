@@ -114,6 +114,15 @@ class Source:
         self.ctx = ctx
         self._paused = False
         self._pending: list = []
+        #: paused-payload buffer bound: a paused source must not become the
+        #: unbounded buffer the junction bound just removed — past this the
+        #: OLDEST pending payload is shed and counted (pause.buffer.size=)
+        self._pending_cap = int(options.get("pause.buffer.size") or 65536)
+        #: persistent reconnect backoff (reference: Source.connectWithRetry
+        #: :155-177 keeps ONE counter per source) — repeated flaps escalate
+        #: the interval across connect_with_retry calls until a connect
+        #: succeeds, which resets it to the 5 ms floor
+        self._retry_counter = BackoffRetryCounter()
 
     # -- transport hooks -----------------------------------------------------
 
@@ -124,19 +133,41 @@ class Source:
         raise NotImplementedError
 
     def pause(self) -> None:
+        """Backpressure hook: stop delivering to the junction; payloads
+        arriving while paused buffer (bounded) in `_pending` until resume."""
         self._paused = True
 
     def resume(self) -> None:
+        if not self._paused:
+            return
         self._paused = False
         pending, self._pending = self._pending, []
         for payload in pending:
-            self.on_payload(payload)
+            # internal re-drain: NOT via on_payload — instance-level
+            # wrappers (fault injection, flap schedules) must only see NEW
+            # transport callbacks, never this replay. _deliver_payload
+            # re-checks _paused, so a re-pause mid-drain re-buffers the rest
+            self._deliver_payload(payload)
+
+    @property
+    def paused(self) -> bool:
+        return self._paused
 
     # -- runtime -------------------------------------------------------------
 
     def on_payload(self, payload) -> None:
         """Transport callback: map + hand rows to the junction."""
+        self._deliver_payload(payload)
+
+    def _deliver_payload(self, payload) -> None:
         if self._paused:
+            if len(self._pending) >= self._pending_cap:
+                self._pending.pop(0)  # shed oldest, keep the fresh payload
+                stats = getattr(getattr(self, "ctx", None), "statistics",
+                                None)
+                if stats is not None:
+                    stats.track_ingress_drop(self.definition.id,
+                                             "source.pending", 1)
             self._pending.append(payload)
             return
         self._handler(self.mapper.map(payload))
@@ -145,8 +176,13 @@ class Source:
                            sleep: Callable[[float], None] = time.sleep) -> None:
         """Reference: Source.connectWithRetry:155-177 — exponential backoff on
         ConnectionUnavailableException. max_attempts bounds the synchronous
-        build (the reference retries forever on a scheduler thread)."""
-        counter = BackoffRetryCounter()
+        build (the reference retries forever on a scheduler thread). The
+        backoff counter is the SOURCE'S persistent one (mirror of the
+        sink-side reconnect): a transport that flaps across repeated calls
+        keeps escalating; only a successful connect resets it."""
+        counter = getattr(self, "_retry_counter", None)
+        if counter is None:  # source used without init() (tests)
+            counter = self._retry_counter = BackoffRetryCounter()
         attempt = 0
         while True:
             try:

@@ -102,10 +102,17 @@ def build_source(ann: Annotation, junction, ctx) -> Source:
         for row in rows:
             junction.send_row(now, row)
         # push semantics like the reference's synchronous inMemory delivery;
-        # high-rate transports amortize via the junction's batch threshold
-        junction.flush(now)
+        # high-rate transports amortize via the junction's batch threshold.
+        # Bounded (drop/fault-policy) junctions skip the per-payload flush:
+        # delivery there is pull-driven (feeder/auto-flush) so the staging
+        # bound — not the transport's push rate — paces the pipeline.
+        if not junction._bounded_mode():
+            junction.flush(now)
 
     source.init(definition, options, mapper, handler, ctx)
+    # backpressure wiring: the junction pauses/resumes its attached sources
+    # on watermark crossings (Source.pause/resume, reference :113-153)
+    junction.attached_sources.append(source)
     return source
 
 

@@ -13,6 +13,16 @@ Endpoints (JSON):
   POST   /siddhi-apps/<name>/persist  → {"revision": "..."}
   POST   /siddhi-apps/<name>/recover  → {"revision": ..., "wal_replayed": n}
   GET    /siddhi-apps/<name>/statistics
+  GET    /health                      → 200 always while the process serves
+  GET    /ready                       → 200 when every app is "running";
+                                        503 with per-app detail otherwise
+                                        (degraded = breaker open,
+                                        recovering, or the service lock is
+                                        busy past a short wait)
+
+Probe note: /health and /ready skip bearer-token auth by design —
+orchestrator probes carry no credentials; the bodies expose only app names
+and health states, never data or query text.
 
 Usage:  python -m siddhi_tpu.service [port]
 
@@ -109,6 +119,26 @@ class SiddhiService:
         with self.lock:
             return self.manager.runtimes[app].recover()
 
+    def health(self) -> dict:
+        """Liveness: no lock — the process answering IS the signal (a
+        liveness probe must not hang behind a long deploy)."""
+        return {"status": "up", "apps": len(self.manager.runtimes)}
+
+    def readiness(self) -> tuple[int, dict]:
+        """Readiness: (http_status, body). 200 only when every deployed app
+        reports "running"; a breaker-open/degraded or recovering app — or a
+        service lock held past a short wait — answers 503 so load balancers
+        drain traffic while the engine sheds load."""
+        if not self.lock.acquire(timeout=0.5):
+            return 503, {"ready": False, "reason": "busy", "apps": {}}
+        try:
+            apps = {name: rt.health()
+                    for name, rt in self.manager.runtimes.items()}
+        finally:
+            self.lock.release()
+        ready = all(a["state"] == "running" for a in apps.values())
+        return (200 if ready else 503), {"ready": ready, "apps": apps}
+
     # ---------------------------------------------------------------- server
 
     def make_server(self, port: int = 9090,
@@ -143,9 +173,18 @@ class SiddhiService:
                 return False
 
             def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                # probe endpoints skip auth (orchestrator probes carry no
+                # credentials; bodies expose names + states only)
+                if parts == ["health"]:
+                    self._reply(200, service.health())
+                    return
+                if parts == ["ready"]:
+                    code, body = service.readiness()
+                    self._reply(code, body)
+                    return
                 if not self._authorized():
                     return
-                parts = self.path.strip("/").split("/")
                 try:
                     if parts == ["siddhi-apps"]:
                         self._reply(200, {"apps": service.list_apps()})

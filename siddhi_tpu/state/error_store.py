@@ -24,17 +24,22 @@ class ErrorEntry:
     stream_name: str
     events: list  # original (event_timestamp, row) pairs
     cause: str
+    #: origin of the entry — "error" (processing/@OnError), "sink"
+    #: (dead-letter), "breaker" (circuit-breaker divert), "overflow"
+    #: (bounded-ingress fault policy) — so operators replay selectively
+    kind: str = "error"
 
 
 class ErrorStore:
     """SPI (reference: ErrorStore.java:46)."""
 
     def save(self, app_name: str, stream_name: str, events: list,
-             cause: str) -> ErrorEntry:
+             cause: str, kind: str = "error") -> ErrorEntry:
         """`events` is a list of (event_timestamp, row) pairs."""
         raise NotImplementedError
 
-    def load(self, app_name: str, stream_name: Optional[str] = None) -> list:
+    def load(self, app_name: str, stream_name: Optional[str] = None,
+             kind: Optional[str] = None) -> list:
         raise NotImplementedError
 
     def discard(self, entry_id: int) -> None:
@@ -56,11 +61,12 @@ class InMemoryErrorStore(ErrorStore):
         #: app name -> entries evicted before the user could replay them
         self.dropped: dict[str, int] = {}
 
-    def save(self, app_name, stream_name, events, cause) -> ErrorEntry:
+    def save(self, app_name, stream_name, events, cause,
+             kind="error") -> ErrorEntry:
         entry = ErrorEntry(
             id=next(self._ids), timestamp=int(time.time() * 1000),
             app_name=app_name, stream_name=stream_name,
-            events=list(events), cause=cause)
+            events=list(events), cause=cause, kind=kind)
         self._entries[entry.id] = entry
         while len(self._entries) > self.max_entries:
             # dict preserves insertion order: the first key is the oldest
@@ -72,10 +78,11 @@ class InMemoryErrorStore(ErrorStore):
     def dropped_count(self, app_name: str) -> int:
         return self.dropped.get(app_name, 0)
 
-    def load(self, app_name, stream_name=None) -> list:
+    def load(self, app_name, stream_name=None, kind=None) -> list:
         return [e for e in self._entries.values()
                 if e.app_name == app_name
-                and (stream_name is None or e.stream_name == stream_name)]
+                and (stream_name is None or e.stream_name == stream_name)
+                and (kind is None or e.kind == kind)]
 
     def discard(self, entry_id) -> None:
         self._entries.pop(entry_id, None)

@@ -16,6 +16,9 @@ Failure schedules compose (any may fire on a given call):
                         pass `clock=` for a virtual clock)
   p=0.02, seed=7        fail each call with probability p from a FIXED seed
                         (same seed = same schedule, run to run)
+  slow_s=0.01           SLOW-CONSUMER mode: when the schedule fires, sleep
+                        slow_s instead of raising — the fault is latency,
+                        not an exception (overload/backpressure chaos)
 
 `inject()` wraps a bound method on one INSTANCE (sinks, sources, persistence
 stores, tables — anything), so wiring stays untouched. `apply_fault_spec()`
@@ -26,12 +29,19 @@ SIDDHI_FAULT_SPEC environment variable for bench soak runs:
 
 Grammar:  spec   := clause (';' clause)*
           clause := target ':' param (',' param)*
-          target := sink | source | store | table
+          target := sink | source | store | table | query
           param  := nth=N[+N...] | after=N | for=SECONDS | p=PROB
-                    | seed=N | exc=(connection|error)
+                    | seed=N | exc=(connection|error) | slow=SECONDS
 
 Targets map to: every Sink.publish, every Source.on_payload, the runtime's
-PersistenceStore.save, every table's insert_batch.
+PersistenceStore.save, every table's insert_batch, every query runtime's
+on_batch (the `query` target is how chaos runs make a query step throw —
+tripping its circuit breaker — or, with slow=, lag behind its producers so
+bounded-ingress/backpressure paths engage).
+
+Source flapping (`inject_source_flap`) exercises the pause/resume path
+deterministically: every `every`-th payload pauses the source, and after
+`down` more payloads it resumes (buffered payloads re-deliver).
 """
 
 from __future__ import annotations
@@ -61,7 +71,9 @@ class FaultPlan:
     def __init__(self, *, nth=(), after: Optional[int] = None,
                  for_s: Optional[float] = None, p: float = 0.0,
                  seed: int = 0, exc=ConnectionUnavailableException,
-                 clock: Callable[[], float] = time.monotonic) -> None:
+                 clock: Callable[[], float] = time.monotonic,
+                 slow_s: Optional[float] = None,
+                 sleep: Callable[[float], None] = time.sleep) -> None:
         self.nth = frozenset(int(n) for n in nth)
         self.after = int(after) if after is not None else None
         self.for_s = float(for_s) if for_s is not None else None
@@ -69,6 +81,9 @@ class FaultPlan:
         self._rng = random.Random(seed)
         self.exc = exc
         self.clock = clock
+        #: slow-consumer mode: a due call sleeps instead of raising
+        self.slow_s = float(slow_s) if slow_s is not None else None
+        self.sleep = sleep
         #: total calls seen / faults raised (assertable in tests)
         self.calls = 0
         self.fired = 0
@@ -87,10 +102,14 @@ class FaultPlan:
         return False
 
     def check(self, op: str = "") -> None:
-        """Count one call; raise `self.exc` when the schedule says so."""
+        """Count one call; when the schedule says so, raise `self.exc` — or,
+        in slow-consumer mode (slow_s=), stall the caller instead."""
         self.calls += 1
         if self._due():
             self.fired += 1
+            if self.slow_s is not None:
+                self.sleep(self.slow_s)
+                return
             raise self.exc(
                 f"injected fault on call #{self.calls}"
                 + (f" of {op}" if op else ""))
@@ -124,7 +143,7 @@ def restore(obj, method_name: str) -> None:
 # spec grammar (SIDDHI_FAULT_SPEC)
 # --------------------------------------------------------------------------- #
 
-_TARGETS = ("sink", "source", "store", "table")
+_TARGETS = ("sink", "source", "store", "table", "query")
 
 
 def parse_fault_spec(spec: str) -> dict:
@@ -154,6 +173,8 @@ def parse_fault_spec(spec: str) -> dict:
                 kw["p"] = float(val)
             elif key == "seed":
                 kw["seed"] = int(val)
+            elif key == "slow":
+                kw["slow_s"] = float(val)
             elif key == "exc":
                 try:
                     kw["exc"] = _EXC_BY_NAME[val.lower()]
@@ -196,4 +217,56 @@ def apply_fault_spec(runtime, spec: Optional[str] = None) -> dict:
             for table in runtime.tables.values():
                 if hasattr(table, "insert_batch"):
                     inject(table, "insert_batch", plan)
+        elif target == "query":
+            for qr in runtime.query_runtimes.values():
+                inject(qr, "on_batch", plan)
     return plans
+
+
+# --------------------------------------------------------------------------- #
+# source flapping (pause/resume chaos)
+# --------------------------------------------------------------------------- #
+
+
+class SourceFlapPlan:
+    """Deterministic pause/resume schedule for one source: every `every`-th
+    payload PAUSES the source (subsequent payloads buffer in its bounded
+    pending queue), and after `down` more payloads it RESUMES — buffered
+    payloads re-deliver in order. `flaps` counts completed pause→resume
+    cycles for assertions."""
+
+    def __init__(self, *, every: int, down: int = 1) -> None:
+        if every < 1 or down < 1:
+            raise ValueError("every and down must be >= 1")
+        self.every = int(every)
+        self.down = int(down)
+        self.calls = 0
+        self.flaps = 0
+        self._down_left = 0
+
+    def on_call(self, source) -> None:
+        self.calls += 1
+        if source.paused:
+            self._down_left -= 1
+            if self._down_left <= 0:
+                source.resume()  # buffered payloads re-deliver first
+                self.flaps += 1
+        elif self.calls % self.every == 0:
+            source.pause()
+            self._down_left = self.down
+
+
+def inject_source_flap(source, plan: SourceFlapPlan) -> SourceFlapPlan:
+    """Wrap `source.on_payload` so the flap schedule runs before each
+    delivery. Inject BEFORE runtime.start() (transports capture on_payload
+    at connect time); `restore(source, "on_payload")` undoes it."""
+    orig = source.on_payload
+
+    @functools.wraps(orig)
+    def flapping(payload):
+        plan.on_call(source)
+        return orig(payload)
+
+    flapping.__wrapped_original__ = orig
+    source.on_payload = flapping
+    return plan

@@ -1,0 +1,321 @@
+"""Bounded ingress & backpressure tests (reference: @async ring buffers,
+Source.pause/resume:113-153, StreamJunction OnError fault routing).
+
+The acceptance bar: under overload the staged depth never exceeds the
+configured bound, every admitted event is delivered exactly once, and the
+drop/divert count in statistics_report() matches the oracle EXACTLY for each
+overflow policy; watermark crossings pause and resume attached sources with
+exact counts."""
+
+import threading
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu import native as native_mod
+from siddhi_tpu.errors import SiddhiAppCreationError
+from siddhi_tpu.io.broker import InMemoryBroker
+from siddhi_tpu.io.source import ConnectionUnavailableException
+from siddhi_tpu.state.error_store import InMemoryErrorStore
+from siddhi_tpu.util.faults import (
+    FaultPlan,
+    SourceFlapPlan,
+    apply_fault_spec,
+    inject,
+    inject_source_flap,
+)
+
+pytestmark = pytest.mark.smoke
+
+
+def _build(policy, cap=8, *, stream_anns="", error_store=None):
+    mgr = SiddhiManager()
+    if error_store is not None:
+        mgr.set_error_store(error_store)
+    app = ("@app:name('BP')\n"
+           f"@Async(buffer.size='4', overflow.policy='{policy}', "
+           f"max.staged='{cap}')\n" + stream_anns +
+           "define stream S (v long);\n"
+           "@info(name='q') from S select v insert into Out;")
+    rt = mgr.create_siddhi_app_runtime(app)
+    got: list = []
+    rt.add_callback("Out", lambda evs: got.extend(e.data[0] for e in evs))
+    return mgr, rt, got
+
+
+class TestOverflowPolicies:
+    """Unstarted runtime = no feeder thread: admission decisions are fully
+    deterministic, so the oracles are exact equalities."""
+
+    def test_drop_new_sheds_arrivals_past_capacity(self):
+        _mgr, rt, got = _build("drop.new", cap=8)
+        h = rt.get_input_handler("S")
+        for i in range(20):
+            h.send((i,))
+        rt.flush()
+        rep = rt.statistics_report()
+        assert got == list(range(8))  # first 8 admitted, delivered once
+        assert rep["ingress_dropped"] == {"S": {"drop.new": 12}}
+        assert rep["backpressure"]["queue_hwm"]["S"] == 8
+
+    def test_drop_old_evicts_oldest_staged(self):
+        _mgr, rt, got = _build("drop.old", cap=8)
+        h = rt.get_input_handler("S")
+        for i in range(20):
+            h.send((i,))
+        rt.flush()
+        rep = rt.statistics_report()
+        assert got == list(range(12, 20))  # newest 8 survive
+        assert rep["ingress_dropped"] == {"S": {"drop.old": 12}}
+
+    def test_fault_policy_diverts_to_error_store(self):
+        store = InMemoryErrorStore()
+        _mgr, rt, got = _build("fault", cap=8, error_store=store)
+        h = rt.get_input_handler("S")
+        for i in range(20):
+            h.send((i,))
+        rt.flush()
+        rep = rt.statistics_report()
+        assert got == list(range(8))
+        assert rep["ingress_dropped"] == {"S": {"fault": 12}}
+        entries = store.load("BP", "S", kind="overflow")
+        diverted = [row[0] for e in entries for _ts, row in e.events]
+        assert sorted(diverted) == list(range(8, 20))  # replayable, not lost
+
+    def test_fault_policy_routes_to_fault_stream(self):
+        # @OnError(action='STREAM') declares the `!S` fault junction; the
+        # fault overflow policy prefers it over the error store
+        _mgr, rt, got = _build("fault", cap=8,
+                               stream_anns="@OnError(action='STREAM')\n")
+        faulted: list = []
+        rt.add_callback("!S", lambda evs: faulted.extend(evs))
+        h = rt.get_input_handler("S")
+        for i in range(20):
+            h.send((i,))
+        rt.flush()
+        assert got == list(range(8))
+        assert [e.data[0] for e in faulted] == list(range(8, 20))
+        assert all("overflow" in e.data[1] for e in faulted)
+
+    def test_block_policy_unstarted_delivers_inline(self):
+        # block is the default and keeps the pre-existing behavior: without
+        # a feeder the sender thread flushes at batch-size — nothing drops
+        _mgr, rt, got = _build("block", cap=8)
+        h = rt.get_input_handler("S")
+        for i in range(20):
+            h.send((i,))
+        rt.flush()
+        assert got == list(range(20))
+        assert rt.statistics_report()["ingress_dropped"] == {}
+
+    def test_send_batch_admission_is_counted_identically(self):
+        _mgr, rt, got = _build("drop.new", cap=8)
+        rt.get_input_handler("S").send_batch([(i,) for i in range(20)])
+        rt.flush()
+        assert got == list(range(8))
+        assert rt.statistics_report()["ingress_dropped"] == \
+            {"S": {"drop.new": 12}}
+
+    @pytest.mark.parametrize("ann", [
+        "@Async(buffer.size='4', overflow.policy='explode')",
+        "@Async(buffer.size='4', overflow.policy='drop.new', "
+        "max.staged='2')",  # max.staged < buffer.size
+        "@Async(buffer.size='4', high.watermark='0.2', low.watermark='0.8')",
+    ])
+    def test_bad_annotations_rejected(self, ann):
+        with pytest.raises(SiddhiAppCreationError):
+            SiddhiManager().create_siddhi_app_runtime(
+                ann + "\ndefine stream S (v long);\n"
+                "from S select v insert into Out;")
+
+
+class TestPauseResume:
+    def test_watermarks_pause_and_resume_attached_source(self):
+        """HWM crossing pauses the inMemory source (payloads buffer), the
+        post-flush LWM crossing resumes it (buffered payloads re-deliver) —
+        exact pause/resume counts, no losses, order preserved."""
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            "@app:name('BPsrc')\n"
+            "@source(type='inMemory', topic='bp')\n"
+            "@Async(buffer.size='2', overflow.policy='drop.new', "
+            "max.staged='4', high.watermark='0.75', low.watermark='0.25')\n"
+            "define stream S (v long);\n"
+            "@info(name='q') from S select v insert into Out;")
+        got: list = []
+        rt.add_callback("Out", lambda evs: got.extend(e.data[0] for e in evs))
+        src = rt.sources[0]
+        src.connect()  # subscribe without start(): no feeder, deterministic
+        try:
+            for i in range(3):  # depth 1,2,3 -> 3 >= 0.75*4 pauses
+                InMemoryBroker.publish("bp", (i,))
+            assert src.paused
+            for i in range(3, 5):  # arrive paused: buffer at the source
+                InMemoryBroker.publish("bp", (i,))
+            rt.flush()  # drains to 0 <= 0.25*4 -> resume, pending re-enters
+            assert not src.paused
+            rt.flush()
+            rep = rt.statistics_report()
+            assert got == list(range(5))  # nothing lost, order preserved
+            assert rep["backpressure"]["pauses"] == {"S": 1}
+            assert rep["backpressure"]["resumes"] == {"S": 1}
+            assert rep["backpressure"]["queue_hwm"]["S"] == 3
+            assert rep["ingress_dropped"] == {}
+        finally:
+            src.disconnect()
+
+    def test_source_flap_injection_loses_nothing(self):
+        """Seeded source flapping (util/faults.py): pause every 3rd payload,
+        resume after 2 more — every payload still arrives, in order."""
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            "@app:name('Flap')\n"
+            "@source(type='inMemory', topic='flap')\n"
+            "define stream S (v long);\n"
+            "@info(name='q') from S select v insert into Out;")
+        got: list = []
+        rt.add_callback("Out", lambda evs: got.extend(e.data[0] for e in evs))
+        plan = inject_source_flap(rt.sources[0], SourceFlapPlan(every=3, down=2))
+        rt.start()
+        try:
+            for i in range(8):
+                InMemoryBroker.publish("flap", (i,))
+            rt.flush()
+            assert got == list(range(8))
+            assert plan.flaps == 2 and plan.calls == 8
+        finally:
+            rt.shutdown()
+
+    @pytest.mark.skipif(native_mod.native is None,
+                        reason="native ring unavailable")
+    def test_block_timeout_bounds_the_wait(self):
+        """block policy + block.timeout: a producer facing a full ring (the
+        drainer is wedged behind the controller lock) waits at most the
+        timeout per row, then sheds + counts — conservation still holds."""
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            "@app:name('BT')\n"
+            "@Async(buffer.size='4', block.timeout='50')\n"
+            "define stream S (v long);\n"
+            "@info(name='q') from S select v insert into Out;")
+        got: list = []
+        rt.add_callback("Out", lambda evs: got.extend(e.data[0] for e in evs))
+        rt.start()
+        n = rt.junctions["S"]._ring_cap + 16
+        h = rt.get_input_handler("S")
+
+        def produce():
+            for i in range(n):
+                h.send((i,))
+
+        with rt.ctx.controller_lock:  # wedge the feeder: ring cannot drain
+            t = threading.Thread(target=produce)
+            t.start()
+            t.join(timeout=30)
+            assert not t.is_alive(), "block.timeout failed to bound the wait"
+        rt.flush()
+        rt.shutdown()
+        rep = rt.statistics_report()
+        dropped = rep["ingress_dropped"].get("S", {}).get("block.timeout", 0)
+        assert dropped >= 1
+        assert len(got) + dropped == n  # shed rows are counted, never silent
+
+
+class TestChaosConservation:
+    def test_overload_under_env_fault_spec(self):
+        """The CI chaos-smoke scenario: a started bounded drop.old stream
+        under a fast producer, with whatever SIDDHI_FAULT_SPEC the
+        environment injects (slow consumer etc.). Whatever the interleaving,
+        conservation must hold: sent == delivered + dropped + discarded."""
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            "@app:name('Chaos')\n"
+            "@Async(buffer.size='64', overflow.policy='drop.old', "
+            "max.staged='256')\n"
+            "define stream S (v long);\n"
+            "@info(name='q') from S select v insert into Out;")
+        delivered = [0]
+        rt.add_callback("Out", lambda blk: delivered.__setitem__(
+            0, delivered[0] + blk.count), columnar=True)
+        plans = apply_fault_spec(rt)  # no-op unless the env sets a spec
+        rt.start()
+        h = rt.get_input_handler("S")
+        sent = 0
+        rows = [(i,) for i in range(64)]
+        for _ in range(200):
+            h.send_batch(rows)
+            sent += 64
+        rt.flush()
+        rt.shutdown()
+        rep = rt.statistics_report()
+        dropped = sum(rep["ingress_dropped"].get("S", {}).values())
+        discarded = rep["recovery"]["shutdown_discarded"]
+        assert delivered[0] + dropped + discarded == sent
+        for plan in plans.values():  # the spec really injected
+            assert plan.calls > 0
+
+
+class TestSourceReconnect:
+    def test_retry_counter_escalates_then_resets_on_success(self):
+        """The per-source BackoffRetryCounter persists across
+        connect_with_retry calls (flaps escalate) and resets on success."""
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            "@app:name('Reconn')\n"
+            "@source(type='inMemory', topic='rc')\n"
+            "define stream S (v long);\n"
+            "from S select v insert into Out;")
+        src = rt.sources[0]
+        sleeps: list = []
+        inject(src, "connect", FaultPlan(
+            nth=(1, 2), exc=ConnectionUnavailableException))
+        src.connect_with_retry(sleep=sleeps.append)
+        # two failures: 5 ms then 50 ms backoff, then success resets
+        assert sleeps == [0.005, 0.05]
+        assert src._retry_counter.get_time_interval_ms() == 5
+        assert rt.statistics_report()["source_retries"]["S"] == 2
+        src.disconnect()
+
+    def test_pending_buffer_is_bounded_while_paused(self):
+        """A paused source cannot become the unbounded buffer the junction
+        bound removed: past pause.buffer.size the oldest payload sheds."""
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            "@app:name('Pend')\n"
+            "@source(type='inMemory', topic='pend', pause.buffer.size='4')\n"
+            "define stream S (v long);\n"
+            "from S select v insert into Out;")
+        got: list = []
+        rt.add_callback("Out", lambda evs: got.extend(e.data[0] for e in evs))
+        src = rt.sources[0]
+        src.connect()
+        try:
+            src.pause()
+            for i in range(7):
+                InMemoryBroker.publish("pend", (i,))
+            src.resume()
+            rt.flush()
+            assert got == [3, 4, 5, 6]  # newest 4 kept
+            assert rt.statistics_report()["ingress_dropped"] == \
+                {"S": {"source.pending": 3}}
+        finally:
+            src.disconnect()
+
+
+class TestBrokerPublish:
+    def test_subscribe_during_delivery_is_safe(self):
+        """publish() snapshots the subscriber list under the broker lock and
+        delivers outside it: a subscriber mutating subscriptions from inside
+        on_message neither deadlocks nor corrupts the iteration."""
+        got: list = []
+        try:
+            def cb(msg):
+                InMemoryBroker.subscribe_fn("bk2", got.append)
+                got.append(("bk1", msg))
+
+            InMemoryBroker.subscribe_fn("bk1", cb)
+            InMemoryBroker.publish("bk1", 1)
+            InMemoryBroker.publish("bk2", 2)
+            assert got == [("bk1", 1), 2]
+        finally:
+            InMemoryBroker.clear()

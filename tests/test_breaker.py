@@ -1,0 +1,182 @@
+"""Per-query circuit breaker tests (core/breaker.py): trip on K failures in
+a window, divert input while OPEN, HALF_OPEN probe after cooldown, close on
+probe success — all without stopping sibling queries or the app."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+from siddhi_tpu.state.error_store import InMemoryErrorStore
+from siddhi_tpu.util.faults import FaultPlan, InjectedFault, inject
+
+pytestmark = pytest.mark.smoke
+
+
+class TestCircuitBreakerUnit:
+    def test_trip_cooldown_probe_close(self):
+        clk = {"t": 0.0}
+        br = CircuitBreaker(threshold=2, window_s=60.0, cooldown_s=5.0,
+                            clock=lambda: clk["t"])
+        assert br.allow() and br.state == CLOSED
+        assert br.record_failure() is False
+        assert br.record_failure() is True  # threshold hit -> OPEN
+        assert br.state == OPEN and br.opens == 1
+        assert not br.allow()  # inside cooldown
+        clk["t"] = 5.0
+        assert br.allow() and br.state == HALF_OPEN  # one probe admitted
+        br.record_success()
+        assert br.state == CLOSED and br.closes == 1
+
+    def test_failed_probe_reopens(self):
+        clk = {"t": 0.0}
+        br = CircuitBreaker(threshold=1, cooldown_s=1.0,
+                            clock=lambda: clk["t"])
+        assert br.record_failure() is True
+        clk["t"] = 1.5
+        assert br.allow() and br.state == HALF_OPEN
+        assert br.record_failure() is True  # probe failed: straight back
+        assert br.state == OPEN and br.opens == 2
+        assert not br.allow()
+
+    def test_window_prunes_stale_failures(self):
+        clk = {"t": 0.0}
+        br = CircuitBreaker(threshold=2, window_s=10.0,
+                            clock=lambda: clk["t"])
+        br.record_failure()
+        clk["t"] = 11.0  # first failure ages out of the window
+        assert br.record_failure() is False
+        assert br.state == CLOSED
+
+
+def _build(*, breaker_ann, store=None, extra_query=""):
+    mgr = SiddhiManager()
+    if store is not None:
+        mgr.set_error_store(store)
+    app = ("@app:name('BrkApp')\n"
+           "define stream S (v long);\n"
+           f"@info(name='q') {breaker_ann}\n"
+           "from S select v insert into Out;\n" + extra_query)
+    rt = mgr.create_siddhi_app_runtime(app, batch_size=4)
+    got: list = []
+    rt.add_callback("Out", lambda evs: got.extend(e.data[0] for e in evs))
+    return mgr, rt, got
+
+
+class TestQueryBreaker:
+    def test_lifecycle_trip_divert_halfopen_close(self):
+        """The acceptance scenario end-to-end: K failures trip the breaker,
+        OPEN diverts input to the ErrorStore (replayable, counted), the
+        cooldown admits a probe, and a probe success closes the breaker."""
+        store = InMemoryErrorStore()
+        _mgr, rt, got = _build(
+            breaker_ann="@breaker(threshold='2', window='60 sec', "
+                        "cooldown='5 sec')",
+            store=store)
+        qr = rt.query_runtimes["q"]
+        clk = {"t": 0.0}
+        qr.breaker.clock = lambda: clk["t"]  # virtual time
+        plan = inject(qr, "on_batch", FaultPlan(nth=(1, 2), exc=InjectedFault))
+        h = rt.get_input_handler("S")
+
+        for i in range(3):  # rows 0,1 fail the step; row 2 meets OPEN
+            h.send((i,))
+            rt.flush()
+        rep = rt.statistics_report()
+        assert got == []
+        assert qr.breaker.state == OPEN
+        assert rep["breakers"]["q"]["state"] == OPEN
+        assert rep["breakers"]["q"]["opens"] == 1
+        assert rep["breakers"]["q"]["failures"] == 2
+        # every undelivered row was diverted — rows 0,1 on failure, row 2
+        # while open — and is replayable from the store
+        assert rep["breakers"]["q"]["diverted_rows"] == 3
+        diverted = [row[0] for e in store.load("BrkApp", kind="breaker")
+                    for _ts, row in e.events]
+        assert sorted(diverted) == [0, 1, 2]
+        assert plan.calls == 2  # the OPEN divert never dispatched the step
+
+        clk["t"] = 5.0  # cooldown over: next batch is the HALF_OPEN probe
+        h.send((3,))
+        rt.flush()
+        assert qr.breaker.state == CLOSED  # probe (fault plan exhausted) ok
+        h.send((4,))
+        rt.flush()
+        assert got == [3, 4]
+        assert rt.health()["state"] == "stopped"  # never started; not degraded
+
+    def test_sibling_queries_survive_a_tripped_query(self):
+        """One poisoned query must not take the app down: its breaker opens
+        while the sibling on the same junction keeps delivering."""
+        store = InMemoryErrorStore()
+        _mgr, rt, got = _build(
+            breaker_ann="@breaker(threshold='1')",
+            store=store,
+            extra_query="@info(name='sibling') "
+                        "from S select v insert into Out2;")
+        got2: list = []
+        rt.add_callback("Out2", lambda evs: got2.extend(e.data[0] for e in evs))
+        qr = rt.query_runtimes["q"]
+        inject(qr, "on_batch", FaultPlan(for_s=1e9, exc=InjectedFault))
+        h = rt.get_input_handler("S")
+        for i in range(6):
+            h.send((i,))
+            rt.flush()
+        assert qr.breaker.state == OPEN
+        assert got == []
+        assert got2 == list(range(6))  # sibling untouched
+        assert rt.statistics_report()["breakers"]["q"]["diverted_rows"] == 6
+
+    def test_open_breaker_marks_app_degraded(self):
+        store = InMemoryErrorStore()
+        _mgr, rt, _got = _build(breaker_ann="@breaker(threshold='1')",
+                                store=store)
+        rt.start()
+        try:
+            inject(rt.query_runtimes["q"], "on_batch",
+                   FaultPlan(nth=(1,), exc=InjectedFault))
+            rt.get_input_handler("S").send((1,))
+            rt.flush()
+            health = rt.health()
+            assert health["state"] == "degraded"
+            assert health["breakers"]["q"]["state"] == OPEN
+        finally:
+            rt.shutdown()
+
+    def test_divert_prefers_fault_stream(self):
+        """With @OnError(action='STREAM') on the input stream, breaker
+        diverts ride the `!stream` fault stream with the error message."""
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            "@app:name('BrkFS')\n"
+            "@OnError(action='STREAM')\n"
+            "define stream S (v long);\n"
+            "@info(name='q') @breaker(threshold='1')\n"
+            "from S select v insert into Out;", batch_size=4)
+        faulted: list = []
+        rt.add_callback("!S", lambda evs: faulted.extend(evs))
+        inject(rt.query_runtimes["q"], "on_batch",
+               FaultPlan(nth=(1,), exc=InjectedFault))
+        rt.get_input_handler("S").send((7,))
+        rt.flush()
+        assert len(faulted) == 1
+        assert faulted[0].data[0] == 7
+        assert "injected fault" in faulted[0].data[1]
+
+    def test_no_breaker_preserves_propagation(self):
+        """Queries without @breaker keep the pre-existing contract: a step
+        failure with no @OnError propagates to the caller."""
+        _mgr, rt, _got = _build(breaker_ann="")
+        inject(rt.query_runtimes["q"], "on_batch",
+               FaultPlan(nth=(1,), exc=InjectedFault))
+        h = rt.get_input_handler("S")
+        h.send((1,))
+        with pytest.raises(InjectedFault):
+            rt.flush()
+
+    def test_bad_breaker_annotation_rejected(self):
+        from siddhi_tpu.errors import SiddhiAppCreationError
+        with pytest.raises(SiddhiAppCreationError):
+            SiddhiManager().create_siddhi_app_runtime(
+                "define stream S (v long);\n"
+                "@breaker(threshold='0')\n"
+                "from S select v insert into Out;")

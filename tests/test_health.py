@@ -1,0 +1,100 @@
+"""/health + /ready endpoint tests: liveness is unconditional, readiness
+reflects per-app state (breaker-open -> degraded -> 503) and lock busyness."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from siddhi_tpu.service import SiddhiService
+from siddhi_tpu.util.faults import FaultPlan, InjectedFault, inject
+
+pytestmark = pytest.mark.smoke
+
+APP = """@app:name('hsvc')
+define stream S (v long);
+@info(name='q') @breaker(threshold='1', cooldown='1 hour')
+from S select v insert into Out;
+"""
+
+
+@pytest.fixture()
+def server():
+    svc = SiddhiService(token="secret-token")
+    httpd = svc.make_server(port=0)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", svc
+    httpd.shutdown()
+
+
+def _get(url, token=None):
+    req = urllib.request.Request(url)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post(url, body, token=None):
+    req = urllib.request.Request(url, data=body.encode(), method="POST")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class TestHealth:
+    def test_health_is_up_and_unauthenticated(self, server):
+        base, _svc = server
+        code, body = _get(f"{base}/health")  # no bearer token on purpose
+        assert code == 200
+        assert body["status"] == "up"
+
+    def test_data_endpoints_still_require_auth(self, server):
+        base, _svc = server
+        code, _ = _get(f"{base}/siddhi-apps")
+        assert code == 401
+
+    def test_ready_with_no_apps(self, server):
+        base, _svc = server
+        code, body = _get(f"{base}/ready")
+        assert code == 200 and body["ready"] is True
+
+
+class TestReady:
+    def test_running_app_is_ready(self, server):
+        base, _svc = server
+        _post(f"{base}/siddhi-apps", APP, token="secret-token")
+        code, body = _get(f"{base}/ready")
+        assert code == 200 and body["ready"] is True
+        assert body["apps"]["hsvc"]["state"] == "running"
+        assert body["apps"]["hsvc"]["breakers"]["q"]["state"] == "closed"
+
+    def test_breaker_open_reports_degraded_503(self, server):
+        base, svc = server
+        _post(f"{base}/siddhi-apps", APP, token="secret-token")
+        rt = svc.manager.runtimes["hsvc"]
+        inject(rt.query_runtimes["q"], "on_batch",
+               FaultPlan(nth=(1,), exc=InjectedFault))
+        _post(f"{base}/siddhi-apps/hsvc/streams/S",
+              json.dumps({"events": [[1]]}), token="secret-token")
+        code, body = _get(f"{base}/ready")
+        assert code == 503 and body["ready"] is False
+        assert body["apps"]["hsvc"]["state"] == "degraded"
+        assert body["apps"]["hsvc"]["breakers"]["q"]["state"] == "open"
+        # liveness is unaffected: the process still serves
+        code, _body = _get(f"{base}/health")
+        assert code == 200
+
+    def test_busy_service_lock_reports_503(self, server):
+        base, svc = server
+        with svc.lock:  # a long deploy in flight: probes must not hang
+            code, body = _get(f"{base}/ready")
+        assert code == 503
+        assert body["ready"] is False and body["reason"] == "busy"
