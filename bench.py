@@ -198,6 +198,33 @@ _DENOMINATORS = {
 }
 
 
+def _preflight(app: str) -> dict:
+    """Static-analysis overhead per config app: parse, lint (the SL rule
+    catalog over the plan graph), and full validate (plan + discard, the
+    SIDDHI_LINT=error worst case). One-shot wall times in ms — these land
+    in BENCH_*.json so lint cost regressions show up next to throughput."""
+    from siddhi_tpu import SiddhiManager, compiler
+    from siddhi_tpu.analysis import analyze
+
+    t0 = time.perf_counter()
+    parsed = compiler.parse(app)
+    parse_ms = (time.perf_counter() - t0) * 1e3
+    t1 = time.perf_counter()
+    report = analyze(parsed)
+    lint_ms = (time.perf_counter() - t1) * 1e3
+    t2 = time.perf_counter()
+    SiddhiManager().validate_siddhi_app(parsed)
+    validate_ms = (time.perf_counter() - t2) * 1e3
+    out = {
+        "parse_ms": round(parse_ms, 2),
+        "lint_ms": round(lint_ms, 2),
+        "validate_ms": round(validate_ms, 2),
+        "lint_findings": len(report.diagnostics),
+    }
+    _partial(out)
+    return out
+
+
 def _baseline_for(key: str) -> float:
     fallback = _DENOMINATORS.get(key, 1_000_000.0)
     try:
@@ -494,6 +521,7 @@ def bench_filter() -> dict:
             _measure_e2e(rt3, "OutStream", feed_rows, E2E_BATCH,
                          columnar=False, rounds=4), 1)
         _partial({"e2e_rows_events_per_sec": res["e2e_rows_events_per_sec"]})
+        res.update(_preflight(app))
     return res
 
 
@@ -541,7 +569,8 @@ def bench_groupby() -> dict:
     res["e2e_events_per_sec"] = round(
         _measure_e2e(rt2, "SummaryStream", feed, E2E_BATCH), 1)
     _partial({"e2e_events_per_sec": res["e2e_events_per_sec"]})
-
+    if not E2E_ONLY:
+        res.update(_preflight(app))
     return res
 
 
@@ -611,6 +640,8 @@ def _distinct_e2e(app: str, res: dict) -> dict:
     res["e2e_events_per_sec"] = round(
         _measure_e2e(rt2, "OutStream", feed, E2E_BATCH), 1)
     _partial({"e2e_events_per_sec": res["e2e_events_per_sec"]})
+    if not E2E_ONLY:
+        res.update(_preflight(app))
     return res
 
 
@@ -696,6 +727,8 @@ def bench_pattern() -> dict:
     res["e2e_events_per_sec"] = round(
         _measure_e2e(rt2, "OutStream", feed, 2 * eb), 1)
     _partial({"e2e_events_per_sec": res["e2e_events_per_sec"]})
+    if not E2E_ONLY:
+        res.update(_preflight(app))
     return res
 
 
@@ -770,6 +803,8 @@ def bench_join() -> dict:
     res["e2e_events_per_sec"] = round(
         _measure_e2e(rt2, "OutStream", feed, 2 * jb), 1)
     _partial({"e2e_events_per_sec": res["e2e_events_per_sec"]})
+    if not E2E_ONLY:
+        res.update(_preflight(app))
     return res
 
 
@@ -840,6 +875,7 @@ def bench_overload() -> dict:
             delivered[0] + dropped + discarded == sent,
     })
     _partial(res)
+    res.update(_preflight(app))
     return res
 
 

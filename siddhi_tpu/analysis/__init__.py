@@ -1,0 +1,56 @@
+"""siddhi_tpu.analysis — static query-plan analyzer + jaxpr hazard linter.
+
+`analyze(app)` lowers a SiddhiApp (or SiddhiQL text) into a typed plan graph
+(plan.py) and runs the SL1xx rule catalog (rules.py) over it — no device
+state is planned, so the static pass costs milliseconds. With `jaxpr=True`
+it additionally builds a sandbox runtime and walks each compiled step's
+jaxpr for host-sync / dtype hazards (jaxpr_pass.py, SL2xx).
+
+Surfaces: `SiddhiManager.validate(app)`, the SIDDHI_LINT startup gate,
+`python -m siddhi_tpu.lint`, and REST `POST /siddhi-apps/validate` all call
+`analyze()`; docs/LINT.md is the user-facing rule reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from .diagnostics import Diagnostic, LintReport, Severity, Suppressions
+from .plan import PlanGraph, build_plan
+from .rules import RULES, run_rules
+
+__all__ = [
+    "Diagnostic", "LintReport", "Severity", "Suppressions",
+    "PlanGraph", "build_plan", "RULES", "analyze", "lint_mode",
+]
+
+
+def analyze(app: Union[str, "object"], *, jaxpr: bool = False,
+            name: Optional[str] = None) -> LintReport:
+    """Lint one app. `app` is a SiddhiApp or SiddhiQL source text (parse
+    errors propagate as SiddhiParserError — callers that need them as
+    diagnostics catch and wrap, see siddhi_tpu/lint.py).
+
+    The static pass never raises; the optional jaxpr pass is best-effort
+    (queries it cannot trace are skipped)."""
+    if isinstance(app, str):
+        from ..compiler import SiddhiCompiler
+        app = SiddhiCompiler.parse(app)
+    report = LintReport(app_name=name or getattr(app, "name", None)
+                        or "SiddhiApp")
+    plan = build_plan(app)
+    run_rules(plan, report)
+    if jaxpr:
+        from .jaxpr_pass import run_jaxpr_pass
+        run_jaxpr_pass(app, report, plan.suppressions)
+    return report
+
+
+def lint_mode() -> str:
+    """The SIDDHI_LINT startup gate: 'error' | 'warn' (default) | 'off'."""
+    import os
+
+    mode = os.environ.get("SIDDHI_LINT", "warn").strip().lower()
+    if mode not in ("error", "warn", "off"):
+        return "warn"
+    return mode

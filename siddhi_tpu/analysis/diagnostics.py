@@ -1,0 +1,150 @@
+"""Lint diagnostics: severities, findings, reports, suppression.
+
+Diagnostics reuse SiddhiParserError's " at line L:C" location format so every
+tool in the stack (parser, linter, CLI, REST validate) reports positions
+identically. A Diagnostic is pure data; rendering lives here too so the CLI
+and the runtime log lines agree byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+class Severity(enum.Enum):
+    ERROR = "error"
+    WARN = "warn"
+    INFO = "info"
+
+    @property
+    def rank(self) -> int:
+        return {"error": 0, "warn": 1, "info": 2}[self.value]
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One finding. `element` names the app element it anchors to (a query
+    name, stream id, ...); `loc` is the element's (line, column) when the
+    parser captured one."""
+
+    rule_id: str
+    severity: Severity
+    message: str
+    element: Optional[str] = None
+    loc: Optional[tuple] = None
+
+    @property
+    def location(self) -> str:
+        if not self.loc:
+            return ""
+        return f" at line {self.loc[0]}:{self.loc[1]}"
+
+    def format(self) -> str:
+        where = f" [{self.element}]" if self.element else ""
+        return (f"{self.severity.value.upper():5s} {self.rule_id}{where} "
+                f"{self.message}{self.location}")
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule_id,
+            "severity": self.severity.value,
+            "message": self.message,
+            "element": self.element,
+            "line": self.loc[0] if self.loc else None,
+            "column": self.loc[1] if self.loc else None,
+        }
+
+
+@dataclass
+class LintReport:
+    """All diagnostics for one app, ordered most-severe first."""
+
+    app_name: str = "SiddhiApp"
+    diagnostics: list[Diagnostic] = field(default_factory=list)
+
+    def add(self, diag: Diagnostic) -> None:
+        self.diagnostics.append(diag)
+
+    def extend(self, other: "LintReport") -> None:
+        self.diagnostics.extend(other.diagnostics)
+
+    @property
+    def errors(self) -> list[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity is Severity.ERROR]
+
+    @property
+    def warnings(self) -> list[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity is Severity.WARN]
+
+    @property
+    def has_errors(self) -> bool:
+        return bool(self.errors)
+
+    def rule_counts(self) -> dict[str, int]:
+        counts: dict[str, int] = {}
+        for d in self.diagnostics:
+            counts[d.rule_id] = counts.get(d.rule_id, 0) + 1
+        return counts
+
+    def sorted(self) -> list[Diagnostic]:
+        return sorted(self.diagnostics,
+                      key=lambda d: (d.severity.rank, d.rule_id,
+                                     d.loc or (1 << 30, 0)))
+
+    def format(self) -> str:
+        lines = [d.format() for d in self.sorted()]
+        n_err, n_warn = len(self.errors), len(self.warnings)
+        n_info = len(self.diagnostics) - n_err - n_warn
+        lines.append(f"{self.app_name}: {n_err} error(s), {n_warn} "
+                     f"warning(s), {n_info} info")
+        return "\n".join(lines)
+
+    def to_dict(self) -> dict:
+        return {
+            "app": self.app_name,
+            "valid": not self.has_errors,
+            "counts": self.rule_counts(),
+            "diagnostics": [d.to_dict() for d in self.sorted()],
+        }
+
+    def to_json(self, **kw) -> str:
+        return json.dumps(self.to_dict(), **kw)
+
+
+# ----------------------------------------------------------------- suppression
+
+
+def _suppressed_ids(annotations) -> set[str]:
+    """Rule ids named by `@suppress.lint('SL101', ...)` annotations.
+
+    The grammar accepts both `.` and `:` as the name separator (normalized
+    to `:` by the transformer); an argument-less `@suppress.lint` suppresses
+    every rule on that element."""
+    ids: set[str] = set()
+    for ann in annotations or ():
+        if ann.name.lower().replace(":", ".") != "suppress.lint":
+            continue
+        if not ann.elements:
+            return {"*"}
+        for el in ann.elements:
+            ids.add(str(el.value).strip().upper())
+    return ids
+
+
+class Suppressions:
+    """App-level + per-element suppression lookup."""
+
+    def __init__(self, app) -> None:
+        self._app_level = _suppressed_ids(getattr(app, "annotations", ()))
+
+    def is_suppressed(self, rule_id: str, element=None) -> bool:
+        if "*" in self._app_level or rule_id in self._app_level:
+            return True
+        if element is not None:
+            ids = _suppressed_ids(getattr(element, "annotations", ()))
+            if "*" in ids or rule_id in ids:
+                return True
+        return False

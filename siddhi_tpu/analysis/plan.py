@@ -1,0 +1,469 @@
+"""Plan graph: a typed DAG lowered from a parsed SiddhiApp.
+
+The graph is the analysis-side analogue of what SiddhiAppRuntime._build wires
+at creation time — stream/table/window/trigger/aggregation schemas as nodes,
+queries as edges — but built WITHOUT planning any device state, so linting an
+app costs milliseconds and can never allocate or compile anything.
+
+Schemas are permissive on purpose: any element the analyzer cannot type
+statically (stream functions rewriting columns, script functions, unknown
+extensions) degrades to an *open* schema that downstream rules skip, so the
+linter under-reports instead of false-positiving (the zero-FP sweep in
+tests/test_lint.py holds the line).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..query_api import SiddhiApp
+from ..query_api.definition import AttributeType
+from ..query_api.execution import (
+    AbsentStreamStateElement,
+    CountStateElement,
+    EveryStateElement,
+    JoinInputStream,
+    LogicalStateElement,
+    NextStateElement,
+    Partition,
+    Query,
+    SingleInputStream,
+    StateInputStream,
+    StreamStateElement,
+)
+from ..query_api.expression import (
+    And,
+    AttributeFunction,
+    Compare,
+    CompareOp,
+    Constant,
+    Expression,
+    In,
+    IsNull,
+    MathExpression,
+    Not,
+    Or,
+    Variable,
+)
+from .diagnostics import Suppressions
+
+#: attrs dict value for "exists but statically untypeable"
+UNKNOWN = None
+
+_CONST_TYPES = {
+    "int": AttributeType.INT, "long": AttributeType.LONG,
+    "float": AttributeType.FLOAT, "double": AttributeType.DOUBLE,
+    "bool": AttributeType.BOOL, "string": AttributeType.STRING,
+    "time": AttributeType.LONG,
+}
+
+_NUMERIC = {AttributeType.INT, AttributeType.LONG,
+            AttributeType.FLOAT, AttributeType.DOUBLE}
+_INTEGRAL = {AttributeType.INT, AttributeType.LONG}
+_FLOATING = {AttributeType.FLOAT, AttributeType.DOUBLE}
+_RANK = {AttributeType.INT: 0, AttributeType.LONG: 1,
+         AttributeType.FLOAT: 2, AttributeType.DOUBLE: 3}
+
+
+def _promote(a: AttributeType, b: AttributeType) -> Optional[AttributeType]:
+    if a not in _NUMERIC or b not in _NUMERIC:
+        return None
+    return a if _RANK[a] >= _RANK[b] else b
+
+
+@dataclass
+class StreamSchema:
+    """One named node: kind + attribute types. `attrs=None` means the schema
+    is open (unknown columns); a present attr mapped to UNKNOWN means the
+    column exists but its type could not be inferred."""
+
+    name: str
+    kind: str  # stream | table | window | trigger | aggregation | derived | fault
+    attrs: Optional[dict[str, Optional[AttributeType]]] = None
+    defn: object = None  # declaring definition, when one exists
+
+    @property
+    def is_open(self) -> bool:
+        return self.attrs is None
+
+
+@dataclass
+class ConsumedStream:
+    """One stream reference consumed by a query's FROM clause."""
+
+    stream_id: str
+    single: SingleInputStream
+    role: str  # single | join-left | join-right | pattern
+    is_fault: bool = False
+    is_inner: bool = False
+
+
+@dataclass
+class QueryNode:
+    query: Query
+    name: str
+    explicit_name: bool
+    index: int
+    partition: Optional[Partition] = None
+    consumed: list[ConsumedStream] = field(default_factory=list)
+    #: insert-target stream id (None for table writes / RETURN)
+    produces: Optional[str] = None
+    produces_fault: bool = False
+
+    @property
+    def loc(self):
+        return self.query.loc
+
+
+@dataclass
+class PlanGraph:
+    app: SiddhiApp
+    schemas: dict[str, StreamSchema] = field(default_factory=dict)
+    queries: list[QueryNode] = field(default_factory=list)
+    producers: dict[str, list[QueryNode]] = field(default_factory=dict)
+    consumers: dict[str, list[QueryNode]] = field(default_factory=dict)
+    suppressions: Optional[Suppressions] = None
+    #: (rule_code, message, query_node) tuples collected while typing
+    #: expressions during the build; rules.py turns them into diagnostics
+    expr_issues: list[tuple] = field(default_factory=list)
+
+    def schema(self, name: str) -> Optional[StreamSchema]:
+        return self.schemas.get(name)
+
+
+def _leaf_streams(state) -> list[SingleInputStream]:
+    """Flatten a pattern/sequence state tree to its stream leaves."""
+    if isinstance(state, StreamStateElement):
+        return [state.stream]
+    if isinstance(state, AbsentStreamStateElement):
+        return [state.stream]
+    if isinstance(state, CountStateElement):
+        return _leaf_streams(state.element)
+    if isinstance(state, (EveryStateElement,)):
+        return _leaf_streams(state.state)
+    if isinstance(state, LogicalStateElement):
+        return _leaf_streams(state.left) + _leaf_streams(state.right)
+    if isinstance(state, NextStateElement):
+        return _leaf_streams(state.state) + _leaf_streams(state.next)
+    return []
+
+
+def consumed_streams(query: Query) -> list[ConsumedStream]:
+    ins = query.input_stream
+    out: list[ConsumedStream] = []
+    if isinstance(ins, SingleInputStream):
+        out.append(ConsumedStream(ins.stream_id, ins, "single",
+                                  is_fault=ins.is_fault, is_inner=ins.is_inner))
+    elif isinstance(ins, JoinInputStream):
+        out.append(ConsumedStream(ins.left.stream_id, ins.left, "join-left",
+                                  is_fault=ins.left.is_fault,
+                                  is_inner=ins.left.is_inner))
+        out.append(ConsumedStream(ins.right.stream_id, ins.right, "join-right",
+                                  is_fault=ins.right.is_fault,
+                                  is_inner=ins.right.is_inner))
+    elif isinstance(ins, StateInputStream):
+        for s in _leaf_streams(ins.state):
+            out.append(ConsumedStream(s.stream_id, s, "pattern",
+                                      is_fault=s.is_fault, is_inner=s.is_inner))
+    return out
+
+
+# -------------------------------------------------------------- expr typing
+
+
+class ExprTyper:
+    """Static mirror of ops/expr_compile.py's type rules. `frames` maps a
+    stream reference (alias or id) to its attrs dict (None = open frame).
+    Typing NEVER raises; confident violations are appended to `issues` as
+    (code, message) and everything uncertain types as UNKNOWN."""
+
+    def __init__(self, frames: dict[str, Optional[dict]],
+                 default_frame: Optional[str] = None) -> None:
+        self.frames = frames
+        self.default = default_frame
+        self.issues: list[tuple[str, str]] = []
+        self.promotions: list[str] = []
+        self.any_open = any(v is None for v in frames.values())
+
+    # -- resolution
+
+    def _resolve(self, v: Variable) -> Optional[AttributeType]:
+        if v.stream_id is not None:
+            frame = self.frames.get(v.stream_id)
+            if frame is None:
+                # unknown frame name or open frame: runtime resolution owns it
+                return UNKNOWN
+            if v.attribute not in frame:
+                self.issues.append((
+                    "SL103",
+                    f"attribute {v.attribute!r} is not defined on "
+                    f"{v.stream_id!r} (has: {', '.join(sorted(frame))})"))
+                return UNKNOWN
+            return frame[v.attribute]
+        hits = [frame[v.attribute] for frame in self.frames.values()
+                if frame is not None and v.attribute in frame]
+        if not hits:
+            if self.any_open:
+                return UNKNOWN  # could live on an open frame
+            self.issues.append((
+                "SL103",
+                f"attribute {v.attribute!r} is not defined on any input "
+                f"stream ({', '.join(sorted(self.frames))})"))
+            return UNKNOWN
+        if len(hits) > 1:
+            return UNKNOWN  # ambiguity is a creation-time error; not re-flagged
+        return hits[0]
+
+    # -- typing
+
+    def type_of(self, expr: Expression) -> Optional[AttributeType]:
+        if isinstance(expr, Constant):
+            return _CONST_TYPES.get(expr.type_name, UNKNOWN)
+        if isinstance(expr, Variable):
+            return self._resolve(expr)
+        if isinstance(expr, (And, Or)):
+            lt, rt = self.type_of(expr.left), self.type_of(expr.right)
+            for t in (lt, rt):
+                if t is not UNKNOWN and t != AttributeType.BOOL:
+                    self.issues.append((
+                        "SL104",
+                        f"logical operator requires bool operands, got "
+                        f"{t.value}"))
+            return AttributeType.BOOL
+        if isinstance(expr, Not):
+            t = self.type_of(expr.expression)
+            if t is not UNKNOWN and t != AttributeType.BOOL:
+                self.issues.append((
+                    "SL104",
+                    f"`not` requires a bool operand, got {t.value}"))
+            return AttributeType.BOOL
+        if isinstance(expr, Compare):
+            return self._type_compare(expr)
+        if isinstance(expr, MathExpression):
+            return self._type_math(expr)
+        if isinstance(expr, IsNull):
+            if expr.expression is not None and expr.stream_id is None:
+                self.type_of(expr.expression)
+            return AttributeType.BOOL
+        if isinstance(expr, In):
+            self.type_of(expr.expression)
+            return AttributeType.BOOL
+        if isinstance(expr, AttributeFunction):
+            return self._type_function(expr)
+        return UNKNOWN
+
+    def _type_compare(self, expr: Compare) -> AttributeType:
+        lt, rt = self.type_of(expr.left), self.type_of(expr.right)
+        if lt is UNKNOWN or rt is UNKNOWN:
+            return AttributeType.BOOL
+        ordered = expr.op not in (CompareOp.EQUAL, CompareOp.NOT_EQUAL)
+        if lt == AttributeType.STRING and rt == AttributeType.STRING:
+            if ordered:
+                self.issues.append((
+                    "SL104",
+                    "string ordering comparisons are unsupported on device "
+                    "(dictionary codes are unordered); only ==/!= work"))
+            return AttributeType.BOOL
+        if AttributeType.STRING in (lt, rt):
+            self.issues.append((
+                "SL104",
+                f"cannot compare {lt.value} with {rt.value}"))
+            return AttributeType.BOOL
+        if AttributeType.BOOL in (lt, rt):
+            if lt != rt:
+                self.issues.append((
+                    "SL104", f"cannot compare {lt.value} with {rt.value}"))
+            return AttributeType.BOOL
+        if not (isinstance(expr.left, Constant)
+                or isinstance(expr.right, Constant)):
+            # literals adopt the column dtype (weak typing): only flag
+            # column-vs-column mixing
+            self._note_promotion(lt, rt, "comparison")
+        return AttributeType.BOOL
+
+    def _type_math(self, expr: MathExpression) -> Optional[AttributeType]:
+        lt, rt = self.type_of(expr.left), self.type_of(expr.right)
+        if lt is UNKNOWN or rt is UNKNOWN:
+            return UNKNOWN
+        if lt not in _NUMERIC or rt not in _NUMERIC:
+            self.issues.append((
+                "SL104",
+                f"cannot apply arithmetic ({expr.op.value}) to "
+                f"{lt.value}/{rt.value}"))
+            return UNKNOWN
+        if not (isinstance(expr.left, Constant)
+                or isinstance(expr.right, Constant)):
+            self._note_promotion(lt, rt, f"arithmetic ({expr.op.value})")
+        return _promote(lt, rt)
+
+    def _note_promotion(self, lt, rt, ctx: str) -> None:
+        """Integral/floating mixing silently promotes: long→float32/float64
+        loses precision above 2^24/2^53 (and DOUBLE itself maps to float32
+        on device by default — core/dtypes.py)."""
+        if (lt in _INTEGRAL) != (rt in _INTEGRAL):
+            big, small = (lt, rt) if _RANK[lt] >= _RANK[rt] else (rt, lt)
+            self.promotions.append(
+                f"{ctx} mixes {small.value} with {big.value}: the "
+                f"{'long' if AttributeType.LONG in (lt, rt) else 'int'} side "
+                f"silently promotes to {big.value} "
+                f"(float32 on device unless config.double_dtype is widened)")
+
+    def _type_function(self, expr: AttributeFunction) -> Optional[AttributeType]:
+        arg_types = [self.type_of(p) for p in expr.parameters]
+        name = expr.name
+        full = expr.full_name.lower()
+        if full in ("eventtimestamp", "currenttimemillis", "count",
+                    "distinctcount", "hll:distinctcount", "sizeofset"):
+            return AttributeType.LONG
+        if full in ("avg", "stddev", "math:sqrt", "math:log", "math:exp",
+                    "math:sin", "math:cos", "math:power"):
+            return AttributeType.DOUBLE
+        if full == "uuid":
+            return AttributeType.STRING
+        if full.startswith("instanceof"):
+            return AttributeType.BOOL
+        if full in ("and", "or"):
+            return AttributeType.BOOL
+        if full == "sum":
+            if arg_types and arg_types[0] in _INTEGRAL:
+                return AttributeType.LONG
+            if arg_types and arg_types[0] in _FLOATING:
+                return AttributeType.DOUBLE
+            return UNKNOWN
+        if full in ("min", "max", "minforever", "maxforever", "math:abs",
+                    "math:floor", "math:ceil", "math:round"):
+            return arg_types[0] if arg_types else UNKNOWN
+        if full in ("maximum", "minimum"):
+            out = arg_types[0] if arg_types else UNKNOWN
+            for t in arg_types[1:]:
+                out = _promote(out, t) if (out and t) else UNKNOWN
+            return out
+        if full in ("convert", "cast") and len(expr.parameters) >= 2:
+            target = expr.parameters[1]
+            if isinstance(target, Constant) and isinstance(target.value, str):
+                try:
+                    return AttributeType.parse(target.value)
+                except ValueError:
+                    return UNKNOWN
+            return UNKNOWN
+        if full == "ifthenelse" and len(arg_types) == 3:
+            cond = arg_types[0]
+            if cond is not UNKNOWN and cond != AttributeType.BOOL:
+                self.issues.append((
+                    "SL104",
+                    f"ifThenElse condition must be bool, got {cond.value}"))
+            a, b = arg_types[1], arg_types[2]
+            if a is UNKNOWN or b is UNKNOWN:
+                return UNKNOWN
+            return a if a == b else _promote(a, b)
+        if full == "coalesce":
+            return arg_types[0] if arg_types else UNKNOWN
+        _ = name
+        return UNKNOWN  # extension/script function: stay open
+
+
+# ---------------------------------------------------------------- the build
+
+
+def _declared_schemas(app: SiddhiApp) -> dict[str, StreamSchema]:
+    schemas: dict[str, StreamSchema] = {}
+
+    def attrs_of(defn) -> dict:
+        return {a.name: a.type for a in defn.attributes}
+
+    for sid, d in app.stream_definitions.items():
+        schemas[sid] = StreamSchema(sid, "stream", attrs_of(d), d)
+    for tid, d in app.table_definitions.items():
+        schemas[tid] = StreamSchema(tid, "table", attrs_of(d), d)
+    for wid, d in app.window_definitions.items():
+        schemas[wid] = StreamSchema(wid, "window", attrs_of(d), d)
+    for gid, d in app.trigger_definitions.items():
+        # a trigger IS a stream of (triggered_time long) — core/trigger.py
+        schemas[gid] = StreamSchema(
+            gid, "trigger", {"triggered_time": AttributeType.LONG}, d)
+    for aid, d in app.aggregation_definitions.items():
+        schemas[aid] = StreamSchema(aid, "aggregation", None, d)
+    return schemas
+
+
+def _frames_for(node: QueryNode, plan: PlanGraph) -> dict[str, Optional[dict]]:
+    """Reference-id → attrs frames for one query's expressions."""
+    frames: dict[str, Optional[dict]] = {}
+    for c in node.consumed:
+        schema = plan.schemas.get(c.stream_id)
+        attrs = None if schema is None else schema.attrs
+        # stream functions (#fn) may rewrite the column set → open frame
+        h = c.single.handlers
+        if h.pre_window_functions or h.post_window_functions:
+            attrs = None
+        frames[c.single.alias or c.stream_id] = attrs
+    # join `on` clauses may also address the underlying ids
+    for c in node.consumed:
+        if c.single.alias and c.stream_id not in frames:
+            schema = plan.schemas.get(c.stream_id)
+            frames[c.stream_id] = None if schema is None else schema.attrs
+    return frames
+
+
+def _output_schema(node: QueryNode, plan: PlanGraph) -> Optional[dict]:
+    """Static select-list schema for an INSERT target (None = open)."""
+    sel = node.query.selector
+    frames = _frames_for(node, plan)
+    if sel.is_select_all:
+        if len(node.consumed) == 1:
+            return frames.get(node.consumed[0].single.alias
+                              or node.consumed[0].stream_id)
+        return None  # join/pattern select *: runtime concatenation order
+    typer = ExprTyper(frames)
+    out: dict[str, Optional[AttributeType]] = {}
+    for attr in sel.attributes:
+        out[attr.rename] = typer.type_of(attr.expression)
+    return out
+
+
+def build_plan(app: SiddhiApp) -> PlanGraph:
+    plan = PlanGraph(app=app, suppressions=Suppressions(app))
+    plan.schemas = _declared_schemas(app)
+
+    # collect queries (partition inners included) in source order
+    idx = 0
+    for element in app.execution_elements:
+        if isinstance(element, Query):
+            qs = [(element, None)]
+        elif isinstance(element, Partition):
+            qs = [(q, element) for q in element.queries]
+        else:
+            qs = []
+        for q, part in qs:
+            name = q.name or f"query_{idx}"
+            plan.queries.append(QueryNode(
+                query=q, name=name, explicit_name=q.name is not None,
+                index=idx, partition=part, consumed=consumed_streams(q)))
+            idx += 1
+
+    # producer edges + derived schemas, iterated to a fixpoint so queries
+    # may consume streams produced further down the file
+    for node in plan.queries:
+        out = node.query.output_stream
+        if out.action.value == "insert" and out.target_id:
+            node.produces = out.target_id
+            node.produces_fault = out.is_fault
+            plan.producers.setdefault(out.target_id, []).append(node)
+        for c in node.consumed:
+            plan.consumers.setdefault(c.stream_id, []).append(node)
+
+    for _ in range(max(len(plan.queries), 1)):
+        changed = False
+        for node in plan.queries:
+            target = node.produces
+            if (not target or node.produces_fault
+                    or target in plan.schemas):
+                continue
+            attrs = _output_schema(node, plan)
+            plan.schemas[target] = StreamSchema(target, "derived", attrs)
+            changed = True
+        if not changed:
+            break
+
+    return plan

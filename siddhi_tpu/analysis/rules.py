@@ -1,0 +1,511 @@
+"""The lint rule catalog (SL1xx: static plan rules).
+
+Each rule is a function over a PlanGraph that yields Diagnostics. Rules run
+inside a guard — a crashing rule is dropped (and logged at debug), never
+surfaced to app creation — and every finding passes the suppression filter
+(`@suppress.lint('SL101', ...)` on the element or the app) before it lands
+in the report.
+
+Severity policy: ERROR marks defects that build fine but are wrong at
+runtime (silent query shadowing, dead fault wiring) or that creation would
+reject anyway; WARN marks unbounded-state and config hazards; INFO marks
+performance footnotes (silent numeric promotion, pad-back copies).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Callable, Iterable, Optional
+
+from ..query_api.definition import AttributeType
+from ..query_api.execution import (
+    EveryStateElement,
+    JoinInputStream,
+    LogicalStateElement,
+    NextStateElement,
+    CountStateElement,
+    Query,
+    StateInputStream,
+)
+from ..query_api.expression import (
+    And,
+    Compare,
+    CompareOp,
+    Constant,
+    Expression,
+    Not,
+    Or,
+)
+from .diagnostics import Diagnostic, LintReport, Severity
+from .plan import ExprTyper, PlanGraph, QueryNode, _frames_for, _output_schema
+
+log = logging.getLogger("siddhi_tpu.lint")
+
+#: (rule_id, severity, checker, one-line description) — docs/LINT.md mirrors
+#: this table
+RULES: list[tuple[str, Severity, Callable, str]] = []
+
+
+def rule(rule_id: str, severity: Severity, description: str):
+    def deco(fn):
+        RULES.append((rule_id, severity, fn, description))
+        return fn
+    return deco
+
+
+def run_rules(plan: PlanGraph, report: LintReport) -> None:
+    for rule_id, severity, fn, _desc in RULES:
+        try:
+            findings = fn(plan) or ()
+        except Exception:
+            log.debug("lint rule %s crashed; skipped", rule_id, exc_info=True)
+            continue
+        for element, message, anchor, loc in findings:
+            if plan.suppressions.is_suppressed(rule_id, anchor):
+                continue
+            report.add(Diagnostic(rule_id, severity, message,
+                                  element=element, loc=loc))
+
+
+def _q(node: QueryNode, message: str):
+    """Finding anchored at a query."""
+    return (node.name, message, node.query, node.loc)
+
+
+def _d(name: str, defn, message: str):
+    """Finding anchored at a definition."""
+    return (name, message, defn, getattr(defn, "loc", None))
+
+
+# ------------------------------------------------------------- SL101 / SL102
+
+
+@rule("SL101", Severity.ERROR,
+      "a query consumes a stream that is neither defined nor produced")
+def undefined_stream(plan: PlanGraph) -> Iterable:
+    for node in plan.queries:
+        for c in node.consumed:
+            if c.stream_id in plan.schemas:
+                continue
+            if c.is_fault:
+                continue  # base-stream existence is SL111's concern
+            kind = "partition inner stream" if c.is_inner else "stream"
+            yield _q(node, f"{kind} {c.stream_id!r} is not defined and no "
+                           "query inserts into it")
+
+
+@rule("SL102", Severity.WARN,
+      "a defined stream is fully disconnected (no producer, consumer, "
+      "@source or @sink)")
+def unused_stream(plan: PlanGraph) -> Iterable:
+    if not plan.queries:
+        return  # definition-only apps feed everything externally
+    for sid, schema in plan.schemas.items():
+        if schema.kind != "stream" or schema.defn is None:
+            continue
+        d = schema.defn
+        if sid in plan.consumers or sid in plan.producers:
+            continue
+        if any(a.name.lower() in ("source", "sink", "export", "import")
+               for a in d.annotations or ()):
+            continue
+        yield _d(sid, d, f"stream {sid!r} is never consumed or produced by "
+                         "any query and has no @source/@sink")
+
+
+# ------------------------------------------------- SL103 / SL104 / SL105
+
+
+def _filter_exprs(node: QueryNode):
+    for c in node.consumed:
+        h = c.single.handlers
+        for f in h.filters:
+            yield f, (c.single.alias or c.stream_id)
+        for f in h.post_window_filters:
+            yield f, (c.single.alias or c.stream_id)
+
+
+def _type_check(node: QueryNode, plan: PlanGraph):
+    """One typing pass per query: returns (issues, promotions)."""
+    frames = _frames_for(node, plan)
+    typer = ExprTyper(frames)
+
+    for f, _ref in _filter_exprs(node):
+        t = typer.type_of(f)
+        if t is not None and t != AttributeType.BOOL:
+            typer.issues.append(
+                ("SL104", f"filter expression must be bool, got {t.value}"))
+
+    ins = node.query.input_stream
+    if isinstance(ins, JoinInputStream) and ins.on is not None:
+        t = typer.type_of(ins.on)
+        if t is not None and t != AttributeType.BOOL:
+            typer.issues.append(
+                ("SL104", f"join `on` condition must be bool, got {t.value}"))
+
+    sel = node.query.selector
+    for attr in sel.attributes:
+        typer.type_of(attr.expression)
+    for v in sel.group_by:
+        typer.type_of(v)
+
+    # having / order by see the select list's output columns too
+    out_attrs = _output_schema(node, plan)
+    post_frames = dict(frames)
+    post_frames["#out"] = out_attrs
+    post = ExprTyper(post_frames)
+    if sel.having is not None:
+        t = post.type_of(sel.having)
+        if t is not None and t != AttributeType.BOOL:
+            post.issues.append(
+                ("SL104", f"having condition must be bool, got {t.value}"))
+    for ob in sel.order_by:
+        post.type_of(ob.variable)
+
+    # delete/update ... on <cond> additionally sees the target table
+    out = node.query.output_stream
+    if out.on_condition is not None and out.target_id:
+        tbl = plan.schemas.get(out.target_id)
+        cond_frames = dict(frames)
+        cond_frames[out.target_id] = tbl.attrs if tbl else None
+        ct = ExprTyper(cond_frames)
+        t = ct.type_of(out.on_condition)
+        if t is not None and t != AttributeType.BOOL:
+            ct.issues.append(
+                ("SL104", f"`on` condition must be bool, got {t.value}"))
+        typer.issues.extend(ct.issues)
+        typer.promotions.extend(ct.promotions)
+
+    typer.issues.extend(post.issues)
+    typer.promotions.extend(post.promotions)
+    return typer.issues, typer.promotions
+
+
+def _typing_findings(plan: PlanGraph, want_code: str, promotions: bool = False):
+    for node in plan.queries:
+        issues, promos = _type_check(node, plan)
+        if promotions:
+            for msg in promos:
+                yield _q(node, msg)
+        else:
+            seen = set()
+            for code, msg in issues:
+                if code == want_code and msg not in seen:
+                    seen.add(msg)
+                    yield _q(node, msg)
+
+
+@rule("SL103", Severity.ERROR,
+      "an expression references an attribute its input streams do not define")
+def undefined_attribute(plan: PlanGraph) -> Iterable:
+    yield from _typing_findings(plan, "SL103")
+
+
+@rule("SL104", Severity.ERROR,
+      "expression dtype mismatch (non-bool filter, string arithmetic, "
+      "string ordering, bool/numeric comparison)")
+def type_mismatch(plan: PlanGraph) -> Iterable:
+    yield from _typing_findings(plan, "SL104")
+
+
+@rule("SL105", Severity.INFO,
+      "silent numeric promotion: integral and floating operands mix, the "
+      "integral side loses precision on device")
+def silent_promotion(plan: PlanGraph) -> Iterable:
+    for node in plan.queries:
+        _issues, promos = _type_check(node, plan)
+        for msg in dict.fromkeys(promos):
+            yield _q(node, msg)
+
+
+# --------------------------------------------------- SL106 / SL107 / SL108
+
+
+@rule("SL106", Severity.WARN,
+      "join over a raw (unwindowed) stream retains every event forever")
+def unbounded_join(plan: PlanGraph) -> Iterable:
+    for node in plan.queries:
+        ins = node.query.input_stream
+        if not isinstance(ins, JoinInputStream):
+            continue
+        for side, label in ((ins.left, "left"), (ins.right, "right")):
+            schema = plan.schemas.get(side.stream_id)
+            kind = schema.kind if schema else "stream"
+            if kind in ("table", "window", "aggregation"):
+                continue  # bounded by the store's own retention
+            if side.handlers.window is None:
+                yield _q(node, f"{label} join side {side.stream_id!r} has no "
+                               "window: its join buffer grows without "
+                               "eviction (add #window.time/length or join a "
+                               "table)")
+
+
+def _has_every(state) -> bool:
+    if isinstance(state, EveryStateElement):
+        return True
+    if isinstance(state, NextStateElement):
+        return _has_every(state.state) or _has_every(state.next)
+    if isinstance(state, LogicalStateElement):
+        return _has_every(state.left) or _has_every(state.right)
+    if isinstance(state, CountStateElement):
+        return _has_every(state.element)
+    return False
+
+
+@rule("SL107", Severity.WARN,
+      "pattern with `every` but no `within`: partial matches re-arm and "
+      "accumulate unboundedly")
+def every_without_within(plan: PlanGraph) -> Iterable:
+    for node in plan.queries:
+        ins = node.query.input_stream
+        if not isinstance(ins, StateInputStream):
+            continue
+        if ins.within_ms is None and _has_every(ins.state):
+            yield _q(node, "`every` pattern has no `within` bound: every "
+                           "arrival re-arms the NFA and partial matches are "
+                           "never expired (add `within <time>`)")
+
+
+@rule("SL108", Severity.WARN,
+      "named window defined without a window spec never evicts")
+def window_without_eviction(plan: PlanGraph) -> Iterable:
+    for wid, d in plan.app.window_definitions.items():
+        if d.window is None:
+            yield _d(wid, d, f"define window {wid!r} carries no window "
+                             "specification: nothing is ever evicted")
+
+
+# --------------------------------------------------- SL109 / SL110 / SL111
+
+
+@rule("SL109", Severity.ERROR,
+      "two queries share an @info name: the later silently shadows the "
+      "earlier in runtime addressing")
+def shadowed_query(plan: PlanGraph) -> Iterable:
+    by_name: dict[str, list[QueryNode]] = {}
+    for node in plan.queries:
+        if node.explicit_name:
+            by_name.setdefault(node.name, []).append(node)
+    for name, nodes in by_name.items():
+        for later in nodes[1:]:
+            yield _q(later, f"query name {name!r} is already used by an "
+                            "earlier query; callbacks and statistics "
+                            "addressed by name silently bind to only one "
+                            "of them")
+
+
+def _const_fold(expr: Expression):
+    """Fold constant boolean expressions; None = not statically known."""
+    if isinstance(expr, Constant):
+        if expr.type_name == "bool":
+            return bool(expr.value)
+        return None
+    if isinstance(expr, Not):
+        inner = _const_fold(expr.expression)
+        return None if inner is None else not inner
+    if isinstance(expr, And):
+        l, r = _const_fold(expr.left), _const_fold(expr.right)
+        if l is False or r is False:
+            return False
+        if l is True and r is True:
+            return True
+        return None
+    if isinstance(expr, Or):
+        l, r = _const_fold(expr.left), _const_fold(expr.right)
+        if l is True or r is True:
+            return True
+        if l is False and r is False:
+            return False
+        return None
+    if isinstance(expr, Compare):
+        lc, rc = expr.left, expr.right
+        if not (isinstance(lc, Constant) and isinstance(rc, Constant)):
+            return None
+        lv, rv = lc.value, rc.value
+        if isinstance(lv, bool) != isinstance(rv, bool):
+            return None
+        if isinstance(lv, str) != isinstance(rv, str):
+            return None
+        try:
+            return {
+                CompareOp.EQUAL: lv == rv,
+                CompareOp.NOT_EQUAL: lv != rv,
+                CompareOp.GREATER_THAN: lv > rv,
+                CompareOp.GREATER_THAN_EQUAL: lv >= rv,
+                CompareOp.LESS_THAN: lv < rv,
+                CompareOp.LESS_THAN_EQUAL: lv <= rv,
+            }[expr.op]
+        except TypeError:
+            return None
+    return None
+
+
+@rule("SL110", Severity.WARN,
+      "a filter folds to constant false: the query can never emit")
+def dead_query(plan: PlanGraph) -> Iterable:
+    for node in plan.queries:
+        for f, ref in _filter_exprs(node):
+            if _const_fold(f) is False:
+                yield _q(node, f"filter on {ref!r} is constant false — the "
+                               "query is dead (no event can ever pass)")
+
+
+@rule("SL111", Severity.ERROR,
+      "fault-stream wiring (`!S`) without @OnError(action='STREAM') on S")
+def fault_wiring(plan: PlanGraph) -> Iterable:
+    def has_fault_stream(sid: str) -> bool:
+        schema = plan.schemas.get(sid)
+        d = schema.defn if schema else None
+        if d is None or not getattr(d, "annotations", None):
+            return False
+        for ann in d.annotations:
+            if ann.name.lower() == "onerror":
+                action = (ann.element("action") or "log")
+                return str(action).lower() == "stream"
+        return False
+
+    for node in plan.queries:
+        for c in node.consumed:
+            if c.is_fault and not has_fault_stream(c.stream_id):
+                yield _q(node, f"`from !{c.stream_id}` consumes a fault "
+                               f"stream, but {c.stream_id!r} does not "
+                               "declare @OnError(action='STREAM') so no "
+                               "fault stream exists")
+        out = node.query.output_stream
+        if node.produces_fault and not has_fault_stream(node.produces):
+            yield _q(node, f"`insert into !{out.target_id}` targets a fault "
+                           f"stream, but {out.target_id!r} does not declare "
+                           "@OnError(action='STREAM')")
+
+
+# ------------------------------------------------------------------- SL112
+
+
+_TIME_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*"
+                      r"(ms|milli\w*|sec\w*|min\w*|hour\w*|day\w*)?\s*$",
+                      re.IGNORECASE)
+_TIME_MS = {"ms": 1, "milli": 1, "sec": 1000, "min": 60_000,
+            "hour": 3_600_000, "day": 86_400_000}
+
+
+def _ann_time_ms(text: str) -> Optional[float]:
+    m = _TIME_RE.match(str(text))
+    if not m:
+        return None
+    value = float(m.group(1))
+    unit = (m.group(2) or "ms").lower()
+    for prefix, ms in _TIME_MS.items():
+        if unit.startswith(prefix):
+            return value * ms
+    return value
+
+
+@rule("SL112", Severity.ERROR,
+      "nonsensical @Async/@breaker configuration (inverted watermarks, "
+      "threshold < 1, max.staged < buffer.size, unknown overflow policy)")
+def bad_backpressure_config(plan: PlanGraph) -> Iterable:
+    for sid, schema in plan.schemas.items():
+        d = schema.defn
+        if d is None or not getattr(d, "annotations", None):
+            continue
+        ann = next((a for a in d.annotations
+                    if a.name.lower() == "async"), None)
+        if ann is None:
+            continue
+
+        def num(key):
+            v = ann.element(key)
+            try:
+                return float(v) if v is not None else None
+            except (TypeError, ValueError):
+                return None
+
+        buf = num("buffer.size")
+        if buf is not None and buf <= 0:
+            yield _d(sid, d, f"@Async on {sid!r}: buffer.size must be "
+                             "positive")
+        staged = num("max.staged")
+        if buf is not None and staged is not None and staged < buf:
+            yield _d(sid, d, f"@Async on {sid!r}: max.staged ({staged:g}) "
+                             f"must be >= buffer.size ({buf:g})")
+        pol = ann.element("overflow.policy")
+        if pol is not None and str(pol).lower() not in (
+                "block", "drop.new", "drop.old", "fault"):
+            yield _d(sid, d, f"@Async on {sid!r}: unknown overflow.policy "
+                             f"{pol!r} (block | drop.new | drop.old | fault)")
+        hw = num("high.watermark")
+        lw = num("low.watermark")
+        hw_v = 0.8 if hw is None else hw
+        lw_v = 0.2 if lw is None else lw
+        if (hw is not None or lw is not None) and not (
+                0.0 <= lw_v < hw_v <= 1.0):
+            yield _d(sid, d, f"@Async on {sid!r}: watermarks must satisfy "
+                             f"0 <= low.watermark ({lw_v:g}) < "
+                             f"high.watermark ({hw_v:g}) <= 1")
+
+    for node in plan.queries:
+        ann = next((a for a in node.query.annotations
+                    if a.name.lower() == "breaker"), None)
+        if ann is None:
+            continue
+        thr = ann.element("threshold")
+        if thr is not None:
+            try:
+                if int(str(thr)) < 1:
+                    yield _q(node, f"@breaker threshold ({thr}) must be "
+                                   ">= 1 — a breaker that trips on zero "
+                                   "failures never closes")
+            except ValueError:
+                yield _q(node, f"@breaker threshold {thr!r} is not an "
+                               "integer")
+        for key in ("window", "cooldown"):
+            v = ann.element(key)
+            if v is None:
+                continue
+            ms = _ann_time_ms(v)
+            if ms is None:
+                yield _q(node, f"@breaker {key} {v!r} is not a time "
+                               "literal (e.g. '30 sec')")
+            elif ms <= 0:
+                yield _q(node, f"@breaker {key} must be positive, got {v!r}")
+
+
+# ------------------------------------------------------------------- SL113
+
+
+#: window names whose device implementation consumes variable-lane batches
+#: directly (ops/windows.py shape_polymorphic=True); every other window is
+#: shape-baked: bucketed batches pad back to full capacity before the step
+_SHAPE_POLYMORPHIC_WINDOWS = {"time"}
+
+
+@rule("SL113", Severity.WARN,
+      "shape buckets are enabled but the query's step is shape-baked: "
+      "every partial batch pads back to full capacity")
+def shape_bucket_padback(plan: PlanGraph) -> Iterable:
+    from ..core import dtypes
+    if not dtypes.config.shape_buckets:
+        return
+    for node in plan.queries:
+        for c in node.consumed:
+            if c.role != "single":
+                continue  # joins/patterns are shape-baked by design
+            w = c.single.handlers.window
+            if w is None:
+                continue  # pass-through is shape-polymorphic
+            if w.name in _SHAPE_POLYMORPHIC_WINDOWS:
+                continue
+            if w.name == "batch" and not w.parameters:
+                continue  # paramless batch lowers to pass-through
+            yield _q(node, f"#window.{w.name} is shape-baked while shape "
+                           "buckets are on: small batches pad back to the "
+                           "full batch capacity each step (copies, no "
+                           "per-bucket kernels); use #window.time for "
+                           "shape-polymorphic steps or set "
+                           "SIDDHI_SHAPE_BUCKETS=0")
+
+
+def check_query(query: Query) -> None:
+    """Hook for future per-query API use; kept minimal."""
+    _ = query

@@ -18,8 +18,27 @@ from .transformer import AstTransformer
 
 @functools.lru_cache(maxsize=1)
 def _parser() -> Lark:
+    # propagate_positions feeds tree meta (line/column) to the transformer,
+    # which stamps `loc` onto queries/definitions for lint diagnostics
     return Lark(GRAMMAR, parser="earley", lexer="dynamic", maybe_placeholders=False,
+                propagate_positions=True,
                 start=["start", "on_demand_query", "expression"])
+
+
+def _parse_error(e: UnexpectedInput, text: str) -> SiddhiParserError:
+    """UnexpectedInput → SiddhiParserError with line:column AND the offending
+    source snippet (lark's get_context: the line plus a caret marker)."""
+    line = getattr(e, "line", None)
+    column = getattr(e, "column", None)
+    if isinstance(line, int) and line < 1:  # UnexpectedEOF reports -1
+        # anchor end-of-input errors to the last source line instead
+        line = text.count("\n") + 1
+        column = len(text.rsplit("\n", 1)[-1]) + 1
+    try:
+        snippet = e.get_context(text)
+    except Exception:  # token-less errors have no position to excerpt
+        snippet = None
+    return SiddhiParserError(str(e).split("\n")[0], line, column, snippet)
 
 
 _VAR_PATTERN = re.compile(r"\$\{(\w+)\}")
@@ -57,9 +76,7 @@ def parse(siddhi_ql: str) -> SiddhiApp:
     try:
         tree = _parser().parse(siddhi_ql, start="start")
     except UnexpectedInput as e:
-        line = getattr(e, "line", None)
-        column = getattr(e, "column", None)
-        raise SiddhiParserError(str(e).split("\n")[0], line, column) from e
+        raise _parse_error(e, siddhi_ql) from e
     return _transform(tree)
 
 
@@ -70,8 +87,7 @@ def parse_on_demand_query(text: str):
     try:
         tree = _parser().parse(text, start="on_demand_query")
     except UnexpectedInput as e:
-        raise SiddhiParserError(str(e).split("\n")[0], getattr(e, "line", None),
-                                getattr(e, "column", None)) from e
+        raise _parse_error(e, text) from e
     return _transform(tree)
 
 
@@ -83,8 +99,7 @@ def parse_expression(text: str):
     try:
         tree = _parser().parse(text, start="expression")
     except UnexpectedInput as e:
-        raise SiddhiParserError(str(e).split("\n")[0], getattr(e, "line", None),
-                                getattr(e, "column", None)) from e
+        raise _parse_error(e, text) from e
     return _transform(tree)
 
 

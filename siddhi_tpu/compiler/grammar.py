@@ -24,7 +24,7 @@ execution_element: query ";"? | partition ";"?
 app_annotation.5: "@" APP_KW ":" NAME ("(" annotation_body? ")")?
 APP_KW: "app"i
 annotation: "@" qualified_name ("(" annotation_body? ")")?
-qualified_name: NAME (":" NAME)?
+qualified_name: NAME ((":"|".") NAME)?  // `:` and `.` both separate (`@suppress.lint`)
 annotation_body: annotation_item ("," annotation_item)*
 annotation_item: annotation | keyed_element | bare_element
 keyed_element: NAME ("." NAME)* "=" literal_value

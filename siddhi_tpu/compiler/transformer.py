@@ -106,6 +106,21 @@ class _Window:
         self.handler = handler
 
 
+def _loc(meta):
+    """Tree meta → (line, column), or None when positions are unavailable
+    (synthetic trees, or rules whose children were all inlined away)."""
+    try:
+        if meta is None or getattr(meta, "empty", True):
+            return None
+        return (meta.line, meta.column)
+    except AttributeError:
+        return None
+
+
+#: methods that also want the rule's source position (lint diagnostics)
+_with_meta = v_args(inline=True, meta=True)
+
+
 def _build_chain(handlers: list) -> HandlerChain:
     filters, pre_fns, post_fns, post_filters = [], [], [], []
     window = None
@@ -319,15 +334,19 @@ class AstTransformer(Transformer):
     def stream_id(self, tok):
         return str(tok)
 
-    def define_stream(self, *parts):
+    @_with_meta
+    def define_stream(self, meta, *parts):
         anns, rest = _split_annotations(parts)
         _define, _stream, name, attrs = rest
-        return StreamDefinition(id=str(name), attributes=attrs, annotations=anns)
+        return StreamDefinition(id=str(name), attributes=attrs, annotations=anns,
+                                loc=_loc(meta))
 
-    def define_table(self, *parts):
+    @_with_meta
+    def define_table(self, meta, *parts):
         anns, rest = _split_annotations(parts)
         _define, _table, name, attrs = rest
-        return TableDefinition(id=str(name), attributes=attrs, annotations=anns)
+        return TableDefinition(id=str(name), attributes=attrs, annotations=anns,
+                               loc=_loc(meta))
 
     def window_spec(self, name, *args):
         params = args[0] if args else ()
@@ -336,7 +355,8 @@ class AstTransformer(Transformer):
     def output_event_kw(self, _out, etype, _events):
         return etype
 
-    def define_window(self, *parts):
+    @_with_meta
+    def define_window(self, meta, *parts):
         anns, rest = _split_annotations(parts)
         _define, _window, name, attrs, *extra = rest
         window = None
@@ -347,7 +367,8 @@ class AstTransformer(Transformer):
             elif isinstance(e, OutputEventType):
                 out_type = e.name.lower()
         return WindowDefinition(id=str(name), attributes=attrs, annotations=anns,
-                                window=window, output_event_type=out_type)
+                                window=window, output_event_type=out_type,
+                                loc=_loc(meta))
 
     def trigger_every(self, _every, tv):
         return ("every", tv.value)
@@ -356,7 +377,8 @@ class AstTransformer(Transformer):
         s = _unquote(tok)
         return ("start", None) if s.lower() == "start" else ("cron", s)
 
-    def define_trigger(self, *parts):
+    @_with_meta
+    def define_trigger(self, meta, *parts):
         anns, rest = _split_annotations(parts)
         _define, _trigger, name, _at, at = rest
         kind, val = at
@@ -366,13 +388,16 @@ class AstTransformer(Transformer):
             at_cron=val if kind == "cron" else None,
             at_start=kind == "start",
             annotations=anns,
+            loc=_loc(meta),
         )
 
-    def define_function(self, *parts):
+    @_with_meta
+    def define_function(self, meta, *parts):
         anns, rest = _split_annotations(parts)
         _define, _function, name, lang, _ret, rtype, body = rest
         return FunctionDefinition(id=str(name), language=str(lang),
-                                  return_type=rtype, body=str(body)[1:-1].strip())
+                                  return_type=rtype, body=str(body)[1:-1].strip(),
+                                  loc=_loc(meta))
 
     def duration_name(self, tok):
         return Duration.parse(str(tok))
@@ -398,7 +423,8 @@ class AstTransformer(Transformer):
         durations = items[-1]
         return (by_attr, durations)
 
-    def define_aggregation(self, *parts):
+    @_with_meta
+    def define_aggregation(self, meta, *parts):
         anns, rest = _split_annotations(parts)
         _define, _aggregation, name, _from, stream, *clauses = rest
         selector = Selector()
@@ -417,7 +443,7 @@ class AstTransformer(Transformer):
             selector=Selector(attributes=selector.attributes,
                               group_by=group_by, having=selector.having),
             group_by=group_by, aggregate_attribute=by_attr,
-            durations=durations, annotations=anns)
+            durations=durations, annotations=anns, loc=_loc(meta))
 
     def definition(self, d):
         return d
@@ -441,7 +467,7 @@ class AstTransformer(Transformer):
         n = getattr(self, "_anon_n", 0)
         self._anon_n = n + 1
         name = f"_anon_{n}"
-        inner = self.query(*parts)
+        inner = self.query(None, *parts)
         if isinstance(inner, tuple) and inner and inner[0] == "queries":
             qs = list(inner[1])
             inner = qs.pop()
@@ -825,7 +851,8 @@ class AstTransformer(Transformer):
     def query_output(self, o):
         return o
 
-    def query(self, *parts):
+    @_with_meta
+    def query(self, meta, *parts):
         anns, rest = _split_annotations(parts)
         input_stream = None
         selector_parts = {"selector": Selector(), "group_by": (), "having": None,
@@ -864,7 +891,7 @@ class AstTransformer(Transformer):
         )
         q = Query(input_stream=input_stream, selector=selector,
                   output_stream=output_stream or OutputStream(OutputAction.RETURN),
-                  output_rate=output_rate, annotations=anns)
+                  output_rate=output_rate, annotations=anns, loc=_loc(meta))
         pending = getattr(self, "_pending_anon", None)
         if pending:
             # desugared anonymous-stream inner queries run before the query
@@ -987,7 +1014,8 @@ class AstTransformer(Transformer):
     def partition_item(self, item):
         return item
 
-    def partition(self, *parts):
+    @_with_meta
+    def partition(self, meta, *parts):
         anns, rest = _split_annotations(parts)
         ptypes = []
         queries = []
@@ -1002,7 +1030,7 @@ class AstTransformer(Transformer):
                     "anonymous streams are not supported inside partitions — "
                     "define the inner query as its own stream")
         return Partition(partition_types=tuple(ptypes), queries=tuple(queries),
-                         annotations=anns)
+                         annotations=anns, loc=_loc(meta))
 
     def execution_element(self, e):
         return e

@@ -40,6 +40,9 @@ class SiddhiAppRuntime:
                  wal_dir: Optional[str] = None,
                  persistence_interval_s: Optional[float] = None) -> None:
         self.app = app
+        #: LintReport attached by SiddhiManager's SIDDHI_LINT gate
+        #: (None when linting is off or the app was built directly)
+        self.lint_report = None
         #: AOT-compile every query's step ladder at start() (also
         #: SIDDHI_AOT_WARMUP=1) so the first real batch never pays
         #: first-compile latency — see warmup()

@@ -304,6 +304,16 @@ class Statistics:
                 }
             if breakers:
                 out["breakers"] = breakers
+            lint = getattr(runtime, "lint_report", None)
+            if lint is not None:
+                # what the SIDDHI_LINT gate saw at creation: rule counts +
+                # severity totals (full diagnostics via the lint CLI/REST)
+                out["lint"] = {
+                    "valid": not lint.has_errors,
+                    "errors": len(lint.errors),
+                    "warnings": len(lint.warnings),
+                    "rules": lint.rule_counts(),
+                }
         if self.detail:
             out["query_latency_ms"] = {
                 q: (t / c / 1e6 if c else 0.0)

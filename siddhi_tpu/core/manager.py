@@ -28,6 +28,9 @@ class SiddhiManager:
         self.error_store = None
         #: deployment config (reference: SiddhiManager.setConfigManager)
         self.config_manager = None
+        #: internal: the jaxpr lint pass builds sandbox runtimes through a
+        #: private manager and must not re-enter the lint gate
+        self._lint_enabled = True
 
     @staticmethod
     def _parse(app: Union[str, SiddhiApp]) -> SiddhiApp:
@@ -45,6 +48,7 @@ class SiddhiManager:
         wal_dir=None, persistence_interval_s=None,
     ) -> SiddhiAppRuntime:
         app = self._parse(app)
+        lint_report = self._lint_gate(app)
         rt = SiddhiAppRuntime(app, self.registry, batch_size=batch_size,
                               group_capacity=group_capacity,
                               error_store=self.error_store,
@@ -57,8 +61,50 @@ class SiddhiManager:
                               persistence_interval_s=persistence_interval_s)
         if self.persistence_store is not None:
             rt.persistence_store = self.persistence_store
+        rt.lint_report = lint_report
         self.runtimes[app.name] = rt
         return rt
+
+    def _lint_gate(self, app: SiddhiApp):
+        """Run the static linter per SIDDHI_LINT (error|warn|off, default
+        warn): `error` refuses apps with ERROR findings before any device
+        state is planned; `warn` logs and attaches the report; `off` skips.
+        The linter itself never raises — a crash in analysis is logged and
+        treated as `off` for this app."""
+        from ..analysis import analyze, lint_mode
+
+        mode = lint_mode()
+        if mode == "off" or not self._lint_enabled:
+            return None
+        try:
+            report = analyze(app)
+        except Exception:
+            import logging
+            logging.getLogger("siddhi_tpu.lint").debug(
+                "lint pass crashed; app %r proceeds unlinted",
+                app.name, exc_info=True)
+            return None
+        if report.has_errors and mode == "error":
+            raise SiddhiAppCreationError(
+                f"SIDDHI_LINT=error: app {app.name!r} has "
+                f"{len(report.errors)} lint error(s):\n" +
+                "\n".join(d.format() for d in report.sorted()))
+        if report.diagnostics:
+            import logging
+            log = logging.getLogger("siddhi_tpu.lint")
+            for d in report.sorted():
+                log.log({"error": 40, "warn": 30}.get(
+                    d.severity.value, 20), "%s: %s", app.name, d.format())
+        return report
+
+    def validate(self, app: Union[str, "SiddhiApp"], *,
+                 jaxpr: bool = False):
+        """Lint the app and return the LintReport WITHOUT creating a
+        runtime. With jaxpr=True also traces each query's compiled step
+        for host-sync/dtype hazards (slower: builds a sandbox plan)."""
+        from ..analysis import analyze
+
+        return analyze(self._parse(app), jaxpr=jaxpr)
 
     def validate_siddhi_app(self, app: Union[str, "SiddhiApp"]) -> None:
         """Parse AND plan the app, then discard it — surfacing every

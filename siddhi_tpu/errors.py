@@ -25,12 +25,18 @@ class DefinitionNotExistError(SiddhiAppValidationError):
 
 class SiddhiParserError(SiddhiError):
     """Syntax error with line/column context (reference:
-    siddhi-query-compiler/.../exception/SiddhiParserException.java)."""
+    siddhi-query-compiler/.../exception/SiddhiParserException.java).
 
-    def __init__(self, message: str, line: int | None = None, column: int | None = None):
-        self.line, self.column = line, column
+    `snippet` carries the offending source line with a caret marker; lint
+    diagnostics (analysis/diagnostics.py) reuse the same " at line L:C"
+    location format so every tool reports positions identically."""
+
+    def __init__(self, message: str, line: int | None = None,
+                 column: int | None = None, snippet: str | None = None):
+        self.line, self.column, self.snippet = line, column, snippet
         loc = f" at line {line}:{column}" if line is not None else ""
-        super().__init__(f"{message}{loc}")
+        ctx = f"\n{snippet.rstrip()}" if snippet else ""
+        super().__init__(f"{message}{loc}{ctx}")
 
 
 class SiddhiAppRuntimeError(SiddhiError):

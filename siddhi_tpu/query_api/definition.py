@@ -61,6 +61,9 @@ class AbstractDefinition:
     id: str
     attributes: tuple[Attribute, ...] = ()
     annotations: tuple[Annotation, ...] = ()
+    #: (line, column) of the `define ...` in the source text; metadata only —
+    #: excluded from equality so AST comparisons ignore formatting
+    loc: Optional[tuple] = field(default=None, compare=False, repr=False)
 
     @property
     def attribute_names(self) -> tuple[str, ...]:
@@ -140,6 +143,7 @@ class TriggerDefinition:
     at_cron: Optional[str] = None  # cron expression
     at_start: bool = False
     annotations: tuple[Annotation, ...] = ()
+    loc: Optional[tuple] = field(default=None, compare=False, repr=False)
 
 
 @dataclass(frozen=True)
@@ -152,6 +156,7 @@ class FunctionDefinition:
     language: str
     return_type: AttributeType
     body: str
+    loc: Optional[tuple] = field(default=None, compare=False, repr=False)
 
 
 # --- Incremental aggregation ---------------------------------------------------
@@ -209,3 +214,4 @@ class AggregationDefinition:
     aggregate_attribute: Optional[str] = None  # `aggregate by <attr>`; None = arrival ts
     durations: tuple[Duration, ...] = ()
     annotations: tuple[Annotation, ...] = ()
+    loc: Optional[tuple] = field(default=None, compare=False, repr=False)

@@ -268,6 +268,8 @@ class Query:
     output_stream: OutputStream = field(default_factory=lambda: OutputStream(OutputAction.RETURN))
     output_rate: Optional[OutputRate] = None
     annotations: tuple[Annotation, ...] = ()
+    #: (line, column) of the `from ...` clause; metadata only, never compared
+    loc: Optional[tuple] = field(default=None, compare=False, repr=False)
 
     @property
     def name(self) -> Optional[str]:
@@ -313,6 +315,7 @@ class Partition:
     partition_types: tuple[PartitionType, ...]
     queries: tuple[Query, ...]
     annotations: tuple[Annotation, ...] = ()
+    loc: Optional[tuple] = field(default=None, compare=False, repr=False)
 
 
 @dataclass(frozen=True)

@@ -119,6 +119,14 @@ class SiddhiService:
         with self.lock:
             return self.manager.runtimes[app].recover()
 
+    def validate(self, siddhi_ql: str) -> dict:
+        """Static lint WITHOUT deploying (no runtime is created, nothing
+        starts): the CLI's report shape over HTTP. Parse failures come back
+        as an SL000 diagnostic in the same shape, not an HTTP error."""
+        from .lint import lint_text
+        report = lint_text(siddhi_ql)
+        return report.to_dict()
+
     def health(self) -> dict:
         """Liveness: no lock — the process answering IS the signal (a
         liveness probe must not hang behind a long deploy)."""
@@ -204,6 +212,8 @@ class SiddhiService:
                     if parts == ["siddhi-apps"]:
                         name = service.deploy(self._body())
                         self._reply(201, {"app": name})
+                    elif parts == ["siddhi-apps", "validate"]:
+                        self._reply(200, service.validate(self._body()))
                     elif (len(parts) == 4 and parts[0] == "siddhi-apps"
                           and parts[2] == "streams"):
                         data = json.loads(self._body())
