@@ -751,6 +751,384 @@ ring_size(PyObject *self, PyObject *args)
     return PyLong_FromSize_t(atomic_load(&r->head) - r->tail);
 }
 
+/* ------------------------------------------------------------------------
+ * Lock-free multi-producer COLUMNAR ring — the zero-copy ingress stage.
+ *
+ * Where the MPSC ring above stages PyObject* rows (decoded under the GIL by
+ * the feeder), this ring stages raw columnar bytes: fixed-width native
+ * buffers, one per attribute (string attrs as pre-interned int32 dictionary
+ * codes). Producers claim a contiguous run of slots with one CAS
+ * (claim-then-write, Disruptor-style, so parallel encode workers can fill
+ * their runs out of order while consumption stays in claim order), write
+ * with the GIL RELEASED (the payload is plain memory — memcpy needs no
+ * interpreter), and publish per-slot sequence stamps. One consumer copies
+ * contiguous published runs out into caller buffers, also without the GIL.
+ *
+ * Slot sequence entries are cache-line padded: adjacent slots are published
+ * by different producer threads, and false sharing on the seq array is the
+ * classic scalability cliff for exactly this structure.
+ * ---------------------------------------------------------------------- */
+
+#define COLRING_MAX_COLS 64
+
+typedef struct {
+    atomic_size_t v;
+    char pad[64 - sizeof(atomic_size_t)];
+} padded_seq;
+
+typedef struct {
+    size_t cap;               /* power of two */
+    size_t mask;
+    int n_cols;
+    Py_ssize_t widths[COLRING_MAX_COLS];
+    char *cols[COLRING_MAX_COLS];   /* cap * width bytes each */
+    int64_t *ts;
+    padded_seq *seq;          /* published when seq[i & mask] == i + 1 */
+    atomic_size_t head;       /* next slot to claim (producers, CAS) */
+    char pad1[64 - sizeof(atomic_size_t)];
+    atomic_size_t tail;       /* next slot to read (single consumer) */
+    char pad2[64 - sizeof(atomic_size_t)];
+    atomic_size_t hwm;        /* claimed-depth high-water mark */
+} colring;
+
+static void
+colring_capsule_destruct(PyObject *capsule)
+{
+    colring *r = (colring *)PyCapsule_GetPointer(capsule, "siddhi.colring");
+    if (r == NULL)
+        return;
+    for (int c = 0; c < r->n_cols; c++)
+        PyMem_Free(r->cols[c]);
+    PyMem_Free(r->ts);
+    PyMem_Free(r->seq);
+    PyMem_Free(r);
+}
+
+static Py_ssize_t
+colring_width(char tc)
+{
+    switch (tc) {
+    case 'b': return 1;
+    case 'i': return 4;
+    case 'l': return 8;
+    case 'f': return 4;
+    case 'd': return 8;
+    case 's': return 4;  /* pre-interned int32 dictionary codes */
+    default:  return 0;
+    }
+}
+
+/* colring_new(capacity, typecodes: bytes) -> capsule */
+static PyObject *
+colring_new(PyObject *self, PyObject *args)
+{
+    Py_ssize_t cap_req;
+    PyObject *typecodes_obj;
+    if (!PyArg_ParseTuple(args, "nS", &cap_req, &typecodes_obj))
+        return NULL;
+    if (cap_req < 1) {
+        PyErr_SetString(PyExc_ValueError, "colring capacity must be >= 1");
+        return NULL;
+    }
+    Py_ssize_t n_cols = PyBytes_GET_SIZE(typecodes_obj);
+    if (n_cols > COLRING_MAX_COLS) {
+        PyErr_Format(PyExc_ValueError, "colring supports at most %d columns",
+                     COLRING_MAX_COLS);
+        return NULL;
+    }
+    size_t cap = 1;
+    while (cap < (size_t)cap_req)
+        cap <<= 1;
+    colring *r = PyMem_Calloc(1, sizeof(colring));
+    if (r == NULL)
+        return PyErr_NoMemory();
+    r->cap = cap;
+    r->mask = cap - 1;
+    r->n_cols = (int)n_cols;
+    atomic_init(&r->head, 0);
+    atomic_init(&r->tail, 0);
+    atomic_init(&r->hwm, 0);
+    const char *tcs = PyBytes_AS_STRING(typecodes_obj);
+    r->ts = PyMem_Malloc(cap * sizeof(int64_t));
+    r->seq = PyMem_Calloc(cap, sizeof(padded_seq));
+    if (r->ts == NULL || r->seq == NULL) {
+        PyErr_NoMemory();
+        goto fail;
+    }
+    for (Py_ssize_t c = 0; c < n_cols; c++) {
+        Py_ssize_t w = colring_width(tcs[c]);
+        if (w == 0) {
+            PyErr_Format(PyExc_ValueError, "bad type code %c", tcs[c]);
+            goto fail;
+        }
+        r->widths[c] = w;
+        r->cols[c] = PyMem_Malloc(cap * (size_t)w);
+        if (r->cols[c] == NULL) {
+            PyErr_NoMemory();
+            goto fail;
+        }
+    }
+    return PyCapsule_New(r, "siddhi.colring", colring_capsule_destruct);
+
+fail:
+    for (Py_ssize_t k = 0; k < n_cols; k++)
+        PyMem_Free(r->cols[k]);  /* calloc'd struct: unset slots are NULL */
+    PyMem_Free(r->ts);
+    PyMem_Free(r->seq);
+    PyMem_Free(r);
+    return NULL;
+}
+
+static colring *
+colring_of(PyObject *capsule)
+{
+    return (colring *)PyCapsule_GetPointer(capsule, "siddhi.colring");
+}
+
+/* colring_claim(ring, n) -> start index, or -1 when the ring lacks n free
+ * slots (all-or-nothing; the caller spins/backpressures). One CAS claims
+ * the whole contiguous run — claim order IS delivery order, which is what
+ * makes parallel out-of-order encode workers deterministic downstream. */
+static PyObject *
+colring_claim(PyObject *self, PyObject *args)
+{
+    PyObject *capsule;
+    Py_ssize_t n;
+    if (!PyArg_ParseTuple(args, "On", &capsule, &n))
+        return NULL;
+    colring *r = colring_of(capsule);
+    if (r == NULL)
+        return NULL;
+    if (n < 1 || (size_t)n > r->cap) {
+        PyErr_Format(PyExc_ValueError,
+                     "colring_claim: n=%zd out of range (cap %zu)",
+                     n, r->cap);
+        return NULL;
+    }
+    size_t h = atomic_load_explicit(&r->head, memory_order_relaxed);
+    for (;;) {
+        size_t t = atomic_load_explicit(&r->tail, memory_order_acquire);
+        if (h + (size_t)n - t > r->cap)
+            return PyLong_FromLong(-1); /* insufficient free space */
+        if (atomic_compare_exchange_weak_explicit(
+                &r->head, &h, h + (size_t)n,
+                memory_order_acq_rel, memory_order_relaxed)) {
+            size_t depth = h + (size_t)n - t;
+            size_t hwm = atomic_load_explicit(&r->hwm, memory_order_relaxed);
+            while (depth > hwm &&
+                   !atomic_compare_exchange_weak_explicit(
+                       &r->hwm, &hwm, depth,
+                       memory_order_relaxed, memory_order_relaxed))
+                ;
+            return PyLong_FromUnsignedLongLong((unsigned long long)h);
+        }
+    }
+}
+
+/* colring_write(ring, start, n, ts_buf: int64[n], cols: tuple[buffer]) —
+ * copy one claimed run into the ring and publish it. The copies run with
+ * the GIL released; string columns arrive here already interned to int32
+ * codes (interning is the only stage that still batch-acquires the GIL,
+ * in the worker pool above this). */
+static PyObject *
+colring_write(PyObject *self, PyObject *args)
+{
+    PyObject *capsule, *ts_obj, *cols;
+    unsigned long long start;
+    Py_ssize_t n;
+    if (!PyArg_ParseTuple(args, "OKnOO!", &capsule, &start, &n, &ts_obj,
+                          &PyTuple_Type, &cols))
+        return NULL;
+    colring *r = colring_of(capsule);
+    if (r == NULL)
+        return NULL;
+    if (PyTuple_GET_SIZE(cols) != r->n_cols) {
+        PyErr_Format(PyExc_ValueError, "colring_write: expected %d columns",
+                     r->n_cols);
+        return NULL;
+    }
+    Py_buffer ts_buf;
+    Py_buffer bufs[COLRING_MAX_COLS];
+    if (PyObject_GetBuffer(ts_obj, &ts_buf, PyBUF_C_CONTIGUOUS) < 0)
+        return NULL;
+    if (ts_buf.len < n * (Py_ssize_t)sizeof(int64_t)) {
+        PyErr_SetString(PyExc_ValueError, "colring_write: ts buffer short");
+        PyBuffer_Release(&ts_buf);
+        return NULL;
+    }
+    int acquired = 0;
+    for (; acquired < r->n_cols; acquired++) {
+        if (PyObject_GetBuffer(PyTuple_GET_ITEM(cols, acquired),
+                               &bufs[acquired], PyBUF_C_CONTIGUOUS) < 0)
+            goto fail;
+        if (bufs[acquired].len < n * r->widths[acquired]) {
+            PyErr_Format(PyExc_ValueError,
+                         "colring_write: column %d buffer short", acquired);
+            acquired++;
+            goto fail;
+        }
+    }
+    Py_BEGIN_ALLOW_THREADS
+    {
+        size_t s0 = (size_t)start & r->mask;
+        size_t first = r->cap - s0;          /* slots before wrap */
+        if (first > (size_t)n)
+            first = (size_t)n;
+        size_t second = (size_t)n - first;
+        memcpy(r->ts + s0, ts_buf.buf, first * sizeof(int64_t));
+        if (second)
+            memcpy(r->ts, (const int64_t *)ts_buf.buf + first,
+                   second * sizeof(int64_t));
+        for (int c = 0; c < r->n_cols; c++) {
+            size_t w = (size_t)r->widths[c];
+            const char *src = (const char *)bufs[c].buf;
+            memcpy(r->cols[c] + s0 * w, src, first * w);
+            if (second)
+                memcpy(r->cols[c], src + first * w, second * w);
+        }
+        /* publish AFTER the data: release stores pair with the consumer's
+         * acquire loads, slot by slot */
+        for (size_t i = 0; i < (size_t)n; i++)
+            atomic_store_explicit(&r->seq[((size_t)start + i) & r->mask].v,
+                                  (size_t)start + i + 1,
+                                  memory_order_release);
+    }
+    Py_END_ALLOW_THREADS
+    for (int i = 0; i < acquired; i++)
+        PyBuffer_Release(&bufs[i]);
+    PyBuffer_Release(&ts_buf);
+    Py_RETURN_NONE;
+
+fail:
+    for (int i = 0; i < acquired; i++)
+        PyBuffer_Release(&bufs[i]);
+    PyBuffer_Release(&ts_buf);
+    return NULL;
+}
+
+/* colring_pop(ring, max_n, ts_out: int64 buffer, cols_out: tuple[buffer])
+ * -> n copied (0 when nothing contiguous is published). Single consumer. */
+static PyObject *
+colring_pop(PyObject *self, PyObject *args)
+{
+    PyObject *capsule, *ts_obj, *cols;
+    Py_ssize_t max_n;
+    if (!PyArg_ParseTuple(args, "OnOO!", &capsule, &max_n, &ts_obj,
+                          &PyTuple_Type, &cols))
+        return NULL;
+    colring *r = colring_of(capsule);
+    if (r == NULL)
+        return NULL;
+    if (PyTuple_GET_SIZE(cols) != r->n_cols) {
+        PyErr_Format(PyExc_ValueError, "colring_pop: expected %d columns",
+                     r->n_cols);
+        return NULL;
+    }
+    Py_buffer ts_buf;
+    Py_buffer bufs[COLRING_MAX_COLS];
+    if (PyObject_GetBuffer(ts_obj, &ts_buf,
+                           PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0)
+        return NULL;
+    int acquired = 0;
+    for (; acquired < r->n_cols; acquired++) {
+        if (PyObject_GetBuffer(PyTuple_GET_ITEM(cols, acquired),
+                               &bufs[acquired],
+                               PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0)
+            goto fail;
+    }
+    size_t t = atomic_load_explicit(&r->tail, memory_order_relaxed);
+    /* bound max_n by the output buffers up front */
+    if (ts_buf.len / (Py_ssize_t)sizeof(int64_t) < max_n)
+        max_n = ts_buf.len / (Py_ssize_t)sizeof(int64_t);
+    for (int c = 0; c < r->n_cols; c++)
+        if (bufs[c].len / r->widths[c] < max_n)
+            max_n = bufs[c].len / r->widths[c];
+    size_t n = 0;
+    while ((Py_ssize_t)n < max_n &&
+           atomic_load_explicit(&r->seq[(t + n) & r->mask].v,
+                                memory_order_acquire) == t + n + 1)
+        n++;
+    if (n > 0) {
+        Py_BEGIN_ALLOW_THREADS
+        {
+            size_t s0 = t & r->mask;
+            size_t first = r->cap - s0;
+            if (first > n)
+                first = n;
+            size_t second = n - first;
+            memcpy(ts_buf.buf, r->ts + s0, first * sizeof(int64_t));
+            if (second)
+                memcpy((int64_t *)ts_buf.buf + first, r->ts,
+                       second * sizeof(int64_t));
+            for (int c = 0; c < r->n_cols; c++) {
+                size_t w = (size_t)r->widths[c];
+                char *dst = (char *)bufs[c].buf;
+                memcpy(dst, r->cols[c] + s0 * w, first * w);
+                if (second)
+                    memcpy(dst + first * w, r->cols[c], second * w);
+            }
+            for (size_t i = 0; i < n; i++)
+                atomic_store_explicit(&r->seq[(t + i) & r->mask].v, 0,
+                                      memory_order_relaxed);
+            atomic_store_explicit(&r->tail, t + n, memory_order_release);
+        }
+        Py_END_ALLOW_THREADS
+    }
+    for (int i = 0; i < acquired; i++)
+        PyBuffer_Release(&bufs[i]);
+    PyBuffer_Release(&ts_buf);
+    return PyLong_FromSize_t(n);
+
+fail:
+    for (int i = 0; i < acquired; i++)
+        PyBuffer_Release(&bufs[i]);
+    PyBuffer_Release(&ts_buf);
+    return NULL;
+}
+
+/* colring_size(ring) -> claimed, unconsumed depth (approximate under
+ * concurrent producers; includes claimed-but-unwritten runs) */
+static PyObject *
+colring_size(PyObject *self, PyObject *args)
+{
+    PyObject *capsule;
+    if (!PyArg_ParseTuple(args, "O", &capsule))
+        return NULL;
+    colring *r = colring_of(capsule);
+    if (r == NULL)
+        return NULL;
+    return PyLong_FromSize_t(
+        atomic_load_explicit(&r->head, memory_order_relaxed) -
+        atomic_load_explicit(&r->tail, memory_order_relaxed));
+}
+
+/* colring_capacity(ring) -> rounded power-of-two slot count */
+static PyObject *
+colring_capacity(PyObject *self, PyObject *args)
+{
+    PyObject *capsule;
+    if (!PyArg_ParseTuple(args, "O", &capsule))
+        return NULL;
+    colring *r = colring_of(capsule);
+    if (r == NULL)
+        return NULL;
+    return PyLong_FromSize_t(r->cap);
+}
+
+/* colring_hwm(ring) -> claimed-depth high-water mark over the ring's life */
+static PyObject *
+colring_hwm(PyObject *self, PyObject *args)
+{
+    PyObject *capsule;
+    if (!PyArg_ParseTuple(args, "O", &capsule))
+        return NULL;
+    colring *r = colring_of(capsule);
+    if (r == NULL)
+        return NULL;
+    return PyLong_FromSize_t(
+        atomic_load_explicit(&r->hwm, memory_order_relaxed));
+}
+
 static PyMethodDef methods[] = {
     {"encode_rows", encode_rows, METH_VARARGS,
      "Encode row tuples into columnar buffers with string interning."},
@@ -774,6 +1152,20 @@ static PyMethodDef methods[] = {
      "Drain up to max_n published entries (single consumer)."},
     {"ring_size", ring_size, METH_VARARGS,
      "Published, unconsumed entry count."},
+    {"colring_new", colring_new, METH_VARARGS,
+     "Create a lock-free multi-producer columnar ring (capacity, typecodes)."},
+    {"colring_claim", colring_claim, METH_VARARGS,
+     "CAS-claim n contiguous slots; returns start index or -1 when full."},
+    {"colring_write", colring_write, METH_VARARGS,
+     "Copy a claimed run's ts+columns into the ring and publish (GIL released)."},
+    {"colring_pop", colring_pop, METH_VARARGS,
+     "Copy the contiguous published prefix out (single consumer, GIL released)."},
+    {"colring_size", colring_size, METH_VARARGS,
+     "Claimed, unconsumed slot count."},
+    {"colring_capacity", colring_capacity, METH_VARARGS,
+     "Rounded power-of-two slot capacity."},
+    {"colring_hwm", colring_hwm, METH_VARARGS,
+     "Claimed-depth high-water mark."},
     {NULL, NULL, 0, NULL},
 };
 

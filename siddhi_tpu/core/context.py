@@ -279,7 +279,17 @@ class Statistics:
                 "wal_replayed": self.wal_replayed,
                 "shutdown_discarded": self.shutdown_discarded,
             },
+            # always-on, like overflow: a serialized ingress pipeline is a
+            # performance regression operators must see in production.
+            # Populated below from the live pipelines (ring depth HWM,
+            # worker utilization, h2d overlap ratio, per-stage wall time).
+            "ingress_pipeline": {},
         }
+        if runtime is not None:
+            for sid, j in runtime.junctions.items():
+                p = getattr(j, "_pipeline", None)
+                if p is not None:
+                    out["ingress_pipeline"][sid] = p.stats_snapshot()
         if runtime is not None:
             wal = getattr(runtime, "wal", None)
             if wal is not None:

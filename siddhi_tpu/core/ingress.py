@@ -1,0 +1,624 @@
+"""Zero-copy parallel ingress pipeline (the host half of the perf story).
+
+BENCH_r04 measured the device sustaining 61–105M ev/s while e2e throughput
+topped out at 0.7–3M ev/s: the product is host-bound, not TPU-bound. The
+pipeline here closes that gap by overlapping the three host stages that the
+synchronous path runs strictly in sequence:
+
+    producers ──claim──▶ [decode/intern worker pool] ──publish──▶
+        lock-free columnar ring ──pop──▶ [feeder] ──device_put──▶
+            double-buffered EventBatch ──deliver──▶ engine compute
+
+  stage 1  submit: producer threads CAS-claim contiguous ring runs
+           (claim order is a total order — it IS delivery order) and hand
+           the raw payload to the worker pool. Claiming is the only
+           producer-side work; a full ring is blocking backpressure.
+  stage 2  decode/intern: N workers convert rows/columns to fixed-width
+           native buffers and write them into their pre-claimed slots with
+           the GIL released (columnar.c colring_write is a plain memcpy).
+           String interning is the one stage that must be deterministic —
+           dictionary codes are assigned by first appearance — so workers
+           take an "intern ticket" and intern in claim order; numeric
+           conversion runs unordered.
+  stage 3  feed: a single consumer pops contiguous published runs,
+           assembles batch_size chunks, and starts the host→device
+           transfer for chunk k+1 (EventBatch.from_numpy = device_put)
+           BEFORE delivering chunk k under the controller lock, so H2D
+           overlaps engine compute (double buffering; SIDDHI_DOUBLE_BUFFER=0
+           disables).
+
+Determinism/parity: with a single producer the delivered batches are
+bit-identical to the synchronous path — same chunk boundaries (batch_size
+from offset 0), same padding (monotone ts, zero columns, _pad_cap buckets),
+same string codes (ticket-ordered interning). With multiple producers the
+interleaving is the claim order, and conservation (sent == delivered +
+dropped) is the invariant; tests/test_ingress_parity.py asserts both.
+
+Gating: the pipeline is opt-in via @Async(workers='N') or
+SIDDHI_INGRESS_WORKERS, and only engages when the junction has no WAL
+(durability serializes through the controller lock by design), no sequence
+taps (they need true per-row send order on the producer thread), a 'block'
+overflow policy (drop/fault accounting lives in the bounded path), and no
+OBJECT attributes (no columnar layout). Everything else falls back to the
+existing MPSC ring or synchronous staging untouched.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+_log = logging.getLogger("siddhi_tpu")
+
+#: np dtype name -> colring type code (widths: b=1, i=4, l=8, f=4, d=8)
+_NP_TYPECODE = {"bool": "b", "int8": "b", "int32": "i", "int64": "l",
+                "float32": "f", "float64": "d"}
+
+
+def _typecodes(np_dtypes: Sequence[np.dtype]) -> Optional[bytes]:
+    codes = []
+    for dt in np_dtypes:
+        c = _NP_TYPECODE.get(dt.name)
+        if c is None:
+            return None
+        codes.append(c)
+    return "".join(codes).encode("ascii")
+
+
+class _NativeColRing:
+    """Thin adapter over the columnar.c lock-free ring."""
+
+    def __init__(self, cap: int, typecodes: bytes, nmod) -> None:
+        self._n = nmod
+        self._r = nmod.colring_new(cap, typecodes)
+        self.capacity = nmod.colring_capacity(self._r)
+
+    def claim(self, n: int) -> int:
+        return self._n.colring_claim(self._r, n)
+
+    def write(self, start: int, n: int, ts, cols) -> None:
+        self._n.colring_write(self._r, start, n, ts, cols)
+
+    def pop(self, max_n: int, ts_out, cols_out) -> int:
+        return self._n.colring_pop(self._r, max_n, ts_out, cols_out)
+
+    def size(self) -> int:
+        return self._n.colring_size(self._r)
+
+    def hwm(self) -> int:
+        return self._n.colring_hwm(self._r)
+
+
+class _PyColRing:
+    """Pure-Python fallback with the same surface: a lock guards claim()
+    (the CAS), numpy slice copies do write/pop, and per-slot sequence
+    stamps carry the publish ordering exactly like the C ring. Correctness
+    twin for environments without a C toolchain — and the reference the
+    parity test runs against."""
+
+    def __init__(self, cap: int, dtypes_list: Sequence[np.dtype]) -> None:
+        c = 1
+        while c < cap:
+            c <<= 1
+        self.capacity = c
+        self._mask = c - 1
+        self._ts = np.zeros(c, dtype=np.int64)
+        self._cols = [np.zeros(c, dtype=dt) for dt in dtypes_list]
+        self._seq = np.zeros(c, dtype=np.int64)
+        self._head = 0
+        self._tail = 0
+        self._hwm = 0
+        self._lock = threading.Lock()
+
+    def claim(self, n: int) -> int:
+        with self._lock:
+            if self._head + n - self._tail > self.capacity:
+                return -1
+            s = self._head
+            self._head += n
+            depth = self._head - self._tail
+            if depth > self._hwm:
+                self._hwm = depth
+            return s
+
+    def write(self, start: int, n: int, ts, cols) -> None:
+        cap, mask = self.capacity, self._mask
+        s0 = start & mask
+        first = min(cap - s0, n)
+        second = n - first
+        self._ts[s0:s0 + first] = ts[:first]
+        if second:
+            self._ts[:second] = ts[first:n]
+        for dst, src in zip(self._cols, cols):
+            dst[s0:s0 + first] = src[:first]
+            if second:
+                dst[:second] = src[first:n]
+        idx = np.arange(start, start + n) & mask
+        self._seq[idx] = np.arange(start + 1, start + n + 1)
+
+    def pop(self, max_n: int, ts_out, cols_out) -> int:
+        t, cap, mask = self._tail, self.capacity, self._mask
+        max_n = min(max_n, len(ts_out))
+        if max_n <= 0:
+            return 0
+        want = np.arange(t + 1, t + max_n + 1)
+        got = self._seq[np.arange(t, t + max_n) & mask]
+        ok = got == want
+        n = max_n if ok.all() else int(ok.argmin())
+        if n == 0:
+            return 0
+        s0 = t & mask
+        first = min(cap - s0, n)
+        second = n - first
+        ts_out[:first] = self._ts[s0:s0 + first]
+        if second:
+            ts_out[first:n] = self._ts[:second]
+        for dst, src in zip(cols_out, self._cols):
+            dst[:first] = src[s0:s0 + first]
+            if second:
+                dst[first:n] = src[:second]
+        self._seq[np.arange(t, t + n) & mask] = 0
+        self._tail = t + n
+        return n
+
+    def size(self) -> int:
+        return self._head - self._tail
+
+    def hwm(self) -> int:
+        return self._hwm
+
+
+class IngressPipeline:
+    """Per-junction parallel ingress: worker pool + columnar ring + feeder.
+
+    Thread/lock discipline (the deadlock audit):
+      - producers take only the submit lock (claim+enqueue ordering) and
+        never the controller lock;
+      - workers take the intern ticket and, while interning, the controller
+        lock (interning mutates the app-global StringTable, which
+        synchronous paths mutate under that lock) — never the submit lock;
+      - the feeder takes the controller lock only around delivery;
+      - drain() is called only by threads NOT holding the controller lock
+        (junction.flush guards on _lock_owned), so the feeder can always
+        acquire it to make progress.
+    """
+
+    def __init__(self, junction, workers: int) -> None:
+        from .. import native as native_mod
+
+        self.j = junction
+        self.ctx = junction.ctx
+        self.workers = max(1, int(workers))
+        defn = junction.definition
+        if junction.codec.object_attrs:
+            raise ValueError("ingress pipeline: OBJECT attrs have no "
+                             "columnar layout")
+        self.attrs = [a.name for a in defn.attributes]
+        self.np_dtypes = [junction.codec.np_dtypes[n] for n in self.attrs]
+        tcs = _typecodes(self.np_dtypes)
+        if tcs is None:
+            raise ValueError("ingress pipeline: unsupported dtype in schema")
+        self._string_attrs = set(junction.codec.string_tables)
+        self._ordered = bool(self._string_attrs)
+        cap = junction._ring_cap
+        if native_mod.native is not None and \
+                hasattr(native_mod.native, "colring_new"):
+            self.ring = _NativeColRing(cap, tcs, native_mod.native)
+        else:
+            self.ring = _PyColRing(cap, self.np_dtypes)
+        self._q: queue.Queue = queue.Queue()
+        #: claim+enqueue run under this lock so queue order == claim order —
+        #: the invariant the intern tickets (and 1-worker liveness) need
+        self._submit_lock = threading.Lock()
+        self._ticket_cv = threading.Condition()
+        self._next_ticket = 0
+        self._stopping = False
+        self._threads: list[threading.Thread] = []
+        self._feeder: Optional[threading.Thread] = None
+        self._feeder_stop = threading.Event()
+        self._flush_req = threading.Event()
+        self._feeder_idle = threading.Event()
+        self._feeder_idle.set()
+        self._double_buffer = os.environ.get(
+            "SIDDHI_DOUBLE_BUFFER", "1").strip() != "0"
+        # --- statistics (each slot has a single writer thread) ---
+        self._t0 = time.monotonic()
+        self._worker_busy_ns = [0] * self.workers
+        self._worker_decode_ns = [0] * self.workers
+        self._worker_intern_ns = [0] * self.workers
+        self._h2d_ns = 0        # feeder only
+        self._device_ns = 0     # feeder only
+        self._batches = 0       # feeder only
+        self._overlapped = 0    # feeder only
+        self._rows_in = 0       # under submit lock
+        self._runs_in = 0       # under submit lock
+        self._frames_in = 0     # wire path, under submit lock
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        sid = self.j.definition.id
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker_loop, args=(i,),
+                                 daemon=True,
+                                 name=f"siddhi-ingress-{sid}-w{i}")
+            t.start()
+            self._threads.append(t)
+        self._feeder = threading.Thread(target=self._feed_loop, daemon=True,
+                                        name=f"siddhi-ingress-{sid}-feed")
+        self._feeder.start()
+
+    def stop(self) -> None:
+        """Orderly shutdown: no new submits, queued runs finish (every
+        claimed slot publishes — an unpublished hole would strand the rows
+        behind it), the feeder delivers the remainder, threads join."""
+        self._stopping = True
+        for _ in self._threads:
+            self._q.put(None)
+        for t in self._threads:
+            t.join(timeout=120)
+        self._flush_req.set()
+        self._feeder_stop.set()
+        if self._feeder is not None:
+            self._feeder.join(timeout=120)
+            if self._feeder.is_alive():  # pragma: no cover — wedged device
+                _log.warning("ingress feeder for %r did not stop",
+                             self.j.definition.id)
+
+    # ---------------------------------------------------------------- submit
+
+    def _claim_blocking(self, n: int,
+                        deadline: Optional[float]) -> int:
+        """Claim n contiguous slots, blocking while the ring is full (the
+        Disruptor blocking wait strategy — a full ring IS backpressure).
+        Returns -1 on block.timeout expiry, -2 when the pipeline stopped."""
+        ring = self.ring
+        while True:
+            if self._stopping:
+                return -2
+            s = ring.claim(n)
+            if s >= 0:
+                return s
+            if deadline is not None and time.monotonic() >= deadline:
+                return -1
+            self._flush_req.set()
+            time.sleep(0.0002)
+
+    def _deadline(self) -> Optional[float]:
+        bt = self.j.block_timeout_s
+        return None if bt is None else time.monotonic() + bt
+
+    def submit_rows(self, tss: Sequence[int], rows: Sequence) -> int:
+        """Producer-thread entry for the row path. Chunks into runs of at
+        most batch_size, claims each, and hands (start, rows) to the
+        workers. Returns the number of rows CONSUMED (claimed or shed): a
+        short count means the pipeline is stopping and the caller owns the
+        remainder (fall back to synchronous staging)."""
+        if self._stopping:
+            return 0
+        bs = self.j.batch_size
+        n = len(rows)
+        i = 0
+        deadline = self._deadline()
+        while i < n:
+            m = min(bs, n - i)
+            with self._submit_lock:
+                s = self._claim_blocking(m, deadline)
+                if s == -2:
+                    return i  # claimed prefix is in flight; caller owns rest
+                if s == -1:
+                    self.ctx.statistics.track_ingress_drop(
+                        self.j.definition.id, "block.timeout", n - i)
+                    return n  # shed per block.timeout: consumed by policy
+                self._rows_in += m
+                self._runs_in += 1
+                self._q.put(("rows", s, m, tss[i:i + m], rows[i:i + m]))
+            i += m
+        return n
+
+    def submit_columns(self, ts_arr: np.ndarray, columns: dict,
+                       n: int, frame: bool = False) -> int:
+        """Producer-thread entry for the columnar/wire path. `columns` maps
+        attr -> numpy array (numeric, pre-encoded int codes, or str/None
+        objects) or, for wire frames, attr -> ('dict', strings, idx) where
+        idx is int32 with -1 = null — the zero-copy dictionary form.
+        Returns rows consumed; see submit_rows."""
+        if self._stopping:
+            return 0
+        specs = []
+        for name in self.attrs:
+            if name not in columns:
+                raise ValueError(
+                    f"send_columns: missing column {name!r} for stream "
+                    f"{self.j.definition.id!r}")
+            src = columns[name]
+            if isinstance(src, tuple) and len(src) == 3 and src[0] == "dict":
+                specs.append(src)
+                continue
+            arr = np.asarray(src)
+            if arr.shape[0] < n:
+                raise ValueError(
+                    f"send_columns: column {name!r} has {arr.shape[0]} "
+                    f"rows, expected {n}")
+            if name in self._string_attrs and \
+                    not np.issubdtype(arr.dtype, np.integer):
+                specs.append(("strs", arr, None))
+            else:
+                specs.append(("num", arr, None))
+        ts_arr = np.asarray(ts_arr, dtype=np.int64)
+        bs = self.j.batch_size
+        i = 0
+        deadline = self._deadline()
+        while i < n:
+            m = min(bs, n - i)
+            run = []
+            for kind, a, b in specs:
+                if kind == "dict":
+                    run.append(("dict", a, b[i:i + m]))
+                else:
+                    run.append((kind, a[i:i + m], None))
+            with self._submit_lock:
+                s = self._claim_blocking(m, deadline)
+                if s == -2:
+                    return i
+                if s == -1:
+                    self.ctx.statistics.track_ingress_drop(
+                        self.j.definition.id, "block.timeout", n - i)
+                    return n
+                self._rows_in += m
+                self._runs_in += 1
+                if frame:
+                    self._frames_in += 1
+                self._q.put(("cols", s, m, ts_arr[i:i + m], run))
+            i += m
+        return n
+
+    # --------------------------------------------------------------- workers
+
+    def _take_ticket(self, start: int) -> None:
+        with self._ticket_cv:
+            while self._next_ticket != start:
+                self._ticket_cv.wait(timeout=0.05)
+
+    def _release_ticket(self, start: int, n: int) -> None:
+        with self._ticket_cv:
+            self._next_ticket = start + n
+            self._ticket_cv.notify_all()
+
+    def _worker_loop(self, wid: int) -> None:
+        codec = self.j.codec
+        dtypes_list = self.np_dtypes
+        attrs = self.attrs
+        string_attrs = self._string_attrs
+        ordered = self._ordered
+        clock = self.ctx.controller_lock
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            t0 = time.perf_counter_ns()
+            try:
+                kind, start, m, ts, payload = item
+                intern_ns = 0
+                if kind == "rows":
+                    if ordered:
+                        # rows_to_columns interns inline (native
+                        # encode_rows is one call): ticket-order the whole
+                        # decode, under the controller lock because the
+                        # StringTable is also mutated by synchronous paths
+                        # that hold it
+                        self._take_ticket(start)
+                        ti = time.perf_counter_ns()
+                        try:
+                            with clock:
+                                cols_d = codec.rows_to_columns(payload,
+                                                               n_pad=m)
+                        finally:
+                            self._release_ticket(start, m)
+                        intern_ns = time.perf_counter_ns() - ti
+                        cols = tuple(cols_d[a] for a in attrs)
+                    else:
+                        cols_d = codec.rows_to_columns(payload, n_pad=m)
+                        cols = tuple(cols_d[a] for a in attrs)
+                    ts_buf = np.asarray(ts, dtype=np.int64)
+                else:  # "cols"
+                    out = []
+                    took = False
+                    try:
+                        for name, dt, (ck, a, b) in zip(attrs, dtypes_list,
+                                                        payload):
+                            if ck == "num":
+                                out.append(np.ascontiguousarray(a, dtype=dt))
+                            elif ck == "strs":
+                                if not took and ordered:
+                                    self._take_ticket(start)
+                                    took = True
+                                ti = time.perf_counter_ns()
+                                tbl = codec.string_tables[name]
+                                with clock:
+                                    codes = tbl.encode_array(a)
+                                intern_ns += time.perf_counter_ns() - ti
+                                out.append(np.ascontiguousarray(
+                                    codes, dtype=dt))
+                            else:  # "dict": intern DISTINCT values, take
+                                if not took and ordered:
+                                    self._take_ticket(start)
+                                    took = True
+                                ti = time.perf_counter_ns()
+                                tbl = codec.string_tables[name]
+                                with clock:
+                                    codes = tbl.encode_array(
+                                        np.asarray(a, dtype=object))
+                                # idx -1 = null -> code 0 via a shifted LUT
+                                lut = np.empty(len(codes) + 1,
+                                               dtype=np.int32)
+                                lut[0] = 0
+                                lut[1:] = codes
+                                out.append(np.ascontiguousarray(
+                                    lut[b.astype(np.int64) + 1], dtype=dt))
+                                intern_ns += time.perf_counter_ns() - ti
+                    finally:
+                        if ordered:
+                            if not took:
+                                self._take_ticket(start)
+                            self._release_ticket(start, m)
+                    cols = tuple(out)
+                    ts_buf = np.ascontiguousarray(ts, dtype=np.int64)
+                self.ring.write(start, m, ts_buf, cols)
+                self._worker_intern_ns[wid] += intern_ns
+                spent = time.perf_counter_ns() - t0
+                self._worker_busy_ns[wid] += spent
+                self._worker_decode_ns[wid] += spent - intern_ns
+                self._feeder_idle.clear()
+            except Exception:  # pragma: no cover — logged, slot published 0s
+                _log.exception("ingress worker error on %r",
+                               self.j.definition.id)
+                try:
+                    zero = tuple(np.zeros(m, dtype=dt)
+                                 for dt in dtypes_list)
+                    self.ring.write(start, m,
+                                    np.zeros(m, dtype=np.int64), zero)
+                except Exception:
+                    pass
+            finally:
+                self._q.task_done()
+
+    # ---------------------------------------------------------------- feeder
+
+    def _deliver_locked(self, batch, m: int) -> None:
+        j = self.j
+        t0 = time.perf_counter_ns()
+        with self.ctx.controller_lock:
+            if j._staged_rows or j._tap_queue:
+                j.flush()  # staged (sync-path) rows first: arrival order
+            j._deliver(batch, self.ctx.timestamp_generator.current_time())
+        self._device_ns += time.perf_counter_ns() - t0
+        self._batches += 1
+
+    def _feed_loop(self) -> None:
+        from .event import EventBatch
+        j = self.j
+        bs = j.batch_size
+        ring = self.ring
+        attrs = self.attrs
+        pending = None  # the double buffer: built + transferring, undelivered
+        fill = 0
+        ts_buf = np.zeros(bs, dtype=np.int64)
+        col_bufs = [np.zeros(bs, dtype=dt) for dt in self.np_dtypes]
+        while True:
+            got = ring.pop(bs - fill, ts_buf[fill:],
+                           tuple(c[fill:] for c in col_bufs))
+            if got:
+                fill += got
+            if fill == bs:
+                # full chunk: start its H2D NOW (from_numpy = device_put),
+                # then deliver the PREVIOUS chunk while this transfer runs
+                t0 = time.perf_counter_ns()
+                batch = EventBatch.from_numpy(
+                    ts_buf, dict(zip(attrs, col_bufs)), bs)
+                self._h2d_ns += time.perf_counter_ns() - t0
+                ts_buf = np.zeros(bs, dtype=np.int64)
+                col_bufs = [np.zeros(bs, dtype=dt) for dt in self.np_dtypes]
+                fill = 0
+                if self._double_buffer:
+                    if pending is not None:
+                        self._deliver_locked(pending, bs)
+                        self._overlapped += 1
+                    pending = batch
+                else:
+                    self._deliver_locked(batch, bs)
+                continue
+            if got:
+                continue  # partially filled; keep popping while data flows
+            # ring momentarily empty
+            flushing = self._flush_req.is_set()
+            if flushing and (fill or pending is not None):
+                if pending is not None:
+                    self._deliver_locked(pending, bs)
+                    pending = None
+                if fill:
+                    m = fill
+                    pcap = j._pad_cap(m)
+                    ts_c = np.empty(pcap, dtype=np.int64)
+                    ts_c[:m] = ts_buf[:m]
+                    ts_c[m:] = ts_buf[m - 1]  # monotone pad
+                    cols_c = {}
+                    for name, src in zip(attrs, col_bufs):
+                        pad = np.zeros(pcap, dtype=src.dtype)
+                        pad[:m] = src[:m]
+                        cols_c[name] = pad
+                    t0 = time.perf_counter_ns()
+                    batch = EventBatch.from_numpy(ts_c, cols_c, m)
+                    self._h2d_ns += time.perf_counter_ns() - t0
+                    fill = 0
+                    ts_buf = np.zeros(bs, dtype=np.int64)
+                    col_bufs = [np.zeros(bs, dtype=dt)
+                                for dt in self.np_dtypes]
+                    self._deliver_locked(batch, m)
+                continue
+            if fill == 0 and pending is None and ring.size() == 0 \
+                    and self._q.unfinished_tasks == 0:
+                self._feeder_idle.set()
+                if self._feeder_stop.is_set():
+                    return
+                self._flush_req.clear()
+                self._flush_req.wait(timeout=0.001)
+            elif self._feeder_stop.is_set() and ring.size() == 0 \
+                    and self._q.unfinished_tasks == 0:
+                # stopping with a partial chunk: force the final flush
+                self._flush_req.set()
+            else:
+                time.sleep(0.0002)
+
+    # ----------------------------------------------------------------- drain
+
+    def drain(self, timeout: float = 120.0) -> None:
+        """Barrier: every row submitted before this call is delivered when
+        it returns. Callers must NOT hold the controller lock (the feeder
+        needs it to deliver); junction.flush() guards on _lock_owned."""
+        self._q.join()  # all claimed runs are encoded + published
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self._flush_req.set()
+            if self._feeder_idle.is_set() and self.ring.size() == 0 \
+                    and self._q.unfinished_tasks == 0:
+                return
+            time.sleep(0.0005)
+        _log.warning("ingress drain timed out on %r (ring=%d)",  # pragma: no cover
+                     self.j.definition.id, self.ring.size())
+
+    def size(self) -> int:
+        return self.ring.size() + self._q.unfinished_tasks
+
+    # ------------------------------------------------------------ statistics
+
+    def stats_snapshot(self) -> dict:
+        elapsed_ns = max((time.monotonic() - self._t0) * 1e9, 1.0)
+        busy = sum(self._worker_busy_ns)
+        delivered = self._batches
+        return {
+            "workers": self.workers,
+            "ring_capacity": self.ring.capacity,
+            "ring_depth_hwm": self.ring.hwm(),
+            "rows_in": self._rows_in,
+            "runs_in": self._runs_in,
+            "frames_in": self._frames_in,
+            "batches_delivered": delivered,
+            "batches_overlapped": self._overlapped,
+            "h2d_overlap_ratio": (self._overlapped / delivered
+                                  if delivered else 0.0),
+            "worker_utilization": busy / (elapsed_ns * self.workers),
+            "stage_ms": {
+                "decode": sum(self._worker_decode_ns) / 1e6,
+                "intern": sum(self._worker_intern_ns) / 1e6,
+                "h2d": self._h2d_ns / 1e6,
+                "device": self._device_ns / 1e6,
+            },
+        }

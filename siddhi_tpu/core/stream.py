@@ -393,6 +393,23 @@ class StreamJunction:
             if bs:
                 self.batch_size = int(bs)
             self._ring_cap = max(4 * self.batch_size, 1024)
+        # @Async(workers='N') — parallel ingress pipeline (core/ingress.py):
+        # N decode/intern workers + a lock-free columnar ring + a
+        # double-buffering feeder replace the MPSC ring. Opt-in per stream
+        # via the annotation (reference parity: @Async's workers element) or
+        # app-wide via SIDDHI_INGRESS_WORKERS; start_async gates on the
+        # policies the pipeline cannot honor (WAL, taps, drop policies,
+        # OBJECT attrs) and falls back to the MPSC ring.
+        self._pipeline = None
+        self.ingress_workers = 0
+        if ann is not None:
+            w = ann.element("workers")
+            if w:
+                self.ingress_workers = int(w)
+            if self.ingress_workers == 0:
+                import os as _os
+                self.ingress_workers = int(
+                    _os.environ.get("SIDDHI_INGRESS_WORKERS", "0") or 0)
         # --- overload protection (bounded ingress + backpressure signal) ---
         # @Async(buffer.size=N, overflow.policy=..., max.staged=...,
         #        block.timeout='1 sec', high.watermark=0.8, low.watermark=0.2)
@@ -532,6 +549,11 @@ class StreamJunction:
             self.ctx.timestamp_generator.observe_event_time(ts)
             self._stage_bounded(((ts, tuple(data)),))
             return
+        if self._pipeline is not None and not self._lock_owned():
+            self.ctx.timestamp_generator.observe_event_time(ts)
+            if self._pipeline.submit_rows((ts,), (tuple(data),)) == 1:
+                return
+            # pipeline stopping: fall through to synchronous staging
         if self._ring is not None and not self._lock_owned():
             self.ctx.timestamp_generator.observe_event_time(ts)
             # blocking backpressure when the ring is full, like the
@@ -591,6 +613,13 @@ class StreamJunction:
             self._stage_bounded((ts, tuple(row))
                                 for ts, row in zip(tss, rows))
             return
+        if self._pipeline is not None and not self._lock_owned():
+            done = self._pipeline.submit_rows(tss, rows)
+            if done >= len(rows):
+                return
+            # pipeline stopping mid-batch: the unconsumed remainder falls
+            # back to synchronous staging (claimed prefix is in flight)
+            tss, rows = tss[done:], rows[done:]
         if self._ring is not None and not self._lock_owned():
             push = self._ring_push
             for i, (ts, row) in enumerate(zip(tss, rows)):
@@ -756,6 +785,8 @@ class StreamJunction:
         ring = self._ring
         if ring is not None:
             depth += self._ring_size(ring)
+        if self._pipeline is not None:
+            depth += self._pipeline.size()
         return depth
 
     def _ring_size(self, ring) -> int:
@@ -785,8 +816,23 @@ class StreamJunction:
         """Spin up the staging ring + feeder thread (app start; reference:
         StreamJunction.startProcessing starting the Disruptor)."""
         from .. import native as native_mod
-        if not self.is_async or self._feeder is not None:
+        if not self.is_async or self._feeder is not None \
+                or self._pipeline is not None:
             return
+        if (self.ingress_workers > 0 and self.overflow_policy == "block"
+                and self.wal is None and not self.taps
+                and not self.codec.object_attrs):
+            from .ingress import IngressPipeline
+            try:
+                self._pipeline = IngressPipeline(self, self.ingress_workers)
+                self._pipeline.start()
+                return
+            except Exception:
+                logging.getLogger("siddhi_tpu").exception(
+                    "@Async(workers=%d) on %r: ingress pipeline failed to "
+                    "start; falling back to the staging ring",
+                    self.ingress_workers, self.definition.id)
+                self._pipeline = None
         if self._bounded_mode():
             # drop/fault policies: producer-side accounting must stay exact,
             # so no MPSC ring — a plain feeder drains the bounded pre-staging
@@ -815,6 +861,12 @@ class StreamJunction:
         self._feeder.start()
 
     def stop_async(self) -> None:
+        if self._pipeline is not None:
+            # detach FIRST: producers mid-submit fall back to the
+            # synchronous staging path; stop() then delivers everything
+            # already claimed (workers finish the queue, feeder flushes)
+            p, self._pipeline = self._pipeline, None
+            p.stop()
         if self._feeder is None:
             return
         self._feeder_stop.set()
@@ -916,6 +968,12 @@ class StreamJunction:
             # same-thread re-entrant flush (a callback sending into its own
             # stream): defer to the outer delivery
             return
+        if self._pipeline is not None and not self._lock_owned():
+            # barrier: every row submitted to the parallel pipeline before
+            # this flush is delivered before it returns. Lock-holding
+            # callers (auto-flusher, heartbeat, callbacks) skip the barrier
+            # — the feeder needs the controller lock to make progress.
+            self._pipeline.drain()
         # the staged-list swap and delivery run under the controller lock:
         # the feeder thread extends/flushes the same lists
         with self.ctx.controller_lock:
@@ -1138,6 +1196,20 @@ class InputHandler:
             for ts, row in zip(ts_arr[:n].tolist(), zip(*lists)):
                 j.send_row(ts, row)
             return
+        if (j._pipeline is not None and j.wal is None
+                and not j._lock_owned()):
+            # parallel ingress: claim ring slots here, encode + intern in
+            # the worker pool, device transfer double-buffered by the
+            # feeder — this producer thread returns as soon as the runs
+            # are claimed
+            j.ctx.timestamp_generator.observe_event_time(
+                int(ts_arr[:n].max()))
+            done = j._pipeline.submit_columns(ts_arr, columns, n)
+            if done >= n:
+                return
+            ts_arr = ts_arr[done:]
+            columns = {k: np.asarray(v)[done:] for k, v in columns.items()}
+            n -= done
         # interning mutates the app-global StringTable: hold the controller
         # lock (RLock — send_column_batch re-enters it) so the Python-loop
         # fallback cannot race the async feeder's locked encode path

@@ -2,8 +2,9 @@
 
 Builds native/columnar.c on first import (g++/cc via setuptools), caches the
 shared object under siddhi_tpu/_native_build/, and degrades to the pure-Python
-encoder when no toolchain is available. Set SIDDHI_TPU_NO_NATIVE=1 to force
-the Python path (useful for A/B benchmarking the marshalling hot loop).
+encoder when no toolchain is available. Set SIDDHI_TPU_NO_NATIVE=1 (or the
+shorter SIDDHI_NATIVE=0) to force the Python path (useful for A/B
+benchmarking the marshalling hot loop and for fallback-parity CI runs).
 
 The cache is keyed by a hash of the C source: editing columnar.c invalidates
 the cached .so and triggers a rebuild, so a stale binary can never shadow a
@@ -78,7 +79,10 @@ def _build() -> bool:
     return True
 
 
-if not os.environ.get("SIDDHI_TPU_NO_NATIVE"):
+_DISABLED = bool(os.environ.get("SIDDHI_TPU_NO_NATIVE")) or \
+    os.environ.get("SIDDHI_NATIVE", "").strip() == "0"
+
+if not _DISABLED:
     try:
         _try_import()
     except ImportError:

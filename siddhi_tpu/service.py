@@ -100,6 +100,20 @@ class SiddhiService:
             rt.flush()
             return len(events)
 
+    def send_frames(self, app: str, stream: str, body: bytes) -> int:
+        """Binary columnar ingestion (Content-Type:
+        application/x-siddhi-frames, io/wire.py SXF1 framing). The service
+        lock covers only the runtime lookup: frame decode and staging run
+        lock-free so N client connections feed the ingress pipeline
+        concurrently — the engine's own junction/controller locks protect
+        delivery. No flush: the pipeline (or the columnar path's immediate
+        delivery) owns batching."""
+        with self.lock:
+            rt = self.manager.runtimes[app]
+            handler = rt.get_input_handler(stream)
+        from .io import wire
+        return wire.deliver_frames(handler, body)
+
     def query(self, app: str, text: str) -> list:
         with self.lock:
             rt = self.manager.runtimes[app]
@@ -169,6 +183,10 @@ class SiddhiService:
                 n = int(self.headers.get("Content-Length", 0))
                 return self.rfile.read(n).decode()
 
+            def _raw_body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n)
+
             def _authorized(self) -> bool:
                 if service.token is None:
                     return True
@@ -216,9 +234,16 @@ class SiddhiService:
                         self._reply(200, service.validate(self._body()))
                     elif (len(parts) == 4 and parts[0] == "siddhi-apps"
                           and parts[2] == "streams"):
-                        data = json.loads(self._body())
-                        n = service.send(parts[1], parts[3],
-                                         data.get("events", []))
+                        ctype = (self.headers.get("Content-Type") or "")
+                        if ctype.split(";")[0].strip() == \
+                                "application/x-siddhi-frames":
+                            # zero-copy columnar path: raw SXF1 frames
+                            n = service.send_frames(parts[1], parts[3],
+                                                    self._raw_body())
+                        else:
+                            data = json.loads(self._body())
+                            n = service.send(parts[1], parts[3],
+                                             data.get("events", []))
                         self._reply(200, {"accepted": n})
                     elif (len(parts) == 3 and parts[0] == "siddhi-apps"
                           and parts[2] == "query"):
@@ -238,6 +263,8 @@ class SiddhiService:
                     self._reply(404, {"error": f"unknown: {e}"})
                 except json.JSONDecodeError as e:
                     self._reply(400, {"error": f"bad JSON body: {e}"})
+                except ValueError as e:  # bad SXF1 framing / column shape
+                    self._reply(400, {"error": str(e)})
                 except SiddhiError as e:
                     self._reply(400, {"error": str(e)})
 

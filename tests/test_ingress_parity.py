@@ -1,0 +1,289 @@
+"""Parallel-ingress parity & conservation tests (core/ingress.py).
+
+The acceptance bar: the ingress pipeline must be INVISIBLE downstream — the
+same single-producer row stream yields bit-identical delivered blocks
+(timestamps, every column including string dictionary codes, expiry flags)
+whether it runs through the lock-free pipeline or the plain synchronous
+staging path, and with either the C colring or the pure-Python fallback
+underneath. CI runs this module twice: once natively and once with
+SIDDHI_NATIVE=0, so both ring implementations face the same oracle.
+Multi-producer runs cannot promise delivery order, so their invariant is
+exact conservation: sent == delivered + dropped.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu import native as native_mod
+
+pytestmark = pytest.mark.smoke
+
+BS = 64  # micro-batch capacity for both variants (buffer.size == batch_size)
+
+APP_PIPE = f"""
+@app:name('Pipe')
+@Async(buffer.size='{BS}', workers='2')
+define stream TradeStream (symbol string, price double, volume long);
+@info(name='q')
+from TradeStream[price < 700.0]
+select symbol, price, volume
+insert into OutStream;
+"""
+
+#: same query, no @Async: the synchronous staging path is the oracle
+APP_SERIAL = """
+@app:name('Serial')
+define stream TradeStream (symbol string, price double, volume long);
+@info(name='q')
+from TradeStream[price < 700.0]
+select symbol, price, volume
+insert into OutStream;
+"""
+
+
+def _rows(n: int, seed: int = 11):
+    rng = np.random.default_rng(seed)
+    ks = rng.integers(1, 40, n)
+    ps = rng.uniform(1.0, 1000.0, n)
+    vs = rng.integers(1, 1000, n)
+    rows = [(f"S{int(k)}", float(p), int(v))
+            for k, p, v in zip(ks, ps, vs)]
+    for i in range(0, n, 17):  # sprinkle nulls through the string column
+        rows[i] = (None,) + rows[i][1:]
+    return rows
+
+
+def _capture(app: str, feed, *, batch_size=None):
+    """Build, feed via `feed(handler, runtime)`, return the delivered blocks
+    as host tuples (ts, {col: array}, expired) for bit-exact comparison."""
+    kw = {"batch_size": batch_size} if batch_size else {}
+    rt = SiddhiManager().create_siddhi_app_runtime(app, **kw)
+    blocks: list = []
+    rt.add_callback("OutStream", lambda b: blocks.append(
+        (b.timestamps.copy(),
+         {k: v.copy() for k, v in b.columns.items()},
+         b.is_expired.copy())), columnar=True)
+    rt.start()
+    try:
+        feed(rt.get_input_handler("TradeStream"), rt)
+        rt.drain()
+    finally:
+        rt.shutdown()
+    return blocks
+
+
+def _assert_blocks_identical(got, want):
+    assert len(got) == len(want)
+    for (gt, gc, ge), (wt, wc, we) in zip(got, want):
+        np.testing.assert_array_equal(gt, wt)
+        np.testing.assert_array_equal(ge, we)
+        assert gc.keys() == wc.keys()
+        for k in wc:
+            assert gc[k].dtype == wc[k].dtype, k
+            np.testing.assert_array_equal(gc[k], wc[k], err_msg=k)
+
+
+def _pipeline_of(rt):
+    return rt.junctions["TradeStream"]._pipeline
+
+
+class TestBitParity:
+    """Single producer: identical chunk boundaries, padding, and interning
+    order are guaranteed by construction — so the blocks must match bit for
+    bit, dictionary codes included."""
+
+    def test_rows_path(self):
+        rows = _rows(500)
+        tss = np.arange(1, 501, dtype=np.int64)
+
+        def feed(h, rt):
+            h.send_batch(rows, timestamps=tss)
+            rt.flush()
+
+        pipe = _capture(APP_PIPE, feed)
+        serial = _capture(APP_SERIAL, feed, batch_size=BS)
+        assert sum(len(b[0]) for b in pipe) > 0
+        _assert_blocks_identical(pipe, serial)
+
+    def test_columns_path(self):
+        rows = _rows(300, seed=12)
+        cols = {
+            "symbol": np.array([r[0] for r in rows], dtype=object),
+            "price": np.array([r[1] for r in rows]),
+            "volume": np.array([r[2] for r in rows], dtype=np.int64),
+        }
+        tss = np.arange(10, 310, dtype=np.int64)
+
+        def feed(h, rt):
+            h.send_columns(cols, timestamps=tss)
+            rt.flush()
+
+        pipe = _capture(APP_PIPE, feed)
+        serial = _capture(APP_SERIAL, feed, batch_size=BS)
+        _assert_blocks_identical(pipe, serial)
+
+    def test_wire_frames_path(self):
+        from siddhi_tpu.io import wire
+        rows = _rows(400, seed=13)
+        cols = {
+            "symbol": np.array([r[0] for r in rows], dtype=object),
+            "price": np.array([r[1] for r in rows]),
+            "volume": np.array([r[2] for r in rows], dtype=np.int64),
+        }
+        tss = np.arange(5, 405, dtype=np.int64)
+
+        def feed_frames(h, rt):
+            plan = wire.schema_plan(h.junction.definition)
+            body = wire.encode_frames(plan, cols, 400, ts=tss, chunk=96)
+            assert wire.deliver_frames(h, body) == 400
+            rt.flush()
+
+        def feed_serial(h, rt):
+            h.send_columns(cols, timestamps=tss)
+            rt.flush()
+
+        pipe = _capture(APP_PIPE, feed_frames)
+        serial = _capture(APP_SERIAL, feed_serial, batch_size=BS)
+        _assert_blocks_identical(pipe, serial)
+
+    def test_pipeline_actually_engaged(self):
+        """Guard against the parity tests silently comparing serial vs
+        serial (e.g. the gate falling back): the @Async(workers=) app must
+        run the pipeline, and its stats must show the traffic."""
+        rows = _rows(200, seed=14)
+        tss = np.arange(1, 201, dtype=np.int64)
+        seen: dict = {}
+
+        def feed(h, rt):
+            p = _pipeline_of(rt)
+            assert p is not None, "pipeline did not engage"
+            h.send_batch(rows, timestamps=tss)
+            rt.flush()
+            seen.update(p.stats_snapshot())
+
+        _capture(APP_PIPE, feed)
+        assert seen["rows_in"] == 200
+        assert seen["batches_delivered"] >= 1
+        assert seen["ring_depth_hwm"] >= 1
+        assert set(seen["stage_ms"]) == {"decode", "intern", "h2d", "device"}
+
+    def test_fallback_ring_selected_without_native(self):
+        """With SIDDHI_NATIVE=0 (or the C module missing) the pipeline must
+        ride the pure-Python ring — same API, same parity oracle."""
+        from siddhi_tpu.core.ingress import _PyColRing
+
+        def feed(h, rt):
+            p = _pipeline_of(rt)
+            assert p is not None
+            if native_mod.available() and hasattr(native_mod.native,
+                                                 "colring_new"):
+                assert not isinstance(p.ring, _PyColRing)
+            else:
+                assert isinstance(p.ring, _PyColRing)
+            h.send_batch(_rows(64), timestamps=np.arange(64, dtype=np.int64))
+            rt.flush()
+
+        _capture(APP_PIPE, feed)
+
+
+class TestConservation:
+    """Multi-producer: order is unspecified, accounting is not. Every sent
+    event is delivered exactly once or counted as dropped — under the
+    pipeline (block policy) and under the fallback ring (drop policies,
+    where @Async(workers=) gates back to the MPSC path)."""
+
+    N_PRODUCERS = 4
+    PER_PRODUCER = 600
+
+    def _stress(self, app: str, *, expect_pipeline: bool):
+        rt = SiddhiManager().create_siddhi_app_runtime(app)
+        delivered = [0]
+        lock = threading.Lock()
+
+        def cb(b):
+            with lock:
+                delivered[0] += b.count
+
+        rt.add_callback("OutStream", cb, columnar=True)
+        rt.start()
+        try:
+            assert (rt.junctions["TradeStream"]._pipeline
+                    is not None) == expect_pipeline
+            h = rt.get_input_handler("TradeStream")
+            rows = _rows(self.PER_PRODUCER, seed=21)
+
+            def produce(p):
+                tss = np.arange(p * self.PER_PRODUCER,
+                                (p + 1) * self.PER_PRODUCER, dtype=np.int64)
+                h.send_batch(rows, timestamps=tss)
+
+            threads = [threading.Thread(target=produce, args=(p,))
+                       for p in range(self.N_PRODUCERS)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            rt.flush()
+        finally:
+            rt.shutdown()  # drains whatever is still staged
+        rep = rt.statistics_report()
+        sent = self.N_PRODUCERS * self.PER_PRODUCER
+        dropped = sum(rep["ingress_dropped"].get("TradeStream", {}).values())
+        discarded = rep["recovery"]["shutdown_discarded"]
+        # pass-through query: every admitted row reaches the callback, so
+        # conservation is exact — delivered + dropped + discarded == sent
+        assert delivered[0] + dropped + discarded == sent
+        return delivered[0]
+
+    def test_pipeline_block_policy_conserves(self):
+        app = ("@app:name('C1')\n"
+               "@Async(buffer.size='128', workers='2', "
+               "overflow.policy='block', block.timeout='30 sec')\n"
+               "define stream TradeStream "
+               "(symbol string, price double, volume long);\n"
+               "@info(name='q') from TradeStream "
+               "select symbol, price, volume insert into OutStream;")
+        self._stress(app, expect_pipeline=True)
+
+    def test_drop_policy_falls_back_and_conserves(self):
+        app = ("@app:name('C2')\n"
+               "@Async(buffer.size='128', workers='2', "
+               "overflow.policy='drop.old', max.staged='512')\n"
+               "define stream TradeStream "
+               "(symbol string, price double, volume long);\n"
+               "@info(name='q') from TradeStream "
+               "select symbol, price, volume insert into OutStream;")
+        self._stress(app, expect_pipeline=False)
+
+
+class TestStatisticsSection:
+    def test_ingress_pipeline_section_always_present(self):
+        """statistics_report() carries the section even for apps with no
+        pipeline (empty dict) — dashboards key on it unconditionally."""
+        rt = SiddhiManager().create_siddhi_app_runtime(APP_SERIAL)
+        try:
+            rep = rt.statistics_report()
+            assert rep["ingress_pipeline"] == {}
+        finally:
+            rt.shutdown()
+
+    def test_ingress_pipeline_section_populated(self):
+        rt = SiddhiManager().create_siddhi_app_runtime(APP_PIPE)
+        rt.start()
+        try:
+            h = rt.get_input_handler("TradeStream")
+            h.send_batch(_rows(100),
+                         timestamps=np.arange(100, dtype=np.int64))
+            rt.flush()
+            rep = rt.statistics_report()
+            sec = rep["ingress_pipeline"]["TradeStream"]
+            assert sec["workers"] == 2
+            assert sec["rows_in"] == 100
+            for key in ("ring_depth_hwm", "h2d_overlap_ratio",
+                        "worker_utilization", "stage_ms"):
+                assert key in sec
+        finally:
+            rt.shutdown()

@@ -1,0 +1,259 @@
+"""SXF1 wire-format tests (io/wire.py): framing roundtrip, malformed-input
+rejection, the service's binary streams endpoint, and the @map(type='frame')
+source mapper. The format is the zero-copy contract between producers and
+the ingress pipeline, so the decode side must both reproduce the encoder's
+columns exactly and refuse anything that does not match the stream schema.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager, compiler
+from siddhi_tpu.io import wire
+
+pytestmark = pytest.mark.smoke
+
+DEF_TEXT = ("define stream T (symbol string, price double, "
+            "volume long, flag bool);")
+
+
+def _definition():
+    return compiler.parse(DEF_TEXT + "\nfrom T select symbol insert into O;"
+                          ).stream_definitions["T"]
+
+
+def _cols(n, seed=3):
+    rng = np.random.default_rng(seed)
+    syms = np.array([None if i % 9 == 0 else f"S{int(k)}"
+                     for i, k in enumerate(rng.integers(1, 20, n))],
+                    dtype=object)
+    return {
+        "symbol": syms,
+        "price": rng.uniform(0.5, 900.0, n),
+        "volume": rng.integers(1, 1000, n).astype(np.int64),
+        "flag": rng.integers(0, 2, n).astype(bool),
+    }
+
+
+class TestRoundtrip:
+    def test_plan_shape(self):
+        plan = wire.schema_plan(_definition())
+        # price is DOUBLE in SiddhiQL but the engine's device dtype is
+        # float32 (x64 off) — the wire carries what the device will hold
+        assert [(name, code) for name, _dt, code in plan] == [
+            ("symbol", "s"), ("price", "f"), ("volume", "l"), ("flag", "b")]
+
+    def test_encode_decode_roundtrip(self):
+        plan = wire.schema_plan(_definition())
+        cols = _cols(257)
+        ts = np.arange(100, 357, dtype=np.int64)
+        body = wire.encode_frames(plan, cols, 257, ts=ts)
+        frames = list(wire.iter_frames(body))
+        assert len(frames) == 1
+        got_ts, got, n = wire.decode_frame(frames[0], plan)
+        assert n == 257
+        np.testing.assert_array_equal(got_ts, ts)
+        np.testing.assert_array_equal(
+            wire.materialize_strings(got["symbol"]), cols["symbol"])
+        np.testing.assert_allclose(got["price"], cols["price"])
+        np.testing.assert_array_equal(got["volume"], cols["volume"])
+        np.testing.assert_array_equal(got["flag"].astype(bool), cols["flag"])
+
+    def test_chunked_bodies_cover_all_rows(self):
+        plan = wire.schema_plan(_definition())
+        cols = _cols(500)
+        body = wire.encode_frames(plan, cols, 500, chunk=128)
+        sizes = []
+        seen_syms = []
+        for frame in wire.iter_frames(body):
+            _ts, got, n = wire.decode_frame(frame, plan)
+            sizes.append(n)
+            seen_syms.append(wire.materialize_strings(got["symbol"]))
+        assert sizes == [128, 128, 128, 116]
+        np.testing.assert_array_equal(np.concatenate(seen_syms),
+                                      cols["symbol"])
+
+    def test_encoding_is_deterministic(self):
+        plan = wire.schema_plan(_definition())
+        cols = _cols(100)
+        assert wire.encode_frames(plan, cols, 100) == \
+            wire.encode_frames(plan, cols, 100)
+
+    def test_numeric_views_are_zero_copy(self):
+        plan = wire.schema_plan(_definition())
+        cols = _cols(64)
+        body = wire.encode_frames(plan, cols, 64)
+        frame = next(wire.iter_frames(body))
+        _ts, got, _n = wire.decode_frame(frame, plan)
+        assert not got["price"].flags.owndata  # a view over the payload
+
+    def test_object_attrs_rejected(self):
+        definition = compiler.parse(
+            "define stream T (payload object);\n"
+            "from T select payload insert into O;"
+        ).stream_definitions["T"]
+        with pytest.raises(wire.WireFormatError):
+            wire.schema_plan(definition)
+
+
+class TestMalformedInput:
+    def _one_frame(self, n=16):
+        plan = wire.schema_plan(_definition())
+        return plan, wire.encode_frames(plan, _cols(n), n)
+
+    def test_bad_magic(self):
+        plan, body = self._one_frame()
+        corrupt = bytearray(body)
+        corrupt[4:8] = b"NOPE"
+        with pytest.raises(wire.WireFormatError, match="magic"):
+            for f in wire.iter_frames(bytes(corrupt)):
+                wire.decode_frame(f, plan)
+
+    def test_truncated_body(self):
+        plan, body = self._one_frame()
+        with pytest.raises(wire.WireFormatError, match="truncated"):
+            list(wire.iter_frames(body[:-3]))
+
+    def test_truncated_length_prefix(self):
+        with pytest.raises(wire.WireFormatError, match="length prefix"):
+            list(wire.iter_frames(b"\x01\x02"))
+
+    def test_column_count_mismatch(self):
+        plan, body = self._one_frame()
+        with pytest.raises(wire.WireFormatError, match="columns"):
+            wire.decode_frame(next(wire.iter_frames(body)), plan[:-1])
+
+    def test_typecode_mismatch(self):
+        plan, body = self._one_frame()
+        swapped = [plan[1], plan[0]] + list(plan[2:])  # symbol <-> price
+        with pytest.raises(wire.WireFormatError, match="typecode"):
+            wire.decode_frame(next(wire.iter_frames(body)), swapped)
+
+
+APP = """
+@app:name('WireApp')
+define stream TradeStream (symbol string, price double, volume long);
+@info(name='q')
+from TradeStream[price < 700.0]
+select symbol, price, volume
+insert into OutStream;
+"""
+
+
+class TestServiceIngestion:
+    def _deploy(self):
+        from siddhi_tpu.service import SiddhiService
+        svc = SiddhiService()
+        svc.deploy(APP)
+        rt = svc.manager.runtimes["WireApp"]
+        got = [0]
+        rt.add_callback("OutStream", lambda b: got.__setitem__(
+            0, got[0] + b.count), columnar=True)
+        return svc, rt, got
+
+    def _body(self, n=200):
+        rng = np.random.default_rng(5)
+        cols = {
+            "symbol": np.array([f"S{int(k)}"
+                                for k in rng.integers(1, 10, n)],
+                               dtype=object),
+            "price": rng.uniform(1.0, 1000.0, n),
+            "volume": rng.integers(1, 100, n).astype(np.int64),
+        }
+        plan = wire.schema_plan(
+            compiler.parse(APP).stream_definitions["TradeStream"])
+        expected = int((cols["price"] < 700.0).sum())
+        return wire.encode_frames(plan, cols, n, chunk=64), expected
+
+    def test_send_frames_delivers(self):
+        svc, rt, got = self._deploy()
+        try:
+            body, expected = self._body()
+            assert svc.send_frames("WireApp", "TradeStream", body) == 200
+            rt.flush()
+            rt.drain()
+            assert got[0] == expected
+        finally:
+            svc.undeploy("WireApp")
+
+    def test_http_frames_endpoint(self):
+        svc, rt, got = self._deploy()
+        server = svc.make_server(port=0)  # ephemeral port
+        port = server.server_address[1]
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            body, expected = self._body()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/siddhi-apps/WireApp/streams/"
+                "TradeStream", data=body,
+                headers={"Content-Type": "application/x-siddhi-frames"})
+            with urllib.request.urlopen(req) as resp:
+                assert json.loads(resp.read())["accepted"] == 200
+            rt.flush()
+            rt.drain()
+            assert got[0] == expected
+
+            # malformed body → 400, not a 500 traceback
+            bad = urllib.request.Request(
+                f"http://127.0.0.1:{port}/siddhi-apps/WireApp/streams/"
+                "TradeStream", data=b"\x10\x00\x00\x00garbagegarbagegar",
+                headers={"Content-Type": "application/x-siddhi-frames"})
+            try:
+                urllib.request.urlopen(bad)
+                raise AssertionError("expected HTTP 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            server.shutdown()
+            svc.undeploy("WireApp")
+
+    def test_json_path_unaffected(self):
+        svc, rt, got = self._deploy()
+        try:
+            n = svc.send("WireApp", "TradeStream",
+                         [["S1", 10.0, 5], ["S2", 900.0, 6]])
+            assert n == 2
+            rt.drain()
+            assert got[0] == 1  # 900.0 filtered out
+        finally:
+            svc.undeploy("WireApp")
+
+
+class TestFrameSourceMapper:
+    def test_mapper_roundtrip(self):
+        from siddhi_tpu.io.broker import InMemoryBroker
+
+        app = """
+        @app:name('FrameSrc')
+        @source(type='inMemory', topic='frames', @map(type='frame'))
+        define stream TradeStream (symbol string, price double, volume long);
+        @info(name='q')
+        from TradeStream select symbol, price, volume insert into OutStream;
+        """
+        rt = SiddhiManager().create_siddhi_app_runtime(app)
+        rows: list = []
+        rt.add_callback("OutStream",
+                        lambda evs: rows.extend(tuple(e.data) for e in evs))
+        rt.start()
+        try:
+            plan = wire.schema_plan(
+                rt.junctions["TradeStream"].definition)
+            cols = {
+                "symbol": np.array(["A", None, "B"], dtype=object),
+                "price": np.array([1.5, 2.5, 3.5]),
+                "volume": np.array([10, 20, 30], dtype=np.int64),
+            }
+            InMemoryBroker.publish("frames",
+                                   wire.encode_frames(plan, cols, 3))
+            rt.flush()
+            rt.drain()
+        finally:
+            rt.shutdown()
+        assert [r[0] for r in rows] == ["A", None, "B"]
+        assert [r[2] for r in rows] == [10, 20, 30]
