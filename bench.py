@@ -291,7 +291,9 @@ def _measure(run_step, events_per_step: int, metric: str, *,
             n_lat = max(10, LAT_STEPS // 5)
         if i + 1 >= n_lat:
             break
-    p99_ms = float(np.percentile(np.array(lat), 99) * 1e3)
+    lat_arr = np.array(lat)
+    p99_ms = float(np.percentile(lat_arr, 99) * 1e3)
+    p50_ms = float(np.percentile(lat_arr, 50) * 1e3)
 
     baseline = _baseline_for(metric)
     res = {
@@ -301,9 +303,45 @@ def _measure(run_step, events_per_step: int, metric: str, *,
         "vs_baseline": round(events_per_sec / baseline, 3),
         "device_step_ms": round(events_per_step * 1e3 / events_per_sec, 4),
         "p99_batch_latency_ms": round(p99_ms, 3),
+        # first-class percentile fields for every config; e2e runs
+        # overwrite them with true ingest→delivery numbers from the
+        # telemetry histograms (_e2e_latency_fields)
+        "p50_latency_ms": round(p50_ms, 3),
+        "p99_latency_ms": round(p99_ms, 3),
     }
     _partial(res)
     return res
+
+
+def _e2e_latency_fields(rt) -> dict:
+    """p50/p99 end-to-end batch latency (mint-at-ingress → delivery end)
+    from the always-on telemetry stage histograms, merged across streams."""
+    from siddhi_tpu.telemetry.metrics import N_BUCKETS, quantile_from_buckets
+    tele = getattr(rt.ctx, "telemetry", None)
+    if tele is None or not tele.on:
+        return {}
+    buckets = [0] * N_BUCKETS
+    count = 0
+    for (_stream, stage), hist in tele.stage_hist.samples():
+        if stage != "e2e":
+            continue
+        b, c, _ = hist.snapshot()
+        for i in range(N_BUCKETS):
+            buckets[i] += b[i]
+        count += c
+    if not count:
+        return {}
+    return {
+        "p50_latency_ms":
+            round(quantile_from_buckets(buckets, count, 0.5) / 1e6, 3),
+        "p99_latency_ms":
+            round(quantile_from_buckets(buckets, count, 0.99) / 1e6, 3),
+    }
+
+
+#: p50/p99 of the most recent _measure_e2e run (merged into the config's
+#: result dict by each caller)
+_E2E_LAT: dict = {}
 
 
 def _measure_e2e(rt, out_stream: str, feed_round, events_per_round: int,
@@ -359,6 +397,8 @@ def _measure_e2e(rt, out_stream: str, feed_round, events_per_round: int,
         elapsed = time.perf_counter() - t0
         r0 += rounds
         best = max(best, events_per_round * rounds / elapsed)
+    _E2E_LAT.clear()
+    _E2E_LAT.update(_e2e_latency_fields(rt))
     rt.shutdown()
     if fault_plans:
         _partial({"fault_injection": {
@@ -510,7 +550,8 @@ def bench_filter() -> dict:
 
     res["e2e_events_per_sec"] = round(
         _measure_e2e(rt2, "OutStream", feed, E2E_BATCH), 1)
-    _partial({"e2e_events_per_sec": res["e2e_events_per_sec"]})
+    res.update(_E2E_LAT)
+    _partial({"e2e_events_per_sec": res["e2e_events_per_sec"], **_E2E_LAT})
 
     # auto-flush latency at LOW rate (1k ev/s, no flush() from the caller):
     # the wall-clock flusher bounds staged latency (VERDICT r04 item 5;
@@ -580,7 +621,8 @@ def bench_groupby() -> dict:
 
     res["e2e_events_per_sec"] = round(
         _measure_e2e(rt2, "SummaryStream", feed, E2E_BATCH), 1)
-    _partial({"e2e_events_per_sec": res["e2e_events_per_sec"]})
+    res.update(_E2E_LAT)
+    _partial({"e2e_events_per_sec": res["e2e_events_per_sec"], **_E2E_LAT})
     if not E2E_ONLY:
         res.update(_preflight(app))
     return res
@@ -651,7 +693,8 @@ def _distinct_e2e(app: str, res: dict) -> dict:
 
     res["e2e_events_per_sec"] = round(
         _measure_e2e(rt2, "OutStream", feed, E2E_BATCH), 1)
-    _partial({"e2e_events_per_sec": res["e2e_events_per_sec"]})
+    res.update(_E2E_LAT)
+    _partial({"e2e_events_per_sec": res["e2e_events_per_sec"], **_E2E_LAT})
     if not E2E_ONLY:
         res.update(_preflight(app))
     return res
@@ -738,7 +781,8 @@ def bench_pattern() -> dict:
 
     res["e2e_events_per_sec"] = round(
         _measure_e2e(rt2, "OutStream", feed, 2 * eb), 1)
-    _partial({"e2e_events_per_sec": res["e2e_events_per_sec"]})
+    res.update(_E2E_LAT)
+    _partial({"e2e_events_per_sec": res["e2e_events_per_sec"], **_E2E_LAT})
     if not E2E_ONLY:
         res.update(_preflight(app))
     return res
@@ -814,7 +858,8 @@ def bench_join() -> dict:
 
     res["e2e_events_per_sec"] = round(
         _measure_e2e(rt2, "OutStream", feed, 2 * jb), 1)
-    _partial({"e2e_events_per_sec": res["e2e_events_per_sec"]})
+    res.update(_E2E_LAT)
+    _partial({"e2e_events_per_sec": res["e2e_events_per_sec"], **_E2E_LAT})
     if not E2E_ONLY:
         res.update(_preflight(app))
     return res
@@ -957,39 +1002,49 @@ def bench_e2e_ingress() -> dict:
             per.append(wire.encode_frames(plan, cols, eb))
         bodies.append(per)
 
-    def producer(p: int, rounds: int, r0: int) -> None:
-        per = bodies[p]
-        for r in range(rounds):
-            svc.send_frames("IngressBench", "TradeStream",
-                            per[(r0 + r) % len(per)])
+    def measure(svc_x, rt_x, rounds: int) -> float:
+        def producer(p: int, n_rounds: int, r0: int) -> None:
+            per = bodies[p]
+            for r in range(n_rounds):
+                svc_x.send_frames("IngressBench", "TradeStream",
+                                  per[(r0 + r) % len(per)])
 
-    def run_rounds(rounds: int, r0: int) -> None:
-        threads = [threading.Thread(target=producer, args=(p, rounds, r0),
-                                    name=f"bench-producer-{p}")
-                   for p in range(n_producers)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        rt.drain()  # clock stops only after every event is delivered
+        def run_rounds(n_rounds: int, r0: int) -> None:
+            threads = [threading.Thread(target=producer,
+                                        args=(p, n_rounds, r0),
+                                        name=f"bench-producer-{p}")
+                       for p in range(n_producers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            rt_x.drain()  # clock stops only after every event is delivered
+
+        run_rounds(2, 0)
+        best_x = 0.0
+        r0 = 2
+        for _rep in range(3):
+            t0 = time.perf_counter()
+            run_rounds(rounds, r0)
+            elapsed = time.perf_counter() - t0
+            r0 += rounds
+            best_x = max(best_x, n_producers * rounds * eb / elapsed)
+        return best_x
 
     _phase("e2e_ingress:feed")
     rounds = 2 if cpu else 6
-    run_rounds(2, 0)
-    best = 0.0
-    r0 = 2
-    for _rep in range(3):
-        t0 = time.perf_counter()
-        run_rounds(rounds, r0)
-        elapsed = time.perf_counter() - t0
-        r0 += rounds
-        best = max(best, n_producers * rounds * eb / elapsed)
+    best = measure(svc, rt, rounds)
 
     rep = rt.statistics_report()  # before shutdown: stop detaches pipelines
     pipe = rep.get("ingress_pipeline", {}).get("TradeStream", {})
     stage = pipe.get("stage_ms", {})
+    lat_fields = _e2e_latency_fields(rt)
     rt.shutdown()
     assert n_out[0] > 0, "e2e_ingress produced no output — not a valid measure"
+
+    def _mean(name: str):
+        cell = stage.get(name) or {}
+        return cell.get("mean_ms")
 
     value = round(best, 1)
     res = {
@@ -1002,15 +1057,48 @@ def bench_e2e_ingress() -> dict:
         "producers": n_producers,
         "ingress_workers": n_workers,
         "delivered": n_out[0],
-        "decode_ms": stage.get("decode"),
-        "intern_ms": stage.get("intern"),
-        "h2d_ms": stage.get("h2d"),
-        "device_ms": stage.get("device"),
+        # per-stage means (per worker run / per batch) — the satellite fix
+        # replaced bare cumulative totals with {total_ms, batches, mean_ms}
+        "decode_mean_ms": _mean("decode"),
+        "intern_mean_ms": _mean("intern"),
+        "h2d_mean_ms": _mean("h2d"),
+        "device_mean_ms": _mean("device"),
+        "stage_ms": stage,
         "h2d_overlap_ratio": pipe.get("h2d_overlap_ratio"),
         "worker_utilization": pipe.get("worker_utilization"),
         "ring_depth_hwm": pipe.get("ring_depth_hwm"),
+        **lat_fields,
     }
     _partial(res)
+
+    # telemetry overhead A/B: identical workload with SIDDHI_TELEMETRY=0
+    # (span recording off at AppTelemetry creation). Overhead must stay
+    # under 5% — the always-on budget from ISSUE 7.
+    _phase("e2e_ingress:telemetry_off")
+    os.environ["SIDDHI_TELEMETRY"] = "0"
+    try:
+        mgr_off = SiddhiManager()
+        rt_off = mgr_off.create_siddhi_app_runtime(
+            app, batch_size=eb, group_capacity=1 << 17,
+            async_callbacks=True)
+        svc_off = SiddhiService(mgr_off)
+        n_off = [0]
+        rt_off.add_callback("SummaryStream", lambda blk: n_off.__setitem__(
+            0, n_off[0] + blk.count), columnar=True)
+        rt_off.start()
+        rt_off.warmup(tuple(sorted(
+            {j.batch_size for j in rt_off.junctions.values()})))
+        best_off = measure(svc_off, rt_off, rounds)
+        rt_off.shutdown()
+        assert n_off[0] > 0
+        res["telemetry_off_events_per_sec"] = round(best_off, 1)
+        res["telemetry_overhead_pct"] = round(
+            max(0.0, (best_off - best) / best_off) * 100.0, 2)
+        _partial({"telemetry_off_events_per_sec":
+                  res["telemetry_off_events_per_sec"],
+                  "telemetry_overhead_pct": res["telemetry_overhead_pct"]})
+    finally:
+        os.environ.pop("SIDDHI_TELEMETRY", None)
     if not E2E_ONLY:
         res.update(_preflight(app))
     return res

@@ -56,6 +56,9 @@ from .core.stream import (  # noqa: E402
 from .core.manager import SiddhiManager  # noqa: E402
 from .errors import SiddhiError, SiddhiParserError  # noqa: E402
 from .query_api import SiddhiApp  # noqa: E402
+from .telemetry.logs import configure_logging as _configure_logging  # noqa: E402
+
+_configure_logging()  # no-op unless SIDDHI_LOG_FORMAT=json
 
 __version__ = "0.1.0"
 

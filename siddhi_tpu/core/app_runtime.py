@@ -112,6 +112,9 @@ class SiddhiAppRuntime:
         self.ctx.config_manager = config_manager
         from .event import StringTable
         self.ctx.global_strings = StringTable()
+        from ..telemetry import AppTelemetry
+        self.ctx.telemetry = AppTelemetry(app.name)
+        self._owns_jax_trace = False
         stats_ann = app.annotation("app:statistics")
         if stats_ann is not None:
             # @app:statistics('true'|'BASIC'|'DETAIL') (reference:
@@ -354,6 +357,10 @@ class SiddhiAppRuntime:
 
     def start(self) -> None:
         self._started = True
+        from ..telemetry.profiling import maybe_start_jax_profiler
+        # SIDDHI_PROFILE=<dir>: the first runtime to start owns the
+        # process-wide jax.profiler capture and closes it on shutdown
+        self._owns_jax_trace = maybe_start_jax_profiler()
         if self.aot_warmup:
             self.warmup()
         if self.ctx.async_callbacks and self.ctx.decoder is None:
@@ -526,6 +533,25 @@ class SiddhiAppRuntime:
             sink.disconnect()
         if self.wal is not None:
             self.wal.close()
+        if self._owns_jax_trace:
+            from ..telemetry.profiling import stop_jax_profiler
+            stop_jax_profiler()
+            self._owns_jax_trace = False
+
+    def profile(self, n_batches: int = 32):
+        """Arm a one-shot per-query device/host time split over the next
+        `n_batches` query-step invocations (across all queries). Returns the
+        ProfileSession; call .wait() after driving traffic, then .report()
+        for {query: {batches, host_ms, device_wait_ms, device_fraction}}.
+
+        Each profiled step pays a block_until_ready() on its post-step
+        state — the device sync the steady-state pipeline avoids — which is
+        why this is a bounded one-shot, not an always-on metric."""
+        from ..telemetry.profiling import ProfileSession
+        tele = self.ctx.telemetry
+        sess = ProfileSession(tele, n_batches)
+        tele.profile = sess
+        return sess
 
     # ------------------------------------------------------------------- I/O
 

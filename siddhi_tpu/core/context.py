@@ -314,6 +314,13 @@ class Statistics:
                 }
             if breakers:
                 out["breakers"] = breakers
+            tele = getattr(runtime.ctx, "telemetry", None)
+            if tele is not None:
+                # always-on (independent of statistics level): the batch
+                # tracer's per-stage/per-query percentiles and the worst-N
+                # slow-batch exemplars — same histograms /metrics exports
+                out["latency"] = tele.latency_snapshot()
+                out["slow_batches"] = tele.slow_batches()
             lint = getattr(runtime, "lint_report", None)
             if lint is not None:
                 # what the SIDDHI_LINT gate saw at creation: rule counts +
@@ -379,6 +386,9 @@ class SiddhiAppContext:
     #: callbacks ran — runtime.drain() is the barrier.
     async_callbacks: bool = False
     decoder: object = None
+    #: telemetry.AppTelemetry — always-on metrics registry + batch tracer
+    #: (set by SiddhiAppRuntime before any junction is built)
+    telemetry: object = None
 
     @property
     def effective_batch_size(self) -> int:

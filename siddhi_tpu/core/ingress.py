@@ -232,7 +232,9 @@ class IngressPipeline:
         self._worker_busy_ns = [0] * self.workers
         self._worker_decode_ns = [0] * self.workers
         self._worker_intern_ns = [0] * self.workers
+        self._worker_runs = [0] * self.workers
         self._h2d_ns = 0        # feeder only
+        self._h2d_count = 0     # feeder only
         self._device_ns = 0     # feeder only
         self._batches = 0       # feeder only
         self._overlapped = 0    # feeder only
@@ -476,6 +478,7 @@ class IngressPipeline:
                 spent = time.perf_counter_ns() - t0
                 self._worker_busy_ns[wid] += spent
                 self._worker_decode_ns[wid] += spent - intern_ns
+                self._worker_runs[wid] += 1
                 self._feeder_idle.clear()
             except Exception:  # pragma: no cover — logged, slot published 0s
                 _log.exception("ingress worker error on %r",
@@ -508,14 +511,20 @@ class IngressPipeline:
         bs = j.batch_size
         ring = self.ring
         attrs = self.attrs
+        tele = getattr(self.ctx, "telemetry", None)
+        tracing = tele is not None and tele.on
+        sid = j.definition.id
         pending = None  # the double buffer: built + transferring, undelivered
         fill = 0
+        fill_t0 = 0  # when the first row popped into the (empty) chunk
         ts_buf = np.zeros(bs, dtype=np.int64)
         col_bufs = [np.zeros(bs, dtype=dt) for dt in self.np_dtypes]
         while True:
             got = ring.pop(bs - fill, ts_buf[fill:],
                            tuple(c[fill:] for c in col_bufs))
             if got:
+                if fill == 0 and tracing:
+                    fill_t0 = time.perf_counter_ns()
                 fill += got
             if fill == bs:
                 # full chunk: start its H2D NOW (from_numpy = device_put),
@@ -523,7 +532,13 @@ class IngressPipeline:
                 t0 = time.perf_counter_ns()
                 batch = EventBatch.from_numpy(
                     ts_buf, dict(zip(attrs, col_bufs)), bs)
-                self._h2d_ns += time.perf_counter_ns() - t0
+                h2d = time.perf_counter_ns() - t0
+                self._h2d_ns += h2d
+                self._h2d_count += 1
+                if tracing:
+                    trace = tele.mint(sid, bs, t0=fill_t0)
+                    trace.h2d_ns = h2d
+                    batch._trace = trace
                 ts_buf = np.zeros(bs, dtype=np.int64)
                 col_bufs = [np.zeros(bs, dtype=dt) for dt in self.np_dtypes]
                 fill = 0
@@ -556,7 +571,13 @@ class IngressPipeline:
                         cols_c[name] = pad
                     t0 = time.perf_counter_ns()
                     batch = EventBatch.from_numpy(ts_c, cols_c, m)
-                    self._h2d_ns += time.perf_counter_ns() - t0
+                    h2d = time.perf_counter_ns() - t0
+                    self._h2d_ns += h2d
+                    self._h2d_count += 1
+                    if tracing:
+                        trace = tele.mint(sid, m, t0=fill_t0)
+                        trace.h2d_ns = h2d
+                        batch._trace = trace
                     fill = 0
                     ts_buf = np.zeros(bs, dtype=np.int64)
                     col_bufs = [np.zeros(bs, dtype=dt)
@@ -615,10 +636,21 @@ class IngressPipeline:
             "h2d_overlap_ratio": (self._overlapped / delivered
                                   if delivered else 0.0),
             "worker_utilization": busy / (elapsed_ns * self.workers),
+            # per-stage: cumulative wall, how many units it covers, and the
+            # per-unit mean — total alone made per-batch math impossible
+            # (decode/intern are per worker RUN; h2d/device are per BATCH)
             "stage_ms": {
-                "decode": sum(self._worker_decode_ns) / 1e6,
-                "intern": sum(self._worker_intern_ns) / 1e6,
-                "h2d": self._h2d_ns / 1e6,
-                "device": self._device_ns / 1e6,
+                "decode": _stage_cell(sum(self._worker_decode_ns),
+                                      sum(self._worker_runs)),
+                "intern": _stage_cell(sum(self._worker_intern_ns),
+                                      sum(self._worker_runs)),
+                "h2d": _stage_cell(self._h2d_ns, self._h2d_count),
+                "device": _stage_cell(self._device_ns, self._batches),
             },
         }
+
+
+def _stage_cell(total_ns: int, count: int) -> dict:
+    total_ms = total_ns / 1e6
+    return {"total_ms": total_ms, "batches": count,
+            "mean_ms": total_ms / count if count else 0.0}

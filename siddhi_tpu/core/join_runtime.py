@@ -16,6 +16,7 @@ interleaving is preserved.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Optional
 
 import jax
@@ -798,4 +799,8 @@ class _JoinSideReceiver(Receiver):
         self.from_left = from_left
 
     def on_batch(self, batch: EventBatch, now: int) -> None:
+        t0 = time.perf_counter_ns()
         self.runtime.on_side_batch(self.from_left, batch, now)
+        tele = getattr(self.runtime.ctx, "telemetry", None)
+        if tele is not None and tele.on:
+            tele.record_query(self.runtime.name, time.perf_counter_ns() - t0)

@@ -37,6 +37,7 @@ positions (optional beyond m), with `e[k]`-indexed frames.
 from __future__ import annotations
 
 import dataclasses
+import time
 from dataclasses import dataclass, field
 from typing import NamedTuple, Optional
 
@@ -1633,4 +1634,8 @@ class _PatternSideReceiver(Receiver):
         self.sid = sid
 
     def on_batch(self, batch: EventBatch, now: int) -> None:
+        t0 = time.perf_counter_ns()
         self.runtime.on_junction_batch(self.sid, batch, now)
+        tele = getattr(self.runtime.ctx, "telemetry", None)
+        if tele is not None and tele.on:
+            tele.record_query(self.runtime.name, time.perf_counter_ns() - t0)

@@ -597,7 +597,21 @@ class QueryRuntime(Receiver):
         self.state, out = self._step(self.state, batch, jnp.int64(now),
                                      self._table_states())
         self._distribute(out, now)
-        self.ctx.statistics.track_latency(self.name, time.perf_counter_ns() - t0)
+        elapsed = time.perf_counter_ns() - t0
+        self.ctx.statistics.track_latency(self.name, elapsed)
+        tele = getattr(self.ctx, "telemetry", None)
+        if tele is not None:
+            if tele.on:
+                tele.record_query(self.name, elapsed)
+            sess = tele.profile
+            if sess is not None and sess.active:
+                # one-shot profile(): block on the post-step state to split
+                # host wall time from device execution still in flight
+                import jax
+                w0 = time.perf_counter_ns()
+                jax.block_until_ready(self.state)
+                wait = time.perf_counter_ns() - w0
+                sess.record(self.name, elapsed + wait, wait)
         self._batches_seen += 1
         # adaptive cadence: cheap (one scalar sync) but sparse normally;
         # tight once a table runs hot so compaction outruns overflow.

@@ -661,10 +661,13 @@ class StreamJunction:
             return
         self.ctx.timestamp_generator.observe_event_time(int(ts_arr[:n].max()))
         cap = self.batch_size
+        tele = getattr(self.ctx, "telemetry", None)
+        tracing = tele is not None and tele.on
         with self.ctx.controller_lock:
             self.flush()  # staged rows first: preserve arrival order
             now = self.ctx.timestamp_generator.current_time()
             for start in range(0, n, cap):
+                t0 = time.perf_counter_ns() if tracing else 0
                 m = min(cap, n - start)
                 if m == cap:
                     ts_c = ts_arr[start:start + cap]
@@ -679,7 +682,15 @@ class StreamJunction:
                         pad = np.zeros(pcap, dtype=v.dtype)
                         pad[:m] = v[start:start + m]
                         cols_c[k] = pad
-                self._deliver(EventBatch.from_numpy(ts_c, cols_c, m), now)
+                if tracing:
+                    h2d_t0 = time.perf_counter_ns()
+                    batch = EventBatch.from_numpy(ts_c, cols_c, m)
+                    trace = tele.mint(self.definition.id, m, t0=t0)
+                    trace.h2d_ns = time.perf_counter_ns() - h2d_t0
+                    batch._trace = trace
+                else:
+                    batch = EventBatch.from_numpy(ts_c, cols_c, m)
+                self._deliver(batch, now)
 
     # ------------------------------------------------------------ async mode
 
@@ -997,7 +1008,10 @@ class StreamJunction:
     def _flush_rows(self, rows, tss, now) -> None:
         cap = self.batch_size
         n = len(rows)
+        tele = getattr(self.ctx, "telemetry", None)
+        tracing = tele is not None and tele.on
         for start in range(0, n, cap):
+            t0 = time.perf_counter_ns() if tracing else 0
             chunk_rows = rows[start:start + cap]
             chunk_ts = tss[start:start + cap]
             m = len(chunk_rows)
@@ -1008,7 +1022,17 @@ class StreamJunction:
             if m < pad and m > 0:
                 ts_arr[m:] = chunk_ts[-1]
             cols = self.codec.rows_to_columns(chunk_rows, n_pad=pad)
-            batch = EventBatch.from_numpy(ts_arr, cols, m)
+            if tracing:
+                h2d_t0 = time.perf_counter_ns()
+                batch = EventBatch.from_numpy(ts_arr, cols, m)
+                trace = tele.mint(self.definition.id, m, t0=t0)
+                trace.h2d_ns = time.perf_counter_ns() - h2d_t0
+                # plain instance attribute: invisible to pytree flatten, so
+                # it never reaches a jitted step (EventBatch is a non-slots
+                # dataclass); _deliver pops it
+                batch._trace = trace
+            else:
+                batch = EventBatch.from_numpy(ts_arr, cols, m)
             self._deliver(batch, now if now is not None else
                           self.ctx.timestamp_generator.current_time())
 
@@ -1077,6 +1101,17 @@ class StreamJunction:
 
     def _deliver(self, batch: EventBatch, now: int) -> None:
         self._reentry.flushing = True
+        tele = getattr(self.ctx, "telemetry", None)
+        trace = None
+        if tele is not None and tele.on:
+            # adopt the trace minted at batch formation; derived-stream
+            # publishes and heartbeats mint one here (size unknown without a
+            # device sync — left None)
+            trace = batch.__dict__.pop("_trace", None)
+            if trace is None:
+                trace = tele.mint(self.definition.id)
+            trace.deliver_t0 = time.perf_counter_ns()
+            tele.push_active(trace)
         try:
             n = int(batch.count()) if self.ctx.statistics.enabled else 0
             self.ctx.statistics.track_in(self.definition.id, n)
@@ -1117,6 +1152,8 @@ class StreamJunction:
                         raise
         finally:
             self._reentry.flushing = False
+            if trace is not None:
+                tele.pop_active(trace)
         # deliver rows staged re-entrantly during callbacks
         if self._staged_rows and len(self._staged_rows) >= self.batch_size:
             self.flush()

@@ -129,6 +129,18 @@ class Sink:
         """Publish a batch row-by-row under the sink's on.error policy.
         `timestamps` (parallel to rows) ride into dead-letter entries and
         fault-stream events; None falls back to the current time."""
+        tele = getattr(self.ctx, "telemetry", None)
+        if tele is not None and tele.on:
+            t0 = time.perf_counter_ns()
+            try:
+                self._publish_rows(rows, timestamps)
+            finally:
+                tele.record_sink(self.definition.id, len(rows),
+                                 time.perf_counter_ns() - t0)
+        else:
+            self._publish_rows(rows, timestamps)
+
+    def _publish_rows(self, rows: list[tuple], timestamps=None) -> None:
         for i, row in enumerate(rows):
             try:
                 self._map_and_publish(row)

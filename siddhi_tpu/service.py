@@ -16,13 +16,16 @@ Endpoints (JSON):
   GET    /health                      → 200 always while the process serves
   GET    /ready                       → 200 when every app is "running";
                                         503 with per-app detail otherwise
-                                        (degraded = breaker open,
-                                        recovering, or the service lock is
-                                        busy past a short wait)
+                                        (degraded = breaker open, or
+                                        recovering) — lock-free, so a
+                                        wedged deploy can't flap probes
+  GET    /metrics                     → Prometheus text exposition
+                                        (docs/OBSERVABILITY.md)
 
-Probe note: /health and /ready skip bearer-token auth by design —
-orchestrator probes carry no credentials; the bodies expose only app names
-and health states, never data or query text.
+Probe note: /health, /ready, and /metrics skip bearer-token auth by design —
+orchestrator probes and scrapers carry no credentials; the bodies expose
+only app names, health states, and metric aggregates, never data or query
+text.
 
 Usage:  python -m siddhi_tpu.service [port]
 
@@ -148,18 +151,29 @@ class SiddhiService:
 
     def readiness(self) -> tuple[int, dict]:
         """Readiness: (http_status, body). 200 only when every deployed app
-        reports "running"; a breaker-open/degraded or recovering app — or a
-        service lock held past a short wait — answers 503 so load balancers
-        drain traffic while the engine sheds load."""
-        if not self.lock.acquire(timeout=0.5):
-            return 503, {"ready": False, "reason": "busy", "apps": {}}
-        try:
-            apps = {name: rt.health()
-                    for name, rt in self.manager.runtimes.items()}
-        finally:
-            self.lock.release()
+        reports "running"; a breaker-open/degraded or recovering app answers
+        503 so load balancers drain traffic while the engine sheds load.
+
+        Lock-free like /health: a wedged deploy holding the service lock
+        must not 503-flap probes — runtime.health() reads GIL-atomic
+        snapshots, and iterating a point-in-time copy of the runtime table
+        tolerates concurrent deploy/undeploy (an app mid-removal simply
+        drops out of this probe)."""
+        apps = {}
+        for name, rt in list(self.manager.runtimes.items()):
+            try:
+                apps[name] = rt.health()
+            except Exception:  # racing undeploy/shutdown
+                apps[name] = {"state": "stopped", "breakers": {},
+                              "queues": {}}
         ready = all(a["state"] == "running" for a in apps.values())
         return (200 if ready else 503), {"ready": ready, "apps": apps}
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition for every deployed app. Lock-free:
+        a scrape must never queue behind a deploy or a device step."""
+        from .telemetry import prometheus
+        return prometheus.render_manager(self.manager)
 
     # ---------------------------------------------------------------- server
 
@@ -208,6 +222,18 @@ class SiddhiService:
                 if parts == ["ready"]:
                     code, body = service.readiness()
                     self._reply(code, body)
+                    return
+                if parts == ["metrics"]:
+                    # auth-exempt like /health: scrapers carry no bearer
+                    # token; the body exposes names + aggregates, not data
+                    from .telemetry import prometheus
+                    body = service.metrics_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     prometheus.CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                     return
                 if not self._authorized():
                     return
@@ -286,6 +312,8 @@ def main(argv=None) -> None:
     import os
     import sys
     argv = argv if argv is not None else sys.argv[1:]
+    from .telemetry.logs import configure_logging
+    configure_logging()  # SIDDHI_LOG_FORMAT=json → structured one-liners
     allow_scripts = "--allow-scripts" in argv
     argv = [a for a in argv if a != "--allow-scripts"]
     port = int(argv[0]) if argv else 9090

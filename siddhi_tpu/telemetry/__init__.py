@@ -1,0 +1,49 @@
+"""Always-on, low-overhead observability layer (ISSUE 7).
+
+The package threads one measurement substrate through the whole pipeline:
+
+  metrics.py     lock-free per-thread metrics registry — counters, gauges,
+                 fixed-bucket log-scale latency histograms with
+                 p50/p95/p99/p99.9 extraction. Writers touch only a shard
+                 owned by their thread (no lock, no CAS on the hot path);
+                 readers sum shards at scrape time.
+  tracing.py     batch tracing — a monotonically increasing batch ID minted
+                 at ingress and carried through delivery, per-stage span
+                 timings (accept→stage→H2D→device→sink) into per-stage
+                 histograms, plus a bounded worst-N slow-batch exemplar ring
+                 surfaced in statistics_report()["slow_batches"].
+  prometheus.py  text-exposition rendering for GET /metrics (hand-rolled —
+                 no prometheus_client dependency) + a conformance validator
+                 used by tests and the CI smoke.
+  profiling.py   SIDDHI_PROFILE=<dir> jax.profiler trace capture and the
+                 SiddhiAppRuntime.profile(n_batches) host/device time split.
+  logs.py        SIDDHI_LOG_FORMAT=json one-line structured log records.
+
+Gating: SIDDHI_TELEMETRY=0 turns span/histogram recording off (the <5%
+overhead budget is measured by bench.py's e2e_ingress config and guarded by
+tests/test_telemetry.py); default is ON — the whole point is that production
+always has the data.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .tracing import AppTelemetry, BatchTrace
+
+__all__ = [
+    "AppTelemetry",
+    "BatchTrace",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "telemetry_enabled",
+]
+
+
+def telemetry_enabled() -> bool:
+    """Process-wide default for new apps: SIDDHI_TELEMETRY=0 disables the
+    always-on span/histogram recording (overhead A/B runs flip this)."""
+    return os.environ.get("SIDDHI_TELEMETRY", "1").strip() != "0"

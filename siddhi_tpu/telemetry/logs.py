@@ -1,0 +1,51 @@
+"""SIDDHI_LOG_FORMAT=json — one-line structured log records.
+
+Emits each record as a single JSON object: ts (epoch seconds), level,
+logger, event (the formatted message), plus any of app/query/stream passed
+via logging's `extra=` mechanism, and exc on exceptions. Keeps service
+logs machine-parseable next to /metrics without changing any call site —
+the default (unset / "text") leaves logging exactly as it was.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+#: record attrs lifted into the JSON object when present (set via extra=)
+_CONTEXT_ATTRS = ("app", "query", "stream", "batch_id")
+
+
+class JsonLogFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "event": record.getMessage(),
+        }
+        for attr in _CONTEXT_ATTRS:
+            v = getattr(record, attr, None)
+            if v is not None:
+                out[attr] = v
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+def log_format() -> str:
+    return os.environ.get("SIDDHI_LOG_FORMAT", "text").strip().lower()
+
+
+def configure_logging(level: int = logging.INFO) -> None:
+    """Install the JSON formatter on the root handlers when
+    SIDDHI_LOG_FORMAT=json; no-op otherwise. Idempotent."""
+    if log_format() != "json":
+        return
+    root = logging.getLogger()
+    if not root.handlers:
+        logging.basicConfig(level=level)
+    for handler in root.handlers:
+        if not isinstance(handler.formatter, JsonLogFormatter):
+            handler.setFormatter(JsonLogFormatter())

@@ -1,0 +1,249 @@
+"""Lock-free per-thread metrics registry.
+
+Design: every writer thread owns a private *shard* (a plain Python list —
+the smallest mutable cell) reached through an instance-level
+``threading.local``. The hot path is therefore two attribute loads and a
+list-element increment with no lock, no CAS, and no allocation; under the
+GIL a single-writer cell can never lose an update. Readers (the /metrics
+scrape, statistics_report) sum across shards — a racing read may see a
+value a few increments stale, which is the standard Prometheus contract
+(scrapes are snapshots, not barriers).
+
+Histograms are fixed-bucket log-scale: 28 power-of-two microsecond buckets
+(≤1 µs … ≤2²⁶ µs ≈ 67 s, last bucket = +Inf). Bucket selection is one
+integer ``bit_length`` — no search, no float math — and quantile
+extraction (p50/p95/p99/p99.9) linearly interpolates inside the owning
+bucket, so the relative error is bounded by the ×2 bucket ratio.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, Optional, Sequence
+
+#: number of histogram buckets: index i covers (2^(i-1), 2^i] microseconds
+#: for 0 < i < 27 (index 0 = ≤1 µs); index 27 is the +Inf overflow bucket.
+N_BUCKETS = 28
+
+#: upper bounds in SECONDS for the finite buckets (Prometheus `le` values)
+BUCKET_BOUNDS_S = tuple((1 << i) * 1e-6 for i in range(N_BUCKETS - 1))
+
+
+def bucket_index(ns: int) -> int:
+    """Log2 bucket for a duration in nanoseconds (half-open upper bounds:
+    exactly 2^i µs lands in bucket i, one nanosecond more in i+1)."""
+    if ns <= 1000:
+        return 0
+    i = ((ns + 999) // 1000 - 1).bit_length()
+    return i if i < N_BUCKETS - 1 else N_BUCKETS - 1
+
+
+class Counter:
+    """Monotonic counter with per-thread shards."""
+
+    __slots__ = ("_shards", "_lock", "_tls")
+
+    def __init__(self) -> None:
+        self._shards: list[list] = []
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+
+    def _cell(self) -> list:
+        c = [0]
+        with self._lock:
+            self._shards.append(c)
+        self._tls.c = c
+        return c
+
+    def inc(self, n: int = 1) -> None:
+        c = getattr(self._tls, "c", None)
+        if c is None:
+            c = self._cell()
+        c[0] += n
+
+    def value(self):
+        return sum(c[0] for c in list(self._shards))
+
+
+class Gauge:
+    """Last-write-wins instantaneous value (no sharding: gauges are set
+    from slow paths — scrape staleness is inherent to the type)."""
+
+    __slots__ = ("_v",)
+
+    def __init__(self) -> None:
+        self._v = 0.0
+
+    def set(self, v) -> None:
+        self._v = v
+
+    def value(self):
+        return self._v
+
+
+class Histogram:
+    """Fixed-bucket log-scale latency histogram with per-thread shards.
+
+    Each shard is one flat list: N_BUCKETS bucket counts, then the
+    observation count, then the duration sum in ns — a single allocation
+    per (thread, series)."""
+
+    __slots__ = ("_shards", "_lock", "_tls")
+
+    _COUNT = N_BUCKETS
+    _SUM = N_BUCKETS + 1
+
+    def __init__(self) -> None:
+        self._shards: list[list] = []
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+
+    def _cell(self) -> list:
+        c = [0] * (N_BUCKETS + 2)
+        with self._lock:
+            self._shards.append(c)
+        self._tls.c = c
+        return c
+
+    def observe_ns(self, ns: int) -> None:
+        c = getattr(self._tls, "c", None)
+        if c is None:
+            c = self._cell()
+        c[bucket_index(ns)] += 1
+        c[self._COUNT] += 1
+        c[self._SUM] += ns
+
+    # ---------------------------------------------------------------- readers
+
+    def snapshot(self) -> tuple[list, int, int]:
+        """(bucket_counts, count, sum_ns) merged across shards."""
+        buckets = [0] * N_BUCKETS
+        count = 0
+        total = 0
+        for c in list(self._shards):
+            for i in range(N_BUCKETS):
+                buckets[i] += c[i]
+            count += c[self._COUNT]
+            total += c[self._SUM]
+        return buckets, count, total
+
+    def count(self) -> int:
+        return sum(c[self._COUNT] for c in list(self._shards))
+
+    def percentiles(self, qs: Sequence[float] = (0.5, 0.95, 0.99, 0.999)
+                    ) -> Optional[dict]:
+        """{q: value_ms} via linear interpolation inside the owning log2
+        bucket; None when the histogram is empty."""
+        buckets, count, _ = self.snapshot()
+        if count == 0:
+            return None
+        return {q: quantile_from_buckets(buckets, count, q) / 1e6
+                for q in qs}
+
+    def summary(self) -> dict:
+        """The JSON shape statistics_report()["latency"] carries."""
+        buckets, count, total = self.snapshot()
+        if count == 0:
+            return {"count": 0}
+        return {
+            "count": count,
+            "mean_ms": total / count / 1e6,
+            "p50_ms": quantile_from_buckets(buckets, count, 0.5) / 1e6,
+            "p95_ms": quantile_from_buckets(buckets, count, 0.95) / 1e6,
+            "p99_ms": quantile_from_buckets(buckets, count, 0.99) / 1e6,
+            "p999_ms": quantile_from_buckets(buckets, count, 0.999) / 1e6,
+        }
+
+
+def quantile_from_buckets(buckets: Sequence[int], count: int,
+                          q: float) -> float:
+    """Quantile in NANOSECONDS from merged log2-µs bucket counts."""
+    target = q * count
+    cum = 0
+    for i, n in enumerate(buckets):
+        if n == 0:
+            continue
+        if cum + n >= target:
+            lo = 0 if i == 0 else (1 << (i - 1)) * 1000
+            if i >= N_BUCKETS - 1:  # +Inf bucket: report its lower bound
+                return float(lo)
+            hi = (1 << i) * 1000
+            frac = (target - cum) / n
+            return lo + frac * (hi - lo)
+        cum += n
+    return 0.0
+
+
+class Family:
+    """One named metric family: a label schema plus get-or-create children
+    keyed by label-value tuples. Child creation takes a lock once per
+    series; steady-state lookup is a dict get."""
+
+    __slots__ = ("name", "kind", "help", "labelnames", "_children", "_lock",
+                 "_ctor")
+
+    _CTORS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+    def __init__(self, name: str, kind: str, help_text: str,
+                 labelnames: Sequence[str]) -> None:
+        self.name = name
+        self.kind = kind
+        self.help = help_text
+        self.labelnames = tuple(labelnames)
+        self._children: dict[tuple, object] = {}
+        self._lock = threading.Lock()
+        self._ctor = self._CTORS[kind]
+
+    def labels(self, *values: str):
+        key = values
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.get(key)
+                if child is None:
+                    child = self._ctor()
+                    self._children[key] = child
+        return child
+
+    def samples(self) -> list[tuple[tuple, object]]:
+        return list(self._children.items())
+
+
+class MetricsRegistry:
+    """Per-app family registry. Families are declared once (usually at app
+    construction) so every always-on family renders in /metrics even before
+    traffic arrives."""
+
+    def __init__(self) -> None:
+        self._families: dict[str, Family] = {}
+        self._lock = threading.Lock()
+
+    def _family(self, name: str, kind: str, help_text: str,
+                labelnames: Sequence[str]) -> Family:
+        fam = self._families.get(name)
+        if fam is None:
+            with self._lock:
+                fam = self._families.get(name)
+                if fam is None:
+                    fam = Family(name, kind, help_text, labelnames)
+                    self._families[name] = fam
+        if fam.kind != kind or fam.labelnames != tuple(labelnames):
+            raise ValueError(
+                f"metric {name!r} re-registered with a different "
+                f"kind/label schema")
+        return fam
+
+    def counter(self, name: str, help_text: str,
+                labelnames: Sequence[str] = ()) -> Family:
+        return self._family(name, "counter", help_text, labelnames)
+
+    def gauge(self, name: str, help_text: str,
+              labelnames: Sequence[str] = ()) -> Family:
+        return self._family(name, "gauge", help_text, labelnames)
+
+    def histogram(self, name: str, help_text: str,
+                  labelnames: Sequence[str] = ()) -> Family:
+        return self._family(name, "histogram", help_text, labelnames)
+
+    def collect(self) -> Iterable[Family]:
+        return [self._families[k] for k in sorted(self._families)]

@@ -1,0 +1,382 @@
+"""Prometheus text-exposition (version 0.0.4) rendering + validation.
+
+Hand-rolled on purpose: the container bakes no prometheus_client, and the
+format is small enough that owning the renderer keeps the scrape path
+dependency-free. Two consumers:
+
+  render_manager(manager)   GET /metrics body — every app's registry
+                            families plus an adapter over the pre-existing
+                            Statistics counters (ingress drops, breakers,
+                            recovery, ingress pipeline), all labelled with
+                            app/stream/query.
+  validate_exposition(text) conformance checker (metric-name/label-name
+                            grammar, escaping, TYPE placement, histogram
+                            bucket ordering, _count/_sum consistency) used
+                            by tests/test_telemetry.py and the CI smoke.
+
+Scrape-safety: rendering never takes the service lock or the controller
+lock — it reads GIL-atomic dict snapshots, so a wedged deploy or a slow
+device step cannot wedge the scrape.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .metrics import BUCKET_BOUNDS_S, Counter, Family, Gauge, Histogram
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+#: families every running deployment must expose (the CI smoke fails if a
+#: scrape during traffic is missing one)
+ALWAYS_ON_FAMILIES = (
+    "siddhi_app_up",
+    "siddhi_batches_total",
+    "siddhi_events_total",
+    "siddhi_stage_latency_seconds",
+    "siddhi_query_latency_seconds",
+)
+
+
+def _escape_label(v) -> str:
+    return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _escape_help(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(v) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _labels_str(names, values) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{_escape_label(v)}"'
+                     for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+class _Exposition:
+    """Accumulates samples per family across apps so each metric name gets
+    exactly one HELP/TYPE block (required by the format)."""
+
+    def __init__(self) -> None:
+        self._fams: dict[str, tuple[str, str, tuple]] = {}
+        self._samples: dict[str, list[str]] = {}
+        self._order: list[str] = []
+
+    def declare(self, name: str, kind: str, help_text: str,
+                labelnames: tuple) -> None:
+        if name not in self._fams:
+            self._fams[name] = (kind, help_text, labelnames)
+            self._samples[name] = []
+            self._order.append(name)
+
+    def add(self, name: str, labelvalues: tuple, value) -> None:
+        kind, _, labelnames = self._fams[name]
+        self._samples[name].append(
+            f"{name}{_labels_str(labelnames, labelvalues)} {_fmt(value)}")
+
+    def add_histogram(self, name: str, labelvalues: tuple,
+                      hist: Histogram) -> None:
+        _, _, labelnames = self._fams[name]
+        buckets, count, total_ns = hist.snapshot()
+        cum = 0
+        for i, bound in enumerate(BUCKET_BOUNDS_S):
+            cum += buckets[i]
+            ls = _labels_str(labelnames + ("le",),
+                             labelvalues + (repr(bound),))
+            self._samples[name].append(f"{name}_bucket{ls} {_fmt(cum)}")
+        ls = _labels_str(labelnames + ("le",), labelvalues + ("+Inf",))
+        self._samples[name].append(f"{name}_bucket{ls} {_fmt(count)}")
+        plain = _labels_str(labelnames, labelvalues)
+        self._samples[name].append(f"{name}_sum{plain} {total_ns / 1e9!r}")
+        self._samples[name].append(f"{name}_count{plain} {_fmt(count)}")
+
+    def render(self) -> str:
+        out: list[str] = []
+        for name in self._order:
+            kind, help_text, _ = self._fams[name]
+            out.append(f"# HELP {name} {_escape_help(help_text)}")
+            out.append(f"# TYPE {name} {kind}")
+            out.extend(self._samples[name])
+        out.append("")
+        return "\n".join(out)
+
+
+def _add_family(exp: _Exposition, fam: Family, app: str) -> None:
+    exp.declare(fam.name, fam.kind, fam.help, ("app",) + fam.labelnames)
+    for labelvalues, child in fam.samples():
+        if isinstance(child, Histogram):
+            exp.add_histogram(fam.name, (app,) + labelvalues, child)
+        elif isinstance(child, (Counter, Gauge)):
+            exp.add(fam.name, (app,) + labelvalues, child.value())
+
+
+def _add_dict_counter(exp: _Exposition, name: str, help_text: str,
+                      app: str, label: str, d: dict) -> None:
+    exp.declare(name, "counter", help_text, ("app", label))
+    for k, v in list(d.items()):
+        exp.add(name, (app, k), v)
+
+
+def _stats_families(exp: _Exposition, app: str, runtime) -> None:
+    """Adapter: export the pre-existing Statistics counters (tracked
+    regardless of level) under the same namespace as the registry metrics —
+    the 'one API' the JSON report and the scrape share."""
+    st = runtime.ctx.statistics
+    exp.declare("siddhi_app_up", "gauge",
+                "1 while the app runtime reports state=running", ("app",))
+    try:
+        state = runtime.health().get("state")
+    except Exception:  # pragma: no cover — mid-shutdown race
+        state = None
+    exp.add("siddhi_app_up", (app,), 1 if state == "running" else 0)
+
+    _add_dict_counter(exp, "siddhi_compiles_total",
+                      "XLA compiles of jitted query steps (trace-time exact)",
+                      app, "query", st.compiles)
+    _add_dict_counter(exp, "siddhi_sink_retries_total",
+                      "Sink reconnect/publish retries", app, "stream",
+                      st.sink_retries)
+    _add_dict_counter(exp, "siddhi_sink_dead_letters_total",
+                      "Events dead-lettered to the error store", app,
+                      "stream", st.sink_dead_letters)
+    _add_dict_counter(exp, "siddhi_sink_dropped_total",
+                      "Events dropped by sinks (on.error=LOG)", app,
+                      "stream", st.sink_dropped)
+    _add_dict_counter(exp, "siddhi_source_retries_total",
+                      "Source reconnect attempts", app, "stream",
+                      st.source_retries)
+    _add_dict_counter(exp, "siddhi_backpressure_pauses_total",
+                      "Source pause() calls on high-watermark crossings",
+                      app, "stream", st.bp_pauses)
+    _add_dict_counter(exp, "siddhi_backpressure_resumes_total",
+                      "Source resume() calls on low-watermark crossings",
+                      app, "stream", st.bp_resumes)
+    _add_dict_counter(exp, "siddhi_overflow_rows_total",
+                      "Rows lost to fixed device capacities", app,
+                      "structure", st.overflow)
+    _add_dict_counter(exp, "siddhi_breaker_failures_total",
+                      "Query failures counted toward breaker trips", app,
+                      "query", st.breaker_failures)
+    _add_dict_counter(exp, "siddhi_breaker_opens_total",
+                      "Circuit breaker open transitions", app, "query",
+                      st.breaker_opens)
+    _add_dict_counter(exp, "siddhi_breaker_diverted_rows_total",
+                      "Rows diverted instead of dispatched to a broken "
+                      "query", app, "query", st.breaker_diverted)
+
+    exp.declare("siddhi_staged_depth_hwm", "gauge",
+                "High-watermark of staged rows per stream", ("app", "stream"))
+    for k, v in list(st.queue_hwm.items()):
+        exp.add("siddhi_staged_depth_hwm", (app, k), v)
+
+    exp.declare("siddhi_ingress_dropped_total", "counter",
+                "Rows shed/diverted by bounded ingress, by policy",
+                ("app", "stream", "policy"))
+    for stream, per in list(st.ingress_dropped.items()):
+        for policy, n in list(per.items()):
+            exp.add("siddhi_ingress_dropped_total", (app, stream, policy), n)
+
+    exp.declare("siddhi_recoveries_total", "counter",
+                "recover() completions", ("app",))
+    exp.add("siddhi_recoveries_total", (app,), st.recoveries)
+    exp.declare("siddhi_wal_replayed_total", "counter",
+                "Lifetime events re-sent by recover()", ("app",))
+    exp.add("siddhi_wal_replayed_total", (app,), st.wal_replayed)
+
+    # parallel-ingress pipeline gauges/counters (core/ingress.py)
+    exp.declare("siddhi_ingress_pipeline_rows_total", "counter",
+                "Rows accepted by the parallel ingress pipeline",
+                ("app", "stream"))
+    exp.declare("siddhi_ingress_pipeline_batches_total", "counter",
+                "Batches delivered by the parallel ingress pipeline",
+                ("app", "stream"))
+    exp.declare("siddhi_ingress_worker_utilization", "gauge",
+                "Decode/intern worker busy fraction", ("app", "stream"))
+    exp.declare("siddhi_ingress_ring_depth_hwm", "gauge",
+                "Columnar ring depth high-watermark", ("app", "stream"))
+    exp.declare("siddhi_ingress_stage_seconds_total", "counter",
+                "Cumulative wall time per ingress pipeline stage",
+                ("app", "stream", "stage"))
+    for sid, j in list(getattr(runtime, "junctions", {}).items()):
+        p = getattr(j, "_pipeline", None)
+        if p is None:
+            continue
+        snap = p.stats_snapshot()
+        exp.add("siddhi_ingress_pipeline_rows_total", (app, sid),
+                snap["rows_in"])
+        exp.add("siddhi_ingress_pipeline_batches_total", (app, sid),
+                snap["batches_delivered"])
+        exp.add("siddhi_ingress_worker_utilization", (app, sid),
+                snap["worker_utilization"])
+        exp.add("siddhi_ingress_ring_depth_hwm", (app, sid),
+                snap["ring_depth_hwm"])
+        for stage, cell in snap["stage_ms"].items():
+            exp.add("siddhi_ingress_stage_seconds_total", (app, sid, stage),
+                    cell["total_ms"] / 1e3)
+
+
+def render_manager(manager) -> str:
+    """Full /metrics body for every deployed app. Lock-free: iterates a
+    point-in-time snapshot of the runtime table."""
+    exp = _Exposition()
+    # declare the always-on registry families even with zero apps deployed
+    # (a fresh service must still expose its schema)
+    from .tracing import AppTelemetry
+    runtimes = list(getattr(manager, "runtimes", {}).items())
+    if not runtimes:
+        probe = AppTelemetry("", enabled=False)
+        for fam in probe.registry.collect():
+            exp.declare(fam.name, fam.kind, fam.help,
+                        ("app",) + fam.labelnames)
+        exp.declare("siddhi_app_up", "gauge",
+                    "1 while the app runtime reports state=running", ("app",))
+    for name, rt in runtimes:
+        tele = getattr(rt.ctx, "telemetry", None)
+        if tele is not None:
+            for fam in tele.registry.collect():
+                _add_family(exp, fam, name)
+        _stats_families(exp, name, rt)
+    return exp.render()
+
+
+# --------------------------------------------------------------- validation
+
+def validate_exposition(text: str) -> list[str]:
+    """Return a list of conformance errors (empty = valid).
+
+    Checks the subset of the 0.0.4 text format a scraper relies on:
+    metric/label name grammar, label escaping, one TYPE per family placed
+    before its samples, parseable sample values, histogram `le` ordering
+    ending in +Inf, and `_count` == the +Inf bucket.
+    """
+    errors: list[str] = []
+    typed: dict[str, str] = {}
+    seen_samples: set[str] = set()
+    hist_buckets: dict[str, list[tuple[str, float]]] = {}
+    hist_counts: dict[str, float] = {}
+
+    sample_re = re.compile(
+        r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+        r"(?P<labels>\{.*\})?"
+        r" (?P<value>[^ ]+)( [0-9]+)?$")
+    label_re = re.compile(
+        r'(?P<name>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<value>(?:[^"\\]|\\.)*)"')
+
+    if text and not text.endswith("\n"):
+        errors.append("exposition must end with a newline")
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) != 4:
+                errors.append(f"line {lineno}: malformed TYPE line")
+                continue
+            _, _, name, kind = parts
+            if kind not in ("counter", "gauge", "histogram", "summary",
+                            "untyped"):
+                errors.append(f"line {lineno}: unknown type {kind!r}")
+            if name in typed:
+                errors.append(f"line {lineno}: duplicate TYPE for {name!r}")
+            typed[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        m = sample_re.match(line)
+        if m is None:
+            errors.append(f"line {lineno}: unparseable sample {line!r}")
+            continue
+        name = m.group("name")
+        if not _NAME_RE.match(name):
+            errors.append(f"line {lineno}: bad metric name {name!r}")
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            stripped = name[: -len(suffix)] if name.endswith(suffix) else None
+            if stripped and typed.get(stripped) == "histogram":
+                base = stripped
+                break
+        if base not in typed and name not in typed:
+            errors.append(
+                f"line {lineno}: sample for {name!r} has no TYPE line")
+        labels = m.group("labels")
+        le_val = None
+        if labels:
+            body = labels[1:-1]
+            consumed = label_re.sub("", body).replace(",", "").strip()
+            if consumed:
+                errors.append(
+                    f"line {lineno}: malformed labels {labels!r}")
+            for lm in label_re.finditer(body):
+                lname = lm.group("name")
+                if not _LABEL_RE.match(lname):
+                    errors.append(
+                        f"line {lineno}: bad label name {lname!r}")
+                raw = lm.group("value")
+                if re.search(r'(?<!\\)(?:\\\\)*"', raw):
+                    errors.append(
+                        f"line {lineno}: unescaped quote in label value")
+                if lname == "le":
+                    le_val = raw
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            if m.group("value") not in ("+Inf", "-Inf", "NaN"):
+                errors.append(
+                    f"line {lineno}: unparseable value "
+                    f"{m.group('value')!r}")
+                continue
+            value = float("inf")
+        key = f"{name}{labels or ''}"
+        if key in seen_samples:
+            errors.append(f"line {lineno}: duplicate sample {key!r}")
+        seen_samples.add(key)
+        if name.endswith("_bucket") and typed.get(base) == "histogram":
+            series = f"{base}{_strip_le(labels)}"
+            hist_buckets.setdefault(series, []).append(
+                (le_val or "", value))
+        if name.endswith("_count") and typed.get(base) == "histogram":
+            hist_counts[f"{base}{labels or ''}"] = value
+
+    for series, buckets in hist_buckets.items():
+        bounds = []
+        for le, v in buckets:
+            bounds.append((float("inf") if le == "+Inf" else float(le), v))
+        if not bounds or bounds[-1][0] != float("inf"):
+            errors.append(f"{series}: histogram missing le=\"+Inf\" bucket")
+            continue
+        for (b1, v1), (b2, v2) in zip(bounds, bounds[1:]):
+            if b2 < b1:
+                errors.append(f"{series}: le bounds not sorted")
+            if v2 < v1:
+                errors.append(f"{series}: bucket counts not cumulative")
+        if series in hist_counts and hist_counts[series] != bounds[-1][1]:
+            errors.append(
+                f"{series}: _count != +Inf bucket "
+                f"({hist_counts[series]} vs {bounds[-1][1]})")
+
+    # a declared family with zero samples is legal; nothing else to check
+    return errors
+
+
+def _strip_le(labels: str | None) -> str:
+    if not labels:
+        return ""
+    body = labels[1:-1]
+    parts = [p for p in re.findall(r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"',
+                                   body) if not p.startswith('le="')]
+    return "{" + ",".join(parts) + "}" if parts else ""

@@ -1,0 +1,250 @@
+"""Batch tracing: monotone batch IDs + per-stage spans + slow-batch ring.
+
+A BatchTrace is minted where a micro-batch is FORMED at ingress (the
+parallel pipeline's feeder, the MPSC/staging flush, the columnar path) and
+rides on the EventBatch as a plain instance attribute (`batch._trace`) —
+invisible to JAX's pytree flatten, so it never reaches a jitted step or
+perturbs compilation. StreamJunction._deliver adopts the trace (minting one
+on the fly for derived-stream publishes and heartbeats), pushes it onto a
+thread-local active stack for the duration of the fan-out, and query steps
+and sinks attribute their spans to the innermost active trace without any
+argument threading.
+
+Stage model (all spans in ns, recorded into per-stage histograms):
+
+  accept   trace mint: the instant the batch's first row left the staging
+           structure and batch assembly began
+  stage    mint → delivery start, minus h2d (encode + ring/queue wait +
+           double-buffer residence)
+  h2d      EventBatch.from_numpy (host→device transfer start)
+  device   sum of query/join/pattern step wall time inside the fan-out
+  sink     sum of Sink.publish_rows wall time inside the fan-out
+  e2e      mint → delivery end
+
+Slow-batch exemplars: a bounded worst-N ring (by e2e) with the stage
+breakdown, query names, and batch size — statistics_report()
+["slow_batches"]. A separate recent-completion deque
+(`recent_summaries()`) exists for tests asserting ID propagation; both
+are O(1) per batch (summary dicts are built on read, not on the hot
+path).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from .metrics import Histogram, MetricsRegistry
+
+#: worst-N exemplar ring size
+SLOW_RING = 8
+#: recent-completion ring size (test/debug surface)
+RECENT_RING = 64
+
+
+class BatchTrace:
+    __slots__ = ("batch_id", "stream", "size", "t0", "h2d_ns", "device_ns",
+                 "sink_ns", "deliver_t0", "queries")
+
+    def __init__(self, batch_id: int, stream: str, size: Optional[int],
+                 t0: int) -> None:
+        self.batch_id = batch_id
+        self.stream = stream
+        self.size = size  # rows when known at mint; None for derived batches
+        self.t0 = t0
+        self.h2d_ns = 0
+        self.device_ns = 0
+        self.sink_ns = 0
+        self.deliver_t0 = 0
+        self.queries: list[str] = []
+
+    def summary(self, t_end: int) -> dict:
+        e2e = t_end - self.t0
+        stage = max(self.deliver_t0 - self.t0 - self.h2d_ns, 0)
+        return {
+            "batch_id": self.batch_id,
+            "stream": self.stream,
+            "batch_size": self.size,
+            "queries": list(self.queries),
+            "e2e_ms": e2e / 1e6,
+            "stages_ms": {
+                "stage": stage / 1e6,
+                "h2d": self.h2d_ns / 1e6,
+                "device": self.device_ns / 1e6,
+                "sink": self.sink_ns / 1e6,
+            },
+        }
+
+
+class AppTelemetry:
+    """Per-app telemetry façade: the metrics registry, the batch tracer
+    state, and the (usually-None) profiling session. Attached to
+    SiddhiAppContext.telemetry by the app runtime."""
+
+    def __init__(self, app_name: str, enabled: Optional[bool] = None) -> None:
+        from . import telemetry_enabled
+        self.app = app_name
+        self.on = telemetry_enabled() if enabled is None else enabled
+        self.registry = MetricsRegistry()
+        r = self.registry
+        # always-on families, declared up front so /metrics renders them
+        # (HELP/TYPE) even before the first batch
+        self.batches = r.counter(
+            "siddhi_batches_total",
+            "Micro-batches delivered per stream junction", ("stream",))
+        self.events = r.counter(
+            "siddhi_events_total",
+            "Rows delivered per stream (ingress batches with exact counts)",
+            ("stream",))
+        self.stage_hist = r.histogram(
+            "siddhi_stage_latency_seconds",
+            "Per-stage batch latency (stage|h2d|device|sink|e2e)",
+            ("stream", "stage"))
+        self.query_hist = r.histogram(
+            "siddhi_query_latency_seconds",
+            "Per-query step wall time (device dispatch + distribute)",
+            ("query",))
+        self.sink_hist = r.histogram(
+            "siddhi_sink_latency_seconds",
+            "Sink.publish_rows wall time per output stream", ("stream",))
+        self.sink_events = r.counter(
+            "siddhi_sink_published_total",
+            "Rows handed to Sink.publish_rows per output stream",
+            ("stream",))
+        # tracer state
+        self._ids = itertools.count(1)
+        self._tls = threading.local()
+        self._slow: list[tuple[float, int, dict]] = []  # (e2e_ms, id, summary)
+        self._slow_floor = 0.0  # cheapest e2e_ms in a full ring (fast reject)
+        self._slow_lock = threading.Lock()
+        self.recent: deque = deque(maxlen=RECENT_RING)  # (trace, t_end_ns)
+        #: armed by SiddhiAppRuntime.profile(); checked by query runtimes
+        self.profile = None
+        # per-series child caches: Family.labels() is a guarded dict walk,
+        # and pop_active touches seven series per delivery — resolving them
+        # once per stream keeps the always-on path in single-dict-get
+        # territory (racing first lookups are safe: labels() is idempotent)
+        self._stream_cells: dict = {}
+        self._query_cells: dict = {}
+        self._sink_cells: dict = {}
+
+    # ---------------------------------------------------------------- tracing
+
+    def mint(self, stream: str, size: Optional[int] = None,
+             t0: Optional[int] = None) -> BatchTrace:
+        return BatchTrace(next(self._ids), stream, size,
+                          time.perf_counter_ns() if t0 is None else t0)
+
+    def push_active(self, trace: BatchTrace) -> None:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append(trace)
+
+    def active(self) -> Optional[BatchTrace]:
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else None
+
+    def pop_active(self, trace: BatchTrace) -> None:
+        """Close the delivery: record every stage span + counters, then
+        retire the trace into the recent/slow rings."""
+        stack = self._tls.stack
+        stack.pop()
+        t_end = time.perf_counter_ns()
+        stream = trace.stream
+        cells = self._stream_cells.get(stream)
+        if cells is None:
+            sh = self.stage_hist
+            cells = (self.batches.labels(stream),
+                     self.events.labels(stream),
+                     sh.labels(stream, "stage"), sh.labels(stream, "h2d"),
+                     sh.labels(stream, "device"), sh.labels(stream, "sink"),
+                     sh.labels(stream, "e2e"))
+            self._stream_cells[stream] = cells
+        batches_c, events_c, stage_c, h2d_c, device_c, sink_c, e2e_c = cells
+        stage_ns = trace.deliver_t0 - trace.t0 - trace.h2d_ns
+        stage_c.observe_ns(stage_ns if stage_ns > 0 else 0)
+        if trace.h2d_ns:
+            h2d_c.observe_ns(trace.h2d_ns)
+        if trace.device_ns:
+            device_c.observe_ns(trace.device_ns)
+        if trace.sink_ns:
+            sink_c.observe_ns(trace.sink_ns)
+        e2e_ns = t_end - trace.t0
+        e2e_c.observe_ns(e2e_ns)
+        batches_c.inc()
+        if trace.size is not None:
+            events_c.inc(trace.size)
+        self.recent.append((trace, t_end))
+        e2e_ms = e2e_ns / 1e6
+        # summary dicts are built only for the worst-N ring; the common
+        # (fast-batch) path does one float compare and moves on
+        if len(self._slow) < SLOW_RING or e2e_ms > self._slow_floor:
+            with self._slow_lock:
+                if len(self._slow) < SLOW_RING:
+                    heapq.heappush(
+                        self._slow,
+                        (e2e_ms, trace.batch_id, trace.summary(t_end)))
+                elif e2e_ms > self._slow[0][0]:
+                    heapq.heapreplace(
+                        self._slow,
+                        (e2e_ms, trace.batch_id, trace.summary(t_end)))
+                if len(self._slow) >= SLOW_RING:
+                    self._slow_floor = self._slow[0][0]
+
+    # ------------------------------------------------------------ span hooks
+
+    def record_query(self, query: str, ns: int) -> None:
+        h = self._query_cells.get(query)
+        if h is None:
+            h = self._query_cells[query] = self.query_hist.labels(query)
+        h.observe_ns(ns)
+        tr = self.active()
+        if tr is not None:
+            tr.device_ns += ns
+            tr.queries.append(query)
+
+    def record_sink(self, stream: str, rows: int, ns: int) -> None:
+        cells = self._sink_cells.get(stream)
+        if cells is None:
+            cells = (self.sink_hist.labels(stream),
+                     self.sink_events.labels(stream))
+            self._sink_cells[stream] = cells
+        cells[0].observe_ns(ns)
+        cells[1].inc(rows)
+        tr = self.active()
+        if tr is not None:
+            tr.sink_ns += ns
+
+    # --------------------------------------------------------------- reports
+
+    def slow_batches(self) -> list[dict]:
+        """Worst-N exemplars, slowest first."""
+        with self._slow_lock:
+            items = sorted(self._slow, key=lambda x: -x[0])
+        return [s for _, _, s in items]
+
+    def recent_summaries(self) -> list[dict]:
+        """Summaries of the last RECENT_RING completed deliveries (oldest
+        first) — built on demand, the hot path stores raw traces."""
+        return [tr.summary(t_end) for tr, t_end in list(self.recent)]
+
+    def latency_snapshot(self) -> dict:
+        """statistics_report()["latency"]: per-stream per-stage percentiles
+        and per-query step percentiles, from the same histograms /metrics
+        exports."""
+        streams: dict[str, dict] = {}
+        for (stream, stage), hist in self.stage_hist.samples():
+            s = hist.summary()
+            if s["count"]:
+                streams.setdefault(stream, {})[stage] = s
+        queries = {}
+        for (query,), hist in self.query_hist.samples():
+            s = hist.summary()
+            if s["count"]:
+                queries[query] = s
+        return {"streams": streams, "queries": queries}

@@ -92,9 +92,17 @@ class TestReady:
         code, _body = _get(f"{base}/health")
         assert code == 200
 
-    def test_busy_service_lock_reports_503(self, server):
+    def test_busy_service_lock_does_not_block_probes(self, server):
+        # /ready is lock-free: a wedged deploy holding the service lock
+        # must not make probes hang or 503-flap
         base, svc = server
-        with svc.lock:  # a long deploy in flight: probes must not hang
+        _post(f"{base}/siddhi-apps", APP, token="secret-token")
+        with svc.lock:  # a long deploy in flight
             code, body = _get(f"{base}/ready")
-        assert code == 503
-        assert body["ready"] is False and body["reason"] == "busy"
+            assert code == 200 and body["ready"] is True
+            assert body["apps"]["hsvc"]["state"] == "running"
+            # metrics scrape is equally lock-free
+            req = urllib.request.Request(f"{base}/metrics")
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 200
+                assert b"siddhi_app_up" in resp.read()

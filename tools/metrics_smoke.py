@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""CI smoke: scrape /metrics DURING live e2e traffic and validate it.
+
+Boots the REST service in-process on an ephemeral port, deploys an
+@Async-pipelined app, then runs producer threads pushing SXF1 binary
+frames while a scraper thread hits GET /metrics concurrently — the
+scrape path must answer while the ingress pipeline, controller, and
+deploy lock are all busy. Every scrape body must
+
+  * pass telemetry.prometheus.validate_exposition (zero errors), and
+  * contain a TYPE line for every ALWAYS_ON_FAMILIES entry,
+
+and the final scrape must additionally show real traffic (events_total
+matching what was sent, per-query latency histogram populated). Exits
+non-zero with a diagnostic on any violation.
+
+Usage:  python tools/metrics_smoke.py [--rows 20000] [--producers 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from anywhere without installing
+
+import numpy as np
+
+APP = """@app:name('smoke')
+@Async(buffer.size='2048', workers='2')
+define stream TradeStream (symbol string, price double, volume long);
+@info(name='q')
+from TradeStream[price >= 0.0]
+select symbol, price, volume
+insert into OutStream;
+"""
+
+
+def _get(base: str, path: str) -> tuple[int, str, str]:
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        return (resp.status, resp.headers.get("Content-Type", ""),
+                resp.read().decode())
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=20_000,
+                    help="rows per producer")
+    ap.add_argument("--producers", type=int, default=2)
+    ap.add_argument("--chunk", type=int, default=2048)
+    args = ap.parse_args()
+
+    from siddhi_tpu.io import wire
+    from siddhi_tpu.service import SiddhiService
+    from siddhi_tpu.telemetry.prometheus import (ALWAYS_ON_FAMILIES,
+                                                 validate_exposition)
+
+    svc = SiddhiService()
+    httpd = svc.make_server(port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    failures: list[str] = []
+
+    def check_scrape(text: str, ctype: str, tag: str) -> None:
+        if not ctype.startswith("text/plain"):
+            failures.append(f"{tag}: bad content-type {ctype!r}")
+        for err in validate_exposition(text):
+            failures.append(f"{tag}: {err}")
+        for fam in ALWAYS_ON_FAMILIES:
+            if f"# TYPE {fam} " not in text:
+                failures.append(f"{tag}: missing always-on family {fam}")
+
+    # 1. pre-deploy: a fresh service must already expose its schema
+    status, ctype, text = _get(base, "/metrics")
+    assert status == 200, status
+    check_scrape(text, ctype, "pre-deploy scrape")
+
+    svc.deploy(APP)
+    rt = svc.manager.runtimes["smoke"]
+    handler = rt.get_input_handler("TradeStream")
+    plan = wire.schema_plan(handler.junction.definition)
+
+    # 2. concurrent producers (binary SXF1 frames, the zero-copy path)
+    def produce(seed: int) -> None:
+        rng = np.random.default_rng(seed)
+        cols = {
+            "symbol": np.array([f"S{i % 31}" for i in range(args.rows)],
+                               dtype=object),
+            "price": rng.uniform(1.0, 900.0, args.rows),
+            "volume": rng.integers(1, 1000, args.rows,
+                                   dtype=np.int64),
+        }
+        body = wire.encode_frames(plan, cols, args.rows,
+                                  ts=np.arange(1, args.rows + 1,
+                                               dtype=np.int64),
+                                  chunk=args.chunk)
+        req = urllib.request.Request(
+            f"{base}/siddhi-apps/smoke/streams/TradeStream", data=body,
+            headers={"Content-Type": "application/x-siddhi-frames"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            got = json.loads(resp.read())
+            assert got["accepted"] == args.rows, got
+
+    producers = [threading.Thread(target=produce, args=(100 + i,))
+                 for i in range(args.producers)]
+    stop = threading.Event()
+    mid_scrapes = []
+
+    def scrape_loop() -> None:
+        while not stop.is_set():
+            try:
+                _, ctype, text = _get(base, "/metrics")
+                mid_scrapes.append((ctype, text))
+            except Exception as e:  # noqa: BLE001 — record, keep scraping
+                failures.append(f"mid-traffic scrape raised: {e}")
+            stop.wait(0.05)
+
+    scraper = threading.Thread(target=scrape_loop)
+    scraper.start()
+    for p in producers:
+        p.start()
+    for p in producers:
+        p.join()
+    rt.drain()
+    stop.set()
+    scraper.join()
+
+    if not mid_scrapes:
+        failures.append("scraper got zero bodies during traffic")
+    for i, (ctype, text) in enumerate(mid_scrapes):
+        check_scrape(text, ctype, f"mid-traffic scrape #{i}")
+
+    # 3. final scrape reflects the traffic exactly
+    _, ctype, text = _get(base, "/metrics")
+    check_scrape(text, ctype, "final scrape")
+    total = args.rows * args.producers
+    want = f'siddhi_events_total{{app="smoke",stream="TradeStream"}} {total}'
+    if want not in text:
+        got = [ln for ln in text.splitlines()
+               if ln.startswith("siddhi_events_total")]
+        failures.append(f"final scrape: expected {want!r}, got {got}")
+    if ('siddhi_query_latency_seconds_count{app="smoke",query="q"}'
+            not in text):
+        failures.append("final scrape: per-query latency histogram missing")
+
+    # probes stayed lock-free and honest throughout
+    status, _, ready = _get(base, "/ready")
+    if status != 200 or not json.loads(ready)["ready"]:
+        failures.append(f"/ready degraded after traffic: {ready}")
+
+    httpd.shutdown()
+    if failures:
+        print(f"FAIL metrics smoke ({len(failures)} violations):")
+        for f in failures[:40]:
+            print(f"  - {f}")
+        return 1
+    print(f"metrics smoke OK: {len(mid_scrapes)} mid-traffic scrapes valid, "
+          f"{total} events accounted, all always-on families present")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
