@@ -8,6 +8,8 @@ Configs (BASELINE.md "Baselines to measure"):
   5. join        — stream-stream equi join over two length(100k) windows
   6. overload    — bounded-ingress drop.old under a 10x producer/consumer
                    mismatch: sustained delivery rate + exact drop counts
+  7. upgrade     — blue-green hot-swap under sustained traffic: cutover
+                   pause ms + exact conservation (sent == delivered)
 
 Events are synthesized host-side as pre-encoded columnar batches (dictionary
 interning amortizes in steady state) and pushed through each query's jitted
@@ -936,6 +938,93 @@ def bench_overload() -> dict:
     return res
 
 
+def bench_upgrade() -> dict:
+    """Satellite config: blue-green hot-swap (core/upgrade.py) committed in
+    the middle of sustained public-path traffic. Reports the source-paused
+    (cutover) window — the only span where ingress stalls — and proves exact
+    conservation: every event sent before, during, and after the swap is
+    delivered exactly once (count AND checksum), by exactly one version."""
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.state.persistence import InMemoryPersistenceStore
+
+    res = {"metric": "upgrade_cutover_pause_ms"}
+    if E2E_ONLY:  # no tunnel/topology split for this config
+        return res
+    app_v1 = """
+    @app:name('UpgradeBench')
+    define stream TradeStream (v long);
+    @info(name = 'bench')
+    from TradeStream select v insert into OutStream;
+    """
+    app_v2 = app_v1 + """
+    @info(name = 'mirror')
+    from TradeStream select v insert into MirrorStream;
+    """
+    mgr = SiddhiManager()
+    mgr.set_persistence_store(InMemoryPersistenceStore())
+    rt = mgr.create_siddhi_app_runtime(app_v1, batch_size=1024)
+    delivered = [0, 0]  # count, checksum — dupes+losses can't cancel both
+
+    def cb(evs):
+        delivered[0] += len(evs)
+        delivered[1] += sum(e.data[0] for e in evs)
+
+    rt.add_callback("OutStream", cb)  # migrates with the swap
+    rt.start()
+    h = rt.get_input_handler("TradeStream")
+
+    _phase("upgrade:warmup")
+    h.send_batch([(int(i),) for i in range(1024)])
+    rt.flush()
+    rt.drain()
+    sent, checksum = 1024, sum(range(1024))
+
+    _phase("upgrade:feed")
+    summary: dict = {}
+    stop = threading.Event()
+
+    def swap():  # mid-stream, against live producer traffic
+        time.sleep(0.5)
+        summary.update(mgr.upgrade(app_v2))
+        stop.set()
+
+    sw = threading.Thread(target=swap, name="bench-upgrade-swap")
+    sw.start()
+    t0 = time.perf_counter()
+    v = sent
+    while not stop.is_set() or time.perf_counter() - t0 < 1.5:
+        rows = [(int(i),) for i in range(v, v + 256)]
+        h.send_batch(rows)  # stale v1 handle: forwards through the redirect
+        sent += 256
+        checksum += sum(range(v, v + 256))
+        v += 256
+        mgr.runtimes["UpgradeBench"].flush()
+        if time.perf_counter() - t0 > CONFIG_SECONDS / 3:
+            break  # watchdog floor — partials still conserve
+    sw.join()
+    elapsed = time.perf_counter() - t0
+    rt2 = mgr.runtimes["UpgradeBench"]
+    rt2.drain()
+    rt2.shutdown()
+
+    rep = rt2.statistics_report()["upgrade"]
+    res.update({
+        "value": round(summary.get("cutover_pause_ms", 0.0), 3),
+        "unit": "ms",
+        "classification": summary.get("classification"),
+        "wal_tail_replayed": summary.get("wal_tail_replayed"),
+        "sent": sent,
+        "delivered": delivered[0],
+        "checksum_ok": delivered[1] == checksum,
+        "conserved": delivered[0] == sent and delivered[1] == checksum,
+        "events_per_sec_through_swap": round((sent - 1024) / elapsed, 1),
+        "upgrades": rep["upgrades"],
+    })
+    _partial(res)
+    res.update(_preflight(app_v1))
+    return res
+
+
 def bench_e2e_ingress() -> dict:
     """HEADLINE config: multi-producer SXF1 binary ingestion through the
     service surface (SiddhiService.send_frames — the REST frames endpoint's
@@ -1125,6 +1214,7 @@ CONFIGS = {
     "pattern": bench_pattern,
     "join": bench_join,
     "overload": bench_overload,  # bounded ingress under 10x overload
+    "upgrade": bench_upgrade,  # blue-green hot-swap under live traffic
     "groupby": bench_groupby,
     "e2e_ingress": bench_e2e_ingress,  # HEADLINE: keep last — drivers that
     # parse only the final line track the wire→pipeline→device rate

@@ -16,12 +16,15 @@ from __future__ import annotations
 from typing import Optional, Union
 
 from .diagnostics import Diagnostic, LintReport, Severity, Suppressions
-from .plan import PlanGraph, build_plan
+from .plan import PlanGraph, build_plan, element_fingerprints, plan_fingerprint
 from .rules import RULES, run_rules
+from .upgrade import UPGRADE_RULES, UpgradeDiff, diff_apps
 
 __all__ = [
     "Diagnostic", "LintReport", "Severity", "Suppressions",
     "PlanGraph", "build_plan", "RULES", "analyze", "lint_mode",
+    "element_fingerprints", "plan_fingerprint",
+    "UPGRADE_RULES", "UpgradeDiff", "diff_apps",
 ]
 
 

@@ -467,3 +467,93 @@ def build_plan(app: SiddhiApp) -> PlanGraph:
             break
 
     return plan
+
+
+# ------------------------------------------------------------- fingerprints
+#
+# Structural fingerprints of the typed plan graph.  persistence.py stamps
+# every revision with plan_fingerprint(app) so restore() can refuse a
+# snapshot taken under a structurally different app, and core/upgrade.py
+# uses element_fingerprints() to decide which state sections can migrate
+# across an app version bump.  Element keys use the RUNTIME naming scheme
+# (query{i+1}/partition{i+1} over app.queries/app.partitions — see
+# SiddhiAppRuntime._build), not the analysis-side query_{idx} default, so
+# the keys line up with the sections of a state snapshot.
+
+import hashlib as _hashlib
+from dataclasses import fields as _dc_fields, is_dataclass as _is_dataclass
+from enum import Enum as _Enum
+
+
+def _canon(obj) -> str:
+    """Deterministic structural string for a query_api node. Source
+    locations and annotations are excluded: moving a query down a line or
+    adding @info must not change its identity."""
+    if obj is None:
+        return "~"
+    if _is_dataclass(obj) and not isinstance(obj, type):
+        parts = [type(obj).__name__]
+        for f in _dc_fields(obj):
+            if f.name in ("loc", "annotations"):
+                continue
+            parts.append(f"{f.name}={_canon(getattr(obj, f.name))}")
+        return "(" + ",".join(parts) + ")"
+    if isinstance(obj, _Enum):
+        return f"E:{obj.value}"
+    if isinstance(obj, dict):
+        return "{" + ",".join(
+            f"{_canon(k)}:{_canon(v)}" for k, v in sorted(
+                obj.items(), key=lambda kv: str(kv[0]))) + "}"
+    if isinstance(obj, (list, tuple)):
+        return "[" + ",".join(_canon(x) for x in obj) + "]"
+    if isinstance(obj, (str, int, float, bool, bytes)):
+        return repr(obj)
+    return repr(obj)
+
+
+def _digest(text: str) -> str:
+    return _hashlib.blake2b(text.encode("utf-8"), digest_size=12).hexdigest()
+
+
+def element_fingerprints(app: SiddhiApp) -> dict[str, str]:
+    """Per-element structural digests keyed the way runtime state snapshots
+    key their sections: ``stream:<id>``, ``table:<id>``, ``window:<id>``,
+    ``aggregation:<id>``, ``query:<name>`` (runtime default ``query{i+1}``),
+    ``partition:partition{i+1}``."""
+    fps: dict[str, str] = {}
+    for sid, d in app.stream_definitions.items():
+        attrs = tuple((a.name, a.type.value) for a in d.attributes)
+        fps[f"stream:{sid}"] = _digest(f"{sid}|{attrs!r}")
+    for tid, d in app.table_definitions.items():
+        fps[f"table:{tid}"] = _digest(_canon(d))
+    for wid, d in app.window_definitions.items():
+        fps[f"window:{wid}"] = _digest(_canon(d))
+    for aid, d in app.aggregation_definitions.items():
+        fps[f"aggregation:{aid}"] = _digest(_canon(d))
+    for i, q in enumerate(app.queries):
+        qname = q.name or f"query{i + 1}"
+        fps[f"query:{qname}"] = _digest(_canon(q))
+    for i, p in enumerate(app.partitions):
+        fps[f"partition:partition{i + 1}"] = _digest(_canon(p))
+    return fps
+
+
+def plan_fingerprint(app: SiddhiApp) -> str:
+    """Whole-app structural fingerprint: folds every element digest plus the
+    derived schemas of the typed plan graph, so any change that could alter
+    state layout or query semantics produces a different value."""
+    parts = [f"{k}={v}" for k, v in sorted(element_fingerprints(app).items())]
+    try:
+        plan = build_plan(app)
+        for name in sorted(plan.schemas):
+            s = plan.schemas[name]
+            if s.attrs is None:
+                parts.append(f"schema:{name}|{s.kind}|open")
+            else:
+                cols = tuple(
+                    (a, t.value if t is not None else "?")
+                    for a, t in s.attrs.items())
+                parts.append(f"schema:{name}|{s.kind}|{cols!r}")
+    except Exception:  # pragma: no cover - lowering must never block persist
+        pass
+    return _digest("\n".join(parts))

@@ -1,0 +1,170 @@
+"""Upgrade compatibility analysis (SL3xx: plan-graph diff rules).
+
+`diff_apps(old, new)` compares the structural fingerprints of two parsed
+SiddhiApps (analysis/plan.py `element_fingerprints`) and classifies the
+upgrade:
+
+- **compatible** — every stateful element of v1 survives unchanged in v2
+  (v2 may add elements); the whole v1 snapshot restores into v2.
+- **state-migratable** — some stateful elements changed or disappeared;
+  the unchanged ones migrate, the rest start empty.  The upgrade is still
+  safe (no corruption) but loses state for the changed elements, so
+  core/upgrade.py requires ``force=True`` to take it.
+- **incompatible** — a change that would corrupt replayed state: the app
+  was renamed, or a stream consumed by queries changed its schema (the WAL
+  tail journals rows in the v1 schema; replaying them into a different
+  column layout silently mis-assigns attributes).
+
+The per-rule findings land in a LintReport exactly like the SL1xx catalog
+so the REST surface and CLI render them identically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..query_api import SiddhiApp
+from .diagnostics import Diagnostic, LintReport, Severity
+from .plan import element_fingerprints, plan_fingerprint
+
+#: element-key prefix → snapshot section that holds its state
+STATEFUL_SECTIONS = {
+    "query": "queries",
+    "table": "tables",
+    "window": "windows",
+    "aggregation": "aggregations",
+    "partition": "partitions",
+}
+
+#: (rule_id, severity, one-line description) — docs/FAULT_TOLERANCE.md
+#: mirrors this table
+UPGRADE_RULES: list[tuple[str, Severity, str]] = [
+    ("SL301", Severity.ERROR,
+     "app rename: snapshots and WAL segments are keyed by app name"),
+    ("SL302", Severity.ERROR,
+     "input stream schema changed: WAL tail replay would mis-assign columns"),
+    ("SL303", Severity.WARN,
+     "stateful element changed: its state restarts empty after upgrade"),
+    ("SL304", Severity.WARN,
+     "stateful element removed: its state is dropped"),
+    ("SL305", Severity.INFO,
+     "element added: starts empty"),
+]
+
+
+@dataclass
+class UpgradeDiff:
+    """Outcome of diffing v1 against v2."""
+
+    old_fingerprint: str
+    new_fingerprint: str
+    classification: str  # compatible | state-migratable | incompatible
+    #: element keys (``query:<name>``, ``table:<id>``, ...) whose state
+    #: carries over 1:1
+    migratable: list[str] = field(default_factory=list)
+    changed: list[str] = field(default_factory=list)
+    removed: list[str] = field(default_factory=list)
+    added: list[str] = field(default_factory=list)
+    report: LintReport = field(default_factory=LintReport)
+
+    @property
+    def is_incompatible(self) -> bool:
+        return self.classification == "incompatible"
+
+    def restore_elements(self) -> dict[str, set[str]]:
+        """Snapshot-section → element-name filter for the migratable set
+        (feeds SnapshotService.restore(elements=...))."""
+        out: dict[str, set[str]] = {}
+        for key in self.migratable:
+            kind, _, name = key.partition(":")
+            section = STATEFUL_SECTIONS.get(kind)
+            if section:
+                out.setdefault(section, set()).add(name)
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "classification": self.classification,
+            "old_fingerprint": self.old_fingerprint,
+            "new_fingerprint": self.new_fingerprint,
+            "migratable": sorted(self.migratable),
+            "changed": sorted(self.changed),
+            "removed": sorted(self.removed),
+            "added": sorted(self.added),
+            "diagnostics": [d.to_dict() for d in self.report.sorted()],
+        }
+
+
+def _stream_schemas(app: SiddhiApp) -> dict[str, tuple]:
+    return {
+        sid: tuple((a.name, a.type.value) for a in d.attributes)
+        for sid, d in app.stream_definitions.items()
+    }
+
+
+def diff_apps(old_app: SiddhiApp, new_app: SiddhiApp) -> UpgradeDiff:
+    old_fps = element_fingerprints(old_app)
+    new_fps = element_fingerprints(new_app)
+    diff = UpgradeDiff(
+        old_fingerprint=plan_fingerprint(old_app),
+        new_fingerprint=plan_fingerprint(new_app),
+        classification="compatible",
+        report=LintReport(app_name=new_app.name),
+    )
+    rep = diff.report
+
+    if old_app.name != new_app.name:
+        rep.add(Diagnostic(
+            "SL301", Severity.ERROR,
+            f"app renamed {old_app.name!r} -> {new_app.name!r}: snapshots "
+            f"and WAL segments are keyed by app name",
+            element=new_app.name))
+
+    # streams consumed by v2 must keep the v1 column layout: the WAL tail
+    # journals original (pre-interning) rows positionally per stream id
+    old_streams, new_streams = _stream_schemas(old_app), _stream_schemas(new_app)
+    for sid, cols in old_streams.items():
+        if sid in new_streams and new_streams[sid] != cols:
+            rep.add(Diagnostic(
+                "SL302", Severity.ERROR,
+                f"stream {sid!r} schema changed "
+                f"({cols!r} -> {new_streams[sid]!r}): the journaled WAL "
+                f"tail replays rows positionally in the v1 layout",
+                element=sid))
+
+    for key, fp in sorted(old_fps.items()):
+        kind, _, name = key.partition(":")
+        if key not in new_fps:
+            if kind in STATEFUL_SECTIONS:
+                diff.removed.append(key)
+                rep.add(Diagnostic(
+                    "SL304", Severity.WARN,
+                    f"{key} removed in v2: its state is dropped",
+                    element=name))
+            continue
+        if new_fps[key] == fp:
+            if kind in STATEFUL_SECTIONS:
+                diff.migratable.append(key)
+            continue
+        if kind == "stream":
+            continue  # already flagged (SL302) when consumed layouts differ
+        diff.changed.append(key)
+        if kind in STATEFUL_SECTIONS:
+            rep.add(Diagnostic(
+                "SL303", Severity.WARN,
+                f"{key} changed in v2: its state restarts empty",
+                element=name))
+
+    for key in sorted(set(new_fps) - set(old_fps)):
+        diff.added.append(key)
+        rep.add(Diagnostic(
+            "SL305", Severity.INFO, f"{key} added in v2: starts empty",
+            element=key.partition(":")[2]))
+
+    if rep.has_errors:
+        diff.classification = "incompatible"
+    elif diff.changed or diff.removed:
+        diff.classification = "state-migratable"
+    else:
+        diff.classification = "compatible"
+    return diff

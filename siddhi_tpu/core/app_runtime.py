@@ -355,7 +355,14 @@ class SiddhiAppRuntime:
 
     # ---------------------------------------------------------------- control
 
-    def start(self) -> None:
+    def start(self, *, connect_sources: bool = True,
+              start_persist_scheduler: bool = True) -> None:
+        """Start the runtime. The blue-green upgrade path starts the v2
+        runtime in SHADOW (`connect_sources=False`,
+        `start_persist_scheduler=False`): fully built and able to process,
+        but not yet pulling from transports and not yet writing revisions —
+        cutover calls connect_sources()/_start_persist_scheduler() after the
+        swap commits."""
         self._started = True
         from ..telemetry.profiling import maybe_start_jax_profiler
         # SIDDHI_PROFILE=<dir>: the first runtime to start owns the
@@ -370,8 +377,8 @@ class SiddhiAppRuntime:
             j.start_async()
         for sink in self.sinks:
             sink.connect()
-        for source in self.sources:
-            source.connect_with_retry()
+        if connect_sources:
+            self.connect_sources()
         if self.triggers:
             now = self.ctx.timestamp_generator.current_time()
             for tr in self.triggers.values():
@@ -387,13 +394,26 @@ class SiddhiAppRuntime:
                 target=self._flusher_loop, daemon=True,
                 name=f"siddhi-flusher-{self.app.name}")
             self._flusher_thread.start()
-        if self.persistence_interval_s and self.persistence_store is not None:
-            import threading
-            self._persist_stop = threading.Event()
-            self._persist_thread = threading.Thread(
-                target=self._persist_loop, daemon=True,
-                name=f"siddhi-persist-{self.app.name}")
-            self._persist_thread.start()
+        if start_persist_scheduler:
+            self._start_persist_scheduler()
+
+    def connect_sources(self) -> None:
+        """Connect every declared source transport (idempotent — already
+        connected sources no-op in their connect paths)."""
+        for source in self.sources:
+            source.connect_with_retry()
+
+    def _start_persist_scheduler(self) -> None:
+        if not (self.persistence_interval_s
+                and self.persistence_store is not None) \
+                or self._persist_thread is not None:
+            return
+        import threading
+        self._persist_stop = threading.Event()
+        self._persist_thread = threading.Thread(
+            target=self._persist_loop, daemon=True,
+            name=f"siddhi-persist-{self.app.name}")
+        self._persist_thread.start()
 
     def _persist_loop(self) -> None:
         """Daemon: bound data-at-risk to ~persistence_interval_s without the
@@ -743,8 +763,11 @@ class SiddhiAppRuntime:
         SiddhiAppRuntimeImpl.snapshot)."""
         return self._snapshot_service().full_snapshot()
 
-    def restore(self, snapshot: bytes) -> None:
-        self._snapshot_service().restore(snapshot)
+    def restore(self, snapshot: bytes, *, elements=None) -> None:
+        """Restore a snapshot. `elements` (section -> element-name set)
+        limits the restore to the migratable subset during a state-mapped
+        upgrade (state/persistence.py SnapshotService.restore)."""
+        self._snapshot_service().restore(snapshot, elements=elements)
 
     def persist(self) -> str:
         """Snapshot to the configured PersistenceStore; returns the revision

@@ -106,6 +106,16 @@ class Statistics:
     recoveries: int = 0  # recover() completions
     wal_replayed: int = 0  # lifetime events re-sent by recover()
     shutdown_discarded: int = 0  # staged rows lost at shutdown()
+    #: blue-green upgrade / historical-replay counters (core/upgrade.py) —
+    #: tracked regardless of level: a swap or rollback is an operational
+    #: event operators must see. cutover_pause_ms is the LAST swap's
+    #: source-paused wall time (the headline "how long were we dark").
+    upgrades: int = 0  # committed hot-swaps
+    upgrade_rollbacks: int = 0  # failed swaps rolled back to v1
+    upgrade_cutover_pause_ms: float = 0.0
+    upgrade_wal_replayed: int = 0  # journal-tail events replayed into v2
+    replay_runs: int = 0  # replay_wal() completions
+    replay_events: int = 0  # lifetime events driven by replay_wal()
     #: overload-protection counters — tracked regardless of level, like the
     #: sink_* family: a dropped/diverted/paused event is a correctness signal.
     #: ingress_dropped is keyed stream -> {policy: rows} where policy is one
@@ -202,6 +212,19 @@ class Statistics:
     def track_shutdown_discard(self, n: int) -> None:
         self.shutdown_discarded += n
 
+    def track_upgrade(self, cutover_pause_ms: float, replayed: int,
+                      rollback: bool = False) -> None:
+        if rollback:
+            self.upgrade_rollbacks += 1
+            return
+        self.upgrades += 1
+        self.upgrade_cutover_pause_ms = float(cutover_pause_ms)
+        self.upgrade_wal_replayed += replayed
+
+    def track_replay(self, events: int) -> None:
+        self.replay_runs += 1
+        self.replay_events += events
+
     def record_overflow(self, name: str, n: int) -> None:
         """Register a lifetime overflow counter reading; warns ONCE per
         counter the first time it goes positive (an @OnError-style signal —
@@ -242,6 +265,12 @@ class Statistics:
         self.recoveries = 0
         self.wal_replayed = 0
         self.shutdown_discarded = 0
+        self.upgrades = 0
+        self.upgrade_rollbacks = 0
+        self.upgrade_cutover_pause_ms = 0.0
+        self.upgrade_wal_replayed = 0
+        self.replay_runs = 0
+        self.replay_events = 0
         self.started_at = time.time()
 
     def report(self, runtime=None) -> dict:
@@ -278,6 +307,16 @@ class Statistics:
                 "recoveries": self.recoveries,
                 "wal_replayed": self.wal_replayed,
                 "shutdown_discarded": self.shutdown_discarded,
+            },
+            "upgrade": {
+                "upgrades": self.upgrades,
+                "rollbacks": self.upgrade_rollbacks,
+                "cutover_pause_ms": self.upgrade_cutover_pause_ms,
+                "wal_tail_replayed": self.upgrade_wal_replayed,
+            },
+            "replay": {
+                "runs": self.replay_runs,
+                "events": self.replay_events,
             },
             # always-on, like overflow: a serialized ingress pipeline is a
             # performance regression operators must see in production.

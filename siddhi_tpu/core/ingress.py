@@ -302,7 +302,9 @@ class IngressPipeline:
         workers. Returns the number of rows CONSUMED (claimed or shed): a
         short count means the pipeline is stopping and the caller owns the
         remainder (fall back to synchronous staging)."""
-        if self._stopping:
+        if self._stopping or self.j._redirect is not None:
+            # redirected junction (blue-green cutover): the caller's
+            # synchronous fallback forwards the rows to the live junction
             return 0
         bs = self.j.batch_size
         n = len(rows)
@@ -331,7 +333,7 @@ class IngressPipeline:
         objects) or, for wire frames, attr -> ('dict', strings, idx) where
         idx is int32 with -1 = null — the zero-copy dictionary form.
         Returns rows consumed; see submit_rows."""
-        if self._stopping:
+        if self._stopping or self.j._redirect is not None:
             return 0
         specs = []
         for name in self.attrs:

@@ -16,6 +16,31 @@ from ..ops import builtin_functions as _builtin_functions  # noqa: F401
 from ..ops import window_factories as _window_factories  # noqa: F401
 
 
+def sandbox_app(app: SiddhiApp) -> SiddhiApp:
+    """A copy of `app` with every @source/@sink/@store/@cache annotation
+    stripped so a runtime built from it is fully in-memory (no transports,
+    no external stores). Used by sandbox mode AND the historical-replay
+    harness, which must never let a candidate app publish to production
+    sinks while replaying recorded traffic."""
+    import dataclasses as dc
+    drop = {"source", "sink", "store", "cache"}
+
+    def strip(defn):
+        anns = tuple(a for a in (defn.annotations or ())
+                     if a.name.lower() not in drop)
+        return dc.replace(defn, annotations=anns)
+
+    return dc.replace(
+        app,
+        stream_definitions={k: strip(v) for k, v
+                            in app.stream_definitions.items()},
+        table_definitions={k: strip(v) for k, v
+                           in app.table_definitions.items()},
+        aggregation_definitions={k: strip(v) for k, v
+                                 in app.aggregation_definitions.items()},
+    )
+
+
 class SiddhiManager:
     def __init__(self) -> None:
         self.registry = GLOBAL.copy()
@@ -124,25 +149,36 @@ class SiddhiManager:
         (SiddhiManager.createSandboxSiddhiAppRuntime /
         managment/SandboxTestCase): feed via InputHandler, observe via
         callbacks, no external transports or stores."""
-        import dataclasses as dc
-        app = self._parse(app)
-        drop = {"source", "sink", "store", "cache"}
+        return self.create_siddhi_app_runtime(
+            sandbox_app(self._parse(app)), **kw)
 
-        def strip(defn):
-            anns = tuple(a for a in (defn.annotations or ())
-                         if a.name.lower() not in drop)
-            return dc.replace(defn, annotations=anns)
+    def upgrade(self, new_app: Union[str, "SiddhiApp"], *,
+                force: bool = False) -> dict:
+        """Blue-green hot-swap of a RUNNING app to `new_app` (same name):
+        diff the plan graphs, shadow-start v2, migrate state, replay the WAL
+        tail, atomically cut sources/junctions/REST routing over, resume —
+        or roll everything back to v1 on any failure. See core/upgrade.py.
+        Returns the upgrade summary dict."""
+        from .upgrade import upgrade_app
+        new_app = self._parse(new_app)
+        old = self.runtimes.get(new_app.name)
+        if old is None:
+            raise SiddhiAppCreationError(
+                f"cannot upgrade {new_app.name!r}: no running app by that "
+                "name (deploy it instead)")
+        return upgrade_app(self, old, new_app, force=force)
 
-        app = dc.replace(
-            app,
-            stream_definitions={k: strip(v) for k, v
-                                in app.stream_definitions.items()},
-            table_definitions={k: strip(v) for k, v
-                               in app.table_definitions.items()},
-            aggregation_definitions={k: strip(v) for k, v
-                                     in app.aggregation_definitions.items()},
-        )
-        return self.create_siddhi_app_runtime(app, **kw)
+    def replay(self, app: Union[str, "SiddhiApp"], wal_dir: str, *,
+               app_name: Optional[str] = None,
+               speed: Optional[float] = None) -> dict:
+        """Deterministic accelerated-clock replay of recorded WAL segments
+        against a candidate app (backtesting / what-if). See
+        core/upgrade.py replay_wal. Returns the replay summary (events,
+        per-stream output counts, output digest — bit-identical across runs
+        of the same segments)."""
+        from .upgrade import replay_wal
+        return replay_wal(self, self._parse(app), wal_dir,
+                          app_name=app_name, speed=speed)
 
     def set_persistence_store(self, store) -> None:
         """Reference: SiddhiManager.setPersistenceStore — shared by all apps."""

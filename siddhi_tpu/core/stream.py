@@ -497,6 +497,11 @@ class StreamJunction:
         #: fault junction (`!stream`), created by the app runtime for
         #: action=STREAM; schema = this stream's attrs + _error string
         self.fault_junction: Optional["StreamJunction"] = None
+        #: blue-green cutover (core/upgrade.py): when set, every send into
+        #: this junction forwards to the v2 junction with the ORIGINAL
+        #: (pre-interning) values — v1 and v2 own separate string tables,
+        #: so encoded columns/codes must never cross the boundary
+        self._redirect: Optional["StreamJunction"] = None
 
     def _pad_cap(self, m: int) -> int:
         """Delivery capacity for `m` staged rows: the smallest power-of-two
@@ -516,6 +521,21 @@ class StreamJunction:
             receiver._junction = self
         self.receivers.append(receiver)
 
+    # -------------------------------------------------------------- redirect
+
+    def redirect_to(self, target: Optional["StreamJunction"]) -> None:
+        """Atomically route every subsequent send into `target` (the v2
+        junction during a blue-green upgrade; None undoes it on rollback).
+        Callers set it under the controller lock with this junction quiesced
+        (sources paused, async machinery stopped, staged rows flushed)."""
+        self._redirect = target
+
+    def _resolve_redirect(self) -> "StreamJunction":
+        j = self
+        while j._redirect is not None:
+            j = j._redirect
+        return j
+
     # ---------------------------------------------------------------- ingest
 
     def stage_row(self, ts: int, data: Sequence) -> None:
@@ -531,6 +551,8 @@ class StreamJunction:
             self.flush()
 
     def send_row(self, ts: int, data: Sequence) -> None:
+        if self._redirect is not None:
+            return self._resolve_redirect().send_row(ts, data)
         if self.wal is not None and not self._lock_owned():
             # journal+stage must be ONE atomic step w.r.t. persist()'s
             # snapshot+rotate critical section: interleaving there would
@@ -599,6 +621,8 @@ class StreamJunction:
         is paid once per batch instead of once per event."""
         if not rows:
             return
+        if self._redirect is not None:
+            return self._resolve_redirect().send_rows(tss, rows)
         if self.taps:  # sequence taps need true per-row send order
             for ts, row in zip(tss, rows):
                 self.send_row(ts, row)  # journals per row when WAL is on
@@ -979,6 +1003,24 @@ class StreamJunction:
             # same-thread re-entrant flush (a callback sending into its own
             # stream): defer to the outer delivery
             return
+        if self._redirect is not None:
+            # cutover leftovers (rows a producer staged while racing the
+            # swap) forward to the v2 junction as ORIGINAL rows — v2
+            # re-journals and re-encodes them under its own codec — then the
+            # flush itself delegates
+            target = self._resolve_redirect()
+            with self.ctx.controller_lock:
+                if self._tap_queue:
+                    with self._tap_lock:
+                        q, self._tap_queue = self._tap_queue, []
+                    for ts, row in q:
+                        self._staged_ts.append(ts)
+                        self._staged_rows.append(row)
+                if self._staged_rows:
+                    rows, tss = self._staged_rows, self._staged_ts
+                    self._staged_rows, self._staged_ts = [], []
+                    target.send_rows(tss, rows)
+            return target.flush(now)
         if self._pipeline is not None and not self._lock_owned():
             # barrier: every row submitted to the parallel pipeline before
             # this flush is delivered before it returns. Lock-holding
@@ -1206,7 +1248,10 @@ class InputHandler:
         per DISTINCT value; numeric columns cast whole-array) and enter the
         pipeline with zero per-row Python work. String columns accept str
         object arrays or pre-encoded int32 codes."""
-        j = self.junction
+        # resolve a blue-green redirect BEFORE any WAL/codec use: journaling
+        # or interning through the v1 junction would strand records in a
+        # retired journal / string table
+        j = self.junction._resolve_redirect()
         n = count if count is not None else \
             min(len(v) for v in columns.values())
         if n == 0:
@@ -1251,13 +1296,19 @@ class InputHandler:
         # lock (RLock — send_column_batch re-enters it) so the Python-loop
         # fallback cannot race the async feeder's locked encode path
         with j.ctx.controller_lock:
-            if j.wal is not None:
-                # inside the lock (atomic vs persist's snapshot+rotate —
-                # see send_row), journaling the ORIGINAL pre-interning
-                # values: dictionary codes are process-local and would not
-                # survive a restart
-                j.wal.append_columns(
-                    j.definition.id, ts_arr[:n].tolist(),
-                    {k: np.asarray(v)[:n] for k, v in columns.items()})
-            cols = j.codec.encode_columns(columns, n)
-            j.send_column_batch(ts_arr, cols, n)
+            # a cutover completing while we waited on the lock re-points
+            # the junction: re-resolve, and nest the LIVE junction's lock
+            # (re-entrant no-op when unchanged; v1->v2 ordering matches the
+            # upgrade path) so journal+encode hit the live one safely
+            j = j._resolve_redirect()
+            with j.ctx.controller_lock:
+                if j.wal is not None:
+                    # inside the lock (atomic vs persist's snapshot+rotate —
+                    # see send_row), journaling the ORIGINAL pre-interning
+                    # values: dictionary codes are process-local and would
+                    # not survive a restart
+                    j.wal.append_columns(
+                        j.definition.id, ts_arr[:n].tolist(),
+                        {k: np.asarray(v)[:n] for k, v in columns.items()})
+                cols = j.codec.encode_columns(columns, n)
+                j.send_column_batch(ts_arr, cols, n)

@@ -1,0 +1,455 @@
+"""Blue-green app upgrade + deterministic WAL replay.
+
+Reference analogue: the Java engine upgrades by stop → redeploy → restore,
+accepting a downtime window and replaying from a durable transport (Kafka).
+The TPU build is fed through InputHandlers and journals ingress in its own
+WAL (state/wal.py), so the swap can be done live:
+
+    upgrade_app(): diff the plan graphs (analysis/upgrade.py SL3xx rules),
+    shadow-start v2 (built, processing-capable, no transports), pause v1's
+    sources, drain v1, persist v1 and restore the revision into v2 with a
+    per-element state mapping, hand the ingress journal over, replay its
+    tail with original timestamps, re-point user callbacks, atomically
+    redirect every v1 ingress junction to its v2 twin, swap the manager /
+    REST routing entry, resume — and on ANY failure before the swap commits,
+    undo everything and leave v1 exactly as it was.
+
+Conservation invariant: every event accepted by the engine is processed by
+EXACTLY ONE version. Pre-pause sends are drained through v1 and captured in
+the handoff snapshot; the journaled suffix is replayed into v2 exactly once
+(persist() rotates the journal inside the same critical section); post-swap
+sends — including payloads buffered in paused sources — forward through the
+junction redirect into v2 with their ORIGINAL (pre-interning) values, since
+v1 and v2 own separate string tables.
+
+    replay_wal(): drive a CANDIDATE app from recorded WAL segments on a
+    virtual clock — sandboxed (no sources/sinks/stores), read-only on the
+    journal, per-record flush for deterministic batch boundaries, playback
+    timestamps so time windows fire on record time. Bit-identical output
+    digest across runs of the same segments; `speed` paces the virtual
+    clock against the wall clock via an injectable sleep (util/faults.py
+    virtual-time idiom), default is as-fast-as-possible.
+"""
+
+from __future__ import annotations
+
+import dataclasses as dc
+import hashlib
+import logging
+import os
+import pickle
+import signal
+import time
+from typing import Callable, Optional
+
+from ..errors import SiddhiAppCreationError
+from ..query_api import SiddhiApp
+
+log = logging.getLogger("siddhi_tpu")
+
+
+def _crash_point(name: str) -> None:
+    """Fault-injection hook for the upgrade-under-chaos tests: SIGKILL the
+    process at a named point when SIDDHI_UPGRADE_CRASH selects it. Points:
+    after-pause | after-persist | after-cutover."""
+    if os.environ.get("SIDDHI_UPGRADE_CRASH") == name:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _detach_persist(app: SiddhiApp):
+    """Split @app:persist off the app definition: the v2 runtime must NEVER
+    open its own WriteAheadLog on the live journal directory (two append
+    handles; resume-truncation of the live segment) — it inherits v1's
+    journal object at cutover instead. Returns (app_without_persist_ann,
+    interval_s_or_None)."""
+    interval_s = None
+    ann = app.annotation("app:persist")
+    if ann is None:
+        return app, interval_s
+    from .partition import _parse_annotation_time
+    iv = ann.element("interval") or ann.element()
+    if iv:
+        interval_s = _parse_annotation_time(iv) / 1000.0
+    anns = tuple(a for a in (app.annotations or ())
+                 if a.name.lower() != "app:persist")
+    return dc.replace(app, annotations=anns), interval_s
+
+
+def _migrate_callbacks(rt1, rt2) -> list:
+    """Re-subscribe user stream/query callbacks from v1 onto the matching v2
+    junctions/queries. Sink-owned callbacks (wiring's _SinkCallback, marked
+    _is_sink) stay put — v2 built and connected its own sinks. Returns an
+    undo list for rollback."""
+    from .stream import BatchStreamCallback, StreamCallback
+    undo: list = []
+
+    def move_stream_cbs(j1, j2) -> None:
+        for r in list(j1.receivers):
+            if not isinstance(r, (StreamCallback, BatchStreamCallback)):
+                continue  # engine-internal receivers (query runtimes, taps)
+            if getattr(r, "_is_sink", False):
+                continue
+            j2.subscribe(r)  # re-points r._junction at j2
+            undo.append(("stream", r, j1, j2))
+
+    for sid, j1 in rt1.junctions.items():
+        j2 = rt2.junctions.get(sid)
+        if j2 is not None:
+            move_stream_cbs(j1, j2)
+        elif any(isinstance(r, (StreamCallback, BatchStreamCallback))
+                 and not getattr(r, "_is_sink", False)
+                 for r in j1.receivers):
+            log.warning("upgrade: stream %r does not exist in the new app; "
+                        "its callbacks are dropped with it", sid)
+    for sid, f1 in rt1.fault_junctions.items():
+        f2 = rt2.fault_junctions.get(sid)
+        if f2 is not None:
+            move_stream_cbs(f1, f2)
+    for name, qr1 in rt1.query_runtimes.items():
+        qr2 = rt2.query_runtimes.get(name)
+        if qr2 is None:
+            if qr1.callbacks:
+                log.warning("upgrade: query %r does not exist in the new "
+                            "app; its callbacks are dropped with it", name)
+            continue
+        for cb in qr1.callbacks:
+            qr2.add_callback(cb)
+            undo.append(("query", cb, qr1, qr2))
+    return undo
+
+
+def _undo_callbacks(undo: list) -> None:
+    for kind, cb, old, new in reversed(undo):
+        if kind == "stream":
+            try:
+                new.receivers.remove(cb)
+            except ValueError:  # pragma: no cover
+                pass
+            cb._junction = old
+        else:
+            try:
+                new.callbacks.remove(cb)
+            except ValueError:  # pragma: no cover
+                pass
+
+
+def upgrade_app(manager, rt1, new_app: SiddhiApp, *,
+                force: bool = False) -> dict:
+    """Hot-swap running `rt1` to `new_app` (same app name). See the module
+    docstring for the sequence; raises (with v1 fully restored) when the
+    diff is incompatible, when a state-losing swap lacks force=True, or
+    when any step before the swap commits fails."""
+    from ..analysis.upgrade import diff_apps
+    from .app_runtime import SiddhiAppRuntime
+
+    diff = diff_apps(rt1.app, new_app)
+    if diff.is_incompatible:
+        raise SiddhiAppCreationError(
+            f"cannot upgrade {rt1.app.name!r}: incompatible change(s):\n" +
+            "\n".join(d.format() for d in diff.report.sorted()))
+    if diff.classification == "state-migratable" and not force:
+        raise SiddhiAppCreationError(
+            f"upgrade of {rt1.app.name!r} is state-migratable (changed: "
+            f"{sorted(diff.changed)}; removed: {sorted(diff.removed)}) — "
+            "their state restarts empty/is dropped. Pass force=True to "
+            "accept, or keep the element definitions identical.")
+
+    build_app, new_interval_s = _detach_persist(new_app)
+    lint_report = manager._lint_gate(build_app)
+    ctx1 = rt1.ctx
+    rt2 = SiddhiAppRuntime(
+        build_app, manager.registry,
+        batch_size=ctx1.batch_size, group_capacity=ctx1.group_capacity,
+        error_store=ctx1.error_store, config_manager=ctx1.config_manager,
+        mesh=ctx1.mesh, partition_capacity=ctx1.partition_capacity,
+        async_callbacks=ctx1.async_callbacks,
+        auto_flush_ms=rt1.auto_flush_ms or 0,
+        wal_dir=None,
+        persistence_interval_s=(new_interval_s
+                                if new_interval_s is not None
+                                else rt1.persistence_interval_s))
+    rt2.persistence_store = rt1.persistence_store
+    rt2.lint_report = lint_report
+    # shadow: fully built and able to process; no transports, no revisions
+    rt2.start(connect_sources=False, start_persist_scheduler=False)
+
+    ingress1 = [rt1.junctions[sid] for sid in rt1.app.stream_definitions]
+    paused: list = []
+    undo_cbs: list = []
+    wal_moved = False
+    sources_moved = False
+    new_sources = list(rt2.sources)  # v2's own (not-yet-connected) sources
+    revision = None
+    replayed = 0
+    swapped = False
+    t_pause = time.perf_counter()
+    try:
+        # 1. quiesce v1 ingress: pause transports (payloads buffer in the
+        #    sources, bounded), stop async pipelines/feeders
+        for j in ingress1:
+            for s in j.attached_sources:
+                s.pause()
+                paused.append(s)
+        _crash_point("after-pause")
+        for j in ingress1:
+            j.stop_async()
+
+        with rt1.ctx.controller_lock:      # lock order: v1 -> v2, matching
+            with rt2.ctx.controller_lock:  # the redirected send path
+                # 2. drain everything already accepted through v1
+                rt1.drain()
+
+                # 3. state handoff
+                elements = diff.restore_elements()
+                wal = rt1.wal
+                if rt1.persistence_store is not None:
+                    # persist() snapshots + rotates the journal in ONE
+                    # critical section (re-entrant lock), so the journal
+                    # tail after this is exactly the not-yet-snapshotted
+                    # suffix (normally empty: nothing can append here)
+                    revision = rt1.persist()
+                    _crash_point("after-persist")
+                    blob = rt1.persistence_store.load(rt1.app.name, revision)
+                else:
+                    blob = rt1.snapshot()
+                rt2.restore(blob, elements=elements)
+                rt2._last_rev_ms = getattr(rt1, "_last_rev_ms", 0)
+
+                # 4. journal handover (+ tail replay when a store rotated)
+                if wal is not None:
+                    rt1.wal = None
+                    for j in ingress1:
+                        j.wal = None
+                    rt2.wal = wal
+                    for sid in build_app.stream_definitions:
+                        j2 = rt2.junctions.get(sid)
+                        if j2 is not None:
+                            j2.wal = wal
+                    wal_moved = True
+                    if rt1.persistence_store is not None:
+                        # replayed sends re-journal via v2's junctions —
+                        # the recover() idiom; with the store-backed rotate
+                        # above this is normally zero events
+                        replayed = wal.replay(rt2)
+                    # without a store the snapshot carried the journal's
+                    # whole span: replaying it into v2 would double-apply,
+                    # so v2 adopts the journal as-is
+
+                # 5. re-point user callbacks, then cut over
+                undo_cbs = _migrate_callbacks(rt1, rt2)
+                for j in ingress1:
+                    j2 = rt2.junctions.get(j.definition.id)
+                    if j2 is not None:
+                        j.redirect_to(j2)
+                # live transports carry over (their junction redirects);
+                # they must survive rt1.shutdown and obey v2 backpressure
+                moved = rt1.sources
+                rt1.sources = []
+                rt2.sources.extend(moved)
+                sources_moved = True
+                for j in ingress1:
+                    j2 = rt2.junctions.get(j.definition.id)
+                    if j2 is None:
+                        continue
+                    for s in j.attached_sources:
+                        if s not in j2.attached_sources:
+                            j2.attached_sources.append(s)
+                manager.runtimes[build_app.name] = rt2
+                swapped = True
+        _crash_point("after-cutover")
+    except BaseException:
+        if swapped:  # post-commit failures must not yank v2 back out
+            raise
+        # ---- rollback: undo in reverse, leave v1 exactly as it was ----
+        if sources_moved:
+            rt1.sources = rt2.sources[len(new_sources):]
+            del rt2.sources[len(new_sources):]
+            for j in ingress1:
+                j2 = rt2.junctions.get(j.definition.id)
+                if j2 is None:
+                    continue
+                for s in j.attached_sources:
+                    if s in j2.attached_sources:
+                        j2.attached_sources.remove(s)
+        for j in ingress1:
+            j.redirect_to(None)
+        _undo_callbacks(undo_cbs)
+        if wal_moved:
+            wal = rt2.wal
+            rt2.wal = None
+            for j2 in rt2.junctions.values():
+                j2.wal = None
+            rt1.wal = wal
+            for j in ingress1:
+                j.wal = wal
+        for j in ingress1:
+            j.start_async()
+        for s in paused:
+            s.resume()
+        rt1.ctx.statistics.track_upgrade(
+            (time.perf_counter() - t_pause) * 1000.0, 0, rollback=True)
+        try:
+            rt2.shutdown(flush_durable=False)
+        except Exception:  # noqa: BLE001 — rollback must complete
+            log.exception("upgrade rollback: shadow v2 shutdown failed")
+        raise
+
+    # ---- post-swap: failures here are warnings, never a rollback ----
+    rt2._start_persist_scheduler()
+    transferred_sids = {s.definition.id for s in rt2.sources
+                        if s not in new_sources}
+    for s in new_sources:
+        # connect only sources on streams with no carried-over transport —
+        # a carried transport + a fresh connect would double-deliver
+        if s.definition.id in transferred_sids:
+            continue
+        try:
+            s.connect_with_retry()
+        except Exception:  # noqa: BLE001
+            log.exception("upgrade: connecting new source on %r failed "
+                          "(its retry schedule continues)", s.definition.id)
+    for s in paused:
+        try:
+            s.resume()  # buffered payloads drain through the redirect
+        except Exception:  # noqa: BLE001
+            log.exception("upgrade: resuming a source failed")
+    cutover_pause_ms = (time.perf_counter() - t_pause) * 1000.0
+    try:
+        rt1.shutdown(flush_durable=False)
+    except Exception:  # noqa: BLE001
+        log.exception("upgrade: v1 teardown failed (v2 is live)")
+    rt2.ctx.statistics.track_upgrade(cutover_pause_ms, replayed)
+    tele = getattr(rt2.ctx, "telemetry", None)
+    summary = {
+        "app": build_app.name,
+        "status": "swapped",
+        "classification": diff.classification,
+        "old_fingerprint": diff.old_fingerprint,
+        "new_fingerprint": diff.new_fingerprint,
+        "migrated": sorted(diff.migratable),
+        "changed": sorted(diff.changed),
+        "removed": sorted(diff.removed),
+        "added": sorted(diff.added),
+        "revision": revision,
+        "wal_tail_replayed": replayed,
+        "cutover_pause_ms": cutover_pause_ms,
+        "diagnostics": [d.format() for d in diff.report.sorted()],
+    }
+    if tele is not None:
+        try:
+            tele.observe_upgrade(cutover_pause_ms)
+        except AttributeError:  # pragma: no cover — older telemetry
+            pass
+    log.info("upgraded %r (%s) in %.1f ms source-paused time",
+             build_app.name, diff.classification, cutover_pause_ms)
+    return summary
+
+
+def replay_wal(manager, app: SiddhiApp, wal_dir: str, *,
+               app_name: Optional[str] = None,
+               speed: Optional[float] = None,
+               sleep: Callable[[float], None] = time.sleep) -> dict:
+    """Deterministic accelerated-clock replay of recorded WAL segments
+    against a candidate `app`. Sandboxed (sources/sinks/stores stripped,
+    @app:persist detached — the journal is read-only via
+    state/wal.read_records), virtual playback clock, per-record flush.
+    `speed` scales record time against wall time (2.0 = twice realtime;
+    None/inf = as fast as possible); `sleep` is injectable for tests
+    (util/faults.py virtual-time idiom). Returns the replay summary; the
+    `digest` field is bit-identical across runs of the same segments."""
+    import numpy as np
+
+    from ..errors import DefinitionNotExistError
+    from ..state.wal import read_records
+    from .app_runtime import SiddhiAppRuntime
+    from .manager import sandbox_app
+    from .stream import StreamCallback
+
+    app, _interval = _detach_persist(sandbox_app(app))
+    rt = SiddhiAppRuntime(app, manager.registry,
+                          config_manager=manager.config_manager,
+                          auto_flush_ms=0)  # no flusher thread: batch
+    #                                         boundaries must be replay-driven
+    tg = rt.ctx.timestamp_generator
+    tg.playback = True  # current_time() == last event ts (virtual clock)
+    rt.ctx.playback = True
+
+    sha = hashlib.sha256()
+    counts: dict[str, int] = {}
+
+    class _Recorder(StreamCallback):
+        def __init__(self, sid: str) -> None:
+            self.sid = sid
+
+        def receive(self, events) -> None:
+            counts[self.sid] = counts.get(self.sid, 0) + len(events)
+            sha.update(pickle.dumps(
+                (self.sid,
+                 [(e.timestamp, tuple(e.data), e.is_expired)
+                  for e in events]),
+                protocol=4))
+
+    for sid, j in rt.junctions.items():
+        j.subscribe(_Recorder(sid))
+    for sid, f in rt.fault_junctions.items():
+        f.subscribe(_Recorder(f"!{sid}"))
+
+    rt.start()  # sandboxed: no transports; auto_flush 0: no flusher
+    pace = (float(speed) if speed not in (None, 0)
+            and speed != float("inf") else None)
+    n = records = skipped = 0
+    first_ts: Optional[int] = None
+    last_ts: Optional[int] = None
+    unknown: set = set()
+    t0 = time.perf_counter()
+    try:
+        for kind, sid, tss, data in read_records(wal_dir,
+                                                 app_name or app.name):
+            records += 1
+            try:
+                handler = rt.get_input_handler(sid)
+            except DefinitionNotExistError:
+                if sid not in unknown:
+                    unknown.add(sid)
+                    log.warning("replay: stream %r is not defined on the "
+                                "candidate app; its records are skipped",
+                                sid)
+                skipped += len(tss)
+                continue
+            if tss:
+                if first_ts is None:
+                    first_ts = tss[0]
+                if pace is not None and last_ts is not None:
+                    dt_s = max(0, tss[0] - last_ts) / 1000.0 / pace
+                    if dt_s > 0:
+                        sleep(dt_s)
+                last_ts = tss[-1]
+            if kind == "rows":
+                handler.send_batch(data, timestamps=tss)
+                n += len(data)
+            else:  # "cols"
+                handler.send_columns(
+                    data, timestamps=np.asarray(tss, dtype=np.int64))
+                n += len(tss)
+            # one flush per journal record: batch boundaries — and with
+            # them window/expiry phasing — depend only on the journal
+            rt.flush()
+        rt.drain()
+    finally:
+        rt.shutdown(flush_durable=False)
+    wall_s = time.perf_counter() - t0
+    virtual_ms = (last_ts - first_ts) if first_ts is not None else 0
+    live = manager.runtimes.get(app_name or app.name)
+    (live.ctx.statistics if live is not None
+     else rt.ctx.statistics).track_replay(n)
+    return {
+        "app": app.name,
+        "events": n,
+        "records": records,
+        "skipped": skipped,
+        "outputs": dict(sorted(counts.items())),
+        "digest": sha.hexdigest(),
+        "virtual_ms": int(virtual_ms),
+        "wall_s": wall_s,
+        "speedup": (virtual_ms / 1000.0 / wall_s) if wall_s > 0 else None,
+    }

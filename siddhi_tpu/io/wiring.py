@@ -163,6 +163,11 @@ def build_sink(ann: Annotation, junction, ctx) -> Sink:
     sink._junction = junction
 
     class _SinkCallback(StreamCallback):
+        # sink-owned subscription: the blue-green upgrade migrates USER
+        # callbacks to the v2 junctions but leaves sink callbacks with
+        # their runtime (v2 builds + connects its own sinks)
+        _is_sink = True
+
         def receive(self, events) -> None:
             sink.publish_rows([tuple(e.data) for e in events],
                               timestamps=[e.timestamp for e in events])

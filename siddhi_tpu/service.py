@@ -12,6 +12,24 @@ Endpoints (JSON):
   POST   /siddhi-apps/<name>/query    body = {"query": "from T select ..."}
   POST   /siddhi-apps/<name>/persist  → {"revision": "..."}
   POST   /siddhi-apps/<name>/recover  → {"revision": ..., "wal_replayed": n}
+  POST   /siddhi-apps/<name>/upgrade[?force=true]
+                                      body = SiddhiQL text of the NEW app
+                                      version (same @app:name) → blue-green
+                                      hot-swap (core/upgrade.py): state
+                                      migrates, WAL tail replays, sources/
+                                      routing cut over atomically; any
+                                      pre-commit failure rolls back to v1
+  POST   /siddhi-apps/<name>/replay   body = {"app"?: SiddhiQL, "wal_dir"?:
+                                      path, "speed"?: float} → deterministic
+                                      replay of recorded WAL segments
+                                      against a candidate app (defaults:
+                                      the deployed app over its own journal)
+  GET    /siddhi-apps/<name>/errors?kind=&stream=
+                                      → stored error entries (metadata)
+  POST   /siddhi-apps/<name>/errors/replay
+                                      body = {"kind"?, "stream"?, "ids"?}
+                                      → re-send matching entries into their
+                                      original streams, original timestamps
   GET    /siddhi-apps/<name>/statistics
   GET    /health                      → 200 always while the process serves
   GET    /ready                       → 200 when every app is "running";
@@ -59,6 +77,12 @@ class SiddhiService:
         self.lock = threading.Lock()
         self.token = token
         self.allow_scripts = allow_scripts
+        if self.manager.error_store is None:
+            # the /errors endpoints need a store to read; the bounded
+            # in-memory default makes @OnError(action='STORE') / dead-letter
+            # capture work out of the box on a fresh service
+            from .state.error_store import InMemoryErrorStore
+            self.manager.set_error_store(InMemoryErrorStore())
 
     # ------------------------------------------------------------- operations
 
@@ -136,6 +160,91 @@ class SiddhiService:
         with self.lock:
             return self.manager.runtimes[app].recover()
 
+    def _parse_guarded(self, siddhi_ql: str):
+        """Parse SiddhiQL with the same script-function gate as deploy():
+        an upgrade/replay body is code-execution surface too."""
+        from . import compiler
+        text = (compiler.update_variables(siddhi_ql)
+                if "${" in siddhi_ql else siddhi_ql)
+        app = compiler.parse(text)
+        if app.function_definitions and not self.allow_scripts:
+            names = ", ".join(sorted(app.function_definitions))
+            raise SiddhiError(
+                "app defines script functions (" + names + ") which "
+                "execute arbitrary code; start the service with "
+                "allow_scripts=True to permit them")
+        return app
+
+    def upgrade(self, name: str, siddhi_ql: str, *,
+                force: bool = False) -> dict:
+        """Blue-green hot-swap of deployed app `name` to the new version in
+        the body (core/upgrade.py). Held under the service lock: the swap
+        replaces the manager routing entry every other endpoint resolves."""
+        with self.lock:
+            app = self._parse_guarded(siddhi_ql)
+            if app.name != name:
+                raise SiddhiError(
+                    f"body deploys {app.name!r} but the URL names {name!r}; "
+                    "an upgrade must keep the app name")
+            return self.manager.upgrade(app, force=force)
+
+    def replay(self, name: str, *, siddhi_ql: str | None = None,
+               wal_dir: str | None = None,
+               speed: float | None = None) -> dict:
+        """Deterministic WAL replay against a candidate app (defaults to the
+        deployed app replaying its own journal)."""
+        import os
+        with self.lock:
+            rt = self.manager.runtimes[name]
+            app = (self._parse_guarded(siddhi_ql) if siddhi_ql
+                   else rt.app)
+            if wal_dir is None:
+                if rt.wal is None:
+                    raise SiddhiError(
+                        f"app {name!r} has no WAL; pass wal_dir explicitly")
+                wal_dir = os.path.dirname(rt.wal.dir)
+            return self.manager.replay(app, wal_dir, app_name=name,
+                                       speed=speed)
+
+    def errors(self, name: str, *, stream: str | None = None,
+               kind: str | None = None) -> list[dict]:
+        """Stored error entries for one app (metadata only: row payloads may
+        not be JSON-safe and can be large — replay acts on the stored
+        originals server-side)."""
+        with self.lock:
+            rt = self.manager.runtimes[name]
+            es = rt.ctx.error_store
+            if es is None:
+                return []
+            return [{"id": e.id, "timestamp": e.timestamp,
+                     "stream": e.stream_name, "kind": e.kind,
+                     "events": len(e.events), "cause": e.cause}
+                    for e in es.load(name, stream, kind)]
+
+    def replay_errors(self, name: str, *, stream: str | None = None,
+                      kind: str | None = None,
+                      ids: list | None = None) -> dict:
+        """Re-send matching stored entries into their original streams with
+        their original timestamps; each entry is discarded only once all its
+        rows were accepted (ErrorStore.replay)."""
+        with self.lock:
+            rt = self.manager.runtimes[name]
+            es = rt.ctx.error_store
+            if es is None:
+                return {"replayed_entries": 0, "replayed_events": 0}
+            entries = es.load(name, stream, kind)
+            if ids:
+                wanted = {int(i) for i in ids}
+                entries = [e for e in entries if e.id in wanted]
+            n_entries = n_events = 0
+            for e in entries:
+                es.replay(e, rt)
+                n_entries += 1
+                n_events += len(e.events)
+            rt.flush()
+            return {"replayed_entries": n_entries,
+                    "replayed_events": n_events}
+
     def validate(self, siddhi_ql: str) -> dict:
         """Static lint WITHOUT deploying (no runtime is created, nothing
         starts): the CLI's report shape over HTTP. Parse failures come back
@@ -201,6 +310,16 @@ class SiddhiService:
                 n = int(self.headers.get("Content-Length", 0))
                 return self.rfile.read(n)
 
+            def _route(self):
+                """(path_parts, query_dict) — the path may carry a query
+                string (?force=true, ?kind=sink); parse_qs flattens each
+                key to its first value."""
+                from urllib.parse import parse_qs, urlsplit
+                u = urlsplit(self.path)
+                parts = u.path.strip("/").split("/")
+                q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                return parts, q
+
             def _authorized(self) -> bool:
                 if service.token is None:
                     return True
@@ -213,7 +332,7 @@ class SiddhiService:
                 return False
 
             def do_GET(self):
-                parts = self.path.strip("/").split("/")
+                parts, query = self._route()
                 # probe endpoints skip auth (orchestrator probes carry no
                 # credentials; bodies expose names + states only)
                 if parts == ["health"]:
@@ -243,6 +362,11 @@ class SiddhiService:
                     elif (len(parts) == 3 and parts[0] == "siddhi-apps"
                           and parts[2] == "statistics"):
                         self._reply(200, service.statistics(parts[1]))
+                    elif (len(parts) == 3 and parts[0] == "siddhi-apps"
+                          and parts[2] == "errors"):
+                        self._reply(200, {"errors": service.errors(
+                            parts[1], stream=query.get("stream"),
+                            kind=query.get("kind"))})
                     else:
                         self._reply(404, {"error": "not found"})
                 except KeyError:
@@ -251,7 +375,7 @@ class SiddhiService:
             def do_POST(self):
                 if not self._authorized():
                     return
-                parts = self.path.strip("/").split("/")
+                parts, query = self._route()
                 try:
                     if parts == ["siddhi-apps"]:
                         name = service.deploy(self._body())
@@ -283,6 +407,30 @@ class SiddhiService:
                     elif (len(parts) == 3 and parts[0] == "siddhi-apps"
                           and parts[2] == "recover"):
                         self._reply(200, service.recover(parts[1]))
+                    elif (len(parts) == 3 and parts[0] == "siddhi-apps"
+                          and parts[2] == "upgrade"):
+                        force = query.get("force", "").lower() \
+                            in ("1", "true", "yes")
+                        self._reply(200, service.upgrade(
+                            parts[1], self._body(), force=force))
+                    elif (len(parts) == 3 and parts[0] == "siddhi-apps"
+                          and parts[2] == "replay"):
+                        body = self._body()
+                        data = json.loads(body) if body.strip() else {}
+                        speed = data.get("speed")
+                        self._reply(200, service.replay(
+                            parts[1], siddhi_ql=data.get("app"),
+                            wal_dir=data.get("wal_dir"),
+                            speed=float(speed) if speed is not None
+                            else None))
+                    elif (len(parts) == 4 and parts[0] == "siddhi-apps"
+                          and parts[2] == "errors"
+                          and parts[3] == "replay"):
+                        body = self._body()
+                        data = json.loads(body) if body.strip() else {}
+                        self._reply(200, service.replay_errors(
+                            parts[1], stream=data.get("stream"),
+                            kind=data.get("kind"), ids=data.get("ids")))
                     else:
                         self._reply(404, {"error": "not found"})
                 except KeyError as e:
@@ -297,7 +445,7 @@ class SiddhiService:
             def do_DELETE(self):
                 if not self._authorized():
                     return
-                parts = self.path.strip("/").split("/")
+                parts, _query = self._route()
                 if len(parts) == 2 and parts[0] == "siddhi-apps":
                     ok = service.undeploy(parts[1])
                     self._reply(200 if ok else 404,

@@ -45,6 +45,21 @@ class ErrorStore:
     def discard(self, entry_id: int) -> None:
         raise NotImplementedError
 
+    def replay(self, entry: ErrorEntry, app_runtime) -> None:
+        """Re-send a stored entry's rows into its original stream — with
+        their ORIGINAL timestamps, so windows/aggregations bucket them
+        correctly — and drop it (reference: replay via
+        ReplayableTableRecord). All rows go in ONE batched staging call and
+        the entry is discarded only after every row was accepted: an
+        exception mid-replay leaves the whole entry in the store instead of
+        half-losing it. Base-class behavior — store backends only override
+        the persistence primitives above."""
+        handler = app_runtime.get_input_handler(entry.stream_name)
+        tss = [ts for ts, _row in entry.events]
+        rows = [row for _ts, row in entry.events]
+        handler.send_batch(rows, timestamps=tss)
+        self.discard(entry.id)
+
 
 class InMemoryErrorStore(ErrorStore):
     """Bounded in-memory store: `max_entries` caps host memory (an @OnError
@@ -86,16 +101,3 @@ class InMemoryErrorStore(ErrorStore):
 
     def discard(self, entry_id) -> None:
         self._entries.pop(entry_id, None)
-
-    def replay(self, entry: ErrorEntry, app_runtime) -> None:
-        """Re-send a stored entry's rows into its original stream — with their
-        ORIGINAL timestamps, so windows/aggregations bucket them correctly —
-        and drop it (reference: replay via ReplayableTableRecord). All rows go
-        in ONE batched staging call and the entry is discarded only after
-        every row was accepted: an exception mid-replay leaves the whole entry
-        in the store instead of half-losing it."""
-        handler = app_runtime.get_input_handler(entry.stream_name)
-        tss = [ts for ts, _row in entry.events]
-        rows = [row for _ts, row in entry.events]
-        handler.send_batch(rows, timestamps=tss)
-        self.discard(entry.id)

@@ -316,6 +316,7 @@ class SnapshotService:
 
         snap = {
             "app": rt.app.name,
+            "fingerprint": self._fingerprint(),
             "queries": {name: fetch(f"q:{name}", qr.state)
                         for name, qr in rt.query_runtimes.items()
                         if not getattr(qr, "_partitioned", False)},
@@ -338,7 +339,26 @@ class SnapshotService:
         self._memo = new_memo
         return pickle.dumps(snap, protocol=pickle.HIGHEST_PROTOCOL)
 
-    def restore(self, blob: bytes) -> None:
+    def _fingerprint(self) -> Optional[str]:
+        """App-structure fingerprint stamped into every revision (memoized —
+        the app object never changes after creation). Best-effort: a
+        lowering failure must never block persist."""
+        fp = getattr(self, "_fp_memo", False)
+        if fp is False:
+            try:
+                from ..analysis.plan import plan_fingerprint
+                fp = plan_fingerprint(self.rt.app)
+            except Exception:  # pragma: no cover — fingerprint is advisory
+                fp = None
+            self._fp_memo = fp
+        return fp
+
+    def restore(self, blob: bytes, *,
+                elements: Optional[dict[str, set[str]]] = None) -> None:
+        """Restore a snapshot. `elements` (section name -> element-name set)
+        limits which stateful sections restore — the state-migratable
+        upgrade path feeds it UpgradeDiff.restore_elements(); None restores
+        everything (and then a fingerprint mismatch is refused)."""
         rt = self.rt
         try:
             snap = pickle.loads(blob)
@@ -348,21 +368,43 @@ class SnapshotService:
             raise CannotRestoreStateError(
                 f"snapshot belongs to app {snap.get('app')!r}, "
                 f"not {rt.app.name!r}")
+        # structural gate: refuse a full restore of a snapshot taken under a
+        # different app structure instead of corrupting state leaf-by-leaf.
+        # Pre-fingerprint snapshots (no stamp) stay loadable; element-mapped
+        # restores skip the gate — the caller already diffed the plans.
+        snap_fp = snap.get("fingerprint")
+        if elements is None and snap_fp is not None:
+            own_fp = self._fingerprint()
+            if own_fp is not None and snap_fp != own_fp:
+                raise CannotRestoreStateError(
+                    f"snapshot fingerprint {snap_fp} does not match the "
+                    f"current app structure {own_fp} for {rt.app.name!r} — "
+                    "the app definition changed since this revision was "
+                    "taken; use the upgrade path (element-mapped restore) "
+                    "or clear old revisions")
+
+        def wanted(section: str, name: str) -> bool:
+            return elements is None or name in elements.get(section, ())
+
         try:
             for name, qr in rt.query_runtimes.items():
-                if name in snap["queries"] and not getattr(qr, "_partitioned", False):
+                if name in snap["queries"] and wanted("queries", name) \
+                        and not getattr(qr, "_partitioned", False):
                     qr.state = _to_device(snap["queries"][name], qr.state)
             for tid, t in rt.tables.items():
-                if tid in snap["tables"] and not hasattr(t, "store"):
+                if tid in snap["tables"] and wanted("tables", tid) \
+                        and not hasattr(t, "store"):
                     t.state = _to_device(snap["tables"][tid], t.state)
             for wid, w in getattr(rt, "windows", {}).items():
-                if wid in snap.get("windows", {}):
+                if wid in snap.get("windows", {}) and wanted("windows", wid):
                     w.state = _to_device(snap["windows"][wid], w.state)
             for aid, a in getattr(rt, "aggregations", {}).items():
-                if aid in snap.get("aggregations", {}):
+                if aid in snap.get("aggregations", {}) \
+                        and wanted("aggregations", aid):
                     a.state = _to_device(snap["aggregations"][aid], a.state)
             for pname, p in getattr(rt, "partitions", {}).items():
-                if pname in snap.get("partitions", {}):
+                if pname in snap.get("partitions", {}) \
+                        and wanted("partitions", pname):
                     p.restore_states(snap["partitions"][pname])
         except (ValueError, KeyError) as e:
             raise CannotRestoreStateError(

@@ -66,6 +66,7 @@ class WriteAheadLog:
         self.appended_records = 0
         self.appended_events = 0
         self.replayed_events = 0
+        self.skipped_events = 0
         self._file = None
         segs = self._segments()
         if segs:
@@ -202,8 +203,12 @@ class WriteAheadLog:
         replayed sends re-journal themselves (they are state not yet covered
         by any snapshot — a crash DURING recovery must still recover); the
         consumed segments are deleted only after the replay fully succeeds.
+        Streams the target runtime does not define (a tail recorded under a
+        different app version) are skipped and counted, never fatal.
         Returns the number of events replayed."""
         import numpy as np
+
+        from ..errors import DefinitionNotExistError
         with self._lock:
             old = self._segments()
             if self._file is not None:
@@ -211,11 +216,22 @@ class WriteAheadLog:
             self._seq = (old[-1][0] if old else self._seq) + 1
             self._open_segment()
         n = 0
+        unknown: set = set()
         for _seq, _tag, path in old:
             with open(path, "rb") as f:
                 for payload, _end in self._iter_payloads(f, path):
                     kind, sid, tss, data = pickle.loads(payload)
-                    handler = runtime.get_input_handler(sid)
+                    try:
+                        handler = runtime.get_input_handler(sid)
+                    except DefinitionNotExistError:
+                        if sid not in unknown:
+                            unknown.add(sid)
+                            log.warning(
+                                "WAL replay: stream %r is not defined on "
+                                "%s; its journaled events are skipped",
+                                sid, runtime.app.name)
+                        self.skipped_events += len(tss)
+                        continue
                     if kind == "rows":
                         handler.send_batch(data, timestamps=tss)
                         n += len(data)
@@ -241,3 +257,34 @@ class WriteAheadLog:
                     os.fsync(self._file.fileno())
                 self._file.close()
                 self._file = None
+
+
+def list_segments(base_dir: str, app_name: Optional[str] = None) -> list:
+    """[(seq, tag, path)] for an app's WAL directory, WITHOUT opening the
+    journal for append. `base_dir` is the wal.dir root when `app_name` is
+    given, else directly the segment directory."""
+    d = os.path.join(base_dir, app_name) if app_name else base_dir
+    out = []
+    if not os.path.isdir(d):
+        return out
+    for f in os.listdir(d):
+        if not f.endswith(".wal") or f.startswith("."):
+            continue
+        seq_s, _, tag = f[:-4].partition("_")
+        try:
+            out.append((int(seq_s), tag, os.path.join(d, f)))
+        except ValueError:
+            log.warning("ignoring unrecognized WAL file %r", f)
+    out.sort()
+    return out
+
+
+def read_records(base_dir: str, app_name: Optional[str] = None):
+    """Yield every whole journal record ``(kind, stream_id, tss, data)`` in
+    append order, read-only (no truncation, no rotation, no append handle):
+    the historical-replay path reads a LIVE app's journal without disturbing
+    it, or a dead app's journal without adopting it."""
+    for _seq, _tag, path in list_segments(base_dir, app_name):
+        with open(path, "rb") as f:
+            for payload, _end in WriteAheadLog._iter_payloads(f, path):
+                yield pickle.loads(payload)

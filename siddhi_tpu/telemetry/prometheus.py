@@ -195,6 +195,30 @@ def _stats_families(exp: _Exposition, app: str, runtime) -> None:
                 "Lifetime events re-sent by recover()", ("app",))
     exp.add("siddhi_wal_replayed_total", (app,), st.wal_replayed)
 
+    # blue-green upgrade / historical replay (core/upgrade.py)
+    exp.declare("siddhi_upgrades_total", "counter",
+                "Committed blue-green hot-swaps", ("app",))
+    exp.add("siddhi_upgrades_total", (app,), st.upgrades)
+    exp.declare("siddhi_upgrade_rollbacks_total", "counter",
+                "Hot-swaps that failed pre-commit and rolled back to v1",
+                ("app",))
+    exp.add("siddhi_upgrade_rollbacks_total", (app,), st.upgrade_rollbacks)
+    exp.declare("siddhi_upgrade_cutover_pause_ms", "gauge",
+                "Last hot-swap's source-paused (cutover) wall time", ("app",))
+    exp.add("siddhi_upgrade_cutover_pause_ms", (app,),
+            st.upgrade_cutover_pause_ms)
+    exp.declare("siddhi_upgrade_wal_replayed_total", "counter",
+                "Journal-tail events replayed into v2 during hot-swaps",
+                ("app",))
+    exp.add("siddhi_upgrade_wal_replayed_total", (app,),
+            st.upgrade_wal_replayed)
+    exp.declare("siddhi_replay_runs_total", "counter",
+                "Historical WAL replay runs", ("app",))
+    exp.add("siddhi_replay_runs_total", (app,), st.replay_runs)
+    exp.declare("siddhi_replay_events_total", "counter",
+                "Lifetime events driven by historical WAL replay", ("app",))
+    exp.add("siddhi_replay_events_total", (app,), st.replay_events)
+
     # parallel-ingress pipeline gauges/counters (core/ingress.py)
     exp.declare("siddhi_ingress_pipeline_rows_total", "counter",
                 "Rows accepted by the parallel ingress pipeline",

@@ -115,6 +115,9 @@ class AppTelemetry:
             "siddhi_sink_published_total",
             "Rows handed to Sink.publish_rows per output stream",
             ("stream",))
+        self.upgrade_hist = r.histogram(
+            "siddhi_upgrade_cutover_seconds",
+            "Blue-green hot-swap source-paused (cutover) wall time")
         # tracer state
         self._ids = itertools.count(1)
         self._tls = threading.local()
@@ -207,6 +210,10 @@ class AppTelemetry:
         if tr is not None:
             tr.device_ns += ns
             tr.queries.append(query)
+
+    def observe_upgrade(self, pause_ms: float) -> None:
+        """One committed hot-swap's cutover pause (core/upgrade.py)."""
+        self.upgrade_hist.labels().observe_ns(int(pause_ms * 1e6))
 
     def record_sink(self, stream: str, rows: int, ns: int) -> None:
         cells = self._sink_cells.get(stream)

@@ -10,9 +10,16 @@ never race an in-flight send. Commands:
     persist     persist to the filesystem store, reply "PERSISTED <rev>"
     recover     restore last revision + WAL replay, reply
                 "RECOVERED <rev> <n_replayed>"
+    upgrade     blue-green hot-swap to APP_V2 (adds a 'mirror' query), reply
+                "UPGRADED <classification>" — with SIDDHI_UPGRADE_CRASH set
+                the process SIGKILLs itself at the seeded point instead
     result      flush, reply "RESULT <count> <sum>" (last Out emission)
     stats       reply "STATS <recoveries> <wal_replayed>"
     exit        clean shutdown, reply "BYE"
+
+Every command after an upgrade re-resolves the runtime through
+``mgr.runtimes`` — a committed swap replaces the registered runtime, and the
+migrated "Out" callback keeps feeding the same ``out`` list across versions.
 """
 
 import os
@@ -29,6 +36,14 @@ APP = ("@app:name('CrashApp')\n"
        "define stream S (k string, v long);\n"
        "@info(name='q') from S#window.length(8) "
        "select count() as c, sum(v) as s insert into Out;")
+
+# v2 ADDS a query (SL305, state-compatible): the upgrade must carry q's
+# window state across and keep the Out stream byte-identical to v1
+APP_V2 = ("@app:name('CrashApp')\n"
+          "define stream S (k string, v long);\n"
+          "@info(name='q') from S#window.length(8) "
+          "select count() as c, sum(v) as s insert into Out;\n"
+          "@info(name='mirror') from S select k, v insert into Mirror;")
 
 
 def main() -> None:
@@ -48,15 +63,23 @@ def main() -> None:
     out = []
     rt.add_callback("Out", lambda evs: out.extend(tuple(e.data) for e in evs))
     rt.start()
+    from siddhi_tpu.util.faults import apply_fault_spec
+    apply_fault_spec(rt)  # no-op unless SIDDHI_FAULT_SPEC seeds chaos (CI)
     h = rt.get_input_handler("S")
     print("READY", flush=True)
     for line in sys.stdin:
+        # a committed hot-swap replaces the registered runtime in place
+        rt = mgr.runtimes.get("CrashApp", rt)
         cmd, *args = line.split()
         if cmd == "send":
             i = int(args[0])
             h.send(("k", value(i)), timestamp=1_000 + i)
             rt.flush()
             print(f"OK {i}", flush=True)
+        elif cmd == "upgrade":
+            summary = mgr.upgrade(APP_V2)
+            h = mgr.runtimes["CrashApp"].get_input_handler("S")
+            print(f"UPGRADED {summary['classification']}", flush=True)
         elif cmd == "persist":
             print(f"PERSISTED {rt.persist()}", flush=True)
         elif cmd == "recover":

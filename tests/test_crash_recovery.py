@@ -43,11 +43,13 @@ class _Worker:
     """One engine subprocess with a watchdog so a wedged child fails the
     test instead of hanging the suite."""
 
-    def __init__(self, base: str, timeout_s: float = 240.0):
+    def __init__(self, base: str, timeout_s: float = 240.0,
+                 extra_env: dict = None):
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         env = {**os.environ, "JAX_PLATFORMS": "cpu",
                "PYTHONPATH": repo + os.pathsep
-               + os.environ.get("PYTHONPATH", "")}
+               + os.environ.get("PYTHONPATH", ""),
+               **(extra_env or {})}
         self.proc = subprocess.Popen(
             [sys.executable, WORKER, base],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,

@@ -1,0 +1,87 @@
+"""Upgrade-under-chaos: SIGKILL the engine MID-hot-swap at three seeded
+points (core/upgrade.py _crash_point, selected via SIDDHI_UPGRADE_CRASH),
+recover with the v1 app, finish the stream — and the windowed output must
+match a no-upgrade oracle exactly.
+
+The three points cover the distinct durability shapes of the cutover:
+
+  after-pause    sources quiesced, nothing persisted yet → recovery is the
+                 pre-upgrade revision + the journaled suffix
+  after-persist  the upgrade's own persist() committed (journal rotated)
+                 but the swap didn't → recovery is that revision, empty tail
+  after-cutover  the swap committed in-memory only; the process died before
+                 acking → same durable state as after-persist, and the
+                 operator's manifest still says v1
+
+Recovery always uses the V1 app: a crashed upgrade never acked, and the
+mid-upgrade revision carries v1's structural fingerprint (rt1.persist runs
+before the swap), so the v1 restore passes the persistence gate.
+
+Driven through the same acknowledged-stdin worker as test_crash_recovery, so
+the accepted-event set at the kill is exact, not racy.
+"""
+
+import pytest
+
+from tests.crash_worker import WINDOW
+from tests.test_crash_recovery import _Worker, _value
+
+# slow: each case SIGKILLs and re-boots engine subprocesses — excluded from
+# the tier-1 sweep, run directly by the dedicated CI upgrade-chaos step
+pytestmark = [pytest.mark.smoke, pytest.mark.slow]
+
+EVENTS = 40
+
+
+@pytest.fixture(scope="module")
+def oracle(tmp_path_factory):
+    """No-crash, no-upgrade run of the same stream."""
+    w = _Worker(str(tmp_path_factory.mktemp("oracle")))
+    w.send_range(0, EVENTS)
+    res = w.cmd("result", "RESULT")
+    w.close()
+    vals = [_value(i) for i in range(EVENTS)]
+    assert res == f"RESULT {WINDOW} {sum(vals[-WINDOW:])}"
+    return res
+
+
+@pytest.mark.parametrize("point,expect_replay", [
+    ("after-pause", 5),     # manual persist rotated at 10; journal has 10..14
+    ("after-persist", 0),   # the upgrade's persist rotated; empty tail
+    ("after-cutover", 0),   # same durable state; swap was in-memory only
+])
+def test_sigkill_mid_upgrade_recovery_matches_oracle(
+        tmp_path, oracle, point, expect_replay):
+    base = str(tmp_path / point)
+    w = _Worker(base, extra_env={"SIDDHI_UPGRADE_CRASH": point})
+    w.send_range(0, 10)
+    w.cmd("persist", "PERSISTED")
+    w.send_range(10, 15)
+    # the upgrade SIGKILLs itself at the seeded point: no reply ever comes
+    w.proc.stdin.write("upgrade\n")
+    w.proc.stdin.flush()
+    w.proc.wait(timeout=180)
+    w._watchdog.cancel()
+    assert w.proc.returncode == -9  # died BY the seeded SIGKILL, not an error
+
+    w = _Worker(base)
+    rec = w.cmd("recover", "RECOVERED").split()
+    assert rec[1] != "None", "a persisted revision must survive the crash"
+    assert int(rec[2]) == expect_replay
+    w.send_range(15, EVENTS)
+    got = w.cmd("result", "RESULT")
+    w.close()
+    assert got == oracle
+
+
+def test_committed_upgrade_is_exact_under_the_same_stream(tmp_path, oracle):
+    """Control arm: the SAME worker protocol with a mid-stream hot-swap that
+    is allowed to finish must also match the oracle — the chaos cases above
+    then isolate the crash, not the upgrade, as the variable."""
+    w = _Worker(str(tmp_path / "live"))
+    w.send_range(0, 20)
+    assert w.cmd("upgrade", "UPGRADED") == "UPGRADED compatible"
+    w.send_range(20, EVENTS)
+    got = w.cmd("result", "RESULT")
+    w.close()
+    assert got == oracle
