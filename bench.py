@@ -202,6 +202,10 @@ _DENOMINATORS = {
     # ring tops out around its single-JVM ring throughput; the per-event
     # path is one mapper call + ring publish per event
     "e2e_ingress_events_per_sec": 1_000_000.0,
+    # 256 co-resident queries: every event visits every query's per-event
+    # callback chain in the reference, so single-JVM throughput divides by
+    # query count; 100k favors the reference for this shape
+    "fanout256_events_per_sec": 100_000.0,
 }
 
 
@@ -1193,6 +1197,164 @@ def bench_e2e_ingress() -> dict:
     return res
 
 
+def _fanout_app(n_queries: int) -> str:
+    """N co-resident queries over ONE stream: filters with distinct
+    thresholds, every 32nd a windowless group-by aggregate (sum + count per
+    symbol) — all shape-polymorphic, so the optimizer fuses maximal runs
+    into SharedStepGroups. Windowless aggregates rather than time windows:
+    window machinery costs ~100x a filter per step and would drown the
+    dispatch-bound regime this config measures in both modes."""
+    lines = [
+        "@app:name('FanoutBench')",
+        "define stream TradeStream (symbol string, price double, "
+        "volume long);",
+    ]
+    for i in range(n_queries):
+        if i % 64 == 63:
+            lines.append(
+                f"@info(name='agg{i}') from TradeStream "
+                f"select symbol, sum(price) as total, count() as n "
+                f"group by symbol insert into AggOut{i};")
+        else:
+            thr = (i * 900.0) / max(n_queries, 1)
+            lines.append(
+                f"@info(name='filt{i}') from TradeStream[price > {thr:.1f}] "
+                f"select symbol, price insert into FiltOut{i};")
+    return "\n".join(lines)
+
+
+def bench_fanout() -> dict:
+    """HEADLINE config: multi-tenant fan-out — N ∈ {1, 16, 64, 256}
+    filter/aggregate queries over one stream fed via SXF1 binary frames
+    through the service surface, with the multi-query optimizer ON vs OFF.
+    Reports events/s and the XLA compile count at each N: with the optimizer
+    the compile count stays O(fused groups) while throughput holds; without
+    it both scale linearly with N (the paper's multi-tenant cost problem,
+    ROADMAP open item #1). Also records e2e_rows_events_per_sec — the
+    row-at-a-time compatibility tier's measured number (VERDICT item 10)."""
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.io import wire
+    from siddhi_tpu.service import SiddhiService
+
+    cpu = _is_cpu()
+    # dispatch-bound regime ON PURPOSE: small batches + many queries is
+    # where per-query dispatch dominates and fusion pays. At large batches
+    # the run is compute-bound and both modes converge on the same XLA work.
+    bb = int(os.environ.get("SIDDHI_FANOUT_BATCH", 0)) or 128
+    # group_capacity bounds the per-aggregate key table; the repo default
+    # (1 << 20 slots) makes each group-by step carry million-entry state —
+    # pure overhead at 100 distinct keys.
+    gc = int(os.environ.get("SIDDHI_FANOUT_GROUP_CAPACITY", 0)) or 4096
+    n_keys = 100
+    rng = np.random.default_rng(RNG_SEED + 3)
+    res: dict = {"metric": "fanout256_events_per_sec", "unit": "events/sec",
+                 "batch": bb, "group_capacity": gc}
+    deadline = time.monotonic() + max(CONFIG_SECONDS - 30.0, 60.0)
+
+    def run_mode(n_queries: int, optimize: bool, rounds: int):
+        app = _fanout_app(n_queries)
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(app, batch_size=bb,
+                                           group_capacity=gc,
+                                           optimize=optimize)
+        svc = SiddhiService(mgr)
+        n_out = [0]
+        rt.add_callback("FiltOut0", lambda blk: n_out.__setitem__(
+            0, n_out[0] + blk.count), columnar=True)
+        rt.start()
+        rt.warmup((bb,))
+        plan = wire.schema_plan(rt.junctions["TradeStream"].definition)
+        bodies = []
+        for _ in range(3):
+            ks = rng.integers(1, n_keys + 1, bb)
+            cols = {
+                "symbol": np.array([f"S{int(k)}" for k in ks], dtype=object),
+                "price": rng.uniform(1.0, 1000.0, bb),
+                "volume": rng.integers(1, 1000, bb),
+            }
+            bodies.append(wire.encode_frames(plan, cols, bb))
+
+        def run_rounds(k: int, r0: int) -> None:
+            for r in range(k):
+                svc.send_frames("FanoutBench", "TradeStream",
+                                bodies[(r0 + r) % len(bodies)])
+            rt.drain()
+
+        run_rounds(2, 0)  # residual compiles (partial shapes) out of measure
+        best, r0 = 0.0, 2
+        for _rep in range(3):
+            t0 = time.perf_counter()
+            run_rounds(rounds, r0)
+            elapsed = time.perf_counter() - t0
+            r0 += rounds
+            best = max(best, rounds * bb / elapsed)
+        rep = rt.statistics_report()
+        compiles = sum(rep["compiles"].values())
+        opt_section = rep.get("optimizer", {})
+        rt.shutdown()
+        assert n_out[0] > 0, "fanout produced no output — not a valid measure"
+        return best, compiles, opt_section
+
+    # small-batch regime: enough rounds that each timed rep spans >100 ms
+    # even in the fast (fused) mode, or rep-to-rep jitter dominates
+    rounds = 24 if cpu else 32
+    fanout_ns = (1, 16, 64, 256)
+    for n in fanout_ns:
+        if time.monotonic() > deadline and n > 1:
+            _partial({f"fanout{n}_skipped": "config budget exhausted"})
+            continue
+        _phase(f"fanout:{n}q:optimizer_on")
+        ev_on, comp_on, opt = run_mode(n, True, rounds)
+        _partial({f"fanout{n}_on_events_per_sec": round(ev_on, 1),
+                  f"fanout{n}_on_compiles": comp_on,
+                  f"fanout{n}_groups": opt.get("groups", 0),
+                  f"fanout{n}_queries_fused": opt.get("queries_fused", 0),
+                  f"fanout{n}_compiles_avoided":
+                      opt.get("compiles_avoided", 0)})
+        res.update(PARTIAL)
+        if time.monotonic() > deadline and n > 1:
+            _partial({f"fanout{n}_off_skipped": "config budget exhausted"})
+            continue
+        _phase(f"fanout:{n}q:optimizer_off")
+        ev_off, comp_off, _ = run_mode(n, False, rounds)
+        _partial({f"fanout{n}_off_events_per_sec": round(ev_off, 1),
+                  f"fanout{n}_off_compiles": comp_off,
+                  f"fanout{n}_speedup": round(ev_on / max(ev_off, 1e-9), 2)})
+        res.update(PARTIAL)
+
+    # headline value: optimizer-on events/s at the largest N that completed
+    for n in reversed(fanout_ns):
+        v = res.get(f"fanout{n}_on_events_per_sec")
+        if v is not None:
+            res["value"] = v
+            res["headline_n_queries"] = n
+            break
+    res["vs_baseline"] = round(
+        res.get("value", 0.0) / _baseline_for("fanout256_events_per_sec"), 3)
+
+    # rows-path compatibility tier: the same public path fed with per-row
+    # Python tuples + per-Event callbacks (VERDICT item 10's missing number)
+    _phase("fanout:rows_path")
+    eb = _resolve_e2e_batch()
+    app1 = _fanout_app(1)
+    rt3 = SiddhiManager().create_siddhi_app_runtime(
+        app1, batch_size=eb, async_callbacks=True)
+    rows = _trade_rows(4, n_keys, price_hi=1000.0, n=eb)
+    h3 = rt3.get_input_handler("TradeStream")
+
+    def feed_rows(r):
+        h3.send_batch(rows[r % len(rows)])
+        rt3.flush()
+
+    res["e2e_rows_events_per_sec"] = round(
+        _measure_e2e(rt3, "FiltOut0", feed_rows, eb,
+                     columnar=False, rounds=4), 1)
+    _partial({"e2e_rows_events_per_sec": res["e2e_rows_events_per_sec"]})
+    if not E2E_ONLY:
+        res.update(_preflight(_fanout_app(16)))
+    return res
+
+
 def bench_hang() -> dict:
     """HIDDEN config (`python bench.py _hang`): deliberately wedges before
     importing anything heavy AND swallows the in-process alarm — the
@@ -1216,8 +1378,9 @@ CONFIGS = {
     "overload": bench_overload,  # bounded ingress under 10x overload
     "upgrade": bench_upgrade,  # blue-green hot-swap under live traffic
     "groupby": bench_groupby,
-    "e2e_ingress": bench_e2e_ingress,  # HEADLINE: keep last — drivers that
-    # parse only the final line track the wire→pipeline→device rate
+    "e2e_ingress": bench_e2e_ingress,  # wire→pipeline→device rate
+    "fanout": bench_fanout,  # HEADLINE: keep last — drivers that parse only
+    # the final line track the multi-tenant shared-execution rate
 }
 #: not part of the default run; reachable by explicit name only
 HIDDEN_CONFIGS = {"_hang": bench_hang}

@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Optional, Union
 
 from .diagnostics import Diagnostic, LintReport, Severity, Suppressions
+from .optimizer import OptimizerReport, analyze_sharing, optimizer_enabled
 from .plan import PlanGraph, build_plan, element_fingerprints, plan_fingerprint
 from .rules import RULES, run_rules
 from .upgrade import UPGRADE_RULES, UpgradeDiff, diff_apps
@@ -25,6 +26,7 @@ __all__ = [
     "PlanGraph", "build_plan", "RULES", "analyze", "lint_mode",
     "element_fingerprints", "plan_fingerprint",
     "UPGRADE_RULES", "UpgradeDiff", "diff_apps",
+    "OptimizerReport", "analyze_sharing", "optimizer_enabled",
 ]
 
 

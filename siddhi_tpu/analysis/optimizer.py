@@ -1,0 +1,306 @@
+"""Multi-query shared-execution optimizer — the plan-level pass.
+
+ROADMAP open item #1 (TiLT, arXiv 2301.12030; Factor Windows, arXiv
+2008.12379): when many tenant queries sit on the same input stream, the
+per-query cost model — one jitted step, one XLA compile ladder, one junction
+delivery each — makes query count a linear cost. This module is the ANALYSIS
+half of the fix: it decides, from the typed plan graph alone (no device
+state, no tracing), which co-resident queries can share one compiled step,
+which subexpressions they have in common, which predicates can be pushed
+ahead of their windows, and which window aggregates are span-correlated.
+
+The EXECUTION half lives in core/shared.py (`SharedStepGroup`,
+`build_shared_groups`): member queries are traced together inside ONE
+`jax.jit`, so XLA's own CSE realizes the shared scan / common-subexpression
+rewrites this pass detects, while every member keeps its own state tuple,
+callbacks, output wiring, and snapshot section — optimizer-on output is
+bit-identical to optimizer-off (tests/test_optimizer_parity.py).
+
+Both halves use the same decline taxonomy: a query that cannot join a
+shared group (under @breaker, inside a partition, OBJECT-typed input,
+table-dependent, ...) is declined LOUDLY — surfaced through lint rule SL114
+and `statistics_report()["optimizer"]["declined"]` — never silently fused
+with different isolation semantics.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional, Union
+
+from ..query_api import SiddhiApp
+from ..query_api.definition import AttributeType
+from ..query_api.execution import Query, SingleInputStream
+from .plan import PlanGraph, QueryNode, _canon, build_plan
+
+#: window names whose ops/windows.py implementation consumes variable-lane
+#: batches directly (shape_polymorphic=True) — mirrors rules.py SL113
+_SHAPE_POLYMORPHIC_WINDOWS = {"time"}
+
+#: decline reasons (shared taxonomy between the static pass and the runtime
+#: group builder in core/shared.py)
+DECLINE_BREAKER = "@breaker isolation: fusing would share failure fate"
+DECLINE_PARTITION = "runs inside a partition (per-key isolation)"
+DECLINE_OBJECT = "input stream carries OBJECT-typed attributes"
+DECLINE_JOIN_PATTERN = "join/pattern input (multi-stream state machine)"
+DECLINE_FAULT = "consumes a fault stream (!S)"
+DECLINE_TABLE = "`in Table` dependency: table state is a step argument"
+DECLINE_CUSTOM_AGG = ("custom aggregator state (distinctCount pair table) "
+                      "needs host-side compaction between steps")
+
+
+def optimizer_enabled(app: SiddhiApp,
+                      override: Optional[bool] = None) -> bool:
+    """Opt-in gate: `@app:optimize` on the app (element 'false'/'0'
+    disables), the SIDDHI_OPTIMIZE env var, or an explicit runtime kwarg
+    (which wins over both)."""
+    if override is not None:
+        return bool(override)
+    ann = app.annotation("app:optimize")
+    if ann is not None:
+        val = str(ann.element() or "true").strip().lower()
+        return val not in ("false", "0", "off")
+    return os.environ.get("SIDDHI_OPTIMIZE", "") not in ("", "0")
+
+
+@dataclass
+class FusionGroup:
+    """One set of co-resident queries that can share a compiled step."""
+
+    stream_id: str
+    #: runtime-style query names (query{i+1} / @info name), source order
+    members: list[str]
+    #: plan nodes for lint anchoring (parallel to `members`)
+    nodes: list[QueryNode] = field(default_factory=list)
+    #: number of duplicated filter/projection/group-key subexpressions the
+    #: members share (each computed once per batch under fusion)
+    shared_subexpressions: int = 0
+    #: post-window filters provably safe to evaluate ahead of the window
+    pushdowns: int = 0
+    #: span-correlated window aggregates (same stream + group key, different
+    #: window parameters) whose scans collapse into the one traced step
+    pane_candidates: int = 0
+    #: True when every member's step is shape-polymorphic (the fused step
+    #: compiles once per lane bucket instead of once per member per bucket)
+    shape_polymorphic: bool = True
+
+    @property
+    def steps_saved(self) -> int:
+        """Junction deliveries (and compiles, per shape bucket) saved per
+        batch: N member dispatches become one."""
+        return max(len(self.members) - 1, 0)
+
+
+@dataclass
+class OptimizerReport:
+    """What the pass found (or would find, when the optimizer is off)."""
+
+    enabled: bool = False
+    groups: list[FusionGroup] = field(default_factory=list)
+    #: runtime-style query name -> decline reason (only for queries whose
+    #: stream hosts other fusable work — a lone query declines nothing)
+    declined: dict[str, str] = field(default_factory=dict)
+    #: decline reasons for lint anchoring: (node, reason)
+    declined_nodes: list[tuple] = field(default_factory=list)
+
+    @property
+    def queries_fused(self) -> int:
+        return sum(len(g.members) for g in self.groups)
+
+    @property
+    def cse_hits(self) -> int:
+        return sum(g.shared_subexpressions for g in self.groups)
+
+    @property
+    def pushdowns(self) -> int:
+        return sum(g.pushdowns for g in self.groups)
+
+    @property
+    def pane_candidates(self) -> int:
+        return sum(g.pane_candidates for g in self.groups)
+
+    def summary(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "groups": len(self.groups),
+            "queries_fused": self.queries_fused,
+            "cse_hits": self.cse_hits,
+            "pushdowns": self.pushdowns,
+            "pane_candidates": self.pane_candidates,
+            "declined": dict(self.declined),
+            "group_members": {g.stream_id: list(g.members)
+                              for g in self.groups},
+        }
+
+
+# ------------------------------------------------------------- eligibility
+
+
+def _has_annotation(query: Query, name: str) -> bool:
+    return any(a.name.lower() == name for a in query.annotations or ())
+
+
+def decline_reason(node: QueryNode, plan: PlanGraph) -> Optional[str]:
+    """Why this query cannot join a shared group (None = eligible). The
+    runtime builder re-checks the runtime-only facts (custom aggregator
+    state, table fallbacks); everything statically decidable is here so
+    SL114 reports the same reasons `statistics_report()` will."""
+    if node.partition is not None:
+        return DECLINE_PARTITION
+    ins = node.query.input_stream
+    if not isinstance(ins, SingleInputStream):
+        return DECLINE_JOIN_PATTERN
+    if ins.is_fault:
+        return DECLINE_FAULT
+    if _has_annotation(node.query, "breaker"):
+        return DECLINE_BREAKER
+    schema = plan.schemas.get(ins.stream_id)
+    if schema is not None and schema.attrs is not None and any(
+            t == AttributeType.OBJECT for t in schema.attrs.values()):
+        return DECLINE_OBJECT
+    from ..core.query_runtime import _collect_in_sources
+    tables = set(plan.app.table_definitions)
+    if _collect_in_sources(node.query) & tables:
+        return DECLINE_TABLE
+    return None
+
+
+def _shape_polymorphic(node: QueryNode) -> bool:
+    """Static mirror of QueryRuntime._bucket_ok (window side only — the
+    extrema-plan check is runtime-only and re-applied by core/shared.py)."""
+    w = node.query.input_stream.handlers.window
+    if w is None:
+        return True
+    if w.name in _SHAPE_POLYMORPHIC_WINDOWS:
+        return True
+    return w.name == "batch" and not w.parameters
+
+
+def _runtime_names(plan: PlanGraph) -> dict[int, str]:
+    """node.index -> the RUNTIME query name (query{i+1} over app.queries,
+    matching SiddhiAppRuntime._build / element_fingerprints)."""
+    names: dict[int, str] = {}
+    top = 0
+    for node in plan.queries:
+        if node.partition is not None:
+            names[node.index] = node.query.name or node.name
+            continue
+        top += 1
+        names[node.index] = node.query.name or f"query{top}"
+    return names
+
+
+# ----------------------------------------------------------------- analysis
+
+
+def _member_expr_canons(node: QueryNode) -> list[str]:
+    """Canonical forms of the subexpressions a fused step would evaluate per
+    batch: filters, post-window filters, select projections, group keys."""
+    out: list[str] = []
+    h = node.query.input_stream.handlers
+    for f in (*h.filters, *h.post_window_filters):
+        out.append(_canon(f))
+    sel = node.query.selector
+    for a in sel.attributes:
+        out.append(_canon(a.expression))
+    for v in sel.group_by:
+        out.append(_canon(v))
+    return out
+
+
+def _count_pushdowns(node: QueryNode) -> int:
+    """Post-window filters that are provably pushable: the query's window
+    lowers to pass-through (none, or paramless #window.batch — every
+    surviving arrival is emitted as CURRENT, so filtering after equals
+    filtering before) and there are no stream functions whose computed
+    columns the filter could read. This is the rewrite core/shared.py
+    applies in place."""
+    h = node.query.input_stream.handlers
+    w = h.window
+    passthrough = w is None or (not w.namespace and w.name == "batch"
+                                and not w.parameters)
+    if not passthrough:
+        return 0
+    if h.pre_window_functions or h.post_window_functions:
+        return 0
+    return len(h.post_window_filters)
+
+
+def _count_pane_candidates(nodes: list[QueryNode]) -> int:
+    """Span-correlated window aggregates: members whose windows differ only
+    in parameters (e.g. time(1 min) / time(5 min) / time(1 hour)) over the
+    same group key. Under trace-together fusion their scans run in one
+    compiled step; true factor-pane state sharing is declined for float
+    aggregates (non-associative addition breaks bit-parity — see
+    docs/OPTIMIZER.md)."""
+    sigs: dict[tuple, int] = {}
+    for node in nodes:
+        w = node.query.input_stream.handlers.window
+        if w is None:
+            continue
+        sel = node.query.selector
+        key = (w.namespace, w.name,
+               tuple(sorted(_canon(v) for v in sel.group_by)))
+        sigs[key] = sigs.get(key, 0) + 1
+    return sum(n for n in sigs.values() if n >= 2)
+
+
+def analyze_sharing(app_or_plan: Union[SiddhiApp, PlanGraph],
+                    enabled: Optional[bool] = None) -> OptimizerReport:
+    """The full static pass: group co-resident eligible queries per input
+    stream, count shared subexpressions (via plan.py's structural _canon),
+    pushdown opportunities, and span-correlated windows. Pure analysis —
+    costs microseconds, never builds device state."""
+    plan = (app_or_plan if isinstance(app_or_plan, PlanGraph)
+            else build_plan(app_or_plan))
+    report = OptimizerReport(
+        enabled=optimizer_enabled(plan.app) if enabled is None else enabled)
+    names = _runtime_names(plan)
+
+    by_stream: dict[str, list[QueryNode]] = {}
+    declined: list[tuple[QueryNode, str]] = []
+    consumers: dict[str, int] = {}
+    for node in plan.queries:
+        ins = node.query.input_stream
+        sid = getattr(ins, "stream_id", None) if isinstance(
+            ins, SingleInputStream) else None
+        if sid is not None:
+            consumers[sid] = consumers.get(sid, 0) + 1
+        reason = decline_reason(node, plan)
+        if reason is not None:
+            declined.append((node, reason))
+            continue
+        by_stream.setdefault(sid, []).append(node)
+
+    for sid, nodes in by_stream.items():
+        if len(nodes) < 2:
+            continue
+        canons: dict[str, int] = {}
+        for node in nodes:
+            for c in _member_expr_canons(node):
+                canons[c] = canons.get(c, 0) + 1
+        group = FusionGroup(
+            stream_id=sid,
+            members=[names[n.index] for n in nodes],
+            nodes=list(nodes),
+            shared_subexpressions=sum(
+                n - 1 for n in canons.values() if n > 1),
+            pushdowns=sum(_count_pushdowns(n) for n in nodes),
+            pane_candidates=_count_pane_candidates(nodes),
+            # mixed groups pad to full capacity (the shape-baked members'
+            # own dispatch behavior); all-polymorphic groups keep buckets
+            shape_polymorphic=all(_shape_polymorphic(n) for n in nodes),
+        )
+        report.groups.append(group)
+
+    # a decline is only worth reporting when sharing was actually forgone:
+    # the declined query's stream hosts at least one other consumer
+    for node, reason in declined:
+        ins = node.query.input_stream
+        sid = getattr(ins, "stream_id", None)
+        consumed = [c.stream_id for c in node.consumed]
+        if any(consumers.get(s, 0) >= 2 for s in ([sid] if sid else consumed)):
+            report.declined[names[node.index]] = reason
+            report.declined_nodes.append((node, reason))
+    return report

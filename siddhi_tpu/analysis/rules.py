@@ -506,6 +506,30 @@ def shape_bucket_padback(plan: PlanGraph) -> Iterable:
                            "SIDDHI_SHAPE_BUCKETS=0")
 
 
+# ------------------------------------------------------------------- SL114
+
+
+@rule("SL114", Severity.INFO,
+      "co-resident queries on one stream can share a compiled step "
+      "(multi-query optimizer: @app:optimize / SIDDHI_OPTIMIZE=1)")
+def shareable_work(plan: PlanGraph) -> Iterable:
+    from .optimizer import analyze_sharing
+    report = analyze_sharing(plan)
+    verb = "fuses" if report.enabled else "would fuse (optimizer off)"
+    for g in report.groups:
+        anchor = g.nodes[0]
+        yield _q(anchor,
+                 f"stream {g.stream_id!r}: optimizer {verb} "
+                 f"{len(g.members)} queries ({', '.join(g.members)}) into "
+                 f"one compiled step — {g.shared_subexpressions} shared "
+                 f"subexpression(s), {g.pushdowns} pushable predicate(s), "
+                 f"{g.pane_candidates} span-correlated window(s); saves "
+                 f"{g.steps_saved} step dispatch(es)/compile(s) per batch")
+    for node, reason in report.declined_nodes:
+        yield _q(node, "optimizer declines to fuse this query even though "
+                       f"its stream hosts shareable work: {reason}")
+
+
 def check_query(query: Query) -> None:
     """Hook for future per-query API use; kept minimal."""
     _ = query

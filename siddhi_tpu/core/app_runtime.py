@@ -38,7 +38,8 @@ class SiddhiAppRuntime:
                  auto_flush_ms: Optional[float] = None,
                  aot_warmup: bool = False,
                  wal_dir: Optional[str] = None,
-                 persistence_interval_s: Optional[float] = None) -> None:
+                 persistence_interval_s: Optional[float] = None,
+                 optimize: Optional[bool] = None) -> None:
         self.app = app
         #: LintReport attached by SiddhiManager's SIDDHI_LINT gate
         #: (None when linting is off or the app was built directly)
@@ -141,6 +142,18 @@ class SiddhiAppRuntime:
         self._started = False
 
         self._build()
+
+        # multi-query shared execution (@app:optimize / SIDDHI_OPTIMIZE /
+        # the optimize kwarg): fuse co-resident queries into shared compiled
+        # steps AFTER the runtimes exist but BEFORE any traffic or warmup —
+        # self.app stays the pre-optimization app, so plan fingerprints,
+        # snapshots, and upgrade diffs see the unfused layout
+        self.shared_groups: list = []
+        self.optimizer_report: Optional[dict] = None
+        from ..analysis.optimizer import optimizer_enabled
+        if optimizer_enabled(app, optimize):
+            from .shared import build_shared_groups
+            self.optimizer_report = build_shared_groups(self)
 
         if self.wal is not None:
             # journal INGRESS junctions only: user-defined streams take rows
@@ -478,6 +491,8 @@ class SiddhiAppRuntime:
         out: dict[str, int] = {}
         with self.ctx.controller_lock:
             for name, qr in self.query_runtimes.items():
+                if getattr(qr, "_fused_group", None) is not None:
+                    continue  # its step never runs: the group's fused jit does
                 fn = getattr(qr, "warmup", None)
                 if fn is None:
                     continue
@@ -486,6 +501,12 @@ class SiddhiAppRuntime:
                 except Exception:  # noqa: BLE001 — advisory only
                     logging.getLogger("siddhi_tpu").exception(
                         "AOT warmup failed for query %r", name)
+            for g in self.shared_groups:
+                try:
+                    out[g.name] = g.warmup(buckets)
+                except Exception:  # noqa: BLE001 — advisory only
+                    logging.getLogger("siddhi_tpu").exception(
+                        "AOT warmup failed for shared group %r", g.name)
         return out
 
     def shutdown(self, *, flush_durable: bool = True,

@@ -360,6 +360,21 @@ class Statistics:
                 # slow-batch exemplars — same histograms /metrics exports
                 out["latency"] = tele.latency_snapshot()
                 out["slow_batches"] = tele.slow_batches()
+            opt = getattr(runtime, "optimizer_report", None)
+            if opt is not None:
+                # multi-query shared execution (core/shared.py): fused-group
+                # inventory from creation time, plus the live compile-savings
+                # number — each group compile replaces len(members) per-query
+                # compiles of the same shape
+                groups = getattr(runtime, "shared_groups", ())
+                out["optimizer"] = {
+                    **opt,
+                    "compiles_avoided": sum(
+                        self.compiles.get(g.name, 0) * (len(g.members) - 1)
+                        for g in groups),
+                }
+            else:
+                out["optimizer"] = {"enabled": False}
             lint = getattr(runtime, "lint_report", None)
             if lint is not None:
                 # what the SIDDHI_LINT gate saw at creation: rule counts +

@@ -71,6 +71,7 @@ class SiddhiManager:
         async_callbacks: bool = False,
         auto_flush_ms=None, aot_warmup: bool = False,
         wal_dir=None, persistence_interval_s=None,
+        optimize=None,
     ) -> SiddhiAppRuntime:
         app = self._parse(app)
         lint_report = self._lint_gate(app)
@@ -83,7 +84,8 @@ class SiddhiManager:
                               auto_flush_ms=auto_flush_ms,
                               aot_warmup=aot_warmup,
                               wal_dir=wal_dir,
-                              persistence_interval_s=persistence_interval_s)
+                              persistence_interval_s=persistence_interval_s,
+                              optimize=optimize)
         if self.persistence_store is not None:
             rt.persistence_store = self.persistence_store
         rt.lint_report = lint_report

@@ -368,6 +368,12 @@ class QueryRuntime(Receiver):
         # --- the jitted step ---
         self._step = jax.jit(self._make_step(), donate_argnums=(0,))
         self.state = self._init_state()
+        #: set by core/shared.py when this query's step body is traced into
+        #: a SharedStepGroup's fused jit: the junction then delivers to the
+        #: group (this runtime's own _step stays cold), but state/callbacks/
+        #: output wiring remain per-query, so persistence and upgrade see
+        #: exactly the unfused layout
+        self._fused_group = None
         self._has_custom_aggs = any(
             spec.custom_scan is not None for _, spec, _ in self.selector.agg_specs)
         self._batches_seen = 0
@@ -392,7 +398,7 @@ class QueryRuntime(Receiver):
         return (self.window.init_state(), self.selector.init_state(),
                 self.rate_limiter.init_state())
 
-    def _make_step(self):
+    def _make_step(self, track_compiles: bool = True):
         import dataclasses as dc
 
         filters = self.filters
@@ -427,8 +433,11 @@ class QueryRuntime(Receiver):
 
         def step(state, batch: EventBatch, now, table_states=None):
             # trace-time side effect: fires once per compiled executable —
-            # the per-query compile counter (recompile-storm observability)
-            stats.track_compile(qname, batch.capacity)
+            # the per-query compile counter (recompile-storm observability).
+            # Fused members suppress it: the SharedStepGroup counts ONE
+            # compile for the whole group under its own name.
+            if track_compiles:
+                stats.track_compile(qname, batch.capacity)
             wstate, sstate, rstate = state
 
             scope = Scope()
@@ -612,6 +621,12 @@ class QueryRuntime(Receiver):
                 jax.block_until_ready(self.state)
                 wait = time.perf_counter_ns() - w0
                 sess.record(self.name, elapsed + wait, wait)
+        self._post_step_maintenance()
+
+    def _post_step_maintenance(self) -> None:
+        """Per-batch housekeeping after the jitted step: custom-aggregate
+        compaction cadence + snapshot-overflow warning. Shared between
+        on_batch and SharedStepGroup dispatch (core/shared.py)."""
         self._batches_seen += 1
         # adaptive cadence: cheap (one scalar sync) but sparse normally;
         # tight once a table runs hot so compaction outruns overflow.
@@ -707,6 +722,15 @@ class QueryRuntime(Receiver):
         etype = self.query.output_stream.event_type
 
         debugger = getattr(self.ctx, "debugger", None)
+        if (debugger is None and not self.callbacks
+                and action == OutputAction.INSERT
+                and self.output_junction is not None
+                and _sink_dark(self.output_junction)):
+            # nothing observes this emission: skip the _select_event_type
+            # device ops and the controller-lock publish round trip. For
+            # fan-out apps (N queries, few subscribed outputs) this is the
+            # dominant per-query per-batch cost.
+            return
         if debugger is not None:
             from .debugger import QueryTerminal
             if debugger.wants(self.name, QueryTerminal.OUT):
@@ -809,6 +833,25 @@ class QueryRuntime(Receiver):
 
     def add_callback(self, cb: QueryCallback) -> None:
         self.callbacks.append(cb)
+
+
+def _sink_dark(j) -> bool:
+    """True when publishing to junction `j` is observably a no-op: no
+    receivers, taps, WAL, blue-green redirect, or staged rows, and
+    statistics (explicit opt-in, exact in/out counts) are off. Re-checked
+    per batch, so attaching a callback or subscriber later re-lights the
+    sink immediately. Always-on telemetry does NOT keep a sink lit: its
+    spans measure delivery work, and a skipped no-op delivery has none —
+    dark streams simply stop appearing in per-stream batch series
+    (docs/OPTIMIZER.md)."""
+    if not isinstance(j, StreamJunction):
+        # window/table junction adapters always consume their input
+        return False
+    if j.receivers or j.taps or j._staged_rows:
+        return False
+    if j.wal is not None or j._redirect is not None:
+        return False
+    return not j.ctx.statistics.enabled
 
 
 def _collect_eq_probe_tables(query: Query, tables: dict) -> set:

@@ -54,14 +54,38 @@ def _host_radix_argsort(a):
     return out
 
 
+#: lane count below which CPU uses XLA's native sort instead of the radix
+#: pure_callback. A host callback anywhere in a jitted program disables
+#:  pjit's C++ fastpath for EVERY call of that executable (jax
+#: `_get_fastpath_data` vetoes host_callbacks), costing ~0.5-6 ms of python
+#: dispatch per step — far more than a small comparator sort. Measured on
+#: this backend: native argsort 45 us @256 lanes / 2.5 ms @8192; radix
+#: callback ~0.7 ms flat. Above the threshold the radix asymptotics win
+#: (74 ms vs 4 ms at 262k lanes).
+_RADIX_SORT_MIN_LANES = 8192
+
+
+def _radix_min_lanes() -> int:
+    import os
+    try:
+        return int(os.environ.get("SIDDHI_RADIX_SORT_MIN", "")
+                   or _RADIX_SORT_MIN_LANES)
+    except ValueError:
+        return _RADIX_SORT_MIN_LANES
+
+
 def stable_argsort_bounded(x):
     """Stable argsort of NON-NEGATIVE int32 keys, as int32 positions.
 
-    TPU/other accelerators: native `jnp.argsort` (fast there). CPU backend:
-    an LSD radix argsort in C reached via `jax.pure_callback` — XLA CPU's
-    comparator sort runs ~260 ns/elem (74 ms at 282k lanes, measured) while
-    the radix pass is ~10 ns/elem. The callback is batch-aware (trailing
-    axis) so it stays vmappable."""
+    TPU/other accelerators: native `jnp.argsort` (fast there). CPU backend,
+    wide batches only: an LSD radix argsort in C reached via
+    `jax.pure_callback` — XLA CPU's comparator sort runs ~260 ns/elem
+    (74 ms at 282k lanes, measured) while the radix pass is ~10 ns/elem.
+    Narrow batches stay on the native sort: the callback would knock the
+    whole compiled step off pjit's C++ fastpath (see _RADIX_SORT_MIN_LANES)
+    — which also matters for fused multi-query steps (core/shared.py),
+    where one callback-bearing member would slow every co-resident query.
+    The callback is batch-aware (trailing axis) so it stays vmappable."""
     import jax
     from jax import lax, pure_callback
 
@@ -74,6 +98,8 @@ def stable_argsort_bounded(x):
     def default_fn(v):
         return jnp.argsort(v, axis=-1, stable=True).astype(jnp.int32)
 
+    if x.shape[-1] < _radix_min_lanes():
+        return default_fn(x)
     return lax.platform_dependent(x, cpu=cpu_fn, default=default_fn)
 
 

@@ -113,6 +113,18 @@ class Histogram:
         c[self._COUNT] += 1
         c[self._SUM] += ns
 
+    def observe_ns_at(self, bi: int, ns: int) -> None:
+        """`observe_ns` with the bucket index precomputed — fused groups
+        (core/shared.py) record N per-query series sharing ONE measured
+        span, so the log2 bucket is the same for all of them and computing
+        it N times was measurable at fan-out scale."""
+        c = getattr(self._tls, "c", None)
+        if c is None:
+            c = self._cell()
+        c[bi] += 1
+        c[self._COUNT] += 1
+        c[self._SUM] += ns
+
     # ---------------------------------------------------------------- readers
 
     def snapshot(self) -> tuple[list, int, int]:

@@ -219,6 +219,37 @@ def _stats_families(exp: _Exposition, app: str, runtime) -> None:
                 "Lifetime events driven by historical WAL replay", ("app",))
     exp.add("siddhi_replay_events_total", (app,), st.replay_events)
 
+    # multi-query shared execution (core/shared.py optimizer report)
+    opt = getattr(runtime, "optimizer_report", None) or {}
+    groups = getattr(runtime, "shared_groups", ()) or ()
+    exp.declare("siddhi_optimizer_enabled", "gauge",
+                "1 when the multi-query optimizer rewrote this app", ("app",))
+    exp.add("siddhi_optimizer_enabled", (app,),
+            1 if opt.get("enabled") else 0)
+    for name, help_text, key in (
+            ("siddhi_optimizer_groups", "Shared step groups formed",
+             "groups"),
+            ("siddhi_optimizer_queries_fused",
+             "Queries executing inside shared compiled steps",
+             "queries_fused"),
+            ("siddhi_optimizer_cse_hits",
+             "Subexpressions shared across fused group members",
+             "cse_hits"),
+            ("siddhi_optimizer_pushdowns",
+             "Predicates pushed ahead of windows by the optimizer",
+             "pushdowns"),
+            ("siddhi_optimizer_pane_candidates",
+             "Span-correlated window aggregates sharing one traced step",
+             "pane_candidates")):
+        exp.declare(name, "gauge", help_text, ("app",))
+        exp.add(name, (app,), opt.get(key, 0))
+    exp.declare("siddhi_optimizer_compiles_avoided_total", "counter",
+                "Per-query XLA compiles avoided by fused group compiles",
+                ("app",))
+    exp.add("siddhi_optimizer_compiles_avoided_total", (app,),
+            sum(st.compiles.get(g.name, 0) * (len(g.members) - 1)
+                for g in groups))
+
     # parallel-ingress pipeline gauges/counters (core/ingress.py)
     exp.declare("siddhi_ingress_pipeline_rows_total", "counter",
                 "Rows accepted by the parallel ingress pipeline",

@@ -38,7 +38,7 @@ import time
 from collections import deque
 from typing import Optional
 
-from .metrics import Histogram, MetricsRegistry
+from .metrics import Histogram, MetricsRegistry, bucket_index
 
 #: worst-N exemplar ring size
 SLOW_RING = 8
@@ -210,6 +210,28 @@ class AppTelemetry:
         if tr is not None:
             tr.device_ns += ns
             tr.queries.append(query)
+
+    def query_cell(self, query: str):
+        """Pre-resolve the per-query histogram cell so fused groups can
+        record their whole membership without N dict lookups per batch."""
+        h = self._query_cells.get(query)
+        if h is None:
+            h = self._query_cells[query] = self.query_hist.labels(query)
+        return h
+
+    def record_query_block(self, cells, names, ns: int) -> None:
+        """Bulk `record_query` for one fused group: every member reports
+        the same share `ns` of the group's measured span, so the bucket
+        index is computed once and the cells (from `query_cell`) are
+        observed directly. Series produced are identical to calling
+        `record_query(name, ns)` per member."""
+        bi = bucket_index(ns)
+        for h in cells:
+            h.observe_ns_at(bi, ns)
+        tr = self.active()
+        if tr is not None:
+            tr.device_ns += ns * len(names)
+            tr.queries.extend(names)
 
     def observe_upgrade(self, pause_ms: float) -> None:
         """One committed hot-swap's cutover pause (core/upgrade.py)."""

@@ -38,6 +38,7 @@ CORPUS_EXPECTATIONS = {
     "sl111": ("SL111", Severity.ERROR),
     "sl112": ("SL112", Severity.ERROR),
     "sl113": ("SL113", Severity.WARN),
+    "sl114": ("SL114", Severity.INFO),
 }
 
 

@@ -1,0 +1,291 @@
+"""A/B parity suite for the multi-query optimizer (ISSUE 9 correctness bar).
+
+Every test builds the SAME app twice — optimize=False and optimize=True —
+feeds byte-identical input, and requires BIT-IDENTICAL callback output for
+every query. Fusion traces each member's unchanged step body inside one
+jax.jit (core/shared.py), so any divergence is a real rewrite bug, not
+float noise: the comparison is exact equality, no tolerances.
+
+Covers the acceptance matrix: filters, projections, group-by aggregates,
+correlated (multi-span) time windows, persistence round-trip across modes,
+and the upgrade diff seeing the pre-optimization plan.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+pytestmark = pytest.mark.smoke
+
+
+def run_app(app: str, streams: dict, out_streams, *, optimize: bool,
+            batch_size: int = 8, rt_hook=None):
+    """Build + run one mode. `streams` maps stream id -> [(ts, row), ...];
+    returns {out_stream: [row tuples]} from per-Event callbacks."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app, batch_size=batch_size,
+                                     optimize=optimize)
+    got = {s: [] for s in out_streams}
+    for s in out_streams:
+        rt.add_callback(s, lambda evs, s=s: got[s].extend(
+            tuple(e.data) for e in evs))
+    rt.start()
+    for sid, rows in streams.items():
+        h = rt.get_input_handler(sid)
+        for ts, row in rows:
+            h.send(row, timestamp=ts)
+        rt.flush()
+    rt.flush()
+    if rt_hook is not None:
+        rt_hook(rt)
+    m.shutdown()
+    return got
+
+
+def ab_check(app: str, streams: dict, out_streams, *, batch_size: int = 8,
+             expect_fused: int = 2):
+    """The A/B harness: optimizer-on output must equal optimizer-off output
+    exactly, and fusion must actually have engaged (expect_fused queries)."""
+    off = run_app(app, streams, out_streams, optimize=False,
+                  batch_size=batch_size)
+    report = {}
+    on = run_app(app, streams, out_streams, optimize=True,
+                 batch_size=batch_size,
+                 rt_hook=lambda rt: report.update(rt.optimizer_report or {}))
+    assert on == off, f"optimizer changed output:\n on={on}\noff={off}"
+    assert report.get("queries_fused", 0) >= expect_fused, report
+    return off, report
+
+
+def trades(n, *, t0=1000, dt=100):
+    sym = ("IBM", "WSO2", "ORCL")
+    return [(t0 + i * dt, (sym[i % 3], float((i * 7) % 50) + 0.25, i + 1))
+            for i in range(n)]
+
+
+STREAM = "define stream S (symbol string, price double, volume long);\n"
+
+
+class TestFilterProjectionParity:
+    def test_filters_and_projections(self):
+        app = (STREAM +
+               "@info(name='a') from S[price > 10.0] select symbol, price "
+               "insert into OutA;\n"
+               "@info(name='b') from S[price > 25.0] select symbol, volume "
+               "insert into OutB;\n"
+               "@info(name='c') from S select symbol, price * 2.0 as dbl "
+               "insert into OutC;\n")
+        out, rep = ab_check(app, {"S": trades(40)}, ("OutA", "OutB", "OutC"),
+                            expect_fused=3)
+        assert out["OutA"] and out["OutB"] and out["OutC"]
+        assert rep["groups"] == 1
+
+    def test_shared_subexpressions(self):
+        # identical filter + projection expressions across members: the
+        # canonicalizer must count them, fusion must not change results
+        app = (STREAM +
+               "@info(name='a') from S[price * 1.1 > 20.0] "
+               "select symbol, price * 1.1 as adj insert into OutA;\n"
+               "@info(name='b') from S[price * 1.1 > 20.0] "
+               "select symbol, volume insert into OutB;\n")
+        out, rep = ab_check(app, {"S": trades(32)}, ("OutA", "OutB"))
+        assert out["OutA"]
+        assert rep["cse_hits"] >= 1
+
+    def test_heterogeneous_types(self):
+        app = ("define stream S (sym string, price double, qty int, "
+               "flag bool);\n"
+               "@info(name='a') from S[flag == true] select sym, qty "
+               "insert into OutA;\n"
+               "@info(name='b') from S[qty > 5] select sym, price "
+               "insert into OutB;\n")
+        rows = [(1000 + i, (f"K{i % 4}", i * 1.5, i % 12, i % 3 == 0))
+                for i in range(30)]
+        ab_check(app, {"S": rows}, ("OutA", "OutB"))
+
+
+class TestAggregateParity:
+    def test_group_by_aggregates(self):
+        app = (STREAM +
+               "@info(name='a') from S select symbol, sum(price) as total "
+               "group by symbol insert into OutA;\n"
+               "@info(name='b') from S select symbol, count() as n, "
+               "avg(volume) as av group by symbol insert into OutB;\n")
+        out, _ = ab_check(app, {"S": trades(48)}, ("OutA", "OutB"))
+        assert out["OutA"] and out["OutB"]
+
+    def test_correlated_time_windows(self):
+        # the factor-window shape: same stream + key, three window spans —
+        # fused into one traced step (pane_candidates counts the overlap)
+        app = ("@app:playback\n" + STREAM +
+               "@info(name='w1') from S#window.time(1 sec) select symbol, "
+               "sum(price) as s group by symbol insert into Out1;\n"
+               "@info(name='w2') from S#window.time(5 sec) select symbol, "
+               "sum(price) as s group by symbol insert into Out2;\n"
+               "@info(name='w3') from S#window.time(20 sec) select symbol, "
+               "sum(price) as s, count() as n group by symbol "
+               "insert into Out3;\n")
+        out, rep = ab_check(app, {"S": trades(60, dt=250)},
+                            ("Out1", "Out2", "Out3"), expect_fused=3)
+        assert out["Out1"] and out["Out2"] and out["Out3"]
+        assert rep["pane_candidates"] >= 2
+
+    def test_mixed_stateless_and_windowed(self):
+        app = ("@app:playback\n" + STREAM +
+               "@info(name='f') from S[price > 5.0] select symbol, price "
+               "insert into OutF;\n"
+               "@info(name='w') from S#window.time(2 sec) select symbol, "
+               "max(price) as hi group by symbol insert into OutW;\n")
+        ab_check(app, {"S": trades(40, dt=200)}, ("OutF", "OutW"))
+
+
+class TestPushdownParity:
+    def test_post_filter_pushdown(self):
+        # paramless #window.batch() lowers to pass-through, so its
+        # post-window filter is provably pushable ahead of the window
+        app = (STREAM +
+               "@info(name='a') from S#window.batch()[price > 12.0] "
+               "select symbol, price, volume insert into OutA;\n"
+               "@info(name='b') from S[volume > 3] select symbol "
+               "insert into OutB;\n")
+        off, rep = ab_check(app, {"S": trades(36)}, ("OutA", "OutB"))
+        assert off["OutA"]
+        assert rep["pushdowns"] >= 1
+
+
+class TestPersistenceParity:
+    APP = (STREAM +
+           "@info(name='a') from S select symbol, sum(price) as total "
+           "group by symbol insert into OutA;\n"
+           "@info(name='b') from S select symbol, count() as n "
+           "group by symbol insert into OutB;\n")
+
+    def _runtime(self, optimize, got):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(self.APP, batch_size=4,
+                                         optimize=optimize)
+        for s in ("OutA", "OutB"):
+            rt.add_callback(s, lambda evs, s=s: got[s].extend(
+                tuple(e.data) for e in evs))
+        rt.start()
+        return rt
+
+    @pytest.mark.parametrize("src,dst", [(True, False), (False, True),
+                                         (True, True)])
+    def test_snapshot_crosses_modes(self, src, dst):
+        """Fused state lives per-query, so a snapshot taken under either
+        mode restores into either mode — layout is identical."""
+        got1 = {"OutA": [], "OutB": []}
+        rt1 = self._runtime(src, got1)
+        h = rt1.get_input_handler("S")
+        for ts, row in trades(12):
+            h.send(row, timestamp=ts)
+        rt1.flush()
+        blob = rt1.snapshot()
+        assert got1["OutA"]
+
+        got2 = {"OutA": [], "OutB": []}
+        rt2 = self._runtime(dst, got2)
+        rt2.restore(blob)
+        h2 = rt2.get_input_handler("S")
+        for ts, row in trades(6, t0=9000):
+            h2.send(row, timestamp=ts)
+        rt2.flush()
+
+        # oracle: unfused runtime fed the full 18-row history
+        got3 = {"OutA": [], "OutB": []}
+        rt3 = self._runtime(False, got3)
+        h3 = rt3.get_input_handler("S")
+        for ts, row in trades(12) + trades(6, t0=9000):
+            h3.send(row, timestamp=ts)
+        rt3.flush()
+        assert got2["OutA"] == got3["OutA"][len(got1["OutA"]):]
+        assert got2["OutB"] == got3["OutB"][len(got1["OutB"]):]
+        rt1.shutdown(); rt2.shutdown(); rt3.shutdown()
+
+
+class TestUpgradeDiffParity:
+    def test_plan_fingerprint_sees_unfused_layout(self):
+        """rt.app stays the pre-optimization app: plan fingerprints (and so
+        upgrade classification) are identical across modes."""
+        from siddhi_tpu.analysis import element_fingerprints, plan_fingerprint
+        app = (STREAM +
+               "@info(name='a') from S[price > 1.0] select symbol "
+               "insert into OutA;\n"
+               "@info(name='b') from S[price > 2.0] select symbol "
+               "insert into OutB;\n")
+        m = SiddhiManager()
+        rt_off = m.create_siddhi_app_runtime(app, optimize=False)
+        rt_on = SiddhiManager().create_siddhi_app_runtime(app, optimize=True)
+        assert plan_fingerprint(rt_on.app) == plan_fingerprint(rt_off.app)
+        assert (element_fingerprints(rt_on.app)
+                == element_fingerprints(rt_off.app))
+        assert rt_on.optimizer_report["queries_fused"] == 2
+        rt_off.shutdown(); rt_on.shutdown()
+
+    def test_upgrade_diff_unchanged_under_optimizer(self):
+        from siddhi_tpu.analysis import diff_apps
+        from siddhi_tpu import compiler
+        v1 = (STREAM +
+              "@info(name='a') from S[price > 1.0] select symbol "
+              "insert into OutA;\n"
+              "@info(name='b') from S[price > 2.0] select symbol "
+              "insert into OutB;\n")
+        v2 = (STREAM +
+              "@info(name='a') from S[price > 1.5] select symbol "
+              "insert into OutA;\n"
+              "@info(name='b') from S[price > 2.0] select symbol "
+              "insert into OutB;\n")
+        d = diff_apps(compiler.parse(v1), compiler.parse(v2))
+        # the diff classifies query 'a' as changed whether or not a runtime
+        # would fuse it — the optimizer never rewrites SiddhiApp objects
+        assert "query:a" in d.changed
+        assert "query:b" in d.migratable
+
+
+class TestDispatchEquivalence:
+    def test_partial_batches_and_flush_boundaries(self):
+        # ragged feed: flush after every row → partial-lane batches take the
+        # bucketed (or padded) path through the fused step
+        app = (STREAM +
+               "@info(name='a') from S[price > 10.0] select symbol, price "
+               "insert into OutA;\n"
+               "@info(name='b') from S select symbol, volume "
+               "insert into OutB;\n")
+
+        def run(optimize):
+            m = SiddhiManager()
+            rt = m.create_siddhi_app_runtime(app, batch_size=8,
+                                             optimize=optimize)
+            got = {"OutA": [], "OutB": []}
+            for s in got:
+                rt.add_callback(s, lambda evs, s=s: got[s].extend(
+                    tuple(e.data) for e in evs))
+            rt.start()
+            h = rt.get_input_handler("S")
+            for i, (ts, row) in enumerate(trades(21)):
+                h.send(row, timestamp=ts)
+                if i % 3 == 0:
+                    rt.flush()  # ragged partial batches
+            rt.flush()
+            m.shutdown()
+            return got
+
+        assert run(True) == run(False)
+
+    def test_chained_streams_fuse_downstream(self):
+        # fused group feeding a derived stream that itself hosts a fused
+        # group: cascades must see written-back state (re-entrancy order)
+        app = (STREAM +
+               "@info(name='m1') from S[price > 5.0] select symbol, price "
+               "insert into Mid;\n"
+               "@info(name='m2') from S[price > 15.0] select symbol, price "
+               "insert into Mid;\n"
+               "@info(name='d1') from Mid[price > 20.0] select symbol "
+               "insert into OutD1;\n"
+               "@info(name='d2') from Mid select symbol, price "
+               "insert into OutD2;\n")
+        out, rep = ab_check(app, {"S": trades(30)}, ("OutD1", "OutD2"),
+                            expect_fused=4)
+        assert out["OutD2"]
+        assert rep["groups"] == 2
