@@ -1051,6 +1051,7 @@ def bench_e2e_ingress() -> dict:
     n_keys = 10_000
     app = f"""
     @app:name('IngressBench')
+    @app:slo(stream='TradeStream', p99.ms='60000')
     @Async(buffer.size='{eb}', workers='{n_workers}')
     define stream TradeStream (symbol string, price double, volume long);
     @info(name = 'filt')
@@ -1165,8 +1166,10 @@ def bench_e2e_ingress() -> dict:
     _partial(res)
 
     # telemetry overhead A/B: identical workload with SIDDHI_TELEMETRY=0
-    # (span recording off at AppTelemetry creation). Overhead must stay
-    # under 5% — the always-on budget from ISSUE 7.
+    # (span recording off at AppTelemetry creation — which also disables
+    # the @app:slo engine, so the ON side carries tracing + SLO ticks +
+    # the flight recorder's rings). Overhead must stay under 5% — the
+    # always-on budget from ISSUE 7, inherited by ISSUE 10.
     _phase("e2e_ingress:telemetry_off")
     os.environ["SIDDHI_TELEMETRY"] = "0"
     try:

@@ -162,6 +162,25 @@ class SiddhiAppRuntime:
             for sid in app.stream_definitions:
                 self.junctions[sid].wal = self.wal
 
+        # SLO engine (@app:slo / per-query @slo; None when undeclared) and
+        # the always-on flight recorder — built AFTER _build() so objective
+        # binding can resolve query names and the recorder can snapshot a
+        # fully-wired runtime. SLO breaches trigger the recorder.
+        from ..telemetry.recorder import FlightRecorder
+        from ..telemetry.slo import slo_engine_from_app
+        diag_ann = app.annotation("app:diagnostics")
+        diag_dir = diag_ann.element("dir") if diag_ann is not None else None
+        self.ctx.recorder = FlightRecorder(self, bundle_dir=diag_dir)
+        self.slo_engine = slo_engine_from_app(self)
+        if self.slo_engine is not None:
+            rec = self.ctx.recorder
+            self.slo_engine.on_breach = lambda o, ev: rec.trigger(
+                "slo_breach", reason=f"{o.id} burn fast="
+                f"{o.last_fast.get('burn_rate', 0):.2f} slow="
+                f"{o.last_slow.get('burn_rate', 0):.2f}")
+        self._slo_stop = None
+        self._slo_thread = None
+
     # ------------------------------------------------------------------ build
 
     def _build(self) -> None:
@@ -409,6 +428,37 @@ class SiddhiAppRuntime:
             self._flusher_thread.start()
         if start_persist_scheduler:
             self._start_persist_scheduler()
+        if self.slo_engine is not None and self._slo_thread is None:
+            import threading
+            self._slo_stop = threading.Event()
+            self._slo_thread = threading.Thread(
+                target=self._slo_loop, daemon=True,
+                name=f"siddhi-slo-{self.app.name}")
+            self._slo_thread.start()
+
+    def _slo_loop(self) -> None:
+        """Daemon: one SLO evaluation pass per engine interval (~1 s).
+        tick() samples every objective's cumulative reader, re-judges both
+        burn windows, and fires the recorder on fresh breaches; a failing
+        tick is logged and retried — objectives must not die with one bad
+        sample."""
+        import logging
+        eng = self.slo_engine
+        while not self._slo_stop.wait(eng.interval_s):
+            if not self._started:
+                return
+            try:
+                eng.tick()
+            except Exception:  # noqa: BLE001 — evaluator must not die
+                logging.getLogger("siddhi_tpu").exception(
+                    "SLO evaluation tick failed (will retry next interval)")
+
+    def diagnostics(self, reason: str = "manual") -> dict:
+        """Force a diagnostic bundle now (POST /siddhi-apps/<name>/
+        diagnostics). Bypasses the recorder's de-dup/rate-limit gates."""
+        rec = self.ctx.recorder
+        path = rec.trigger("manual", reason=reason, force=True)
+        return {"bundle": path, "recorder": rec.report()}
 
     def connect_sources(self) -> None:
         """Connect every declared source transport (idempotent — already
@@ -517,6 +567,11 @@ class SiddhiAppRuntime:
             if self._persist_thread is not None:
                 self._persist_thread.join(timeout=10)
             self._persist_stop = self._persist_thread = None
+        if self._slo_stop is not None:
+            self._slo_stop.set()
+            if self._slo_thread is not None:
+                self._slo_thread.join(timeout=5)
+            self._slo_stop = self._slo_thread = None
         if self._flusher_stop is not None:
             self._flusher_stop.set()
             if self._flusher_thread is not None:
@@ -578,6 +633,8 @@ class SiddhiAppRuntime:
             from ..telemetry.profiling import stop_jax_profiler
             stop_jax_profiler()
             self._owns_jax_trace = False
+        if self.ctx.recorder is not None:
+            self.ctx.recorder.close()  # detach the log-tail handler
 
     def profile(self, n_batches: int = 32):
         """Arm a one-shot per-query device/host time split over the next
@@ -864,6 +921,11 @@ class SiddhiAppRuntime:
         finally:
             self._recovering = False
         self.ctx.statistics.track_recovery(replayed)
+        if self.ctx.recorder is not None:
+            # recovery is an anomaly worth evidence: capture the post-replay
+            # state (WAL position, replayed count, stats) for later triage
+            self.ctx.recorder.trigger(
+                "recovery", reason=f"revision={rev} wal_replayed={replayed}")
         return {"revision": rev, "wal_replayed": replayed}
 
     # ------------------------------------------------------------------ health

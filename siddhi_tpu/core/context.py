@@ -279,6 +279,7 @@ class Statistics:
             runtime.collect_overflow()
         out = {
             "level": self.level,
+            "uptime_seconds": elapsed,
             "events_in": dict(self.events_in),
             "batches": dict(self.batches),
             "throughput_eps": {s: n / elapsed for s, n in self.events_in.items()},
@@ -360,6 +361,14 @@ class Statistics:
                 # slow-batch exemplars — same histograms /metrics exports
                 out["latency"] = tele.latency_snapshot()
                 out["slow_batches"] = tele.slow_batches()
+            eng = getattr(runtime, "slo_engine", None)
+            if eng is not None:
+                # declared objectives + both burn windows + breach state
+                # (telemetry/slo.py; same data GET /slo serves)
+                out["slo"] = eng.report()
+            rec = getattr(runtime.ctx, "recorder", None)
+            if rec is not None:
+                out["recorder"] = rec.report()
             opt = getattr(runtime, "optimizer_report", None)
             if opt is not None:
                 # multi-query shared execution (core/shared.py): fused-group
@@ -443,6 +452,9 @@ class SiddhiAppContext:
     #: telemetry.AppTelemetry — always-on metrics registry + batch tracer
     #: (set by SiddhiAppRuntime before any junction is built)
     telemetry: object = None
+    #: telemetry.FlightRecorder — always-on evidence ring + anomaly-triggered
+    #: diagnostic bundles (set by SiddhiAppRuntime after build)
+    recorder: object = None
 
     @property
     def effective_batch_size(self) -> int:

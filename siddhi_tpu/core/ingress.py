@@ -541,6 +541,7 @@ class IngressPipeline:
                     trace = tele.mint(sid, bs, t0=fill_t0)
                     trace.h2d_ns = h2d
                     batch._trace = trace
+                    tele.record_lag(sid, int(ts_buf[bs - 1]))
                 ts_buf = np.zeros(bs, dtype=np.int64)
                 col_bufs = [np.zeros(bs, dtype=dt) for dt in self.np_dtypes]
                 fill = 0
@@ -580,6 +581,7 @@ class IngressPipeline:
                         trace = tele.mint(sid, m, t0=fill_t0)
                         trace.h2d_ns = h2d
                         batch._trace = trace
+                        tele.record_lag(sid, int(ts_c[m - 1]))
                     fill = 0
                     ts_buf = np.zeros(bs, dtype=np.int64)
                     col_bufs = [np.zeros(bs, dtype=dt)

@@ -712,6 +712,7 @@ class StreamJunction:
                     trace = tele.mint(self.definition.id, m, t0=t0)
                     trace.h2d_ns = time.perf_counter_ns() - h2d_t0
                     batch._trace = trace
+                    tele.record_lag(self.definition.id, int(ts_c[m - 1]))
                 else:
                     batch = EventBatch.from_numpy(ts_c, cols_c, m)
                 self._deliver(batch, now)
@@ -1073,6 +1074,8 @@ class StreamJunction:
                 # it never reaches a jitted step (EventBatch is a non-slots
                 # dataclass); _deliver pops it
                 batch._trace = trace
+                if m > 0:
+                    tele.record_lag(self.definition.id, int(chunk_ts[-1]))
             else:
                 batch = EventBatch.from_numpy(ts_arr, cols, m)
             self._deliver(batch, now if now is not None else
@@ -1185,6 +1188,13 @@ class StreamJunction:
                         self.ctx.statistics.track_breaker_failure(qname)
                         if br.record_failure():
                             self.ctx.statistics.track_breaker_open(qname)
+                            rec = getattr(self.ctx, "recorder", None)
+                            if rec is not None:
+                                # freeze evidence at the trip, not later: the
+                                # rings still hold the failing batches
+                                rec.trigger(
+                                    "breaker_open",
+                                    reason=f"query {qname!r}: {e}")
                         self._divert_breaker(br, batch, now, e)
                     elif self.on_error is not None:
                         self.on_error(e, batch)

@@ -288,6 +288,11 @@ def upgrade_app(manager, rt1, new_app: SiddhiApp, *,
             s.resume()
         rt1.ctx.statistics.track_upgrade(
             (time.perf_counter() - t_pause) * 1000.0, 0, rollback=True)
+        rec = getattr(rt1.ctx, "recorder", None)
+        if rec is not None:
+            # evidence for the post-mortem: why did the swap come back?
+            rec.trigger("upgrade_rollback",
+                        reason=f"hot-swap of {rt1.app.name!r} rolled back")
         try:
             rt2.shutdown(flush_durable=False)
         except Exception:  # noqa: BLE001 — rollback must complete

@@ -1,0 +1,374 @@
+"""`python -m siddhi_tpu.doctor` — turn diagnostic evidence into a diagnosis.
+
+    python -m siddhi_tpu.doctor <bundle-dir>              # offline bundle
+    python -m siddhi_tpu.doctor <bundle> --baseline <b0>  # + regression diff
+    python -m siddhi_tpu.doctor --live http://host:9090 --app MyApp
+    python -m siddhi_tpu.doctor <bundle> --json           # machine readable
+
+Loads a flight-recorder bundle (telemetry/recorder.py) — or, with --live,
+scrapes a running service's statistics endpoint into an in-memory pseudo
+bundle — and walks the evidence the way an on-call engineer would:
+
+  1. per breached SLO objective, rank the pipeline stages (stage | h2d |
+     device | sink) by recorded latency and name the DOMINANT one, using
+     the per-stream stage percentiles first and the slow-batch exemplars'
+     stage shares as the tie-breaker/fallback;
+  2. check the failure surfaces the engine already counts: open circuit
+     breakers, dead-lettered/dropped rows, device-capacity overflow,
+     recompile storms (many distinct widths per query), a saturated
+     ingress ring, stored error entries;
+  3. with --baseline, diff per-stage p99s against an earlier bundle and
+     flag stages that regressed past --threshold (default 2.0x).
+
+Findings print ranked (critical > warning > info), each with the evidence
+line that produced it. Exit codes are CI-stable:
+
+  0  healthy — no warning/critical findings (info-only is healthy)
+  1  the bundle is unreadable, has an unknown schema version, or the
+     --live scrape failed
+  3  degraded — at least one warning/critical finding
+
+(2 is deliberately unused: argparse exits 2 on bad usage.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+from .telemetry.recorder import SCHEMA_VERSION
+
+EXIT_OK = 0
+EXIT_BAD_BUNDLE = 1
+EXIT_DEGRADED = 3
+
+SEVERITIES = ("critical", "warning", "info")
+
+#: stages the dominant-stage ranking considers (e2e is the total, not a
+#: stage; "stage" is batch assembly/staging time)
+STAGES = ("stage", "h2d", "device", "sink")
+
+#: distinct compiled widths per query past which we call it a storm
+COMPILE_STORM_WIDTHS = 8
+
+
+class BundleError(Exception):
+    pass
+
+
+# --------------------------------------------------------------------------- #
+# loading
+# --------------------------------------------------------------------------- #
+
+
+def load_bundle(path: str) -> dict:
+    """Read a recorder bundle directory into one dict keyed by section
+    (manifest/stats/traces/logs/plan/config). Raises BundleError on a
+    missing manifest or an unknown schema version."""
+    manifest_path = os.path.join(path, "manifest.json")
+    if not os.path.isfile(manifest_path):
+        raise BundleError(f"{path}: not a diagnostic bundle "
+                          "(no manifest.json)")
+    bundle: dict = {}
+    for section in ("manifest", "stats", "traces", "logs", "plan", "config"):
+        fpath = os.path.join(path, section + ".json")
+        if not os.path.isfile(fpath):
+            bundle[section] = None
+            continue
+        try:
+            with open(fpath) as f:
+                bundle[section] = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise BundleError(f"{fpath}: unreadable ({e})") from e
+    ver = (bundle["manifest"] or {}).get("schema_version")
+    if ver != SCHEMA_VERSION:
+        raise BundleError(
+            f"{path}: bundle schema version {ver!r} != supported "
+            f"{SCHEMA_VERSION}")
+    return bundle
+
+
+def load_live(url: str, app: str, token: Optional[str] = None) -> dict:
+    """Scrape a running service into a pseudo-bundle: the statistics
+    report carries everything the stage/SLO analysis needs (traces ride
+    in as slow_batches)."""
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(
+        f"{url.rstrip('/')}/siddhi-apps/{app}/statistics")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            stats = json.load(resp)
+    except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+        raise BundleError(f"live scrape of {url!r} failed: {e}") from e
+    return {
+        "manifest": {"schema_version": SCHEMA_VERSION, "app": app,
+                     "trigger": {"kind": "live", "reason": url}},
+        "stats": stats,
+        "traces": {"recent": [], "slow_batches":
+                   stats.get("slow_batches", [])},
+        "logs": [], "plan": None, "config": None,
+    }
+
+
+# --------------------------------------------------------------------------- #
+# analysis
+# --------------------------------------------------------------------------- #
+
+
+def _finding(severity: str, title: str, evidence: str,
+             objective: Optional[str] = None) -> dict:
+    return {"severity": severity, "title": title, "evidence": evidence,
+            "objective": objective}
+
+
+def _stage_p99s(stats: dict, stream: Optional[str] = None) -> dict:
+    """{stage: p99_ms} merged across streams (or for one stream)."""
+    out: dict = {}
+    streams = (stats.get("latency") or {}).get("streams") or {}
+    for sid, stages in streams.items():
+        if stream is not None and sid != stream:
+            continue
+        for stage, summary in stages.items():
+            if stage not in STAGES:
+                continue
+            p99 = summary.get("p99_ms")
+            if p99 is not None and p99 > out.get(stage, -1.0):
+                out[stage] = p99
+    return out
+
+
+def _stage_shares_from_exemplars(traces: dict,
+                                 query: Optional[str] = None) -> dict:
+    """{stage: mean_ms} over the slow-batch exemplars (optionally only the
+    ones a given query participated in) — the fallback ranking when the
+    histogram percentiles don't isolate the scope."""
+    out: dict = {s: 0.0 for s in STAGES}
+    n = 0
+    for s in (traces or {}).get("slow_batches") or []:
+        if query is not None and query not in (s.get("queries") or ()):
+            continue
+        stages = s.get("stages_ms") or {}
+        for stage in STAGES:
+            out[stage] += float(stages.get(stage, 0.0))
+        n += 1
+    if n == 0:
+        return {}
+    return {stage: total / n for stage, total in out.items()}
+
+
+def dominant_stage(stats: dict, traces: dict, scope: str) -> Optional[tuple]:
+    """(stage, ms, basis) for one objective scope ("stream:X" /
+    "query:Q"), or None when there is no stage evidence at all."""
+    scope_type, _, name = scope.partition(":")
+    ranking: dict = {}
+    basis = ""
+    if scope_type == "stream":
+        ranking = _stage_p99s(stats, name)
+        basis = f"stage p99 on stream {name!r}"
+    elif scope_type == "query":
+        ranking = _stage_shares_from_exemplars(traces, name)
+        basis = f"mean stage share of slow batches through query {name!r}"
+    if not ranking:
+        ranking = _stage_p99s(stats)
+        basis = "stage p99 across all streams"
+    if not ranking:
+        ranking = _stage_shares_from_exemplars(traces)
+        basis = "mean stage share of slow-batch exemplars"
+    if not ranking:
+        return None
+    stage = max(ranking, key=lambda s: ranking[s])
+    return stage, ranking[stage], basis
+
+
+def analyze(bundle: dict, baseline: Optional[dict] = None,
+            threshold: float = 2.0) -> list[dict]:
+    """All findings, ranked most-severe first."""
+    stats = bundle.get("stats") or {}
+    traces = bundle.get("traces") or {}
+    findings: list[dict] = []
+
+    # 1. breached objectives → dominant stage
+    slo = stats.get("slo") or {}
+    for oid, rep in (slo.get("objectives") or {}).items():
+        if rep.get("state") != "breached":
+            if rep.get("breaches", 0) > 0:
+                findings.append(_finding(
+                    "info", f"objective {oid} breached earlier but "
+                    "recovered",
+                    f"{rep['breaches']} breach(es), "
+                    f"{rep.get('recoveries', 0)} recovery(ies)", oid))
+            continue
+        dom = dominant_stage(stats, traces, rep.get("scope", ""))
+        burn = (rep.get("fast") or {}).get("burn_rate", 0.0)
+        if dom is None:
+            findings.append(_finding(
+                "critical", f"objective {oid} is breached",
+                f"fast-window burn rate {burn:.2f}; no stage evidence "
+                "recorded", oid))
+            continue
+        stage, ms, basis = dom
+        findings.append(_finding(
+            "critical",
+            f"objective {oid} is breached — dominant stage: {stage}",
+            f"fast-window burn rate {burn:.2f}; {basis} = {ms:.2f} ms",
+            oid))
+
+    # 2. engine failure surfaces
+    for q, br in (stats.get("breakers") or {}).items():
+        if br.get("state") and br["state"] != "closed":
+            findings.append(_finding(
+                "critical", f"circuit breaker for query {q!r} is "
+                f"{br['state']}",
+                f"{br.get('failures', 0)} failure(s), "
+                f"{br.get('diverted_rows', 0)} row(s) diverted"))
+    dead = stats.get("sink_dead_letters") or {}
+    if sum(dead.values()):
+        findings.append(_finding(
+            "warning", "sink dead-letters present",
+            ", ".join(f"{s}: {n}" for s, n in sorted(dead.items()))))
+    dropped = stats.get("sink_dropped") or {}
+    if sum(dropped.values()):
+        findings.append(_finding(
+            "warning", "sinks dropped rows (on.error=LOG)",
+            ", ".join(f"{s}: {n}" for s, n in sorted(dropped.items()))))
+    overflow = stats.get("overflow") or {}
+    if overflow:
+        findings.append(_finding(
+            "critical", "device-capacity overflow: results are missing rows",
+            ", ".join(f"{k}: {n}" for k, n in sorted(overflow.items()))))
+    for q, widths in (stats.get("compile_widths") or {}).items():
+        distinct = len(set(widths))
+        if distinct >= COMPILE_STORM_WIDTHS:
+            findings.append(_finding(
+                "warning", f"recompile storm on query {q!r}",
+                f"{distinct} distinct compiled widths "
+                f"({len(widths)} compiles) — unstable batch shapes"))
+    for sid, snap in (stats.get("ingress_pipeline") or {}).items():
+        cap = snap.get("ring_capacity") or 0
+        hwm = snap.get("ring_depth_hwm") or 0
+        if cap and hwm >= cap:
+            findings.append(_finding(
+                "warning", f"ingress ring for {sid!r} hit capacity",
+                f"depth high-watermark {hwm} of {cap} — producers "
+                "outran the feeder (backpressure/shedding engaged)"))
+    es = stats.get("error_store") or {}
+    if es.get("entries"):
+        findings.append(_finding(
+            "info", "error store holds replayable entries",
+            f"{es['entries']} entry(ies), "
+            f"{es.get('dropped_error_entries', 0)} dropped"))
+    rec = stats.get("recovery") or {}
+    if rec.get("recoveries"):
+        findings.append(_finding(
+            "info", "app recovered from a crash/restart",
+            f"{rec['recoveries']} recovery(ies), "
+            f"{rec.get('wal_replayed', 0)} WAL event(s) replayed"))
+    upg = stats.get("upgrade") or {}
+    if upg.get("rollbacks"):
+        findings.append(_finding(
+            "warning", "hot-swap upgrade rolled back",
+            f"{upg['rollbacks']} rollback(s) — v2 failed pre-commit"))
+
+    # 3. baseline regression diff
+    if baseline is not None:
+        base_stats = baseline.get("stats") or {}
+        now_p99 = _stage_p99s(stats)
+        base_p99 = _stage_p99s(base_stats)
+        for stage, ms in sorted(now_p99.items()):
+            b = base_p99.get(stage)
+            if b and b > 0 and ms / b >= threshold:
+                findings.append(_finding(
+                    "warning",
+                    f"stage {stage!r} p99 regressed {ms / b:.1f}x vs "
+                    "baseline",
+                    f"{b:.2f} ms -> {ms:.2f} ms "
+                    f"(threshold {threshold:.1f}x)"))
+
+    findings.sort(key=lambda f: SEVERITIES.index(f["severity"]))
+    return findings
+
+
+# --------------------------------------------------------------------------- #
+# CLI
+# --------------------------------------------------------------------------- #
+
+
+def _render(bundle: dict, findings: list[dict]) -> str:
+    man = bundle.get("manifest") or {}
+    trig = man.get("trigger") or {}
+    lines = [
+        f"doctor: app {man.get('app', '?')!r}, trigger "
+        f"{trig.get('kind', '?')}"
+        + (f" ({trig['reason']})" if trig.get("reason") else ""),
+    ]
+    if not findings:
+        lines.append("  healthy: no findings")
+        return "\n".join(lines)
+    icons = {"critical": "!!", "warning": " !", "info": "  "}
+    for i, f in enumerate(findings, 1):
+        lines.append(f"{icons[f['severity']]} {i}. "
+                     f"[{f['severity'].upper()}] {f['title']}")
+        lines.append(f"       {f['evidence']}")
+    worst = findings[0]["severity"]
+    lines.append(f"diagnosis: {sum(1 for f in findings)} finding(s), "
+                 f"worst severity {worst}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m siddhi_tpu.doctor",
+        description="Analyze a flight-recorder diagnostic bundle (or a "
+                    "live service) and print a ranked diagnosis.")
+    p.add_argument("bundle", nargs="?",
+                   help="path to a diagnostic bundle directory")
+    p.add_argument("--baseline", metavar="BUNDLE",
+                   help="earlier bundle to diff stage p99s against")
+    p.add_argument("--live", metavar="URL",
+                   help="scrape a running service instead of a bundle")
+    p.add_argument("--app", help="app name (required with --live)")
+    p.add_argument("--token", help="bearer token for --live")
+    p.add_argument("--threshold", type=float, default=2.0,
+                   help="baseline regression ratio that flags a stage "
+                        "(default 2.0)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    args = p.parse_args(argv)
+
+    try:
+        if args.live:
+            if not args.app:
+                p.error("--live requires --app")
+            bundle = load_live(args.live, args.app, args.token)
+        elif args.bundle:
+            bundle = load_bundle(args.bundle)
+        else:
+            p.error("need a bundle path or --live URL")
+        baseline = load_bundle(args.baseline) if args.baseline else None
+    except BundleError as e:
+        print(f"doctor: {e}", file=sys.stderr)
+        return EXIT_BAD_BUNDLE
+
+    findings = analyze(bundle, baseline, threshold=args.threshold)
+    degraded = any(f["severity"] in ("critical", "warning")
+                   for f in findings)
+    if args.as_json:
+        print(json.dumps({
+            "app": (bundle.get("manifest") or {}).get("app"),
+            "schema_version": SCHEMA_VERSION,
+            "findings": findings,
+            "degraded": degraded,
+        }, indent=1))
+    else:
+        print(_render(bundle, findings))
+    return EXIT_DEGRADED if degraded else EXIT_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -183,6 +183,18 @@ class Sink:
             return int(timestamps[i])
         return self.ctx.timestamp_generator.current_time()
 
+    def _log_ctx(self) -> dict:
+        """logging `extra` for sink error paths: app/stream plus the active
+        batch-trace ID, so SIDDHI_LOG_FORMAT=json lines (and flight-recorder
+        bundle log tails) correlate with frozen batch traces."""
+        ctx = {"app": self.ctx.name, "stream": self.definition.id}
+        tele = getattr(self.ctx, "telemetry", None)
+        if tele is not None:
+            tr = tele.active()
+            if tr is not None:
+                ctx["batch_id"] = tr.batch_id
+        return ctx
+
     def _handle_error(self, row: tuple, ts: int, e: Exception) -> None:
         """One failed row under LOG / STREAM / STORE (WAIT handles
         connection loss before getting here and degrades to STORE for
@@ -197,17 +209,26 @@ class Sink:
                 return
             log.error("@sink(on.error='STREAM') on %r but the stream has no "
                       "fault stream (add @OnError(action='STREAM')); "
-                      "dead-lettering instead", sid)
+                      "dead-lettering instead", sid, extra=self._log_ctx())
         if action in ("STREAM", "STORE", "WAIT"):
             store = getattr(self.ctx, "error_store", None)
             if store is not None:
                 store.save(self.ctx.name, sid, [(ts, tuple(row))], str(e))
                 self.ctx.statistics.track_dead_letter(sid, 1)
+                self._note_dead_letter(1)
                 return
             log.error("@sink(on.error=%r) on %r but no error store is "
-                      "configured; logging instead", action, sid)
+                      "configured; logging instead", action, sid,
+                      extra=self._log_ctx())
         self.ctx.statistics.track_sink_drop(sid, 1)
-        log.exception("sink %r failed to publish event %r: %s", sid, row, e)
+        log.exception("sink %r failed to publish event %r: %s", sid, row, e,
+                      extra=self._log_ctx())
+
+    def _note_dead_letter(self, n: int) -> None:
+        """Feed the flight recorder's rolling dead-letter burst detector."""
+        rec = getattr(self.ctx, "recorder", None)
+        if rec is not None:
+            rec.on_dead_letter(n)
 
     def _dead_letter(self, rows: list, timestamps, offset: int,
                      e: Exception) -> None:
@@ -221,11 +242,14 @@ class Sink:
             store.save(self.ctx.name, sid, events, str(e))
             self.ctx.statistics.track_dead_letter(sid, len(events))
             log.warning("sink %r: retries exhausted; dead-lettered %d "
-                        "event(s) to the error store", sid, len(events))
+                        "event(s) to the error store", sid, len(events),
+                        extra=self._log_ctx())
+            self._note_dead_letter(len(events))
             return
         self.ctx.statistics.track_sink_drop(sid, len(events))
         log.error("sink %r: retries exhausted and no error store configured; "
-                  "dropped %d event(s): %s", sid, len(events), e)
+                  "dropped %d event(s): %s", sid, len(events), e,
+                  extra=self._log_ctx())
 
 
 class InMemorySink(Sink):

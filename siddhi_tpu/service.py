@@ -31,6 +31,15 @@ Endpoints (JSON):
                                       → re-send matching entries into their
                                       original streams, original timestamps
   GET    /siddhi-apps/<name>/statistics
+  POST   /siddhi-apps/<name>/diagnostics
+                                      → force a flight-recorder diagnostic
+                                        bundle now (telemetry/recorder.py;
+                                        bypasses the trigger rate limits);
+                                        {"bundle": path, "recorder": {...}}
+  GET    /slo                         → 200 when no declared objective is
+                                        breached; 503 with per-app burn
+                                        detail otherwise (same lock-free
+                                        contract as /ready)
   GET    /health                      → 200 always while the process serves
   GET    /ready                       → 200 when every app is "running";
                                         503 with per-app detail otherwise
@@ -278,6 +287,34 @@ class SiddhiService:
         ready = all(a["state"] == "running" for a in apps.values())
         return (200 if ready else 503), {"ready": ready, "apps": apps}
 
+    def slo(self) -> tuple[int, dict]:
+        """SLO probe: (http_status, body). 200 while no declared objective
+        is breached (apps without @slo annotations count as compliant);
+        503 lets alerting/load-balancing key off burn-rate breaches the
+        same way /ready keys off breaker state. Lock-free like /ready."""
+        apps = {}
+        breaching = False
+        for name, rt in list(self.manager.runtimes.items()):
+            eng = getattr(rt, "slo_engine", None)
+            if eng is None:
+                continue
+            try:
+                rep = eng.report()
+            except Exception:  # racing undeploy/shutdown
+                continue
+            apps[name] = rep
+            breaching = breaching or rep.get("breaching", False)
+        return (503 if breaching else 200), {"ok": not breaching,
+                                             "apps": apps}
+
+    def diagnostics(self, name: str, reason: str = "api") -> dict:
+        """Force a diagnostic bundle for one app (bypasses the recorder's
+        de-dup/rate-limit gates — an operator asking for evidence gets
+        evidence)."""
+        with self.lock:
+            rt = self.manager.runtimes[name]
+        return rt.diagnostics(reason=reason)
+
     def metrics_text(self) -> str:
         """Prometheus text exposition for every deployed app. Lock-free:
         a scrape must never queue behind a deploy or a device step."""
@@ -340,6 +377,12 @@ class SiddhiService:
                     return
                 if parts == ["ready"]:
                     code, body = service.readiness()
+                    self._reply(code, body)
+                    return
+                if parts == ["slo"]:
+                    # auth-exempt like /ready: burn rates and objective IDs,
+                    # never data or query text
+                    code, body = service.slo()
                     self._reply(code, body)
                     return
                 if parts == ["metrics"]:
@@ -407,6 +450,12 @@ class SiddhiService:
                     elif (len(parts) == 3 and parts[0] == "siddhi-apps"
                           and parts[2] == "recover"):
                         self._reply(200, service.recover(parts[1]))
+                    elif (len(parts) == 3 and parts[0] == "siddhi-apps"
+                          and parts[2] == "diagnostics"):
+                        body = self._body()
+                        data = json.loads(body) if body.strip() else {}
+                        self._reply(200, service.diagnostics(
+                            parts[1], reason=data.get("reason", "api")))
                     elif (len(parts) == 3 and parts[0] == "siddhi-apps"
                           and parts[2] == "upgrade"):
                         force = query.get("force", "").lower() \

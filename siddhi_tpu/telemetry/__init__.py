@@ -18,6 +18,15 @@ The package threads one measurement substrate through the whole pipeline:
   profiling.py   SIDDHI_PROFILE=<dir> jax.profiler trace capture and the
                  SiddhiAppRuntime.profile(n_batches) host/device time split.
   logs.py        SIDDHI_LOG_FORMAT=json one-line structured log records.
+  slo.py         declarative objectives (@app:slo / @slo) evaluated with
+                 multi-window burn rates on a virtual-clock-testable engine
+                 (ISSUE 10); surfaced via statistics_report()["slo"],
+                 siddhi_slo_* families, and GET /slo.
+  recorder.py    flight recorder — always-on evidence rings frozen into
+                 versioned diagnostic bundles on anomaly triggers (SLO
+                 breach, breaker open, recovery, upgrade rollback,
+                 dead-letter burst, manual POST), rate-limited + de-duped;
+                 analyzed offline by `python -m siddhi_tpu.doctor`.
 
 Gating: SIDDHI_TELEMETRY=0 turns span/histogram recording off (the <5%
 overhead budget is measured by bench.py's e2e_ingress config and guarded by
@@ -30,15 +39,22 @@ from __future__ import annotations
 import os
 
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .recorder import SCHEMA_VERSION, FlightRecorder
+from .slo import Objective, SloEngine, slo_engine_from_app
 from .tracing import AppTelemetry, BatchTrace
 
 __all__ = [
     "AppTelemetry",
     "BatchTrace",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "Objective",
+    "SCHEMA_VERSION",
+    "SloEngine",
+    "slo_engine_from_app",
     "telemetry_enabled",
 ]
 

@@ -38,7 +38,34 @@ ALWAYS_ON_FAMILIES = (
     "siddhi_events_total",
     "siddhi_stage_latency_seconds",
     "siddhi_query_latency_seconds",
+    "siddhi_build_info",
+    "siddhi_app_uptime_seconds",
+    "siddhi_event_time_lag_seconds",
+    "siddhi_slo_breaches_total",
 )
+
+
+def _build_info() -> tuple[str, str, str]:
+    """(version, backend, device_count) — resolved lazily and cached; the
+    backend query initializes JAX, which must not happen at import time."""
+    global _BUILD_INFO
+    if _BUILD_INFO is None:
+        try:
+            import siddhi_tpu as pkg
+            version = getattr(pkg, "__version__", "unknown")
+        except Exception:  # noqa: BLE001 — partial import during teardown
+            version = "unknown"
+        try:
+            import jax
+            backend = jax.default_backend()
+            devices = str(jax.device_count())
+        except Exception:  # noqa: BLE001
+            backend, devices = "unknown", "0"
+        _BUILD_INFO = (version, backend, devices)
+    return _BUILD_INFO
+
+
+_BUILD_INFO = None
 
 
 def _escape_label(v) -> str:
@@ -141,6 +168,59 @@ def _stats_families(exp: _Exposition, app: str, runtime) -> None:
     except Exception:  # pragma: no cover — mid-shutdown race
         state = None
     exp.add("siddhi_app_up", (app,), 1 if state == "running" else 0)
+
+    # build/identity + uptime (always-on; the on-call first-glance pair)
+    version, backend, devices = _build_info()
+    exp.declare("siddhi_build_info", "gauge",
+                "Engine build/runtime identity (value is always 1)",
+                ("app", "version", "backend", "devices"))
+    exp.add("siddhi_build_info", (app, version, backend, devices), 1)
+    exp.declare("siddhi_app_uptime_seconds", "gauge",
+                "Seconds since the app's statistics epoch (start or reset)",
+                ("app",))
+    import time as _time
+    exp.add("siddhi_app_uptime_seconds", (app,),
+            max(_time.time() - st.started_at, 0.0))
+
+    # SLO engine (telemetry/slo.py): compliance + burn per objective
+    exp.declare("siddhi_slo_compliance_ratio", "gauge",
+                "Fraction of fast-window observations meeting the objective",
+                ("app", "objective"))
+    exp.declare("siddhi_slo_burn_rate", "gauge",
+                "Error-budget burn rate per window (1.0 = burning exactly "
+                "the budget)", ("app", "objective", "window"))
+    exp.declare("siddhi_slo_breaches_total", "counter",
+                "Objective transitions into the breached state",
+                ("app", "objective"))
+    eng = getattr(runtime, "slo_engine", None)
+    if eng is not None:
+        for oid, rep in eng.report()["objectives"].items():
+            exp.add("siddhi_slo_compliance_ratio", (app, oid),
+                    rep["fast"].get("compliance", 1.0))
+            exp.add("siddhi_slo_burn_rate", (app, oid, "fast"),
+                    rep["fast"].get("burn_rate", 0.0))
+            exp.add("siddhi_slo_burn_rate", (app, oid, "slow"),
+                    rep["slow"].get("burn_rate", 0.0))
+            exp.add("siddhi_slo_breaches_total", (app, oid), rep["breaches"])
+
+    # flight recorder (telemetry/recorder.py): trigger/bundle counters
+    rec = getattr(runtime.ctx, "recorder", None)
+    if rec is not None:
+        rrep = rec.report()
+        exp.declare("siddhi_diag_bundles_total", "counter",
+                    "Diagnostic bundles written by the flight recorder",
+                    ("app",))
+        exp.add("siddhi_diag_bundles_total", (app,), rrep["bundles_written"])
+        exp.declare("siddhi_diag_triggers_total", "counter",
+                    "Flight-recorder trigger requests by kind", ("app",
+                                                                 "kind"))
+        exp.declare("siddhi_diag_suppressed_total", "counter",
+                    "Triggers suppressed by de-dup/rate-limit gates",
+                    ("app", "kind"))
+        for kind, n in rrep["triggers"].items():
+            exp.add("siddhi_diag_triggers_total", (app, kind), n)
+        for kind, n in rrep["suppressed"].items():
+            exp.add("siddhi_diag_suppressed_total", (app, kind), n)
 
     _add_dict_counter(exp, "siddhi_compiles_total",
                       "XLA compiles of jitted query steps (trace-time exact)",
@@ -297,6 +377,15 @@ def render_manager(manager) -> str:
                         ("app",) + fam.labelnames)
         exp.declare("siddhi_app_up", "gauge",
                     "1 while the app runtime reports state=running", ("app",))
+        exp.declare("siddhi_build_info", "gauge",
+                    "Engine build/runtime identity (value is always 1)",
+                    ("app", "version", "backend", "devices"))
+        exp.declare("siddhi_app_uptime_seconds", "gauge",
+                    "Seconds since the app's statistics epoch (start or "
+                    "reset)", ("app",))
+        exp.declare("siddhi_slo_breaches_total", "counter",
+                    "Objective transitions into the breached state",
+                    ("app", "objective"))
     for name, rt in runtimes:
         tele = getattr(rt.ctx, "telemetry", None)
         if tele is not None:

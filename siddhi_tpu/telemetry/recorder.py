@@ -1,0 +1,317 @@
+"""Flight recorder: always-on evidence ring + anomaly-triggered bundles.
+
+Aviation flight recorders don't wait for the crash to start recording —
+they keep a bounded ring of the recent past, and the crash freezes it.
+Same here: the recorder piggybacks on state the telemetry layer already
+maintains (the recent batch-trace deque, the worst-N slow-batch ring,
+the per-stage histograms) and adds only one always-on cost of its own —
+a bounded deque tail of recent structured log records, fed by a
+logging.Handler on the "siddhi_tpu" logger.
+
+On a **trigger** the recorder freezes everything into a versioned JSON
+**diagnostic bundle** (a directory of small JSON files — greppable,
+diffable, and `python -m siddhi_tpu.doctor`-loadable):
+
+    <dir>/<app>-<trigger>-<seq>/
+      manifest.json   schema version, app, trigger kind/reason, sequence
+      stats.json      full statistics_report() (includes slo, breakers,
+                      compile widths, ingress stage_ms, WAL position)
+      traces.json     frozen recent batch summaries + slow-batch exemplars
+      logs.json       recent structured-log tail
+      plan.json       plan fingerprint + per-element fingerprints + lint
+      config.json     env snapshot (SIDDHI_*/JAX_PLATFORMS), version,
+                      backend, device count, schema of the bundle itself
+
+Trigger kinds: "slo_breach", "breaker_open", "recovery",
+"upgrade_rollback", "dead_letter_burst", "manual" (POST
+/siddhi-apps/<name>/diagnostics). A flapping breaker must not fill the
+disk, so triggers pass through two gates before any I/O happens:
+
+  per-kind cooldown   the same kind re-triggering within
+                      SIDDHI_DIAG_COOLDOWN_S (default 300 s) is counted
+                      but suppressed
+  global min-interval any two bundles must be SIDDHI_DIAG_MIN_INTERVAL_S
+                      (default 30 s) apart
+
+and `keep_last` (default 16) oldest-first pruning bounds total disk.
+`force=True` (the explicit API trigger) bypasses both gates but still
+counts toward them. Bundle writes are synchronous — triggers fire from
+slow paths (breach transitions, breaker trips) and the gates make them
+rare — and are wrapped so a full disk can never break delivery.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+#: bundle format version — bump on any backwards-incompatible layout change
+#: (doctor refuses versions it does not know). v1: initial format.
+SCHEMA_VERSION = 1
+
+#: recent structured-log ring size
+LOG_TAIL = 128
+
+#: dead-letter burst detection: this many dead-lettered rows inside the
+#: rolling window trips a "dead_letter_burst" trigger
+DEAD_LETTER_BURST = 100
+DEAD_LETTER_WINDOW_S = 60.0
+
+TRIGGER_KINDS = ("slo_breach", "breaker_open", "recovery",
+                 "upgrade_rollback", "dead_letter_burst", "manual")
+
+log = logging.getLogger("siddhi_tpu")
+
+
+class _TailHandler(logging.Handler):
+    """Captures the last LOG_TAIL records (WARNING and up by default) as
+    plain dicts into a bounded deque — same context fields the JSON log
+    formatter lifts, so bundle log tails correlate with frozen traces by
+    batch_id."""
+
+    def __init__(self, ring: deque, level: int = logging.WARNING) -> None:
+        super().__init__(level=level)
+        self.ring = ring
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            entry = {
+                "t": record.created,
+                "level": record.levelname,
+                "logger": record.name,
+                "message": record.getMessage(),
+            }
+            for attr in ("app", "query", "stream", "batch_id"):
+                v = getattr(record, attr, None)
+                if v is not None:
+                    entry[attr] = v
+            self.ring.append(entry)
+        except Exception:  # noqa: BLE001 — logging must never raise
+            pass
+
+
+def default_bundle_dir(app_name: str) -> str:
+    env = os.environ.get("SIDDHI_DIAG_DIR")
+    if env:
+        return os.path.join(env, app_name)
+    return os.path.join(tempfile.gettempdir(), "siddhi-diagnostics",
+                        app_name)
+
+
+class FlightRecorder:
+    """One app's recorder. Constructed by SiddhiAppRuntime.__init__ and
+    attached as `ctx.recorder`; trigger hooks live in core/stream.py
+    (breaker open), telemetry/slo.py via the runtime's on_breach wiring,
+    core/upgrade.py (rollback), io/sink.py (dead-letter burst),
+    core/app_runtime.py recover(), and service.py (manual POST)."""
+
+    def __init__(self, runtime, bundle_dir: Optional[str] = None,
+                 cooldown_s: Optional[float] = None,
+                 min_interval_s: Optional[float] = None,
+                 keep_last: int = 16,
+                 clock=time.monotonic) -> None:
+        self.runtime = runtime
+        self.app = runtime.app.name
+        self.bundle_dir = bundle_dir or default_bundle_dir(self.app)
+        if cooldown_s is None:
+            cooldown_s = float(os.environ.get("SIDDHI_DIAG_COOLDOWN_S", 300))
+        if min_interval_s is None:
+            min_interval_s = float(
+                os.environ.get("SIDDHI_DIAG_MIN_INTERVAL_S", 30))
+        self.cooldown_s = cooldown_s
+        self.min_interval_s = min_interval_s
+        self.keep_last = keep_last
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._last_by_kind: dict[str, float] = {}
+        self._last_any: Optional[float] = None
+        self.triggers_total: dict[str, int] = {}
+        self.suppressed_total: dict[str, int] = {}
+        self.bundles_written = 0
+        self.last_bundle: Optional[str] = None
+        # always-on log tail
+        self.log_tail: deque = deque(maxlen=LOG_TAIL)
+        self._handler = _TailHandler(self.log_tail)
+        log.addHandler(self._handler)
+        # dead-letter burst detector state: (t, rows) within the window
+        self._dead_letters: deque = deque()
+
+    # ------------------------------------------------------------- lifecycle
+
+    def close(self) -> None:
+        log.removeHandler(self._handler)
+
+    # -------------------------------------------------------------- triggers
+
+    def on_dead_letter(self, rows: int) -> Optional[str]:
+        """Called by io/sink.py per dead-lettered publish; trips the
+        dead_letter_burst trigger when the rolling-window total crosses
+        DEAD_LETTER_BURST."""
+        now = self.clock()
+        dq = self._dead_letters
+        dq.append((now, rows))
+        horizon = now - DEAD_LETTER_WINDOW_S
+        while dq and dq[0][0] < horizon:
+            dq.popleft()
+        total = sum(n for _, n in dq)
+        if total >= DEAD_LETTER_BURST:
+            return self.trigger(
+                "dead_letter_burst",
+                reason=f"{total} rows dead-lettered in "
+                       f"{DEAD_LETTER_WINDOW_S:.0f}s")
+        return None
+
+    def trigger(self, kind: str, reason: str = "",
+                force: bool = False) -> Optional[str]:
+        """Request a bundle. Returns the bundle path, or None when the
+        de-dup/rate-limit gates suppressed it (or the write failed)."""
+        now = self.clock()
+        with self._lock:
+            self.triggers_total[kind] = self.triggers_total.get(kind, 0) + 1
+            if not force:
+                last_kind = self._last_by_kind.get(kind)
+                if last_kind is not None and now - last_kind < self.cooldown_s:
+                    self.suppressed_total[kind] = (
+                        self.suppressed_total.get(kind, 0) + 1)
+                    return None
+                if (self._last_any is not None
+                        and now - self._last_any < self.min_interval_s):
+                    self.suppressed_total[kind] = (
+                        self.suppressed_total.get(kind, 0) + 1)
+                    return None
+            self._last_by_kind[kind] = now
+            self._last_any = now
+            self._seq += 1
+            seq = self._seq
+        try:
+            path = self._write_bundle(kind, reason, seq)
+            with self._lock:
+                self.bundles_written += 1
+                self.last_bundle = path
+            log.warning("flight recorder: wrote diagnostic bundle %s "
+                        "(trigger=%s%s)", path, kind,
+                        f", {reason}" if reason else "",
+                        extra={"app": self.app})
+            return path
+        except Exception:  # noqa: BLE001 — a full disk must not kill delivery
+            log.exception("flight recorder: bundle write failed "
+                          "(trigger=%s)", kind, extra={"app": self.app})
+            return None
+
+    # --------------------------------------------------------------- reports
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "bundle_dir": self.bundle_dir,
+                "bundles_written": self.bundles_written,
+                "last_bundle": self.last_bundle,
+                "triggers": dict(self.triggers_total),
+                "suppressed": dict(self.suppressed_total),
+                "cooldown_s": self.cooldown_s,
+                "min_interval_s": self.min_interval_s,
+            }
+
+    # ---------------------------------------------------------- bundle write
+
+    def _write_bundle(self, kind: str, reason: str, seq: int) -> str:
+        rt = self.runtime
+        created = time.time()
+        name = f"{self.app}-{kind}-{seq:04d}"
+        path = os.path.join(self.bundle_dir, name)
+        tmp = path + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+
+        manifest = {
+            "schema_version": SCHEMA_VERSION,
+            "app": self.app,
+            "trigger": {"kind": kind, "reason": reason},
+            "created": created,
+            "seq": seq,
+        }
+        # stats.json: the full report — includes slo, breakers, compile
+        # widths, ingress stage_ms, WAL position, recorder counters
+        try:
+            stats = rt.statistics_report()
+        except Exception:  # noqa: BLE001
+            stats = {"error": "statistics_report failed"}
+        # traces.json: freeze the rings NOW (they keep rolling after)
+        tele = getattr(rt.ctx, "telemetry", None)
+        traces = {"recent": [], "slow_batches": []}
+        if tele is not None:
+            try:
+                traces = {"recent": tele.recent_summaries(),
+                          "slow_batches": tele.slow_batches()}
+            except Exception:  # noqa: BLE001
+                pass
+        logs = list(self.log_tail)
+        plan = self._plan_section()
+        config = self._config_section()
+
+        for fname, obj in (("manifest.json", manifest),
+                           ("stats.json", stats),
+                           ("traces.json", traces),
+                           ("logs.json", logs),
+                           ("plan.json", plan),
+                           ("config.json", config)):
+            with open(os.path.join(tmp, fname), "w") as f:
+                json.dump(obj, f, indent=1, default=str)
+        if os.path.exists(path):  # stale same-name bundle: replace it
+            shutil.rmtree(path, ignore_errors=True)
+        os.replace(tmp, path)
+        self._prune()
+        return path
+
+    def _plan_section(self) -> dict:
+        out: dict = {}
+        app = self.runtime.app
+        try:
+            from ..analysis.plan import element_fingerprints, plan_fingerprint
+            out["fingerprint"] = plan_fingerprint(app)
+            out["elements"] = element_fingerprints(app)
+        except Exception:  # noqa: BLE001
+            out["fingerprint"] = None
+        try:
+            from ..analysis import analyze
+            out["lint"] = analyze(app).to_dict()
+        except Exception:  # noqa: BLE001
+            out["lint"] = None
+        return out
+
+    def _config_section(self) -> dict:
+        env = {k: v for k, v in sorted(os.environ.items())
+               if k.startswith("SIDDHI_") or k == "JAX_PLATFORMS"}
+        cfg = {"schema_version": SCHEMA_VERSION, "env": env}
+        try:
+            import siddhi_tpu as pkg
+            cfg["version"] = getattr(pkg, "__version__", "unknown")
+        except Exception:  # noqa: BLE001
+            cfg["version"] = "unknown"
+        try:
+            import jax
+            cfg["backend"] = jax.default_backend()
+            cfg["device_count"] = jax.device_count()
+        except Exception:  # noqa: BLE001
+            cfg["backend"] = "unknown"
+            cfg["device_count"] = 0
+        return cfg
+
+    def _prune(self) -> None:
+        try:
+            entries = [e for e in os.listdir(self.bundle_dir)
+                       if not e.endswith(".tmp")]
+        except OSError:
+            return
+        if len(entries) <= self.keep_last:
+            return
+        full = sorted(os.path.join(self.bundle_dir, e) for e in entries)
+        for stale in full[:len(entries) - self.keep_last]:
+            shutil.rmtree(stale, ignore_errors=True)

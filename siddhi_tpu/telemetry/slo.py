@@ -1,0 +1,411 @@
+"""Declarative SLOs with multi-window burn-rate evaluation (ISSUE 10).
+
+PR 7 produced the raw signals (per-stage histograms, batch traces); this
+module is the layer above them: *objectives* declared in the app text,
+
+    @app:slo(stream='TradeStream', p99.ms='50', min.rate='1000')
+    define stream TradeStream (symbol string, price double, volume long);
+
+    @slo(p99.ms='5', error.ratio='0.01')
+    @info(name='q1')
+    from TradeStream[price > 20.0] select symbol insert into Out;
+
+evaluated continuously on rolling windows with the Google-SRE
+**multi-window burn rate** scheme: an objective breaches only when the
+error budget is burning faster than `burn.threshold` over BOTH the fast
+window (default 5 min — catches the incident quickly) and the slow
+window (default 1 h — confirms it is sustained, not a blip). Burn rate
+1.0 means consuming exactly the budget an objective allows (e.g. a
+p99 target tolerates 1% of observations over the threshold; twice that
+fraction is a burn rate of 2.0).
+
+Objective kinds (annotation element → kind):
+
+  p50.ms / p95.ms / p99.ms / p999.ms   latency: fraction of observations
+                                       above the target must stay inside
+                                       the quantile's budget (0.5/0.05/
+                                       0.01/0.001)
+  min.rate                             throughput floor in events/s over
+                                       the fast window (streams count
+                                       delivered rows; query scope counts
+                                       step executions)
+  error.ratio                          bad-event ratio: dead-lettered +
+                                       sink-dropped + breaker-diverted
+                                       rows per delivered row
+
+Everything is **virtual-clock testable**: the evaluator never calls
+`time.*` directly — `SloEngine(clock=...)` and `tick(now=...)` follow
+the same injectable-clock pattern as core/breaker.py, so the burn-rate
+math is exercised in tests over simulated hours in microseconds.
+
+Surfaces: `statistics_report()["slo"]`, the `siddhi_slo_*` Prometheus
+families (telemetry/prometheus.py), the `GET /slo` readiness-style
+endpoint (service.py), and breach transitions trigger the flight
+recorder (telemetry/recorder.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from .metrics import N_BUCKETS, bucket_index
+
+#: defaults for the two burn windows (seconds) and the burn threshold
+FAST_WINDOW_S = 300.0
+SLOW_WINDOW_S = 3600.0
+BURN_THRESHOLD = 1.0
+
+#: latency element key -> (quantile, error budget = 1 - quantile)
+_QUANTILE_KEYS = {
+    "p50.ms": 0.5,
+    "p95.ms": 0.95,
+    "p99.ms": 0.99,
+    "p999.ms": 0.999,
+}
+
+OK = "ok"
+BREACHED = "breached"
+
+
+def frac_over_threshold(buckets, count: int, threshold_ns: int) -> float:
+    """Fraction of observations strictly above `threshold_ns`, from log2-µs
+    bucket deltas, linearly interpolating inside the owning bucket (the
+    same ×2-bounded estimate quantile extraction uses)."""
+    if count <= 0:
+        return 0.0
+    bi = bucket_index(threshold_ns)
+    above = float(sum(buckets[bi + 1:]))
+    n = buckets[bi]
+    if n:
+        if bi >= N_BUCKETS - 1:
+            above += n  # +Inf bucket: everything exceeds any finite target
+        else:
+            lo = 0 if bi == 0 else (1 << (bi - 1)) * 1000
+            hi = (1 << bi) * 1000
+            frac_above = (hi - threshold_ns) / (hi - lo)
+            above += n * min(max(frac_above, 0.0), 1.0)
+    return min(above / count, 1.0)
+
+
+class Objective:
+    """One declared objective: a cumulative-sample ring + the dual-window
+    burn evaluation + the ok/breached state machine.
+
+    `reader()` returns the CUMULATIVE sample for the objective's kind:
+
+      latency      (count, bucket_tuple)      from Histogram.snapshot()
+      rate         count                      monotone event/step count
+      error_ratio  (bad, total)               monotone counters
+
+    observe() appends (t, sample); evaluate() diffs the newest sample
+    against the oldest inside each window (a window with less history
+    than its span uses what exists — "up to window" semantics)."""
+
+    def __init__(self, oid: str, kind: str, scope_type: str, scope: str,
+                 *, target: float, quantile: Optional[float] = None,
+                 fast_window_s: float = FAST_WINDOW_S,
+                 slow_window_s: float = SLOW_WINDOW_S,
+                 burn_threshold: float = BURN_THRESHOLD,
+                 min_samples: int = 1,
+                 reader: Optional[Callable] = None) -> None:
+        if kind not in ("latency", "rate", "error_ratio"):
+            raise ValueError(f"unknown objective kind {kind!r}")
+        self.id = oid
+        self.kind = kind
+        self.scope_type = scope_type  # "stream" | "query" | "app"
+        self.scope = scope
+        self.target = float(target)
+        self.quantile = quantile
+        self.budget = (1.0 - quantile) if quantile is not None else None
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.burn_threshold = float(burn_threshold)
+        self.min_samples = int(min_samples)
+        self.reader = reader
+        self.state = OK
+        self.breaches = 0
+        self.recoveries = 0
+        self._samples: deque = deque()  # (t, cumulative_sample)
+
+    # ------------------------------------------------------------- sampling
+
+    def observe(self, now: float) -> None:
+        self._samples.append((now, self.reader()))
+        horizon = now - self.slow_window_s
+        # keep one sample OLDER than the slow window so its delta always
+        # spans the full window once enough history exists
+        while len(self._samples) > 2 and self._samples[1][0] <= horizon:
+            self._samples.popleft()
+
+    def _window(self, now: float, span_s: float):
+        """(elapsed_s, oldest_sample, newest_sample) for one window."""
+        newest = self._samples[-1]
+        oldest = self._samples[0]
+        horizon = now - span_s
+        for t, s in self._samples:
+            if t >= horizon:
+                oldest = (t, s)
+                break
+        return max(newest[0] - oldest[0], 1e-9), oldest[1], newest[1]
+
+    # ----------------------------------------------------------- evaluation
+
+    def _burn(self, now: float, span_s: float) -> dict:
+        elapsed, old, new = self._window(now, span_s)
+        if self.kind == "latency":
+            count = new[0] - old[0]
+            buckets = [a - b for a, b in zip(new[1], old[1])]
+            bad_frac = frac_over_threshold(
+                buckets, count, int(self.target * 1e6))
+            return {"samples": count,
+                    "burn_rate": bad_frac / self.budget,
+                    "compliance": 1.0 - bad_frac}
+        if self.kind == "rate":
+            events = new - old
+            rate = events / elapsed
+            return {"samples": events, "rate_eps": rate, "elapsed": elapsed,
+                    # burn framing: how far below the floor we are
+                    "burn_rate": max((self.target - rate) / self.target, 0.0)
+                    if self.target > 0 else 0.0,
+                    "compliance": min(rate / self.target, 1.0)
+                    if self.target > 0 else 1.0}
+        bad = new[0] - old[0]
+        total = new[1] - old[1]
+        ratio = bad / total if total > 0 else 0.0
+        return {"samples": total,
+                "burn_rate": ratio / self.target if self.target > 0 else 0.0,
+                "compliance": 1.0 - ratio}
+
+    def evaluate(self, now: float) -> Optional[dict]:
+        """Re-evaluate both windows; returns a transition event dict when
+        the state changed ({"objective", "from", "to", "at"}), else None."""
+        fast = self._burn(now, self.fast_window_s)
+        slow = self._burn(now, self.slow_window_s)
+        self.last_fast, self.last_slow = fast, slow
+        if self.kind == "rate":
+            # a throughput floor is judged on the fast window alone (the
+            # slow window would average an outage against healthy history);
+            # require ≥1 s of real history so boot doesn't read as outage
+            breaching = (fast.get("elapsed", 0.0) >= 1.0
+                         and fast["rate_eps"] < self.target)
+        else:
+            breaching = (fast["samples"] >= self.min_samples
+                         and fast["burn_rate"] >= self.burn_threshold
+                         and slow["burn_rate"] >= self.burn_threshold)
+        if breaching and self.state == OK:
+            self.state = BREACHED
+            self.breaches += 1
+            return {"objective": self.id, "from": OK, "to": BREACHED,
+                    "at": now}
+        if not breaching and self.state == BREACHED:
+            self.state = OK
+            self.recoveries += 1
+            return {"objective": self.id, "from": BREACHED, "to": OK,
+                    "at": now}
+        return None
+
+    def report(self) -> dict:
+        fast = getattr(self, "last_fast", None) or {"samples": 0,
+                                                    "burn_rate": 0.0,
+                                                    "compliance": 1.0}
+        slow = getattr(self, "last_slow", None) or dict(fast)
+        return {
+            "kind": self.kind,
+            "scope": f"{self.scope_type}:{self.scope}",
+            "target": self.target,
+            "quantile": self.quantile,
+            "burn_threshold": self.burn_threshold,
+            "windows_s": [self.fast_window_s, self.slow_window_s],
+            "state": self.state,
+            "breaches": self.breaches,
+            "recoveries": self.recoveries,
+            "fast": fast,
+            "slow": slow,
+        }
+
+
+class SloEngine:
+    """All of one app's objectives + the tick loop state. The engine never
+    reads wall clock itself: `clock` is injectable and `tick(now=...)`
+    overrides it, so tests drive simulated time."""
+
+    def __init__(self, app_name: str = "",
+                 clock: Callable[[], float] = time.monotonic,
+                 interval_s: float = 1.0) -> None:
+        self.app = app_name
+        self.clock = clock
+        self.interval_s = float(interval_s)
+        self.objectives: list[Objective] = []
+        #: called with (objective, event) on each ok->breached transition
+        self.on_breach: Optional[Callable] = None
+        self._lock = threading.Lock()
+
+    def add(self, objective: Objective) -> Objective:
+        self.objectives.append(objective)
+        objective.observe(self.clock())  # seed the cumulative baseline
+        return objective
+
+    def tick(self, now: Optional[float] = None) -> list[dict]:
+        """One evaluation pass: sample every objective, re-judge both
+        windows, fire on_breach for fresh breaches. Returns the state
+        transitions this tick produced."""
+        t = self.clock() if now is None else now
+        events = []
+        with self._lock:
+            for o in self.objectives:
+                o.observe(t)
+                ev = o.evaluate(t)
+                if ev is None:
+                    continue
+                events.append(ev)
+                if ev["to"] == BREACHED and self.on_breach is not None:
+                    try:
+                        self.on_breach(o, ev)
+                    except Exception:  # noqa: BLE001 — never kill the tick
+                        import logging
+                        logging.getLogger("siddhi_tpu").exception(
+                            "SLO breach hook failed for %r", o.id)
+        return events
+
+    def breaching(self) -> bool:
+        return any(o.state == BREACHED for o in self.objectives)
+
+    def report(self) -> dict:
+        return {
+            "objectives": {o.id: o.report() for o in self.objectives},
+            "breaching": self.breaching(),
+        }
+
+
+# --------------------------------------------------------------------------- #
+# annotation binding
+# --------------------------------------------------------------------------- #
+
+
+def _objectives_from_annotation(ann, scope_type: str, scope: str,
+                                engine: SloEngine, runtime,
+                                default_streams) -> None:
+    from ..core.partition import _parse_annotation_time
+    from ..errors import SiddhiAppCreationError
+    tele = runtime.ctx.telemetry
+    st = runtime.ctx.statistics
+
+    def _time_el(key: str, default_s: float) -> float:
+        v = ann.element(key)
+        return _parse_annotation_time(v) / 1000.0 if v else default_s
+
+    try:
+        fast_s = _time_el("fast.window", FAST_WINDOW_S)
+        slow_s = _time_el("slow.window", SLOW_WINDOW_S)
+        burn = float(ann.element("burn.threshold") or BURN_THRESHOLD)
+        min_samples = int(ann.element("min.samples") or 1)
+    except ValueError as e:
+        raise SiddhiAppCreationError(f"bad @slo annotation: {e}") from e
+
+    scopes = [(scope_type, scope)]
+    if scope_type == "app":
+        sel = ann.element("stream")
+        streams = [sel] if sel else list(default_streams)
+        if not streams:
+            raise SiddhiAppCreationError(
+                "@app:slo needs at least one defined stream")
+        scopes = [("stream", s) for s in streams]
+
+    def _latency_reader(hist):
+        def read():
+            buckets, count, _ = hist.snapshot()
+            return (count, tuple(buckets))
+        return read
+
+    def _stream_rate_reader(counter):
+        return counter.value
+
+    def _query_rate_reader(hist):
+        return hist.count
+
+    def _error_reader(total_fn):
+        def read():
+            bad = (sum(st.sink_dead_letters.values())
+                   + sum(st.sink_dropped.values())
+                   + sum(st.breaker_diverted.values()))
+            return (bad, total_fn())
+        return read
+
+    for s_type, s_name in scopes:
+        if s_type == "stream":
+            e2e_hist = tele.stage_hist.labels(s_name, "e2e")
+            rate_counter = tele.events.labels(s_name)
+            rate_reader = _stream_rate_reader(rate_counter)
+        else:
+            e2e_hist = tele.query_hist.labels(s_name)
+            rate_reader = _query_rate_reader(e2e_hist)
+
+        for key, q in _QUANTILE_KEYS.items():
+            v = ann.element(key)
+            if v is None:
+                continue
+            try:
+                target_ms = float(v)
+            except ValueError as e:
+                raise SiddhiAppCreationError(
+                    f"bad @slo {key}={v!r}: want milliseconds") from e
+            engine.add(Objective(
+                f"{s_type}:{s_name}:{key}", "latency", s_type, s_name,
+                target=target_ms, quantile=q, fast_window_s=fast_s,
+                slow_window_s=slow_s, burn_threshold=burn,
+                min_samples=min_samples,
+                reader=_latency_reader(e2e_hist)))
+        v = ann.element("min.rate")
+        if v is not None:
+            engine.add(Objective(
+                f"{s_type}:{s_name}:min.rate", "rate", s_type, s_name,
+                target=float(v), fast_window_s=fast_s,
+                slow_window_s=slow_s, burn_threshold=burn,
+                reader=rate_reader))
+        v = ann.element("error.ratio")
+        if v is not None:
+            engine.add(Objective(
+                f"{s_type}:{s_name}:error.ratio", "error_ratio",
+                s_type, s_name, target=float(v), fast_window_s=fast_s,
+                slow_window_s=slow_s, burn_threshold=burn,
+                min_samples=min_samples,
+                reader=_error_reader(rate_reader if s_type != "stream"
+                                     else rate_counter.value)))
+
+
+def slo_engine_from_app(runtime) -> Optional[SloEngine]:
+    """Build the app's SloEngine from `@app:slo(...)` (one or more, app
+    level) and per-query `@slo(...)` annotations; None when the app
+    declares no objectives or telemetry is disabled (the objectives read
+    the telemetry histograms — without them every window would be empty)."""
+    app = runtime.app
+    tele = getattr(runtime.ctx, "telemetry", None)
+    if tele is None or not tele.on:
+        return None
+    app_anns = [a for a in (app.annotations or ())
+                if a.name.lower() == "app:slo"]
+    query_anns = []
+    for i, query in enumerate(app.queries):
+        name = query.name or f"query{i + 1}"
+        for a in (query.annotations or ()):
+            if a.name.lower() == "slo":
+                query_anns.append((name, a))
+    if not app_anns and not query_anns:
+        return None
+    engine = SloEngine(app.name)
+    ingress = list(app.stream_definitions)
+    for ann in app_anns:
+        _objectives_from_annotation(ann, "app", app.name, engine, runtime,
+                                    ingress)
+    for qname, ann in query_anns:
+        _objectives_from_annotation(ann, "query", qname, engine, runtime,
+                                    ingress)
+    if not engine.objectives:
+        from ..errors import SiddhiAppCreationError
+        raise SiddhiAppCreationError(
+            "@slo annotation present but no objective elements "
+            "(want p99.ms= / min.rate= / error.ratio= ...)")
+    return engine

@@ -17,8 +17,14 @@ Stage model (all spans in ns, recorded into per-stage histograms):
   stage    mint → delivery start, minus h2d (encode + ring/queue wait +
            double-buffer residence)
   h2d      EventBatch.from_numpy (host→device transfer start)
-  device   sum of query/join/pattern step wall time inside the fan-out
-  sink     sum of Sink.publish_rows wall time inside the fan-out
+  device   sum of query/join/pattern step wall time inside the fan-out,
+           EXCLUSIVE of nested sink time — sinks publish from inside the
+           query's own distribution, so the raw query span contains the
+           sink span; subtracting it keeps device + sink additive and lets
+           the doctor attribute a slow consumer to `sink`, not `device`
+  sink     sum of Sink.publish_rows wall time inside the fan-out, credited
+           to EVERY trace on the active stack (the derived output stream's
+           trace and the ingress trace it is nested under)
   e2e      mint → delivery end
 
 Slow-batch exemplars: a bounded worst-N ring (by e2e) with the stage
@@ -65,6 +71,9 @@ class BatchTrace:
     def summary(self, t_end: int) -> dict:
         e2e = t_end - self.t0
         stage = max(self.deliver_t0 - self.t0 - self.h2d_ns, 0)
+        # sink publishes run nested inside query spans: report device
+        # exclusive of sink so the stage shares stay additive
+        device = max(self.device_ns - self.sink_ns, 0)
         return {
             "batch_id": self.batch_id,
             "stream": self.stream,
@@ -74,7 +83,7 @@ class BatchTrace:
             "stages_ms": {
                 "stage": stage / 1e6,
                 "h2d": self.h2d_ns / 1e6,
-                "device": self.device_ns / 1e6,
+                "device": device / 1e6,
                 "sink": self.sink_ns / 1e6,
             },
         }
@@ -118,6 +127,11 @@ class AppTelemetry:
         self.upgrade_hist = r.histogram(
             "siddhi_upgrade_cutover_seconds",
             "Blue-green hot-swap source-paused (cutover) wall time")
+        self.lag_gauge = r.gauge(
+            "siddhi_event_time_lag_seconds",
+            "Event-time lag at delivery: wall clock minus the newest "
+            "external row timestamp in the batch (epoch-ms producers only)",
+            ("stream",))
         # tracer state
         self._ids = itertools.count(1)
         self._tls = threading.local()
@@ -134,6 +148,7 @@ class AppTelemetry:
         self._stream_cells: dict = {}
         self._query_cells: dict = {}
         self._sink_cells: dict = {}
+        self._lag_cells: dict = {}
 
     # ---------------------------------------------------------------- tracing
 
@@ -173,8 +188,9 @@ class AppTelemetry:
         stage_c.observe_ns(stage_ns if stage_ns > 0 else 0)
         if trace.h2d_ns:
             h2d_c.observe_ns(trace.h2d_ns)
-        if trace.device_ns:
-            device_c.observe_ns(trace.device_ns)
+        device_ns = trace.device_ns - trace.sink_ns  # sink nests in query spans
+        if device_ns > 0:
+            device_c.observe_ns(device_ns)
         if trace.sink_ns:
             sink_c.observe_ns(trace.sink_ns)
         e2e_ns = t_end - trace.t0
@@ -233,6 +249,20 @@ class AppTelemetry:
             tr.device_ns += ns * len(names)
             tr.queries.extend(names)
 
+    def record_lag(self, stream: str, newest_ts_ms: int) -> None:
+        """Event-time lag at delivery: how stale the newest row of the
+        batch already was when the engine saw it (upstream queueing the
+        processing-latency stages can't see). Meaningful only when the
+        producer stamps epoch milliseconds — synthetic/logical timestamps
+        (tests, playback counters) are ignored via a plausibility window
+        so the gauge never reports a ~50-year lag for counter timestamps."""
+        if newest_ts_ms < 1_000_000_000_000:  # pre-2001 epoch-ms: synthetic
+            return
+        g = self._lag_cells.get(stream)
+        if g is None:
+            g = self._lag_cells[stream] = self.lag_gauge.labels(stream)
+        g.set(max(time.time() - newest_ts_ms / 1e3, 0.0))
+
     def observe_upgrade(self, pause_ms: float) -> None:
         """One committed hot-swap's cutover pause (core/upgrade.py)."""
         self.upgrade_hist.labels().observe_ns(int(pause_ms * 1e6))
@@ -245,9 +275,13 @@ class AppTelemetry:
             self._sink_cells[stream] = cells
         cells[0].observe_ns(ns)
         cells[1].inc(rows)
-        tr = self.active()
-        if tr is not None:
-            tr.sink_ns += ns
+        # credit the sink span to the whole active stack: the innermost
+        # (derived output stream) trace owns it directly, and each outer
+        # trace needs it to net sink time OUT of its enclosing query spans
+        stack = getattr(self._tls, "stack", None)
+        if stack:
+            for tr in stack:
+                tr.sink_ns += ns
 
     # --------------------------------------------------------------- reports
 
@@ -276,4 +310,7 @@ class AppTelemetry:
             s = hist.summary()
             if s["count"]:
                 queries[query] = s
-        return {"streams": streams, "queries": queries}
+        lag = {stream: g.value()
+               for (stream,), g in self.lag_gauge.samples()}
+        return {"streams": streams, "queries": queries,
+                "event_time_lag_s": lag}

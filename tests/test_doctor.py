@@ -1,0 +1,198 @@
+"""Doctor CLI tests (siddhi_tpu/doctor.py).
+
+The flagship case is the ISSUE 10 acceptance path run in-process: a real
+runtime with a declared p99 SLO is degraded through the fault-injection
+harness ($SIDDHI_FAULT_SPEC seeding a slow sink), the breach freezes a
+diagnostic bundle, and the doctor must (a) name the INJECTED stage —
+sink, not the device stage the sink publish is nested inside — as
+dominant and (b) exit 3. The synthetic-bundle cases pin the rest of the
+diagnosis matrix (breakers, compile storms, baseline regressions) and
+the CI-stable exit codes 0/1/3.
+"""
+
+import json
+import os
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu import doctor
+from siddhi_tpu.telemetry.recorder import SCHEMA_VERSION
+from siddhi_tpu.util.faults import apply_fault_spec
+
+pytestmark = pytest.mark.smoke
+
+FAULT_APP = """
+@app:name('FaultApp')
+@app:slo(stream='S', p99.ms='50', min.samples='3')
+define stream S (symbol string, price double);
+@sink(type='log', prefix='doctor-test')
+define stream Out (symbol string, price double);
+@info(name='q1')
+from S[price > 0.0] select symbol, price insert into Out;
+"""
+
+
+@pytest.fixture(scope="class")
+def degraded_bundle(request, tmp_path_factory):
+    """Run the acceptance scenario once per class: healthy warm-up, then
+    the env-seeded slow-sink fault until the p99 objective breaches and
+    the recorder freezes exactly one bundle."""
+    diag = tmp_path_factory.mktemp("diag")
+    os.environ["SIDDHI_DIAG_DIR"] = str(diag)
+    os.environ["SIDDHI_FAULT_SPEC"] = "sink:slow=0.05,p=1.0,seed=1"
+    try:
+        rt = SiddhiManager().create_siddhi_app_runtime(FAULT_APP)
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(512):  # healthy warm-up past min.samples
+            h.send(("A", float(i + 1)))
+        rt.flush()
+        rt.slo_engine.tick()
+        assert not rt.slo_engine.breaching()
+        plans = apply_fault_spec(rt)  # spec comes from the env var
+        assert "sink" in plans
+        for _ in range(20):
+            for j in range(5):
+                h.send(("B", float(j + 1)))
+            rt.flush()
+        rt.slo_engine.tick()
+        assert rt.slo_engine.breaching()
+        rep = rt.ctx.recorder.report()
+        assert rep["bundles_written"] == 1, "expected one rate-limited bundle"
+        bundles = os.listdir(os.path.join(diag, "FaultApp"))
+        assert len(bundles) == 1
+        path = os.path.join(diag, "FaultApp", bundles[0])
+        rt.shutdown()
+        yield path
+    finally:
+        os.environ.pop("SIDDHI_DIAG_DIR", None)
+        os.environ.pop("SIDDHI_FAULT_SPEC", None)
+
+
+class TestAcceptancePath:
+    def test_doctor_names_injected_sink_stage_dominant(self, degraded_bundle):
+        bundle = doctor.load_bundle(degraded_bundle)
+        assert bundle["manifest"]["trigger"]["kind"] == "slo_breach"
+        findings = doctor.analyze(bundle)
+        crit = [f for f in findings if f["severity"] == "critical"]
+        assert crit, "breached objective must produce a critical finding"
+        top = crit[0]
+        assert top["objective"] == "stream:S:p99.ms"
+        assert "dominant stage: sink" in top["title"], top["title"]
+
+    def test_cli_exits_degraded(self, degraded_bundle, capsys):
+        rc = doctor.main([degraded_bundle])
+        assert rc == doctor.EXIT_DEGRADED
+        out = capsys.readouterr().out
+        assert "dominant stage: sink" in out
+        assert "[CRITICAL]" in out
+
+    def test_json_output_is_machine_readable(self, degraded_bundle, capsys):
+        rc = doctor.main([degraded_bundle, "--json"])
+        assert rc == doctor.EXIT_DEGRADED
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["app"] == "FaultApp"
+        assert payload["degraded"] is True
+        assert any(f["severity"] == "critical" for f in payload["findings"])
+
+
+class TestExitCodes:
+    def test_healthy_bundle_exits_zero(self, tmp_path, capsys):
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            "@app:name('OkApp')\n"
+            "define stream S (symbol string, price float);\n"
+            "from S select symbol insert into Out;")
+        rt.start()
+        rt.get_input_handler("S").send(("A", 1.0))
+        rt.flush()
+        rec = rt.ctx.recorder
+        rec.bundle_dir = str(tmp_path / "ok")
+        path = rec.trigger("manual", force=True)
+        rt.shutdown()
+        assert doctor.main([path]) == doctor.EXIT_OK
+        assert "healthy" in capsys.readouterr().out
+
+    def test_missing_and_corrupt_bundles_exit_one(self, tmp_path, capsys):
+        assert doctor.main([str(tmp_path / "nope")]) == doctor.EXIT_BAD_BUNDLE
+        bad = tmp_path / "bad"
+        bad.mkdir()
+        (bad / "manifest.json").write_text(
+            json.dumps({"schema_version": SCHEMA_VERSION + 99}))
+        assert doctor.main([str(bad)]) == doctor.EXIT_BAD_BUNDLE
+        capsys.readouterr()
+
+    def test_live_scrape_failure_exits_one(self, capsys):
+        rc = doctor.main(["--live", "http://127.0.0.1:1", "--app", "X"])
+        assert rc == doctor.EXIT_BAD_BUNDLE
+        assert "live scrape" in capsys.readouterr().err
+
+
+def _bundle(stats=None, traces=None):
+    return {"manifest": {"schema_version": SCHEMA_VERSION, "app": "t",
+                         "trigger": {"kind": "manual", "reason": ""}},
+            "stats": stats or {}, "traces": traces or {},
+            "logs": [], "plan": None, "config": None}
+
+
+def _breached_slo(scope="stream:S"):
+    return {"objectives": {f"{scope}:p99.ms": {
+        "state": "breached", "scope": scope, "breaches": 1, "recoveries": 0,
+        "fast": {"burn_rate": 12.0}, "slow": {"burn_rate": 4.0}}}}
+
+
+class TestSyntheticDiagnosis:
+    def test_stream_scope_ranks_stage_p99s(self):
+        stats = {"slo": _breached_slo("stream:S"),
+                 "latency": {"streams": {"S": {
+                     "device": {"p99_ms": 4.0}, "h2d": {"p99_ms": 80.0},
+                     "sink": {"p99_ms": 2.0}, "e2e": {"p99_ms": 90.0}}}}}
+        (f,) = doctor.analyze(_bundle(stats))
+        assert "dominant stage: h2d" in f["title"]
+        assert "stream 'S'" in f["evidence"]
+
+    def test_query_scope_falls_back_to_exemplar_shares(self):
+        stats = {"slo": _breached_slo("query:q1")}
+        traces = {"slow_batches": [
+            {"queries": ["q1"], "stages_ms": {"stage": 1.0, "h2d": 1.0,
+                                              "device": 30.0, "sink": 2.0}},
+            {"queries": ["other"], "stages_ms": {"stage": 99.0, "h2d": 0.0,
+                                                 "device": 0.0, "sink": 0.0}},
+        ]}
+        (f,) = doctor.analyze(_bundle(stats, traces))
+        assert "dominant stage: device" in f["title"]
+        assert "query 'q1'" in f["evidence"]
+
+    def test_recovered_objective_is_info_only(self):
+        stats = {"slo": {"objectives": {"stream:S:p99.ms": {
+            "state": "ok", "scope": "stream:S", "breaches": 2,
+            "recoveries": 2, "fast": {}, "slow": {}}}}}
+        (f,) = doctor.analyze(_bundle(stats))
+        assert f["severity"] == "info" and "recovered" in f["title"]
+
+    def test_engine_surfaces_and_ranking(self):
+        stats = {
+            "breakers": {"q1": {"state": "open", "failures": 5,
+                                "diverted_rows": 40}},
+            "sink_dead_letters": {"Out": 7},
+            "compile_widths": {"q1": list(range(10))},
+        }
+        findings = doctor.analyze(_bundle(stats))
+        sevs = [f["severity"] for f in findings]
+        assert sevs == sorted(
+            sevs, key=doctor.SEVERITIES.index), "ranked most-severe first"
+        titles = " | ".join(f["title"] for f in findings)
+        assert "circuit breaker" in titles
+        assert "dead-letters" in titles
+        assert "recompile storm" in titles
+
+    def test_baseline_regression_diff(self):
+        now = {"latency": {"streams": {"S": {"sink": {"p99_ms": 50.0},
+                                             "device": {"p99_ms": 5.0}}}}}
+        base = {"latency": {"streams": {"S": {"sink": {"p99_ms": 10.0},
+                                              "device": {"p99_ms": 5.0}}}}}
+        findings = doctor.analyze(_bundle(now), baseline=_bundle(base),
+                                  threshold=2.0)
+        (f,) = findings
+        assert f["severity"] == "warning"
+        assert "'sink' p99 regressed 5.0x" in f["title"]

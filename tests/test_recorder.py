@@ -1,0 +1,167 @@
+"""Flight-recorder tests (siddhi_tpu/telemetry/recorder.py).
+
+A second FlightRecorder with an injectable clock is attached to a real
+runtime so the de-dup / rate-limit gates run on virtual time: per-kind
+cooldown, the global min-interval, force bypass, keep_last pruning, the
+dead-letter rolling-window burst detector, and the always-on log tail.
+Bundle contents round-trip through doctor.load_bundle (the consumer),
+which also pins the on-disk schema: six sections, versioned manifest.
+"""
+
+import json
+import logging
+import os
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.doctor import BundleError, load_bundle
+from siddhi_tpu.telemetry.recorder import (
+    DEAD_LETTER_BURST, DEAD_LETTER_WINDOW_S, SCHEMA_VERSION, FlightRecorder)
+
+pytestmark = pytest.mark.smoke
+
+S = "define stream S (symbol string, price float);\n"
+APP = ("@app:name('RecApp')\n" + S
+       + "@info(name='q') from S select symbol insert into Out;")
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def rt():
+    runtime = SiddhiManager().create_siddhi_app_runtime(APP)
+    runtime.start()
+    yield runtime
+    runtime.shutdown()
+
+
+@pytest.fixture
+def rec(rt, tmp_path):
+    clock = Clock()
+    r = FlightRecorder(rt, bundle_dir=str(tmp_path / "diag"),
+                       cooldown_s=300.0, min_interval_s=30.0,
+                       keep_last=16, clock=clock)
+    r.clock_handle = clock
+    yield r
+    r.close()
+
+
+class TestGates:
+    def test_per_kind_cooldown_dedups(self, rec):
+        assert rec.trigger("slo_breach", reason="first") is not None
+        assert rec.trigger("slo_breach", reason="again") is None
+        rep = rec.report()
+        assert rep["bundles_written"] == 1
+        assert rep["triggers"] == {"slo_breach": 2}
+        assert rep["suppressed"] == {"slo_breach": 1}
+        # past the cooldown the same kind records again
+        rec.clock_handle.t = 301.0
+        assert rec.trigger("slo_breach", reason="later") is not None
+        assert rec.report()["bundles_written"] == 2
+
+    def test_global_min_interval_rate_limits_across_kinds(self, rec):
+        assert rec.trigger("slo_breach") is not None
+        rec.clock_handle.t = 10.0  # different kind, inside min-interval
+        assert rec.trigger("breaker_open") is None
+        assert rec.report()["suppressed"] == {"breaker_open": 1}
+        rec.clock_handle.t = 45.0  # past it
+        assert rec.trigger("breaker_open") is not None
+
+    def test_force_bypasses_both_gates(self, rec):
+        assert rec.trigger("manual", force=True) is not None
+        assert rec.trigger("manual", force=True) is not None
+        rep = rec.report()
+        assert rep["bundles_written"] == 2
+        assert rep["suppressed"] == {}
+
+    def test_keep_last_prunes_oldest(self, rt, tmp_path):
+        clock = Clock()
+        r = FlightRecorder(rt, bundle_dir=str(tmp_path / "d"),
+                           keep_last=2, clock=clock)
+        try:
+            for i in range(4):
+                clock.t = i * 1000.0
+                assert r.trigger("manual", force=True) is not None
+            names = sorted(os.listdir(r.bundle_dir))
+            assert names == ["RecApp-manual-0003", "RecApp-manual-0004"]
+        finally:
+            r.close()
+
+
+class TestDeadLetterBurst:
+    def test_burst_trips_once_window_crosses_threshold(self, rec):
+        assert rec.on_dead_letter(DEAD_LETTER_BURST // 2) is None
+        path = rec.on_dead_letter(DEAD_LETTER_BURST // 2)
+        assert path is not None
+        man = json.load(open(os.path.join(path, "manifest.json")))
+        assert man["trigger"]["kind"] == "dead_letter_burst"
+
+    def test_window_expiry_resets_the_count(self, rec):
+        rec.on_dead_letter(DEAD_LETTER_BURST - 1)
+        rec.clock_handle.t = DEAD_LETTER_WINDOW_S + 1.0
+        # the earlier rows rolled out of the window: no trigger
+        assert rec.on_dead_letter(1) is None
+        assert rec.report()["bundles_written"] == 0
+
+
+class TestBundleSchema:
+    def test_round_trip_through_doctor_loader(self, rec, rt):
+        h = rt.get_input_handler("S")
+        for i in range(10):
+            h.send(("A", float(i)))
+        rt.flush()
+        path = rec.trigger("manual", reason="round-trip", force=True)
+        assert sorted(os.listdir(path)) == [
+            "config.json", "logs.json", "manifest.json", "plan.json",
+            "stats.json", "traces.json"]
+        bundle = load_bundle(path)
+        man = bundle["manifest"]
+        assert man["schema_version"] == SCHEMA_VERSION
+        assert man["app"] == "RecApp"
+        assert man["trigger"] == {"kind": "manual", "reason": "round-trip"}
+        assert bundle["stats"]["uptime_seconds"] > 0
+        assert bundle["stats"]["latency"]["streams"]["S"]["e2e"]["count"] > 0
+        assert bundle["traces"]["recent"], "frozen traces missing"
+        assert bundle["plan"]["fingerprint"]
+        assert bundle["config"]["env"].get("JAX_PLATFORMS") == "cpu"
+
+    def test_unknown_schema_version_is_rejected(self, rec, tmp_path):
+        path = rec.trigger("manual", force=True)
+        man_path = os.path.join(path, "manifest.json")
+        man = json.load(open(man_path))
+        man["schema_version"] = 99
+        json.dump(man, open(man_path, "w"))
+        with pytest.raises(BundleError, match="schema version"):
+            load_bundle(path)
+        with pytest.raises(BundleError, match="not a diagnostic bundle"):
+            load_bundle(str(tmp_path))  # no manifest at all
+
+
+class TestLogTailAndWiring:
+    def test_warning_tail_captures_context_fields(self, rec):
+        logging.getLogger("siddhi_tpu").warning(
+            "sink exploded", extra={"app": "RecApp", "stream": "Out",
+                                    "batch_id": 7})
+        entry = list(rec.log_tail)[-1]
+        assert entry["message"] == "sink exploded"
+        assert entry["level"] == "WARNING"
+        assert (entry["app"], entry["stream"], entry["batch_id"]) == (
+            "RecApp", "Out", 7)
+
+    def test_runtime_wires_recorder_and_manual_api(self, rt, tmp_path,
+                                                   monkeypatch):
+        assert rt.ctx.recorder is not None
+        monkeypatch.setattr(rt.ctx.recorder, "bundle_dir",
+                            str(tmp_path / "api"))
+        out = rt.diagnostics(reason="ops request")
+        assert out["bundle"] and os.path.isdir(out["bundle"])
+        assert out["recorder"]["bundles_written"] == 1
+        rep = rt.statistics_report()
+        assert rep["recorder"]["triggers"] == {"manual": 1}

@@ -1,0 +1,257 @@
+"""SLO engine tests (siddhi_tpu/telemetry/slo.py).
+
+The burn-rate math runs entirely on a virtual clock: a fake cumulative
+reader plays the role of the telemetry histograms/counters and the test
+drives `SloEngine.tick(now=...)` across simulated hours in microseconds
+of wall time — breach, recovery, flapping, the multi-window guard
+(a fast-window blip that the slow window refuses to confirm), the rate
+floor's boot guard, and the error-ratio kind. The annotation-binding
+half checks `@app:slo` / per-query `@slo` parsing against real runtimes
+and the surfaces: statistics_report()["slo"], the siddhi_slo_* families,
+and GET /slo's payload shape.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.errors import SiddhiAppCreationError
+from siddhi_tpu.telemetry.metrics import N_BUCKETS, bucket_index
+from siddhi_tpu.telemetry.slo import (
+    BREACHED, OK, Objective, SloEngine, frac_over_threshold)
+
+pytestmark = pytest.mark.smoke
+
+S = "define stream S (symbol string, price float);\n"
+
+
+class FakeHist:
+    """Cumulative (count, buckets) source shaped like Histogram.snapshot."""
+
+    def __init__(self):
+        self.buckets = [0] * N_BUCKETS
+        self.n = 0
+
+    def observe_ms(self, ms, n=1):
+        self.buckets[bucket_index(int(ms * 1e6))] += n
+        self.n += n
+
+    def read(self):
+        return (self.n, tuple(self.buckets))
+
+
+def latency_objective(hist, target_ms=10.0, **kw):
+    kw.setdefault("quantile", 0.99)
+    return Objective("stream:S:p99.ms", "latency", "stream", "S",
+                     target=target_ms, reader=hist.read, **kw)
+
+
+class TestFracOverThreshold:
+    def test_empty_is_zero(self):
+        assert frac_over_threshold([0] * N_BUCKETS, 0, 10**6) == 0.0
+
+    def test_all_above_and_all_below(self):
+        h = FakeHist()
+        h.observe_ms(100.0, 50)
+        cnt, b = h.read()
+        assert frac_over_threshold(list(b), cnt, int(1e6)) == 1.0
+        h2 = FakeHist()
+        h2.observe_ms(0.5, 50)
+        cnt, b = h2.read()
+        # 0.5ms observations against a 100ms threshold: nothing above
+        assert frac_over_threshold(list(b), cnt, int(100e6)) == 0.0
+
+    def test_interpolates_in_owning_bucket(self):
+        # threshold mid-bucket: the owning bucket's mass splits linearly
+        h = FakeHist()
+        h.observe_ms(1.5, 100)  # bucket (1.024ms, 2.048ms]
+        cnt, b = h.read()
+        frac = frac_over_threshold(list(b), cnt, int(1.536e6))  # midpoint
+        assert 0.3 < frac < 0.7
+
+
+class TestBurnRateLifecycle:
+    def test_breach_recover_flap(self):
+        h = FakeHist()
+        eng = SloEngine("t", clock=lambda: 0.0)
+        o = eng.add(latency_objective(h))
+        # healthy traffic
+        h.observe_ms(1.0, 100)
+        assert eng.tick(now=10.0) == []
+        assert o.state == OK
+        # sustained badness: 50% over a 1% budget on both windows
+        h.observe_ms(100.0, 100)
+        evs = eng.tick(now=20.0)
+        assert [e["to"] for e in evs] == [BREACHED]
+        assert o.state == BREACHED and o.breaches == 1
+        assert eng.breaching()
+        # windows roll past the incident -> recovery
+        h.observe_ms(1.0, 100)
+        evs = eng.tick(now=20.0 + 3700.0)
+        assert [e["to"] for e in evs] == [OK]
+        assert o.recoveries == 1 and not eng.breaching()
+        # flap: breach again counts a second breach
+        h.observe_ms(100.0, 100)
+        evs = eng.tick(now=20.0 + 3720.0)
+        assert [e["to"] for e in evs] == [BREACHED]
+        assert o.breaches == 2
+
+    def test_slow_window_vetoes_fast_blip(self):
+        # an hour of healthy history, then one bad burst: the fast window
+        # burns hot but the slow window refuses to confirm -> no breach
+        h = FakeHist()
+        eng = SloEngine("t", clock=lambda: 0.0)
+        o = eng.add(latency_objective(h))
+        for i in range(60):  # a good tick per simulated minute
+            h.observe_ms(1.0, 100)
+            eng.tick(now=(i + 1) * 60.0)
+        assert o.state == OK
+        h.observe_ms(100.0, 30)  # blip: 30 bad out of 6030 in the hour
+        eng.tick(now=3601.0)
+        assert o.last_fast["burn_rate"] >= 1.0
+        assert o.last_slow["burn_rate"] < 1.0
+        assert o.state == OK
+        # sustain it: keep the badness flowing until the slow window burns
+        for i in range(10):
+            h.observe_ms(100.0, 30)
+            eng.tick(now=3601.0 + (i + 1) * 60.0)
+        assert o.state == BREACHED
+
+    def test_min_samples_gate(self):
+        h = FakeHist()
+        eng = SloEngine("t", clock=lambda: 0.0)
+        o = eng.add(latency_objective(h, min_samples=50))
+        h.observe_ms(100.0, 10)  # 100% bad but under the sample floor
+        eng.tick(now=5.0)
+        assert o.state == OK
+        h.observe_ms(100.0, 90)
+        eng.tick(now=10.0)
+        assert o.state == BREACHED
+
+
+class TestRateAndErrorKinds:
+    def test_rate_floor_boot_guard_then_breach(self):
+        count = [0]
+        o = Objective("stream:S:min.rate", "rate", "stream", "S",
+                      target=100.0, reader=lambda: count[0])
+        eng = SloEngine("t", clock=lambda: 0.0)
+        eng.add(o)
+        # sub-second history: never judged (boot must not read as outage)
+        assert eng.tick(now=0.5) == []
+        assert o.state == OK
+        # healthy: 200 ev/s
+        count[0] += 2000
+        eng.tick(now=10.0)
+        assert o.state == OK
+        # throughput collapses on the fast window
+        count[0] += 1
+        evs = eng.tick(now=310.0)
+        assert [e["to"] for e in evs] == [BREACHED]
+        assert o.last_fast["rate_eps"] < 100.0
+        # and recovers once the floor holds again
+        count[0] += 200_000
+        evs = eng.tick(now=620.0)
+        assert [e["to"] for e in evs] == [OK]
+
+    def test_error_ratio(self):
+        bad, total = [0], [0]
+        o = Objective("stream:S:error.ratio", "error_ratio", "stream", "S",
+                      target=0.01, reader=lambda: (bad[0], total[0]))
+        eng = SloEngine("t", clock=lambda: 0.0)
+        eng.add(o)
+        total[0] = 1000
+        eng.tick(now=10.0)
+        assert o.state == OK
+        bad[0] += 100  # 10% bad against a 1% target on both windows
+        total[0] += 100
+        eng.tick(now=20.0)
+        assert o.state == BREACHED
+        assert o.report()["fast"]["burn_rate"] >= 1.0
+
+
+class TestAnnotationBinding:
+    def _rt(self, app, **kw):
+        rt = SiddhiManager().create_siddhi_app_runtime(app, **kw)
+        rt.start()
+        return rt
+
+    def test_app_and_query_annotations_build_objectives(self):
+        rt = self._rt(
+            "@app:name('SloApp')\n"
+            "@app:slo(stream='S', p99.ms='50', min.rate='10', "
+            "error.ratio='0.05')\n" + S
+            + "@slo(p95.ms='5')\n@info(name='q1') "
+            "from S select symbol insert into Out;")
+        eng = rt.slo_engine
+        assert eng is not None
+        ids = {o.id for o in eng.objectives}
+        assert ids == {"stream:S:p99.ms", "stream:S:min.rate",
+                       "stream:S:error.ratio", "query:q1:p95.ms"}
+        rep = rt.statistics_report()["slo"]
+        assert set(rep["objectives"]) == ids
+        assert rep["breaching"] is False
+        rt.shutdown()
+
+    def test_no_annotations_means_no_engine(self):
+        rt = self._rt(S + "from S select symbol insert into Out;")
+        assert rt.slo_engine is None
+        assert "slo" not in rt.statistics_report()
+        rt.shutdown()
+
+    def test_windows_and_threshold_elements(self):
+        rt = self._rt(
+            "@app:slo(stream='S', p99.ms='50', fast.window='60 sec', "
+            "slow.window='10 min', burn.threshold='2.0', "
+            "min.samples='7')\n" + S
+            + "from S select symbol insert into Out;")
+        (o,) = rt.slo_engine.objectives
+        assert (o.fast_window_s, o.slow_window_s) == (60.0, 600.0)
+        assert o.burn_threshold == 2.0 and o.min_samples == 7
+        rt.shutdown()
+
+    def test_bad_values_and_empty_annotation_raise(self):
+        with pytest.raises(SiddhiAppCreationError):
+            self._rt("@app:slo(stream='S', p99.ms='fast')\n" + S
+                     + "from S select symbol insert into Out;")
+        with pytest.raises(SiddhiAppCreationError):
+            self._rt("@app:slo(stream='S')\n" + S
+                     + "from S select symbol insert into Out;")
+
+    def test_disabled_telemetry_disables_slo(self, monkeypatch):
+        monkeypatch.setenv("SIDDHI_TELEMETRY", "0")
+        rt = self._rt("@app:slo(stream='S', p99.ms='50')\n" + S
+                      + "from S select symbol insert into Out;")
+        assert rt.slo_engine is None
+        rt.shutdown()
+
+    def test_live_latency_objective_sees_traffic(self):
+        rt = self._rt("@app:slo(stream='S', p99.ms='10000')\n" + S
+                      + "from S select symbol insert into Out;")
+        h = rt.get_input_handler("S")
+        for i in range(20):
+            h.send(("A", float(i)))
+        rt.flush()
+        eng = rt.slo_engine
+        eng.tick()
+        (o,) = eng.objectives
+        assert o.last_fast["samples"] > 0
+        assert o.state == OK  # 10s p99 target: nothing breaches on CPU
+        rt.shutdown()
+
+    def test_prometheus_families_render(self):
+        from siddhi_tpu.telemetry import prometheus
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            "@app:name('PromSlo')\n"
+            "@app:slo(stream='S', p99.ms='50')\n" + S
+            + "from S select symbol insert into Out;")
+        rt.start()
+        rt.get_input_handler("S").send(("A", 1.0))
+        rt.flush()
+        rt.slo_engine.tick()
+        body = prometheus.render_manager(mgr)
+        assert prometheus.validate_exposition(body) == []
+        for fam in ("siddhi_slo_compliance_ratio", "siddhi_slo_burn_rate",
+                    "siddhi_slo_breaches_total", "siddhi_build_info",
+                    "siddhi_app_uptime_seconds"):
+            assert fam in body, fam
+        rt.shutdown()

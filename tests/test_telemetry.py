@@ -216,7 +216,8 @@ class TestBatchTracing:
         tele = rt.ctx.telemetry
         assert not tele.on
         assert not tele.recent
-        assert tele.latency_snapshot() == {"streams": {}, "queries": {}}
+        assert tele.latency_snapshot() == {"streams": {}, "queries": {},
+                                           "event_time_lag_s": {}}
         rep = rt.statistics_report()
         assert rep["slow_batches"] == []
         rt.shutdown()

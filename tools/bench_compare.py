@@ -1,0 +1,151 @@
+"""Bench-trajectory regression gate: diff the newest BENCH_r*.json round
+against the previous one, per config.
+
+The repo records one `BENCH_rNN.json` per growth round (written by the
+driver around `bench.py`): `tail` holds the run's trailing stdout with one
+JSON object per measured config (`{"metric": ..., "value": ...,
+"p99_batch_latency_ms": ...}`), and error rounds carry
+`{"metric": ..., "error": ...}` instead. Until now nothing read this
+trajectory automatically — a 10x throughput cliff between rounds was only
+visible to a human diffing JSON by eye.
+
+    python tools/bench_compare.py                 # compare newest vs prev
+    python tools/bench_compare.py --threshold 0.5 # fail past 50% regression
+    python tools/bench_compare.py --advisory      # print, always exit 0
+
+Per shared metric the table shows events/s and p99 latency deltas. Exit
+codes: 0 = within threshold (or nothing comparable), 1 = at least one
+metric regressed past --threshold, 2 = usage/IO error. Error entries are
+skipped and a round whose configs ALL errored is passed over when picking
+the comparison pair — a timeout round must not hide the last real
+numbers. CI runs this advisory on CPU runners (shared-runner noise swamps
+the signal there); on TPU hosts it is a real gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+#: regression ratio that fails the gate: new/old below this for rate
+#: metrics (or old/new below it for latency) trips
+DEFAULT_THRESHOLD = 0.5
+
+
+def parse_round(path: str) -> dict:
+    """{metric: entry} for one round file, error entries skipped."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"bench_compare: skipping {path}: {e}", file=sys.stderr)
+        return {}
+    out: dict = {}
+    for line in (data.get("tail") or "").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            entry = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        metric = entry.get("metric")
+        if not metric or "error" in entry or "value" not in entry:
+            continue
+        out[metric] = entry
+    return out
+
+
+def collect_rounds(bench_dir: str) -> list[tuple[int, str, dict]]:
+    """[(round_no, path, {metric: entry})] sorted oldest→newest, rounds
+    with zero parseable configs dropped."""
+    rounds = []
+    for path in glob.glob(os.path.join(bench_dir, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        entries = parse_round(path)
+        if entries:
+            rounds.append((int(m.group(1)), path, entries))
+    rounds.sort()
+    return rounds
+
+
+def compare(old: dict, new: dict, threshold: float) -> tuple[list, list]:
+    """(table_rows, regressions) across metrics present in both rounds."""
+    rows, regressions = [], []
+    for metric in sorted(set(old) & set(new)):
+        o, n = old[metric], new[metric]
+        ratio = n["value"] / o["value"] if o["value"] else float("inf")
+        op99, np99 = (o.get("p99_batch_latency_ms"),
+                      n.get("p99_batch_latency_ms"))
+        p99_ratio = (np99 / op99 if op99 and np99 else None)
+        rows.append((metric, o["value"], n["value"], ratio, op99, np99,
+                     p99_ratio))
+        if ratio < threshold:
+            regressions.append(
+                f"{metric}: events/s fell {ratio:.2f}x "
+                f"({o['value']:.0f} -> {n['value']:.0f})")
+        if p99_ratio is not None and p99_ratio > 1.0 / threshold:
+            regressions.append(
+                f"{metric}: p99 grew {p99_ratio:.2f}x "
+                f"({op99:.2f} ms -> {np99:.2f} ms)")
+    return rows, regressions
+
+
+def render(rows: list, old_path: str, new_path: str) -> str:
+    header = (f"bench_compare: {os.path.basename(old_path)} -> "
+              f"{os.path.basename(new_path)}")
+    if not rows:
+        return header + "\n  (no metric measured in both rounds)"
+    lines = [header,
+             f"  {'metric':<44} {'old ev/s':>12} {'new ev/s':>12} "
+             f"{'ratio':>7} {'old p99':>9} {'new p99':>9}"]
+    for metric, ov, nv, ratio, op99, np99, _ in rows:
+        lines.append(
+            f"  {metric:<44} {ov:>12.0f} {nv:>12.0f} {ratio:>6.2f}x "
+            f"{op99 if op99 is not None else float('nan'):>8.2f}m "
+            f"{np99 if np99 is not None else float('nan'):>8.2f}m")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Diff the newest bench round against the previous one "
+                    "and fail past a regression threshold.")
+    p.add_argument("--dir", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    p.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                   help="events/s ratio (new/old) below which a metric "
+                        f"fails (default {DEFAULT_THRESHOLD}); p99 uses "
+                        "the inverse")
+    p.add_argument("--advisory", action="store_true",
+                   help="print the table but always exit 0 (CPU CI mode)")
+    args = p.parse_args(argv)
+
+    rounds = collect_rounds(args.dir)
+    if len(rounds) < 2:
+        print("bench_compare: fewer than two parseable rounds — "
+              "nothing to compare")
+        return 0
+    (_, old_path, old), (_, new_path, new) = rounds[-2], rounds[-1]
+    rows, regressions = compare(old, new, args.threshold)
+    print(render(rows, old_path, new_path))
+    if regressions:
+        for r in regressions:
+            print(f"  REGRESSION {r}")
+        if args.advisory:
+            print("bench_compare: advisory mode — not failing the build")
+            return 0
+        return 1
+    print("bench_compare: within threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,13 @@ and the final scrape must additionally show real traffic (events_total
 matching what was sent, per-query latency histogram populated). Exits
 non-zero with a diagnostic on any violation.
 
+A second chaos stage then deploys an app with a declared SLO and a
+1-failure breaker, poisons its query through the fault-injection
+harness, and asserts the observability loop closes end to end: the
+breaker trip makes the flight recorder freeze a diagnostic bundle,
+GET /slo serves the objective report, and `python -m siddhi_tpu.doctor`
+exits 3 (degraded) naming the open breaker.
+
 Usage:  python tools/metrics_smoke.py [--rows 20000] [--producers 2]
 """
 
@@ -154,6 +161,57 @@ def main() -> int:
     if status != 200 or not json.loads(ready)["ready"]:
         failures.append(f"/ready degraded after traffic: {ready}")
 
+    # 4. chaos: breaker trip -> flight-recorder bundle -> doctor verdict
+    import subprocess
+    import tempfile
+    from siddhi_tpu.util.faults import apply_fault_spec
+    diag = tempfile.mkdtemp(prefix="smoke-diag-")
+    os.environ["SIDDHI_DIAG_DIR"] = diag
+    svc.deploy("@app:name('chaos')\n"
+               "@app:slo(stream='S', p99.ms='50', min.samples='3')\n"
+               "define stream S (v long);\n"
+               "@info(name='q') @breaker(threshold='1')\n"
+               "from S select v insert into Out;\n")
+    rt2 = svc.manager.runtimes["chaos"]
+    apply_fault_spec(rt2, "query:p=1.0,exc=error,seed=7")
+    h2 = rt2.get_input_handler("S")
+    for i in range(8):
+        h2.send((i,))
+    rt2.flush()
+    brk = rt2.statistics_report().get("breakers", {}).get("q", {})
+    if brk.get("state") != "open":
+        failures.append(f"chaos: breaker did not open: {brk}")
+    rec_rep = rt2.ctx.recorder.report()
+    if rec_rep["bundles_written"] < 1 or not rec_rep["last_bundle"]:
+        failures.append(f"chaos: no diagnostic bundle written: {rec_rep}")
+    else:
+        doc = subprocess.run(
+            [sys.executable, "-m", "siddhi_tpu.doctor",
+             rec_rep["last_bundle"]],
+            capture_output=True, text=True,
+            env={**os.environ, "PYTHONPATH": os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))})
+        if doc.returncode != 3:
+            failures.append(
+                f"chaos: doctor exit {doc.returncode} != 3 (degraded); "
+                f"stdout: {doc.stdout!r} stderr: {doc.stderr!r}")
+        if "circuit breaker" not in doc.stdout:
+            failures.append(
+                f"chaos: doctor did not name the breaker: {doc.stdout!r}")
+    status, _, slo_body = _get(base, "/slo")
+    try:
+        slo = json.loads(slo_body)
+        if "stream:S:p99.ms" not in (slo["apps"].get("chaos") or {}).get(
+                "objectives", {}):
+            failures.append(f"GET /slo missing chaos objectives: {slo}")
+    except (json.JSONDecodeError, KeyError) as e:
+        failures.append(f"GET /slo bad payload ({e}): {slo_body!r}")
+    scrape_tag = "post-chaos scrape"
+    _, ctype, text = _get(base, "/metrics")
+    check_scrape(text, ctype, scrape_tag)
+    if 'siddhi_diag_bundles_total{app="chaos"}' not in text:
+        failures.append(f"{scrape_tag}: recorder families missing")
+
     httpd.shutdown()
     if failures:
         print(f"FAIL metrics smoke ({len(failures)} violations):")
@@ -161,7 +219,8 @@ def main() -> int:
             print(f"  - {f}")
         return 1
     print(f"metrics smoke OK: {len(mid_scrapes)} mid-traffic scrapes valid, "
-          f"{total} events accounted, all always-on families present")
+          f"{total} events accounted, all always-on families present, "
+          "chaos breaker -> bundle -> doctor(3) loop closed")
     return 0
 
 
