@@ -1,0 +1,149 @@
+/* colring_core.h — the lock-free columnar ring's claim/publish/consume
+ * protocol, extracted from columnar.c so that a standalone pthreads stress
+ * harness (colring_stress.c) can compile the EXACT same code under
+ * -fsanitize=thread / address / undefined. The Python extension keeps all
+ * arg parsing, Py_buffer handling, and GIL management in its wrappers and
+ * delegates every atomic to these inline functions — the protocol is
+ * machine-checked, not argued-in-comments.
+ *
+ * Protocol (Disruptor-style multi-producer, single-consumer):
+ *   - producers claim a contiguous run of n slots with ONE CAS on `head`
+ *     (crc_claim); claim order IS delivery order, so parallel out-of-order
+ *     writers stay deterministic downstream;
+ *   - each slot is published by a release store of `index + 1` into its
+ *     cache-line-padded seq entry (crc_publish) AFTER the payload is
+ *     written — the release pairs with the consumer's acquire loads;
+ *   - the single consumer counts the contiguous published prefix with
+ *     acquire loads (crc_poll), copies the payload out, then retires the
+ *     run (crc_consume): seq resets are relaxed (only producers that
+ *     already observed the new tail can reuse the slot), the tail bump is
+ *     a release store (it licenses producers to overwrite the slots).
+ *
+ * Pure C11 + stdatomic; no Python.h. The owner allocates the seq array
+ * (cap entries, zero-initialised) and hands it to crc_init.
+ */
+
+#ifndef SIDDHI_COLRING_CORE_H
+#define SIDDHI_COLRING_CORE_H
+
+#include <stdatomic.h>
+#include <stddef.h>
+
+/* Slot sequence entries are cache-line padded: adjacent slots are
+ * published by different producer threads, and false sharing on the seq
+ * array is the classic scalability cliff for exactly this structure. */
+typedef struct {
+    atomic_size_t v;
+    char pad[64 - sizeof(atomic_size_t)];
+} crc_seq;
+
+typedef struct {
+    size_t cap;               /* power of two */
+    size_t mask;
+    crc_seq *seq;             /* published when seq[i & mask].v == i + 1 */
+    atomic_size_t head;       /* next slot to claim (producers, CAS) */
+    char pad1[64 - sizeof(atomic_size_t)];
+    atomic_size_t tail;       /* next slot to read (single consumer) */
+    char pad2[64 - sizeof(atomic_size_t)];
+    atomic_size_t hwm;        /* claimed-depth high-water mark */
+} crc_ring;
+
+/* cap must be a power of two; seq must hold cap zero-initialised entries
+ * and stay alive as long as the ring. */
+static inline void
+crc_init(crc_ring *r, crc_seq *seq, size_t cap)
+{
+    r->cap = cap;
+    r->mask = cap - 1;
+    r->seq = seq;
+    atomic_init(&r->head, 0);
+    atomic_init(&r->tail, 0);
+    atomic_init(&r->hwm, 0);
+}
+
+/* Claim n contiguous slots; returns the start index, or -1 when the ring
+ * lacks n free slots (all-or-nothing; the caller spins/backpressures).
+ * The successful CAS is acq_rel: the acquire half orders the claim after
+ * the tail observation, the release half makes the claim visible before
+ * any payload store the producer issues next. */
+static inline ptrdiff_t
+crc_claim(crc_ring *r, size_t n)
+{
+    size_t h = atomic_load_explicit(&r->head, memory_order_relaxed);
+    for (;;) {
+        size_t t = atomic_load_explicit(&r->tail, memory_order_acquire);
+        if (h + n - t > r->cap)
+            return -1; /* insufficient free space */
+        if (atomic_compare_exchange_weak_explicit(
+                &r->head, &h, h + n,
+                memory_order_acq_rel, memory_order_relaxed)) {
+            size_t depth = h + n - t;
+            size_t hwm = atomic_load_explicit(&r->hwm, memory_order_relaxed);
+            while (depth > hwm &&
+                   !atomic_compare_exchange_weak_explicit(
+                       &r->hwm, &hwm, depth,
+                       memory_order_relaxed, memory_order_relaxed))
+                ;
+            return (ptrdiff_t)h;
+        }
+    }
+}
+
+/* Publish one claimed run. MUST run after the payload for [start,
+ * start + n) is fully written: the per-slot release stores are what make
+ * those plain payload writes visible to the consumer's acquire loads. */
+static inline void
+crc_publish(crc_ring *r, size_t start, size_t n)
+{
+    for (size_t i = 0; i < n; i++)
+        atomic_store_explicit(&r->seq[(start + i) & r->mask].v,
+                              start + i + 1, memory_order_release);
+}
+
+/* Single consumer: length of the contiguous published prefix at the
+ * current tail, capped at max_n. After this returns k, the payload of
+ * slots [tail, tail + k) is safe to read (acquire loads above). */
+static inline size_t
+crc_poll(crc_ring *r, size_t max_n)
+{
+    size_t t = atomic_load_explicit(&r->tail, memory_order_relaxed);
+    size_t n = 0;
+    while (n < max_n &&
+           atomic_load_explicit(&r->seq[(t + n) & r->mask].v,
+                                memory_order_acquire) == t + n + 1)
+        n++;
+    return n;
+}
+
+/* Single consumer: retire n slots previously returned by crc_poll. Seq
+ * resets can be relaxed — a producer only reuses a slot after observing
+ * the released tail bump, which orders the reset before the reuse. */
+static inline void
+crc_consume(crc_ring *r, size_t n)
+{
+    size_t t = atomic_load_explicit(&r->tail, memory_order_relaxed);
+    for (size_t i = 0; i < n; i++)
+        atomic_store_explicit(&r->seq[(t + i) & r->mask].v, 0,
+                              memory_order_relaxed);
+    atomic_store_explicit(&r->tail, t + n, memory_order_release);
+}
+
+/* Claimed, unconsumed depth (approximate under concurrent producers;
+ * includes claimed-but-unwritten runs). */
+static inline size_t
+crc_size(const crc_ring *r)
+{
+    return atomic_load_explicit(&((crc_ring *)r)->head,
+                                memory_order_relaxed) -
+           atomic_load_explicit(&((crc_ring *)r)->tail,
+                                memory_order_relaxed);
+}
+
+static inline size_t
+crc_hwm(const crc_ring *r)
+{
+    return atomic_load_explicit(&((crc_ring *)r)->hwm,
+                                memory_order_relaxed);
+}
+
+#endif /* SIDDHI_COLRING_CORE_H */

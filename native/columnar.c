@@ -608,6 +608,8 @@ done:
 
 #include <stdatomic.h>
 
+#include "colring_core.h"
+
 typedef struct {
     Py_ssize_t cap;
     atomic_size_t head;       /* next slot to claim (producers) */
@@ -764,31 +766,20 @@ ring_size(PyObject *self, PyObject *args)
  * interpreter), and publish per-slot sequence stamps. One consumer copies
  * contiguous published runs out into caller buffers, also without the GIL.
  *
- * Slot sequence entries are cache-line padded: adjacent slots are published
- * by different producer threads, and false sharing on the seq array is the
- * classic scalability cliff for exactly this structure.
+ * The claim/publish/consume protocol itself lives in colring_core.h (pure
+ * C11, no Python.h) so native/colring_stress.c can compile the IDENTICAL
+ * code under TSan/ASan/UBSan; these wrappers own arg parsing, Py_buffer
+ * handling, payload memcpy, and the GIL.
  * ---------------------------------------------------------------------- */
 
 #define COLRING_MAX_COLS 64
 
 typedef struct {
-    atomic_size_t v;
-    char pad[64 - sizeof(atomic_size_t)];
-} padded_seq;
-
-typedef struct {
-    size_t cap;               /* power of two */
-    size_t mask;
+    crc_ring rc;              /* claim/publish protocol (colring_core.h) */
     int n_cols;
     Py_ssize_t widths[COLRING_MAX_COLS];
     char *cols[COLRING_MAX_COLS];   /* cap * width bytes each */
     int64_t *ts;
-    padded_seq *seq;          /* published when seq[i & mask] == i + 1 */
-    atomic_size_t head;       /* next slot to claim (producers, CAS) */
-    char pad1[64 - sizeof(atomic_size_t)];
-    atomic_size_t tail;       /* next slot to read (single consumer) */
-    char pad2[64 - sizeof(atomic_size_t)];
-    atomic_size_t hwm;        /* claimed-depth high-water mark */
 } colring;
 
 static void
@@ -800,7 +791,7 @@ colring_capsule_destruct(PyObject *capsule)
     for (int c = 0; c < r->n_cols; c++)
         PyMem_Free(r->cols[c]);
     PyMem_Free(r->ts);
-    PyMem_Free(r->seq);
+    PyMem_Free(r->rc.seq);
     PyMem_Free(r);
 }
 
@@ -842,16 +833,11 @@ colring_new(PyObject *self, PyObject *args)
     colring *r = PyMem_Calloc(1, sizeof(colring));
     if (r == NULL)
         return PyErr_NoMemory();
-    r->cap = cap;
-    r->mask = cap - 1;
     r->n_cols = (int)n_cols;
-    atomic_init(&r->head, 0);
-    atomic_init(&r->tail, 0);
-    atomic_init(&r->hwm, 0);
     const char *tcs = PyBytes_AS_STRING(typecodes_obj);
     r->ts = PyMem_Malloc(cap * sizeof(int64_t));
-    r->seq = PyMem_Calloc(cap, sizeof(padded_seq));
-    if (r->ts == NULL || r->seq == NULL) {
+    crc_init(&r->rc, PyMem_Calloc(cap, sizeof(crc_seq)), cap);
+    if (r->ts == NULL || r->rc.seq == NULL) {
         PyErr_NoMemory();
         goto fail;
     }
@@ -874,7 +860,7 @@ fail:
     for (Py_ssize_t k = 0; k < n_cols; k++)
         PyMem_Free(r->cols[k]);  /* calloc'd struct: unset slots are NULL */
     PyMem_Free(r->ts);
-    PyMem_Free(r->seq);
+    PyMem_Free(r->rc.seq);
     PyMem_Free(r);
     return NULL;
 }
@@ -899,30 +885,16 @@ colring_claim(PyObject *self, PyObject *args)
     colring *r = colring_of(capsule);
     if (r == NULL)
         return NULL;
-    if (n < 1 || (size_t)n > r->cap) {
+    if (n < 1 || (size_t)n > r->rc.cap) {
         PyErr_Format(PyExc_ValueError,
                      "colring_claim: n=%zd out of range (cap %zu)",
-                     n, r->cap);
+                     n, r->rc.cap);
         return NULL;
     }
-    size_t h = atomic_load_explicit(&r->head, memory_order_relaxed);
-    for (;;) {
-        size_t t = atomic_load_explicit(&r->tail, memory_order_acquire);
-        if (h + (size_t)n - t > r->cap)
-            return PyLong_FromLong(-1); /* insufficient free space */
-        if (atomic_compare_exchange_weak_explicit(
-                &r->head, &h, h + (size_t)n,
-                memory_order_acq_rel, memory_order_relaxed)) {
-            size_t depth = h + (size_t)n - t;
-            size_t hwm = atomic_load_explicit(&r->hwm, memory_order_relaxed);
-            while (depth > hwm &&
-                   !atomic_compare_exchange_weak_explicit(
-                       &r->hwm, &hwm, depth,
-                       memory_order_relaxed, memory_order_relaxed))
-                ;
-            return PyLong_FromUnsignedLongLong((unsigned long long)h);
-        }
-    }
+    ptrdiff_t start = crc_claim(&r->rc, (size_t)n);
+    if (start < 0)
+        return PyLong_FromLong(-1); /* insufficient free space */
+    return PyLong_FromUnsignedLongLong((unsigned long long)start);
 }
 
 /* colring_write(ring, start, n, ts_buf: int64[n], cols: tuple[buffer]) —
@@ -970,8 +942,8 @@ colring_write(PyObject *self, PyObject *args)
     }
     Py_BEGIN_ALLOW_THREADS
     {
-        size_t s0 = (size_t)start & r->mask;
-        size_t first = r->cap - s0;          /* slots before wrap */
+        size_t s0 = (size_t)start & r->rc.mask;
+        size_t first = r->rc.cap - s0;       /* slots before wrap */
         if (first > (size_t)n)
             first = (size_t)n;
         size_t second = (size_t)n - first;
@@ -986,12 +958,9 @@ colring_write(PyObject *self, PyObject *args)
             if (second)
                 memcpy(r->cols[c], src + first * w, second * w);
         }
-        /* publish AFTER the data: release stores pair with the consumer's
-         * acquire loads, slot by slot */
-        for (size_t i = 0; i < (size_t)n; i++)
-            atomic_store_explicit(&r->seq[((size_t)start + i) & r->mask].v,
-                                  (size_t)start + i + 1,
-                                  memory_order_release);
+        /* publish AFTER the data: crc_publish's release stores pair with
+         * the consumer's acquire loads, slot by slot */
+        crc_publish(&r->rc, (size_t)start, (size_t)n);
     }
     Py_END_ALLOW_THREADS
     for (int i = 0; i < acquired; i++)
@@ -1036,23 +1005,22 @@ colring_pop(PyObject *self, PyObject *args)
                                PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0)
             goto fail;
     }
-    size_t t = atomic_load_explicit(&r->tail, memory_order_relaxed);
     /* bound max_n by the output buffers up front */
     if (ts_buf.len / (Py_ssize_t)sizeof(int64_t) < max_n)
         max_n = ts_buf.len / (Py_ssize_t)sizeof(int64_t);
     for (int c = 0; c < r->n_cols; c++)
         if (bufs[c].len / r->widths[c] < max_n)
             max_n = bufs[c].len / r->widths[c];
-    size_t n = 0;
-    while ((Py_ssize_t)n < max_n &&
-           atomic_load_explicit(&r->seq[(t + n) & r->mask].v,
-                                memory_order_acquire) == t + n + 1)
-        n++;
+    if (max_n < 0)
+        max_n = 0;
+    size_t n = crc_poll(&r->rc, (size_t)max_n);
     if (n > 0) {
         Py_BEGIN_ALLOW_THREADS
         {
-            size_t s0 = t & r->mask;
-            size_t first = r->cap - s0;
+            size_t t = atomic_load_explicit(&r->rc.tail,
+                                            memory_order_relaxed);
+            size_t s0 = t & r->rc.mask;
+            size_t first = r->rc.cap - s0;
             if (first > n)
                 first = n;
             size_t second = n - first;
@@ -1067,10 +1035,7 @@ colring_pop(PyObject *self, PyObject *args)
                 if (second)
                     memcpy(dst + first * w, r->cols[c], second * w);
             }
-            for (size_t i = 0; i < n; i++)
-                atomic_store_explicit(&r->seq[(t + i) & r->mask].v, 0,
-                                      memory_order_relaxed);
-            atomic_store_explicit(&r->tail, t + n, memory_order_release);
+            crc_consume(&r->rc, n);
         }
         Py_END_ALLOW_THREADS
     }
@@ -1097,9 +1062,7 @@ colring_size(PyObject *self, PyObject *args)
     colring *r = colring_of(capsule);
     if (r == NULL)
         return NULL;
-    return PyLong_FromSize_t(
-        atomic_load_explicit(&r->head, memory_order_relaxed) -
-        atomic_load_explicit(&r->tail, memory_order_relaxed));
+    return PyLong_FromSize_t(crc_size(&r->rc));
 }
 
 /* colring_capacity(ring) -> rounded power-of-two slot count */
@@ -1112,7 +1075,7 @@ colring_capacity(PyObject *self, PyObject *args)
     colring *r = colring_of(capsule);
     if (r == NULL)
         return NULL;
-    return PyLong_FromSize_t(r->cap);
+    return PyLong_FromSize_t(r->rc.cap);
 }
 
 /* colring_hwm(ring) -> claimed-depth high-water mark over the ring's life */
@@ -1125,8 +1088,7 @@ colring_hwm(PyObject *self, PyObject *args)
     colring *r = colring_of(capsule);
     if (r == NULL)
         return NULL;
-    return PyLong_FromSize_t(
-        atomic_load_explicit(&r->hwm, memory_order_relaxed));
+    return PyLong_FromSize_t(crc_hwm(&r->rc));
 }
 
 static PyMethodDef methods[] = {

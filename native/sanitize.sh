@@ -1,0 +1,33 @@
+#!/bin/sh
+# Build and run the colring stress harness under ThreadSanitizer, then
+# again under AddressSanitizer + UBSan. Any data race, leak, UB, or oracle
+# failure exits non-zero — this is the tier-1 CI gate that keeps the
+# lock-free ring protocol (native/colring_core.h) machine-checked.
+#
+#     native/sanitize.sh [producers] [items] [capacity] [max_run]
+#
+# Defaults are CI-sized (a few seconds per sanitizer). CC overrides gcc.
+set -eu
+cd "$(dirname "$0")"
+CC="${CC:-gcc}"
+OUT="${TMPDIR:-/tmp}/siddhi-colring-sanitize"
+mkdir -p "$OUT"
+
+PRODUCERS="${1:-4}"
+ITEMS="${2:-200000}"
+CAPACITY="${3:-1024}"
+MAX_RUN="${4:-17}"
+
+echo "== tsan: $CC -fsanitize=thread =="
+"$CC" -std=c11 -O1 -g -pthread -fsanitize=thread \
+    -o "$OUT/colring_stress_tsan" colring_stress.c
+TSAN_OPTIONS="halt_on_error=1 exitcode=66" \
+    "$OUT/colring_stress_tsan" "$PRODUCERS" "$ITEMS" "$CAPACITY" "$MAX_RUN"
+
+echo "== asan+ubsan: $CC -fsanitize=address,undefined =="
+"$CC" -std=c11 -O1 -g -pthread -fsanitize=address,undefined \
+    -fno-sanitize-recover=all \
+    -o "$OUT/colring_stress_asan" colring_stress.c
+"$OUT/colring_stress_asan" "$PRODUCERS" "$ITEMS" "$CAPACITY" "$MAX_RUN"
+
+echo "sanitize: colring stress clean under tsan and asan+ubsan"

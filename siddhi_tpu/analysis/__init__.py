@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import Optional, Union
 
+from .concurrency import lint_package, lint_python_source
 from .diagnostics import Diagnostic, LintReport, Severity, Suppressions
 from .optimizer import OptimizerReport, analyze_sharing, optimizer_enabled
 from .plan import PlanGraph, build_plan, element_fingerprints, plan_fingerprint
@@ -27,6 +28,7 @@ __all__ = [
     "element_fingerprints", "plan_fingerprint",
     "UPGRADE_RULES", "UpgradeDiff", "diff_apps",
     "OptimizerReport", "analyze_sharing", "optimizer_enabled",
+    "lint_package", "lint_python_source",
 ]
 
 

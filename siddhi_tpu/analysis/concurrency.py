@@ -1,0 +1,443 @@
+"""SL4xx — concurrency lint for the engine's OWN Python source.
+
+The SL1xx/SL2xx/SL3xx catalogs certify *user queries* before execution;
+this module points the same machinery at the runtime itself, so the
+locking discipline util/locks.py enforces dynamically is also checked
+statically on every commit (`python -m siddhi_tpu.lint --self`).
+
+Rules:
+
+  SL401  ERROR  raw threading.Lock()/RLock()/Condition() constructed
+                outside the util/locks.py factory — the lock is invisible
+                to lockdep and has no catalog name
+  SL402  WARN   instance attribute assigned from >= 2 thread entry points
+                (methods used as Thread(target=...) plus the public
+                caller-thread API) with no common guarding lock
+  SL403  ERROR  two named locks nested in inconsistent order in different
+                places (the static shadow of lockdep's cycle detection)
+  SL404  WARN   blocking call (time.sleep, os.fsync, socket ops, bare
+                .join(), queue .put()) lexically under a held lock
+  SL405  WARN   mutable module-level container mutated inside a function
+                with no lock held
+
+Suppression uses source comments (these are Python files, not SiddhiQL,
+so `@suppress.lint` annotations don't exist): a trailing
+``# noqa: SL40x`` on the offending line drops that finding, matching
+the per-rule suppression contract of the SiddhiQL CLI.
+
+Everything reports through the shared Diagnostic/LintReport shapes, so
+JSON output, severity filters, and exit codes are identical to the
+SiddhiQL linter's.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Optional
+
+from .diagnostics import Diagnostic, LintReport, Severity
+
+#: modules whose job is constructing raw primitives / spawning threads
+_FACTORY_MODULES = ("util/locks.py",)
+
+_RAW_PRIMITIVES = ("Lock", "RLock", "Condition")
+_FACTORY_FUNCS = ("named_lock", "named_rlock", "named_condition")
+
+#: callables treated as blocking for SL404 (name or dotted suffix)
+_BLOCKING_NAMES = {"time.sleep", "os.fsync", "select.select"}
+_BLOCKING_METHODS = {"recv", "accept", "connect", "sendall", "put"}
+
+_NOQA_RE = re.compile(r"#\s*noqa:\s*([A-Z0-9, ]+)")
+
+
+def _noqa_rules(lines: list, lineno: int) -> set:
+    """Rule ids suppressed by a `# noqa: SL4xx` comment on this line."""
+    if not (1 <= lineno <= len(lines)):
+        return set()
+    m = _NOQA_RE.search(lines[lineno - 1])
+    if not m:
+        return set()
+    return {r.strip().upper() for r in m.group(1).split(",") if r.strip()}
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression ('self.ctx.lock' ...)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _lock_literal(call: ast.Call) -> Optional[str]:
+    """The name argument when `call` is named_lock/rlock/condition(...)."""
+    fn = call.func
+    fname = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else "")
+    if fname not in _FACTORY_FUNCS:
+        return None
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+class _ModuleFacts(ast.NodeVisitor):
+    """Single pass over one module collecting everything the rules need."""
+
+    def __init__(self, path: str, tree: ast.Module) -> None:
+        self.path = path
+        self.raw_locks: list = []          # (lineno, col, primitive)
+        self.lock_keys: dict = {}          # attr/var key -> lock name
+        self.nestings: list = []           # (outer, inner, lineno)
+        self.blocking: list = []           # (lineno, col, desc, [held keys])
+        self.classes: list = []            # ast.ClassDef nodes
+        self.globals_mut: dict = {}        # name -> lineno (module level)
+        self.global_writes: list = []      # (name, lineno, held?)
+        self._with_stack: list = []        # lock keys currently entered
+        self._threading_aliases = {"threading"}
+        self.visit(tree)
+
+    # ------------------------------------------------------------ helpers
+
+    def _lock_key(self, node: ast.AST) -> Optional[str]:
+        """Canonical key for a lock-valued expression: the final attribute
+        or variable name ('_submit_lock', 'controller_lock', ...)."""
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        if isinstance(node, ast.Name):
+            return node.id
+        return None
+
+    # ------------------------------------------------------------ visitors
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.name == "threading":
+                self._threading_aliases.add(alias.asname or "threading")
+        self.generic_visit(node)
+
+    def visit_Module(self, node: ast.Module) -> None:
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                if self._is_mutable_literal(stmt.value):
+                    self.globals_mut[stmt.targets[0].id] = stmt.lineno
+        self.generic_visit(node)
+
+    @staticmethod
+    def _is_mutable_literal(node: ast.AST) -> bool:
+        if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else "")
+            return name in ("dict", "list", "set", "deque", "defaultdict",
+                            "OrderedDict")
+        return False
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.classes.append(node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        # SL401: raw primitive construction
+        if isinstance(fn, ast.Attribute) and fn.attr in _RAW_PRIMITIVES \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id in self._threading_aliases:
+            self.raw_locks.append((node.lineno, node.col_offset, fn.attr))
+        # blocking-call detection for SL404 (only meaningful under a lock)
+        if self._with_stack:
+            desc = self._blocking_desc(node)
+            if desc:
+                self.blocking.append((node.lineno, node.col_offset, desc,
+                                      list(self._with_stack)))
+        self.generic_visit(node)
+
+    def _blocking_desc(self, node: ast.Call) -> Optional[str]:
+        dotted = _dotted(node.func)
+        for b in _BLOCKING_NAMES:
+            if dotted == b or dotted.endswith("." + b):
+                return b
+        if isinstance(node.func, ast.Attribute):
+            meth = node.func.attr
+            if meth == "join" and not node.args:
+                # zero-arg .join() is a thread/queue join; str.join always
+                # carries its iterable positionally
+                return ".join()"
+            if meth in _BLOCKING_METHODS and meth != "put":
+                return f".{meth}()"
+            if meth == "put":
+                recv = _dotted(node.func.value)
+                # only queue-ish receivers: dicts have no .put
+                if recv.rsplit(".", 1)[-1].lstrip("_").startswith("q"):
+                    return ".put()"
+        return None
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if isinstance(node.value, ast.Call):
+            lock_name = _lock_literal(node.value)
+            if lock_name is not None:
+                for tgt in node.targets:
+                    key = self._lock_key(tgt)
+                    if key:
+                        self.lock_keys[key] = lock_name
+        if self._with_stack:
+            for name, line in self._global_targets(node):
+                self.global_writes.append((name, line, True))
+        else:
+            for name, line in self._global_targets(node):
+                self.global_writes.append((name, line, False))
+        self.generic_visit(node)
+
+    def _global_targets(self, node: ast.Assign):
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Subscript):
+                base = tgt.value
+                if isinstance(base, ast.Name) \
+                        and base.id in self.globals_mut:
+                    yield base.id, node.lineno
+
+    def visit_With(self, node: ast.With) -> None:
+        keys = []
+        for item in node.items:
+            key = self._lock_key(item.context_expr)
+            # treat anything lock-ish as a guard: named keys, *lock*, *cv*
+            if key and (key in self.lock_keys or "lock" in key.lower()
+                        or key.lstrip("_").startswith("cv")
+                        or key.lstrip("_").endswith("cv")):
+                keys.append(key)
+        for key in keys:
+            for outer in self._with_stack:
+                if outer != key:
+                    self.nestings.append((outer, key, node.lineno))
+        self._with_stack.extend(keys)
+        self.generic_visit(node)
+        if keys:
+            del self._with_stack[-len(keys):]
+
+
+def _class_entry_points(cls: ast.ClassDef) -> tuple:
+    """(entry_method_names, methods) — entry points are Thread targets
+    plus every public method (the caller's thread enters there)."""
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    entries = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            fname = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if fname != "Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target" and isinstance(kw.value, ast.Attribute):
+                    if isinstance(kw.value.value, ast.Name) \
+                            and kw.value.value.id == "self":
+                        entries.add(kw.value.attr)
+    return entries, methods
+
+
+def _method_attr_stores(meth: ast.AST, lock_keys: dict) -> dict:
+    """attr -> set of guard keys for each `self.attr = ...` store in the
+    method ('' marks an unguarded store)."""
+    stores: dict = {}
+
+    def walk(node, guards):
+        if isinstance(node, ast.With):
+            keys = []
+            for item in node.items:
+                if isinstance(item.context_expr, (ast.Attribute, ast.Name)):
+                    k = (item.context_expr.attr
+                         if isinstance(item.context_expr, ast.Attribute)
+                         else item.context_expr.id)
+                    if k in lock_keys or "lock" in k.lower() \
+                            or k.lstrip("_").startswith("cv") \
+                            or k.lstrip("_").endswith("cv"):
+                        keys.append(k)
+            guards = guards | set(keys)
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign,)):
+            targets = [node.target]
+        for tgt in targets:
+            if isinstance(tgt, ast.Attribute) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self":
+                cell = stores.setdefault(tgt.attr, set())
+                cell.update(guards or {""})
+        for child in ast.iter_child_nodes(node):
+            walk(child, guards)
+
+    walk(meth, frozenset())
+    return stores
+
+
+def lint_python_source(text: str, name: str = "<module>",
+                       report: Optional[LintReport] = None,
+                       shared_nestings: Optional[list] = None
+                       ) -> LintReport:
+    """Run every SL40x rule over one Python source file. When
+    ``shared_nestings`` is given, SL403 pairs are accumulated there for a
+    later cross-module pass instead of being judged per-file."""
+    if report is None:
+        report = LintReport(app_name=name)
+    lines = text.split("\n")
+
+    def emit(rule: str, sev: Severity, msg: str, lineno: int,
+             col: int = 0) -> None:
+        if rule in _noqa_rules(lines, lineno):
+            return
+        report.add(Diagnostic(rule, sev, msg, element=name,
+                              loc=(lineno, col)))
+
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        emit("SL000", Severity.ERROR, f"python parse error: {e.msg}",
+             e.lineno or 1, e.offset or 0)
+        return report
+
+    facts = _ModuleFacts(name, tree)
+
+    # SL401 — raw primitives outside the factory
+    if not any(name.endswith(m) for m in _FACTORY_MODULES):
+        for lineno, col, prim in facts.raw_locks:
+            emit("SL401", Severity.ERROR,
+                 f"raw threading.{prim}() constructed outside "
+                 f"util/locks.py — use named_lock()/named_rlock()/"
+                 f"named_condition() so lockdep can see it", lineno, col)
+
+    # SL402 — shared attribute with no common guard
+    for cls in facts.classes:
+        entries, methods = _class_entry_points(cls)
+        if not entries:
+            continue
+        per_attr: dict = {}
+        for mname, meth in methods.items():
+            if mname == "__init__":
+                continue
+            for attr, guards in _method_attr_stores(
+                    meth, facts.lock_keys).items():
+                per_attr.setdefault(attr, {})[mname] = guards
+        for attr, writers in per_attr.items():
+            if len(writers) < 2:
+                continue
+            if not any(m in entries for m in writers):
+                continue
+            common = None
+            for guards in writers.values():
+                g = {x for x in guards if x}
+                common = g if common is None else (common & g)
+            if common:
+                continue
+            lineno = cls.lineno
+            for meth in methods.values():
+                if meth.name in writers:
+                    lineno = meth.lineno
+                    break
+            emit("SL402", Severity.WARN,
+                 f"attribute self.{attr} is assigned from "
+                 f"{len(writers)} methods of {cls.name} including thread "
+                 f"entry point(s) {sorted(set(writers) & entries)} with no "
+                 f"common guarding lock", lineno)
+
+    # SL403 — inconsistent nesting (cross-module when shared_nestings)
+    resolved = []
+    for outer, inner, lineno in facts.nestings:
+        a = facts.lock_keys.get(outer, outer)
+        b = facts.lock_keys.get(inner, inner)
+        if a != b:
+            resolved.append((a, b, name, lineno))
+    if shared_nestings is not None:
+        shared_nestings.extend(resolved)
+    else:
+        _judge_nestings(resolved, report, lines)
+
+    # SL404 — blocking call under a held lock
+    for lineno, col, desc, held in facts.blocking:
+        held_names = [facts.lock_keys.get(k, k) for k in held]
+        emit("SL404", Severity.WARN,
+             f"blocking call {desc} while holding lock(s) "
+             f"{held_names}", lineno, col)
+
+    # SL405 — module-level mutable state written without a lock
+    seen = set()
+    for gname, lineno, guarded in facts.global_writes:
+        if guarded or (gname, lineno) in seen:
+            continue
+        seen.add((gname, lineno))
+        emit("SL405", Severity.WARN,
+             f"module-level mutable {gname!r} (defined line "
+             f"{facts.globals_mut[gname]}) written without a lock held",
+             lineno)
+
+    return report
+
+
+def _judge_nestings(nestings: list, report: LintReport,
+                    lines_by_file: Optional[dict] = None) -> None:
+    """SL403: flag (A,B) pairs that also occur as (B,A) somewhere."""
+    by_pair: dict = {}
+    for a, b, fname, lineno in nestings:
+        by_pair.setdefault((a, b), []).append((fname, lineno))
+    flagged = set()
+    for (a, b), sites in by_pair.items():
+        if (b, a) not in by_pair or (b, a) in flagged or (a, b) in flagged:
+            continue
+        flagged.add((a, b))
+        flagged.add((b, a))
+        rev = by_pair[(b, a)]
+        for fname, lineno in sites:
+            report.add(Diagnostic(
+                "SL403", Severity.ERROR,
+                f"inconsistent lock order: {a!r} -> {b!r} here but "
+                f"{b!r} -> {a!r} at {rev[0][0]}:{rev[0][1]} — a thread in "
+                f"each order can deadlock", element=fname,
+                loc=(lineno, 0)))
+
+
+def package_root() -> Path:
+    return Path(__file__).resolve().parent.parent
+
+
+def lint_package(root: Optional[Path] = None) -> LintReport:
+    """Run the SL40x catalog over every module of the installed package
+    (what `python -m siddhi_tpu.lint --self` and the CI gate execute)."""
+    root = Path(root) if root is not None else package_root()
+    report = LintReport(app_name=f"self:{root.name}")
+    nestings: list = []
+    for path in sorted(root.rglob("*.py")):
+        if "_native_build" in path.parts:
+            continue
+        rel = path.relative_to(root.parent).as_posix()
+        try:
+            text = path.read_text()
+        except OSError as e:  # pragma: no cover — unreadable tree
+            report.add(Diagnostic("SL000", Severity.ERROR,
+                                  f"cannot read: {e}", element=rel))
+            continue
+        lint_python_source(text, name=rel, report=report,
+                           shared_nestings=nestings)
+    # cross-module SL403 judgement over the union of nesting pairs,
+    # honouring per-line noqa comments at each flagged site
+    sub = LintReport(app_name=report.app_name)
+    _judge_nestings(nestings, sub)
+    for d in sub.diagnostics:
+        if d.element and d.loc:
+            try:
+                text = (root.parent / d.element).read_text()
+                if "SL403" in _noqa_rules(text.split("\n"), d.loc[0]):
+                    continue
+            except OSError:  # pragma: no cover
+                pass
+        report.add(d)
+    return report

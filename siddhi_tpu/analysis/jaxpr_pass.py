@@ -16,6 +16,12 @@ Hazards:
          usually a leaked `jax_enable_x64` literal.
   SL203  widening `convert_element_type` ops: silent upcasts that double a
          column's HBM footprint mid-step.
+  SL204  fastpath NOT certified: the step carries a veto — host callbacks
+         or ordered jaxpr effects — that knocks pjit off its C++
+         no-Python dispatch fastpath, re-paying interpreter overhead on
+         every batch. `fastpath_certify(app)` returns the per-step
+         verdicts; tools/fastpath_gate.py keeps the in-tree bench apps
+         from regressing.
 
 Never raises: a query whose step cannot be traced here is skipped (and the
 skip is logged at debug), because the runtime build path owns those errors.
@@ -62,6 +68,19 @@ class _Hazards:
         self.callbacks: set[str] = set()
         self.f64: set[str] = set()
         self.upcasts: set[tuple[str, str]] = set()
+        self.effects: set[str] = set()
+
+    @property
+    def fastpath_vetoes(self) -> list[str]:
+        """Why pjit's C++ fastpath would reject this step (empty=certified)."""
+        vetoes = []
+        if self.callbacks:
+            vetoes.append("host callback(s): "
+                          + ", ".join(sorted(self.callbacks)))
+        if self.effects:
+            vetoes.append("ordered effect(s): "
+                          + ", ".join(sorted(self.effects)))
+        return vetoes
 
     def visit(self, eqn) -> None:
         import numpy as np
@@ -113,6 +132,12 @@ class _Hazards:
                 f"step silently widens {src} → {dst} "
                 "(convert_element_type): doubles that column's footprint "
                 "per batch")
+        vetoes = self.fastpath_vetoes
+        if vetoes:
+            add("SL204", Severity.WARN,
+                "step is NOT fastpath-certified: "
+                + "; ".join(vetoes)
+                + " — pjit falls back to Python dispatch every batch")
 
 
 def _trace_hazards(step_fn, *args) -> _Hazards:
@@ -121,6 +146,8 @@ def _trace_hazards(step_fn, *args) -> _Hazards:
     hazards = _Hazards()
     fn = getattr(step_fn, "__wrapped__", step_fn)
     jaxpr = jax.make_jaxpr(fn)(*args)
+    for eff in getattr(jaxpr, "effects", ()) or ():
+        hazards.effects.add(type(eff).__name__)
     _walk(jaxpr.jaxpr, hazards.visit)
     return hazards
 
@@ -159,6 +186,43 @@ def _steps_of(qr):
             batch = EventBatch.empty(junction.definition,
                                      junction.batch_size)
             yield f"/{sid}", step, (qr.state, batch, now)
+
+
+def fastpath_certify(app) -> dict:
+    """Per-step fastpath verdicts for one app (SiddhiApp or SiddhiQL text):
+    {step_name: {"certified": bool, "vetoes": [reason, ...]}}.
+
+    A certified step carries no host callback and no ordered effect, so
+    pjit's C++ no-Python dispatch can serve it. Steps that fail to trace
+    are reported as {"certified": False, "vetoes": ["trace failed: ..."]}
+    — an untraceable step cannot be certified."""
+    from ..core.manager import SiddhiManager
+
+    if isinstance(app, str):
+        from ..compiler import SiddhiCompiler
+        app = SiddhiCompiler.parse(app)
+    out: dict = {}
+    manager = SiddhiManager()
+    manager._lint_enabled = False
+    try:
+        rt = manager.create_sandbox_siddhi_app_runtime(app)
+        for name, qr in rt.query_runtimes.items():
+            try:
+                for tag, step, args in _steps_of(qr):
+                    hazards = _trace_hazards(step, *args)
+                    vetoes = hazards.fastpath_vetoes
+                    out[f"{name}{tag}"] = {"certified": not vetoes,
+                                           "vetoes": vetoes}
+            except Exception as e:  # noqa: BLE001 — per-step best effort
+                out[name] = {"certified": False,
+                             "vetoes": [f"trace failed: {e}"]}
+    finally:
+        try:
+            manager.shutdown()
+        except Exception:
+            log.debug("fastpath certify: manager shutdown failed",
+                      exc_info=True)
+    return out
 
 
 def run_jaxpr_pass(app, report: LintReport, suppressions) -> None:

@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..extension.registry import Registry
+from ..util.locks import named_lock, named_rlock
 from . import dtypes
 
 
@@ -33,7 +34,7 @@ class TimestampGenerator:
         self.playback = playback
         self.playback_increment_ms = playback_increment_ms
         self.idle_time_ms = idle_time_ms
-        self._observe_lock = threading.Lock()
+        self._observe_lock = named_lock("app.timestamp")
         self._last_event_ts: Optional[int] = None
 
     def current_time(self) -> int:
@@ -394,6 +395,11 @@ class Statistics:
                     "warnings": len(lint.warnings),
                     "rules": lint.rule_counts(),
                 }
+        from ..util import locks as _locks
+        if _locks.checks_enabled():
+            # lockdep findings (util/locks.py): acquisition-order cycles +
+            # held-across-blocking hazards, only under SIDDHI_LOCK_CHECKS=1
+            out["lockdep"] = _locks.lockdep_report()
         if self.detail:
             out["query_latency_ms"] = {
                 q: (t / c / 1e6 if c else 0.0)
@@ -440,7 +446,8 @@ class SiddhiAppContext:
     #: single-controller gate: async feeder threads and user-thread
     #: flush/heartbeat/query serialize device work through this RLock (the
     #: role of the reference's ThreadBarrier + per-query locks)
-    controller_lock: object = field(default_factory=threading.RLock)
+    controller_lock: object = field(
+        default_factory=lambda: named_rlock("app.controller"))
     #: async stream-callback decode (create_siddhi_app_runtime(...,
     #: async_callbacks=True)): device→host readback + Event decode run on a
     #: dedicated worker so the controller thread never blocks on the

@@ -21,8 +21,9 @@ callback runs at the terminal with decoded events, per event. Two modes:
 from __future__ import annotations
 
 import enum
-import threading
 from typing import Callable, Optional
+
+from ..util.locks import named_condition
 
 
 class QueryTerminal(enum.Enum):
@@ -39,7 +40,7 @@ class SiddhiDebugger:
         self.runtime = runtime
         self._breakpoints: set[tuple[str, QueryTerminal]] = set()
         self._callback: Optional[Callable] = None
-        self._cv = threading.Condition()
+        self._cv = named_condition("debug.stepper")
         self._actions: list[str] = []  # FIFO: scripted next();next() queues
 
     # ------------------------------------------------------------- stepping

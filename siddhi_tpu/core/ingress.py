@@ -54,6 +54,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from ..util.locks import named_condition, named_lock, note_blocking
+
 _log = logging.getLogger("siddhi_tpu")
 
 #: np dtype name -> colring type code (widths: b=1, i=4, l=8, f=4, d=8)
@@ -114,7 +116,7 @@ class _PyColRing:
         self._head = 0
         self._tail = 0
         self._hwm = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("ingress.pyring")
 
     def claim(self, n: int) -> int:
         with self._lock:
@@ -215,8 +217,8 @@ class IngressPipeline:
         self._q: queue.Queue = queue.Queue()
         #: claim+enqueue run under this lock so queue order == claim order —
         #: the invariant the intern tickets (and 1-worker liveness) need
-        self._submit_lock = threading.Lock()
-        self._ticket_cv = threading.Condition()
+        self._submit_lock = named_lock("ingress.submit")
+        self._ticket_cv = named_condition("ingress.ticket")
         self._next_ticket = 0
         self._stopping = False
         self._threads: list[threading.Thread] = []
@@ -290,7 +292,8 @@ class IngressPipeline:
             if deadline is not None and time.monotonic() >= deadline:
                 return -1
             self._flush_req.set()
-            time.sleep(0.0002)
+            note_blocking("ring.claim_wait", allow=("ingress.submit",))
+            time.sleep(0.0002)  # noqa: SL404 — blocking claim IS the backpressure
 
     def _deadline(self) -> Optional[float]:
         bt = self.j.block_timeout_s
@@ -322,7 +325,8 @@ class IngressPipeline:
                     return n  # shed per block.timeout: consumed by policy
                 self._rows_in += m
                 self._runs_in += 1
-                self._q.put(("rows", s, m, tss[i:i + m], rows[i:i + m]))
+                self._q.put(  # noqa: SL404 — unbounded queue, never blocks
+                    ("rows", s, m, tss[i:i + m], rows[i:i + m]))
             i += m
         return n
 
@@ -379,7 +383,8 @@ class IngressPipeline:
                 self._runs_in += 1
                 if frame:
                     self._frames_in += 1
-                self._q.put(("cols", s, m, ts_arr[i:i + m], run))
+                self._q.put(  # noqa: SL404 — unbounded queue, never blocks
+                    ("cols", s, m, ts_arr[i:i + m], run))
             i += m
         return n
 

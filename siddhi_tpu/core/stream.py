@@ -24,6 +24,7 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from ..errors import SiddhiAppCreationError, SiddhiAppRuntimeError
+from ..util.locks import named_condition, named_lock, note_blocking
 from ..query_api.definition import AttributeType, StreamDefinition
 from . import dtypes
 from .context import SiddhiAppContext
@@ -237,7 +238,7 @@ class AsyncDecoder:
         self._seq = 0
         self._deliver_next = 0
         self._buffer: dict = {}
-        self._cv = threading.Condition()
+        self._cv = named_condition("stream.decoder")
         self._stopping = False
         self._threads = [
             threading.Thread(target=self._fetch_loop, daemon=True,
@@ -269,6 +270,11 @@ class AsyncDecoder:
                     start()
         except Exception:  # pragma: no cover — transfer warm-up is advisory
             pass
+        # the bounded put may block under the controller lock; safe
+        # because decoder threads never block unboundedly on that lock
+        # (the @OnError path acquires it with a timeout) so the queue
+        # always drains — see docs/CONCURRENCY.md
+        note_blocking("queue.put", allow=("app.controller",))
         self._q.put((self._seq, receiver, payload, now, junction))
         self._seq += 1
 
@@ -331,15 +337,30 @@ class AsyncDecoder:
                 if junction is not None and (
                         junction.on_error is not None
                         or junction.on_error_action is not None):
-                    try:
-                        with junction.ctx.controller_lock:
+                    # BOUNDED acquire, never a plain `with`: a producer can
+                    # hold the controller lock while blocked on the bounded
+                    # submit queue above — if this thread then waited on the
+                    # same lock forever, nothing would drain the reorder
+                    # buffer and the whole pipeline would wedge. Timing out
+                    # keeps delivery moving (the buffer empties, the
+                    # producer's put completes) at the cost of routing this
+                    # one failure through the plain log.
+                    got = junction.ctx.controller_lock.acquire(timeout=1.0)
+                    if got:
+                        try:
                             if junction.on_error is not None:
                                 junction.on_error(e, host)
                             else:
                                 junction._handle_error(e, host, now)
-                    except Exception:  # pragma: no cover
+                        except Exception:  # pragma: no cover
+                            logging.getLogger("siddhi_tpu").exception(
+                                "async @OnError routing failed")
+                        finally:
+                            junction.ctx.controller_lock.release()
+                    else:
                         logging.getLogger("siddhi_tpu").exception(
-                            "async @OnError routing failed")
+                            "async @OnError routing skipped (controller "
+                            "lock busy): %s", e)
                 else:
                     logging.getLogger("siddhi_tpu").exception(
                         "async stream callback failed")
@@ -472,9 +493,8 @@ class StreamJunction:
         #: unlocked append could land on a list flush() just swapped out and
         #: drained — a silently lost event), drained into the staging
         #: buffers under the controller lock at flush
-        import threading as _t
         self._tap_queue: list = []
-        self._tap_lock = _t.Lock()
+        self._tap_lock = named_lock("junction.tap")
         self.on_error: Optional[Callable] = None
         #: write-ahead event journal (state/wal.py) — attached by the app
         #: runtime to INGRESS junctions only (user-defined streams). Rows
@@ -688,6 +708,7 @@ class StreamJunction:
         tele = getattr(self.ctx, "telemetry", None)
         tracing = tele is not None and tele.on
         with self.ctx.controller_lock:
+            note_blocking("device.dispatch", allow=("app.controller",))
             self.flush()  # staged rows first: preserve arrival order
             now = self.ctx.timestamp_generator.current_time()
             for start in range(0, n, cap):
@@ -1145,6 +1166,7 @@ class StreamJunction:
             self._deliver(empty, now)
 
     def _deliver(self, batch: EventBatch, now: int) -> None:
+        note_blocking("device.dispatch", allow=("app.controller",))
         self._reentry.flushing = True
         tele = getattr(self.ctx, "telemetry", None)
         trace = None

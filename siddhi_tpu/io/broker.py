@@ -8,8 +8,9 @@ reference so separate SiddhiManager instances exchange messages in tests.
 
 from __future__ import annotations
 
-import threading
 from typing import Callable
+
+from ..util.locks import named_lock
 
 
 class Subscriber:
@@ -38,7 +39,7 @@ class InMemoryBroker:
     """Static pub/sub hub (all methods class-level, like the reference)."""
 
     _topics: dict[str, list[Subscriber]] = {}
-    _lock = threading.Lock()
+    _lock = named_lock("broker.registry")
 
     @classmethod
     def subscribe(cls, subscriber: Subscriber) -> None:

@@ -4,6 +4,8 @@
     python -m siddhi_tpu.lint --json app.siddhi
     python -m siddhi_tpu.lint --jaxpr app.siddhi     # + compiled-step hazards
     python -m siddhi_tpu.lint --scan samples/        # every *.siddhi under
+    python -m siddhi_tpu.lint --self                 # SL40x concurrency lint
+                                                     # over the engine source
 
 Exit codes: 0 = no ERROR findings anywhere, 1 = at least one ERROR,
 2 = a file could not be read or parsed (parse failures also surface as an
@@ -60,8 +62,12 @@ def main(argv: list[str] = None) -> int:
         prog="python -m siddhi_tpu.lint",
         description="Static lint for SiddhiQL apps (rule reference: "
                     "docs/LINT.md)")
-    ap.add_argument("paths", nargs="+", help="*.siddhi files (or "
+    ap.add_argument("paths", nargs="*", help="*.siddhi files (or "
                     "directories with --scan)")
+    ap.add_argument("--self", action="store_true", dest="self_mode",
+                    help="lint the engine's own Python source with the "
+                         "SL40x concurrency catalog instead of SiddhiQL "
+                         "files (docs/CONCURRENCY.md)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit one JSON object {file: report} on stdout")
     ap.add_argument("--jaxpr", action="store_true",
@@ -74,6 +80,24 @@ def main(argv: list[str] = None) -> int:
                     help="hide findings below this severity")
     args = ap.parse_args(argv)
 
+    max_rank = {"error": 0, "warn": 1, "info": 2}[args.max_severity]
+    if args.self_mode:
+        from .analysis import lint_package
+        report = lint_package()
+        if args.as_json:
+            print(json.dumps({report.app_name: report.to_dict()}, indent=2))
+        else:
+            for d in report.sorted():
+                if d.severity.rank <= max_rank:
+                    print(d.format())
+            n_err, n_warn = len(report.errors), len(report.warnings)
+            print(f"{report.app_name}: {n_err} error(s), {n_warn} "
+                  f"warning(s), "
+                  f"{len(report.diagnostics) - n_err - n_warn} info")
+        return 1 if report.has_errors else 0
+    if not args.paths:
+        ap.error("paths are required unless --self is given")
+
     try:
         files = _collect(args.paths, args.scan)
     except SystemExit as e:
@@ -83,7 +107,6 @@ def main(argv: list[str] = None) -> int:
     had_error = False
     had_io_or_parse_failure = False
     results: dict[str, dict] = {}
-    max_rank = {"error": 0, "warn": 1, "info": 2}[args.max_severity]
 
     for path in files:
         try:

@@ -76,6 +76,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .core.manager import SiddhiManager
 from .errors import SiddhiError
+from .util.locks import named_lock, note_blocking
 
 
 class SiddhiService:
@@ -83,7 +84,7 @@ class SiddhiService:
                  token: str | None = None,
                  allow_scripts: bool = False) -> None:
         self.manager = manager or SiddhiManager()
-        self.lock = threading.Lock()
+        self.lock = named_lock("service.registry")
         self.token = token
         self.allow_scripts = allow_scripts
         if self.manager.error_store is None:
@@ -369,6 +370,7 @@ class SiddhiService:
                 return False
 
             def do_GET(self):
+                note_blocking("http.handle")
                 parts, query = self._route()
                 # probe endpoints skip auth (orchestrator probes carry no
                 # credentials; bodies expose names + states only)
@@ -416,6 +418,7 @@ class SiddhiService:
                     self._reply(404, {"error": "unknown app"})
 
             def do_POST(self):
+                note_blocking("http.handle")
                 if not self._authorized():
                     return
                 parts, query = self._route()
@@ -492,6 +495,7 @@ class SiddhiService:
                     self._reply(400, {"error": str(e)})
 
             def do_DELETE(self):
+                note_blocking("http.handle")
                 if not self._authorized():
                     return
                 parts, _query = self._route()

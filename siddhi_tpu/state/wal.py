@@ -40,9 +40,10 @@ import logging
 import os
 import pickle
 import struct
-import threading
 import zlib
 from typing import Optional
+
+from ..util.locks import named_rlock, note_blocking
 
 log = logging.getLogger("siddhi_tpu")
 
@@ -61,7 +62,7 @@ class WriteAheadLog:
         self.fsync = fsync
         # one lock serializes appends/rotation; producers on arbitrary
         # threads (async sources, user threads) share the journal
-        self._lock = threading.RLock()
+        self._lock = named_rlock("wal.journal")
         #: lifetime records appended / events journaled (statistics_report)
         self.appended_records = 0
         self.appended_events = 0
@@ -125,7 +126,11 @@ class WriteAheadLog:
             self._file.write(rec)
             self._file.flush()
             if self.fsync:
-                os.fsync(self._file.fileno())
+                note_blocking("wal.fsync",
+                              allow=("wal.journal", "app.controller"))
+                # fsync under the journal lock IS the durability
+                # contract: append order == disk order
+                os.fsync(self._file.fileno())  # noqa: SL404
             self.appended_records += 1
 
     def append_rows(self, stream_id: str, tss, rows) -> None:
@@ -254,7 +259,9 @@ class WriteAheadLog:
             if self._file is not None:
                 self._file.flush()
                 if self.fsync:
-                    os.fsync(self._file.fileno())
+                    note_blocking("wal.fsync",
+                                  allow=("wal.journal", "app.controller"))
+                    os.fsync(self._file.fileno())  # noqa: SL404 — close() drains
                 self._file.close()
                 self._file = None
 

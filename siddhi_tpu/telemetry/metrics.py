@@ -21,6 +21,8 @@ from __future__ import annotations
 import threading
 from typing import Iterable, Optional, Sequence
 
+from ..util.locks import named_lock
+
 #: number of histogram buckets: index i covers (2^(i-1), 2^i] microseconds
 #: for 0 < i < 27 (index 0 = ≤1 µs); index 27 is the +Inf overflow bucket.
 N_BUCKETS = 28
@@ -45,7 +47,7 @@ class Counter:
 
     def __init__(self) -> None:
         self._shards: list[list] = []
-        self._lock = threading.Lock()
+        self._lock = named_lock("telemetry.metrics.shards")
         self._tls = threading.local()
 
     def _cell(self) -> list:
@@ -95,7 +97,7 @@ class Histogram:
 
     def __init__(self) -> None:
         self._shards: list[list] = []
-        self._lock = threading.Lock()
+        self._lock = named_lock("telemetry.metrics.shards")
         self._tls = threading.local()
 
     def _cell(self) -> list:
@@ -203,7 +205,7 @@ class Family:
         self.help = help_text
         self.labelnames = tuple(labelnames)
         self._children: dict[tuple, object] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("telemetry.metrics.family")
         self._ctor = self._CTORS[kind]
 
     def labels(self, *values: str):
@@ -228,7 +230,7 @@ class MetricsRegistry:
 
     def __init__(self) -> None:
         self._families: dict[str, Family] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("telemetry.metrics.registry")
 
     def _family(self, name: str, kind: str, help_text: str,
                 labelnames: Sequence[str]) -> Family:

@@ -25,9 +25,11 @@ import os
 import threading
 from typing import Optional
 
+from ..util.locks import named_lock
+
 log = logging.getLogger("siddhi_tpu.telemetry")
 
-_jax_trace_lock = threading.Lock()
+_jax_trace_lock = named_lock("telemetry.profile.jax")
 _jax_trace_dir: Optional[str] = None
 
 
@@ -74,7 +76,7 @@ class ProfileSession:
         self._telemetry = telemetry
         self.n_batches = int(n_batches)
         self._remaining = self.n_batches
-        self._lock = threading.Lock()
+        self._lock = named_lock("telemetry.profile.session")
         self._done = threading.Event()
         self._per_query: dict[str, list] = {}  # [batches, host_ns, wait_ns]
         if self._remaining <= 0:

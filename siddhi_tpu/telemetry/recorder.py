@@ -47,10 +47,11 @@ import logging
 import os
 import shutil
 import tempfile
-import threading
 import time
 from collections import deque
 from typing import Optional
+
+from ..util.locks import named_lock
 
 #: bundle format version — bump on any backwards-incompatible layout change
 #: (doctor refuses versions it does not know). v1: initial format.
@@ -129,7 +130,7 @@ class FlightRecorder:
         self.min_interval_s = min_interval_s
         self.keep_last = keep_last
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = named_lock("telemetry.recorder.gate")
         self._seq = 0
         self._last_by_kind: dict[str, float] = {}
         self._last_any: Optional[float] = None

@@ -46,11 +46,11 @@ recorder (telemetry/recorder.py).
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 from typing import Callable, Optional
 
+from ..util.locks import named_lock
 from .metrics import N_BUCKETS, bucket_index
 
 #: defaults for the two burn windows (seconds) and the burn threshold
@@ -241,7 +241,7 @@ class SloEngine:
         self.objectives: list[Objective] = []
         #: called with (objective, event) on each ok->breached transition
         self.on_breach: Optional[Callable] = None
-        self._lock = threading.Lock()
+        self._lock = named_lock("telemetry.slo.tick")
 
     def add(self, objective: Objective) -> Objective:
         self.objectives.append(objective)

@@ -44,6 +44,7 @@ import time
 from collections import deque
 from typing import Optional
 
+from ..util.locks import named_lock
 from .metrics import Histogram, MetricsRegistry, bucket_index
 
 #: worst-N exemplar ring size
@@ -137,7 +138,7 @@ class AppTelemetry:
         self._tls = threading.local()
         self._slow: list[tuple[float, int, dict]] = []  # (e2e_ms, id, summary)
         self._slow_floor = 0.0  # cheapest e2e_ms in a full ring (fast reject)
-        self._slow_lock = threading.Lock()
+        self._slow_lock = named_lock("telemetry.trace.slow")
         self.recent: deque = deque(maxlen=RECENT_RING)  # (trace, t_end_ns)
         #: armed by SiddhiAppRuntime.profile(); checked by query runtimes
         self.profile = None

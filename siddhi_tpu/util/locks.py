@@ -1,0 +1,435 @@
+"""Named locks + optional lockdep-style runtime lock-order verification.
+
+Every lock in the engine is created through this module with a stable
+``"<subsystem>.<role>"`` name (the catalog lives in docs/CONCURRENCY.md).
+With ``SIDDHI_LOCK_CHECKS`` unset (the default) the factories return the
+raw ``threading`` primitives — zero wrapper, zero overhead, so the
+telemetry A/B budget is untouched. With ``SIDDHI_LOCK_CHECKS=1`` each
+lock is wrapped with a tracker that maintains:
+
+* a per-thread held-stack of lock *names*;
+* a global acquisition-order digraph keyed by name (instances sharing a
+  name unify — the two controller locks live during a blue-green swap
+  are one node, and re-entrant RLock acquisitions add no edge);
+* cycle detection over that digraph, reporting *potential* deadlocks on
+  the first inconsistent ordering without needing the deadlock to fire;
+* held-across-blocking hazards: instrumented blocking sites (device
+  dispatch, WAL fsync, bounded-queue put, HTTP handling) call
+  :func:`note_blocking` and any held lock not on the site's allow-list
+  is reported once.
+
+Findings surface in ``statistics_report()['lockdep']`` and are logged on
+first detection. ``SIDDHI_SCHED_FUZZ=<seed>`` additionally arms seeded
+preemption points at every tracked acquisition (schedule fuzzing in the
+style of util/faults.py): the perturbation schedule — which acquisitions
+stall, and for how long — is a pure function of (seed, lock name,
+per-thread acquisition counter), so a failing seed replays the same
+pressure pattern.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import traceback
+import zlib
+from typing import Iterable, Optional
+
+log = logging.getLogger("siddhi_tpu.locks")
+
+__all__ = [
+    "named_lock", "named_rlock", "named_condition",
+    "checks_enabled", "enable_checks",
+    "note_blocking", "lockdep_report", "lockdep_reset",
+    "set_schedule_fuzz", "schedule_fuzz_seed",
+]
+
+
+def _env_truthy(v: Optional[str]) -> bool:
+    return bool(v) and v.strip().lower() not in ("0", "false", "no", "off")
+
+
+#: module switch — read at factory-call time so tests can flip it before
+#: constructing the locks they want tracked.
+_CHECKS = _env_truthy(os.environ.get("SIDDHI_LOCK_CHECKS"))
+
+#: schedule-fuzz seed (None = off), from SIDDHI_SCHED_FUZZ.
+_FUZZ_SEED: Optional[int] = None
+_fz = os.environ.get("SIDDHI_SCHED_FUZZ", "").strip()
+if _fz:
+    try:
+        _FUZZ_SEED = int(_fz)
+    except ValueError:  # pragma: no cover — operator typo
+        log.warning("SIDDHI_SCHED_FUZZ=%r is not an integer; ignored", _fz)
+
+
+def checks_enabled() -> bool:
+    return _CHECKS
+
+
+def enable_checks(on: bool = True) -> None:
+    """Flip lockdep tracking for locks created *after* this call (tests)."""
+    global _CHECKS
+    _CHECKS = bool(on)
+
+
+def set_schedule_fuzz(seed: Optional[int]) -> None:
+    global _FUZZ_SEED
+    _FUZZ_SEED = None if seed is None else int(seed)
+
+
+def schedule_fuzz_seed() -> Optional[int]:
+    return _FUZZ_SEED
+
+
+# --------------------------------------------------------------------------
+# lockdep state (only touched when checks are enabled)
+# --------------------------------------------------------------------------
+
+_tls = threading.local()           # .stack: list[str] of held lock names
+_reg = threading.Lock()            # guards every structure below
+_lock_names: dict[str, int] = {}   # name -> instances created
+_edges: dict[str, set] = {}        # name -> names acquired while held
+_edge_site: dict = {}              # (a, b) -> formatted stack (first seen)
+_cycles: list = []                 # recorded potential-deadlock findings
+_cycle_keys: set = set()
+_hazards: list = []                # held-across-blocking findings
+_hazard_keys: set = set()
+
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def _fuzz_counter() -> int:
+    n = getattr(_tls, "fuzz_n", 0)
+    _tls.fuzz_n = n + 1
+    return n
+
+
+def _preempt(name: str) -> None:
+    """Seeded preemption point, executed before a tracked acquisition.
+
+    The decision is a CRC over (seed, name, per-thread acquisition index)
+    — deterministic per thread, independent of wall clock. Roughly one in
+    four acquisitions stalls 0.1–0.8 ms, widening the race windows the OS
+    scheduler would otherwise almost never expose.
+    """
+    seed = _FUZZ_SEED
+    if seed is None:
+        return
+    h = zlib.crc32(("%d:%s:%d" % (seed, name, _fuzz_counter())).encode())
+    if h % 4 == 0:
+        time.sleep(0.0001 * (1 + (h >> 8) % 8))
+
+
+def _find_path(src: str, dst: str) -> Optional[list]:
+    """DFS path src ⇝ dst over _edges (caller holds _reg)."""
+    seen = {src}
+    path = [src]
+
+    def walk(node: str) -> bool:
+        for nxt in sorted(_edges.get(node, ())):
+            if nxt == dst:
+                path.append(nxt)
+                return True
+            if nxt not in seen:
+                seen.add(nxt)
+                path.append(nxt)
+                if walk(nxt):
+                    return True
+                path.pop()
+        return False
+
+    return path if walk(src) else None
+
+
+def _site(skip: int = 3) -> str:
+    return "".join(traceback.format_stack(limit=16)[:-skip])
+
+
+def _on_acquired(name: str) -> None:
+    """Bookkeeping after a tracked lock was acquired by this thread."""
+    stack = _stack()
+    if name in stack:           # re-entrant (RLock) or same-name instance
+        stack.append(name)      # (cross-app controller nesting): no edge
+        return
+    if stack:
+        a, b = stack[-1], name
+        with _reg:
+            out = _edges.setdefault(a, set())
+            if b not in out:
+                out.add(b)
+                _edge_site[(a, b)] = _site()
+                back = _find_path(b, a)
+                if back is not None:
+                    cyc = back  # b ... a, closing edge a->b
+                    key = frozenset(cyc)
+                    if key not in _cycle_keys:
+                        _cycle_keys.add(key)
+                        finding = {
+                            "kind": "lock-order-inversion",
+                            "cycle": cyc + [cyc[0]],
+                            "edge": [a, b],
+                            "this_site": _edge_site[(a, b)],
+                            "reverse_site": _edge_site.get(
+                                (cyc[0], cyc[1]), ""),
+                        }
+                        _cycles.append(finding)
+                        log.warning(
+                            "lockdep: potential deadlock — inconsistent "
+                            "lock order %s (new edge %s -> %s)\n%s",
+                            " -> ".join(finding["cycle"]), a, b,
+                            finding["this_site"])
+    stack.append(name)
+
+
+def _on_released(name: str) -> None:
+    stack = _stack()
+    # release order can differ from acquire order; drop the innermost entry
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i] == name:
+            del stack[i]
+            return
+
+
+def note_blocking(kind: str, allow: Iterable[str] = ()) -> None:
+    """Declare that the calling thread is about to block (``kind`` names
+    the operation: "device_dispatch", "wal.fsync", "queue.put",
+    "http.handle", ...). Under lock checks, every held lock not in
+    ``allow`` is reported as a held-across-blocking hazard (once per
+    (kind, lock set)). No-op — one bool test — when checks are off."""
+    if not _CHECKS:
+        return
+    stack = _stack()
+    if not stack:
+        return
+    held = []
+    for n in stack:
+        if n not in allow and n not in held:
+            held.append(n)
+    if not held:
+        return
+    key = (kind, tuple(held))
+    with _reg:
+        if key in _hazard_keys:
+            return
+        _hazard_keys.add(key)
+        finding = {
+            "kind": "held-across-blocking",
+            "blocking": kind,
+            "held": held,
+            "site": _site(),
+        }
+        _hazards.append(finding)
+    log.warning("lockdep: lock(s) %s held across blocking %r\n%s",
+                held, kind, finding["site"])
+
+
+def lockdep_report() -> dict:
+    """Snapshot of the lockdep state; shape carried by
+    ``statistics_report()['lockdep']``."""
+    with _reg:
+        return {
+            "enabled": _CHECKS,
+            "locks": dict(_lock_names),
+            "edges": sorted((a, b) for a, outs in _edges.items()
+                            for b in outs),
+            "cycles": list(_cycles),
+            "hazards": list(_hazards),
+            "fuzz_seed": _FUZZ_SEED,
+        }
+
+
+def lockdep_reset() -> None:
+    """Clear the digraph and findings (tests). Held-stacks of live
+    threads are left alone; call between quiesced phases."""
+    with _reg:
+        _edges.clear()
+        _edge_site.clear()
+        _cycles.clear()
+        _cycle_keys.clear()
+        _hazards.clear()
+        _hazard_keys.clear()
+
+
+# --------------------------------------------------------------------------
+# tracked primitives
+# --------------------------------------------------------------------------
+
+class _TrackedLock:
+    """threading.Lock wrapper feeding the lockdep tracker."""
+
+    __slots__ = ("name", "_inner")
+
+    def __init__(self, name: str, inner=None) -> None:
+        self.name = name
+        self._inner = inner if inner is not None else threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        _preempt(self.name)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _on_acquired(self.name)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        _on_released(self.name)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return f"<named lock {self.name!r} {self._inner!r}>"
+
+
+class _TrackedRLock:
+    """threading.RLock wrapper; exposes _is_owned() for the junction's
+    controller-ownership fast path (stream.py _lock_owned)."""
+
+    __slots__ = ("name", "_inner")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._inner = threading.RLock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        _preempt(self.name)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _on_acquired(self.name)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        _on_released(self.name)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<named rlock {self.name!r} {self._inner!r}>"
+
+
+class _TrackedCondition:
+    """Condition over a tracked lock. wait()/wait_for() fully release the
+    underlying lock, so the held-stack entries for the name are popped for
+    the duration and restored after re-acquisition."""
+
+    __slots__ = ("name", "_lock", "_cv")
+
+    def __init__(self, name: str, lock=None) -> None:
+        self.name = name
+        if lock is None:
+            lock = _TrackedRLock(name)
+        self._lock = lock
+        # build the real Condition on the *raw* primitive; bookkeeping is
+        # done here so Condition's internal _release_save path stays fast
+        self._cv = threading.Condition(lock._inner)
+
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._lock.release()
+
+    def _pop_all(self) -> int:
+        stack = _stack()
+        n = stack.count(self.name)
+        if n:
+            _tls.stack = [s for s in stack if s != self.name]
+        return n
+
+    def _push(self, n: int) -> None:
+        if n:
+            _stack().extend([self.name] * n)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        n = self._pop_all()
+        try:
+            return self._cv.wait(timeout)
+        finally:
+            self._push(n)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        n = self._pop_all()
+        try:
+            return self._cv.wait_for(predicate, timeout)
+        finally:
+            self._push(n)
+
+    def notify(self, n: int = 1) -> None:
+        self._cv.notify(n)
+
+    def notify_all(self) -> None:
+        self._cv.notify_all()
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<named condition {self.name!r}>"
+
+
+# --------------------------------------------------------------------------
+# factories
+# --------------------------------------------------------------------------
+
+def _register(name: str) -> None:
+    with _reg:
+        _lock_names[name] = _lock_names.get(name, 0) + 1
+
+
+def named_lock(name: str):
+    """A mutex named ``"<subsystem>.<role>"``. Raw ``threading.Lock`` by
+    default; lockdep-tracked under SIDDHI_LOCK_CHECKS=1."""
+    if not _CHECKS:
+        return threading.Lock()
+    _register(name)
+    return _TrackedLock(name)
+
+
+def named_rlock(name: str):
+    """Re-entrant variant of :func:`named_lock`."""
+    if not _CHECKS:
+        return threading.RLock()
+    _register(name)
+    return _TrackedRLock(name)
+
+
+def named_condition(name: str, lock=None):
+    """Condition variable over a named lock. ``lock`` may be a tracked
+    lock created by this module (shared conditions) or None for a private
+    re-entrant lock."""
+    if not _CHECKS:
+        return threading.Condition(lock)
+    _register(name)
+    if lock is not None and not isinstance(lock, (_TrackedLock,
+                                                  _TrackedRLock)):
+        # raw primitive slipped in (checks flipped mid-run): wrap it
+        lock = _TrackedLock(name, inner=lock)
+    return _TrackedCondition(name, lock)

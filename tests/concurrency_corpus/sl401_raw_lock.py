@@ -1,0 +1,11 @@
+"""Seeded defect: raw primitives constructed outside util/locks.py."""
+
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self.lock = threading.Lock()          # SL401
+        self.rlock = threading.RLock()        # SL401
+        self.cv = threading.Condition()       # SL401
+        self.ok = threading.Event()           # not a lock: no finding

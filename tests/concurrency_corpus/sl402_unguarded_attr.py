@@ -1,0 +1,24 @@
+"""Seeded defect: self.depth written from a daemon loop AND the public
+caller-thread API with no common guarding lock."""
+
+import threading
+
+from siddhi_tpu.util.locks import named_lock
+
+
+class Pump:
+    def __init__(self):
+        self._lock = named_lock("corpus.pump")
+        self.depth = 0
+        self._t = threading.Thread(target=self._drain_loop, daemon=True)
+
+    def _drain_loop(self):
+        while True:
+            self.depth = self.depth - 1       # entry point #1, unguarded
+
+    def submit(self, n):
+        self.depth = self.depth + n           # entry point #2, unguarded
+
+    def guarded_reset(self):
+        with self._lock:
+            self.depth = 0                    # guarded — but no COMMON guard

@@ -1,0 +1,22 @@
+"""Seeded defect: the two named locks are nested in BOTH orders."""
+
+from siddhi_tpu.util.locks import named_lock
+
+
+class Transfer:
+    def __init__(self):
+        self._accounts = named_lock("corpus.accounts")
+        self._audit = named_lock("corpus.audit")
+        self.balance = 0
+        self.entries = 0
+
+    def debit(self):
+        with self._accounts:                  # accounts -> audit
+            with self._audit:
+                self.balance -= 1
+                self.entries += 1
+
+    def reconcile(self):
+        with self._audit:                     # audit -> accounts: SL403
+            with self._accounts:
+                self.entries = self.balance

@@ -1,0 +1,16 @@
+"""Seeded defect: blocking calls made while a lock is held."""
+
+import os
+import time
+
+from siddhi_tpu.util.locks import named_lock
+
+_lock = named_lock("corpus.slow")
+
+
+def checkpoint(fd, worker):
+    with _lock:
+        time.sleep(0.5)                       # SL404
+        os.fsync(fd)                          # SL404
+        worker.join()                         # SL404 (zero-arg join)
+        ",".join(["a", "b"])                  # str.join: no finding

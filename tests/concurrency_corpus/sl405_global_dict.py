@@ -1,0 +1,15 @@
+"""Seeded defect: module-level mutable registry written without a lock."""
+
+from siddhi_tpu.util.locks import named_lock
+
+_REGISTRY = {}
+_lock = named_lock("corpus.registry")
+
+
+def register_unguarded(name, value):
+    _REGISTRY[name] = value                   # SL405
+
+
+def register_guarded(name, value):
+    with _lock:
+        _REGISTRY[name] = value               # guarded: no finding

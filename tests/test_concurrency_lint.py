@@ -1,0 +1,85 @@
+"""SL40x concurrency lint: seeded-defect corpus + self-lint gate.
+
+Mirrors tests/lint_corpus/: every tests/concurrency_corpus/sl4NN_*.py file
+must produce its filename-prefix rule, and the engine's own source must be
+SL4xx-ERROR-free (the same gate CI runs via `lint --self`).
+"""
+
+from pathlib import Path
+
+import pytest
+
+from siddhi_tpu.analysis import lint_package, lint_python_source
+from siddhi_tpu.analysis.concurrency import package_root
+
+CORPUS = Path(__file__).parent / "concurrency_corpus"
+CORPUS_FILES = sorted(CORPUS.glob("sl4*.py"))
+
+
+def _report_for(path: Path):
+    return lint_python_source(path.read_text(), name=path.name)
+
+
+@pytest.mark.parametrize("path", CORPUS_FILES, ids=lambda p: p.stem)
+def test_corpus_file_triggers_its_rule(path):
+    expected = path.stem.split("_")[0].upper()
+    report = _report_for(path)
+    rules = {d.rule_id for d in report.diagnostics}
+    assert expected in rules, (
+        f"{path.name}: expected {expected}, got {sorted(rules)}\n"
+        + report.format())
+
+
+def test_corpus_is_complete():
+    # one seeded defect per rule in the catalog
+    stems = {p.stem.split("_")[0].upper() for p in CORPUS_FILES}
+    assert stems == {"SL401", "SL402", "SL403", "SL404", "SL405"}
+
+
+def test_sl401_counts_each_primitive():
+    report = _report_for(CORPUS / "sl401_raw_lock.py")
+    sl401 = [d for d in report.diagnostics if d.rule_id == "SL401"]
+    assert len(sl401) == 3  # Lock, RLock, Condition; Event not flagged
+    assert all(d.severity.value == "error" for d in sl401)
+
+
+def test_sl403_is_error_and_names_both_sites():
+    report = _report_for(CORPUS / "sl403_lock_order.py")
+    sl403 = [d for d in report.diagnostics if d.rule_id == "SL403"]
+    assert sl403 and all(d.severity.value == "error" for d in sl403)
+    assert any("corpus.accounts" in d.message and "corpus.audit" in d.message
+               for d in sl403)
+
+
+def test_sl404_spares_str_join():
+    report = _report_for(CORPUS / "sl404_sleep_under_lock.py")
+    sl404 = [d for d in report.diagnostics if d.rule_id == "SL404"]
+    assert len(sl404) == 3  # sleep, fsync, thread join — NOT str.join
+
+
+def test_noqa_comment_suppresses():
+    src = (CORPUS / "sl405_global_dict.py").read_text()
+    src = src.replace("_REGISTRY[name] = value                   # SL405",
+                      "_REGISTRY[name] = value  # noqa: SL405")
+    report = lint_python_source(src, name="suppressed.py")
+    assert not any(d.rule_id == "SL405" for d in report.diagnostics), \
+        report.format()
+
+
+def test_parse_error_reports_sl000():
+    report = lint_python_source("def broken(:\n", name="broken.py")
+    assert any(d.rule_id == "SL000" for d in report.diagnostics)
+    assert report.has_errors
+
+
+def test_self_lint_is_error_free():
+    """The CI zero-ERROR gate: the in-tree runtime must pass its own
+    concurrency catalog."""
+    report = lint_package(package_root())
+    assert not report.has_errors, report.format()
+
+
+def test_self_lint_covers_the_tree():
+    # sanity: the walk actually visited the runtime (not an empty dir scan)
+    report = lint_package(package_root())
+    assert report.app_name.startswith("self:")

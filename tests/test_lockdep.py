@@ -1,0 +1,282 @@
+"""Lockdep runtime verification (siddhi_tpu/util/locks.py).
+
+Covers the tracker itself — a deliberately seeded lock-order inversion is
+detected without the deadlock ever firing, held-across-blocking hazards
+report and respect allow-lists, RLock re-entrancy and Condition.wait's
+full-release are modeled correctly — plus the zero-overhead contract
+(factories return raw primitives when checks are off) and a
+seed-reproducible regression test for the AsyncDecoder @OnError path's
+bounded controller-lock acquire.
+"""
+
+import threading
+import time
+
+import pytest
+
+from siddhi_tpu.util import locks
+
+
+@pytest.fixture(autouse=True)
+def clean_lockdep():
+    """Tracked state on, clean graph; restore module flags afterwards."""
+    prev_checks = locks.checks_enabled()
+    prev_seed = locks.schedule_fuzz_seed()
+    locks.enable_checks(True)
+    locks.set_schedule_fuzz(None)
+    locks.lockdep_reset()
+    yield
+    locks.lockdep_reset()
+    locks.enable_checks(prev_checks)
+    locks.set_schedule_fuzz(prev_seed)
+
+
+class TestFactories:
+    def test_disabled_returns_raw_primitives(self):
+        locks.enable_checks(False)
+        assert type(locks.named_lock("t.raw")) is type(threading.Lock())
+        assert type(locks.named_rlock("t.raw")) is type(threading.RLock())
+        assert isinstance(locks.named_condition("t.raw"),
+                          threading.Condition)
+
+    def test_enabled_registers_names(self):
+        locks.named_lock("t.reg")
+        locks.named_lock("t.reg")
+        assert locks.lockdep_report()["locks"]["t.reg"] == 2
+
+
+class TestCycleDetection:
+    def test_seeded_inversion_is_detected_without_deadlocking(self):
+        """A -> B in one place, B -> A in another: reported as a potential
+        deadlock from the orderings alone — neither thread ever blocks."""
+        a, b = locks.named_lock("t.a"), locks.named_lock("t.b")
+        with a:
+            with b:
+                pass
+        assert locks.lockdep_report()["cycles"] == []
+        with b:
+            with a:
+                pass
+        cycles = locks.lockdep_report()["cycles"]
+        assert len(cycles) == 1
+        c = cycles[0]
+        assert c["kind"] == "lock-order-inversion"
+        assert set(c["cycle"]) == {"t.a", "t.b"}
+        assert c["this_site"]  # the stack that closed the cycle
+
+    def test_same_cycle_reported_once(self):
+        a, b = locks.named_lock("t.a"), locks.named_lock("t.b")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+        assert len(locks.lockdep_report()["cycles"]) == 1
+
+    def test_three_lock_cycle(self):
+        a = locks.named_lock("t.a")
+        b = locks.named_lock("t.b")
+        c = locks.named_lock("t.c")
+        for outer, inner in ((a, b), (b, c), (c, a)):
+            with outer:
+                with inner:
+                    pass
+        cycles = locks.lockdep_report()["cycles"]
+        assert len(cycles) == 1
+        assert set(cycles[0]["cycle"]) == {"t.a", "t.b", "t.c"}
+
+    def test_consistent_order_stays_clean(self):
+        a, b, c = (locks.named_lock(f"t.{x}") for x in "abc")
+        for _ in range(5):
+            with a:
+                with b:
+                    with c:
+                        pass
+        rep = locks.lockdep_report()
+        assert rep["cycles"] == []
+        assert ("t.a", "t.b") in [tuple(e) for e in rep["edges"]]
+
+    def test_rlock_reentrancy_adds_no_edge(self):
+        r = locks.named_rlock("t.re")
+        with r:
+            with r:
+                pass
+        rep = locks.lockdep_report()
+        assert rep["edges"] == [] and rep["cycles"] == []
+
+    def test_same_name_instances_do_not_self_cycle(self):
+        """Two controller locks live during a blue-green swap share one
+        digraph node: nesting them must not report a false inversion."""
+        l1 = locks.named_rlock("t.controller")
+        l2 = locks.named_rlock("t.controller")
+        with l1:
+            with l2:
+                pass
+        assert locks.lockdep_report()["cycles"] == []
+
+
+class TestBlockingHazards:
+    def test_held_lock_is_reported(self):
+        g = locks.named_lock("t.guard")
+        with g:
+            locks.note_blocking("test.fsync")
+        hz = locks.lockdep_report()["hazards"]
+        assert len(hz) == 1
+        assert hz[0]["blocking"] == "test.fsync"
+        assert hz[0]["held"] == ["t.guard"]
+
+    def test_allow_list_suppresses(self):
+        g = locks.named_lock("t.guard")
+        with g:
+            locks.note_blocking("test.fsync", allow=("t.guard",))
+        assert locks.lockdep_report()["hazards"] == []
+
+    def test_reported_once_per_kind_and_held_set(self):
+        g = locks.named_lock("t.guard")
+        for _ in range(4):
+            with g:
+                locks.note_blocking("test.fsync")
+        assert len(locks.lockdep_report()["hazards"]) == 1
+
+    def test_no_lock_held_is_free(self):
+        locks.note_blocking("test.fsync")
+        assert locks.lockdep_report()["hazards"] == []
+
+
+class TestCondition:
+    def test_wait_releases_the_held_name(self):
+        """Condition.wait fully releases its lock — while a thread waits,
+        its held-stack must not pin the name (else every lock taken by the
+        waker would grow false edges from the sleeper's frame)."""
+        cv = locks.named_condition("t.cv")
+        seen = []
+        started = threading.Event()
+
+        def sleeper():
+            with cv:
+                started.set()
+                cv.wait(timeout=5)
+                # restored after wake: blocking note sees the name again
+                locks.note_blocking("t.probe")
+                seen.append(True)
+
+        t = threading.Thread(target=sleeper)
+        t.start()
+        started.wait(timeout=5)
+        time.sleep(0.05)  # sleeper is inside wait(): name must be off-stack
+        with cv:
+            cv.notify_all()
+        t.join(timeout=5)
+        assert seen == [True]
+        hz = locks.lockdep_report()["hazards"]
+        assert any(h["held"] == ["t.cv"] for h in hz)
+
+    def test_wait_for_roundtrip(self):
+        cv = locks.named_condition("t.cv2")
+        flag = []
+
+        def waker():
+            with cv:
+                flag.append(1)
+                cv.notify_all()
+
+        with cv:
+            threading.Timer(0.05, waker).start()
+            assert cv.wait_for(lambda: flag, timeout=5)
+
+
+class TestScheduleFuzz:
+    def test_seed_roundtrip(self):
+        locks.set_schedule_fuzz(42)
+        assert locks.schedule_fuzz_seed() == 42
+        assert locks.lockdep_report()["fuzz_seed"] == 42
+        locks.set_schedule_fuzz(None)
+        assert locks.schedule_fuzz_seed() is None
+
+    def test_fuzzed_acquisitions_still_correct(self):
+        """Preemption points perturb timing only: a counter guarded by a
+        fuzzed lock stays exact across threads."""
+        locks.set_schedule_fuzz(7)
+        g = locks.named_lock("t.fuzzed")
+        state = {"n": 0}
+
+        def bump():
+            for _ in range(200):
+                with g:
+                    state["n"] += 1
+
+        threads = [threading.Thread(target=bump) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert state["n"] == 800
+        assert locks.lockdep_report()["cycles"] == []
+
+
+class TestAsyncDecoderBoundedAcquire:
+    def test_error_path_survives_producer_holding_controller_lock(self):
+        """Regression (found by lockdep + schedule fuzzing, seed 7): a
+        producer that holds the controller lock while blocked on the
+        decoder's bounded submit queue must not deadlock against the
+        delivery thread's @OnError routing, which needs that same lock.
+        The fix bounds the delivery-side acquire (timeout + log fallback),
+        so the pipeline always drains and the producer's put completes."""
+        import numpy as np
+
+        from siddhi_tpu.core.stream import AsyncDecoder
+
+        locks.set_schedule_fuzz(7)  # replayable pressure pattern
+        controller = locks.named_rlock("app.controller")
+
+        class Ctx:
+            controller_lock = controller
+
+        class Junction:
+            ctx = Ctx()
+            on_error_action = None
+            routed = []
+
+            @staticmethod
+            def on_error(e, host):
+                Junction.routed.append(repr(e))
+
+        class Receiver:
+            calls = 0
+
+            @staticmethod
+            def on_batch(host, now):
+                Receiver.calls += 1
+                raise ValueError("decode boom")
+
+        # n must overflow queue(1) + fetch workers + reorder-buffer lag,
+        # or the pipeline absorbs every submit and the put never blocks —
+        # the deadlock needs the producer wedged INSIDE the bounded put
+        dec = AsyncDecoder(maxsize=1)
+        n = dec.N_FETCH + dec._max_lag + 8
+        finished = threading.Event()
+
+        def produce():
+            # the hazardous shape: submit under the controller lock, queue
+            # bounded at 1 — the put WILL block while the lock is held
+            with controller:
+                for i in range(n):
+                    dec.submit(Receiver, np.arange(4, dtype=np.int64), i,
+                               Junction)
+            finished.set()
+
+        t = threading.Thread(target=produce)
+        t.start()
+        # pre-fix this deadlocked: delivery waited forever on the
+        # controller lock, the reorder buffer never drained, the producer's
+        # put never returned
+        assert finished.wait(timeout=30), \
+            "producer deadlocked against the delivery thread"
+        dec.stop()
+        assert Receiver.calls == n  # every batch was attempted
+        # every failure was routed: through @OnError once the lock freed,
+        # or through the log while the producer still held it
+        assert len(Junction.routed) <= n
+        t.join(timeout=5)

@@ -1,0 +1,32 @@
+"""Sanitizer gate for the lock-free columnar ring (native/colring_core.h).
+
+Builds native/colring_stress.c with -fsanitize=thread, then with
+-fsanitize=address,undefined, and runs the multi-producer stress under
+each. A data race, UB, leak, or oracle failure (conservation / integrity /
+checksum / quiescence) fails the test. Skipped when no gcc is available —
+CI always has one, so the protocol stays machine-checked there.
+"""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+SANITIZE = Path(__file__).parent.parent / "native" / "sanitize.sh"
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("gcc") is None, reason="gcc not available")
+
+
+def test_colring_stress_sanitizer_clean(tmp_path):
+    proc = subprocess.run(
+        ["sh", str(SANITIZE), "4", "100000", "512", "17"],
+        capture_output=True, text=True, timeout=300,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin", "TMPDIR": str(tmp_path)},
+    )
+    assert proc.returncode == 0, (
+        f"sanitize.sh failed (rc={proc.returncode})\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+    assert "OK" in proc.stdout
+    assert "clean under tsan and asan+ubsan" in proc.stdout

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Fastpath zero-regression gate (SL204) over the in-tree bench apps.
+
+Every compiled step of every bench-suite app is certified against pjit's
+C++ dispatch fastpath via `analysis.jaxpr_pass.fastpath_certify`: no host
+callback, no ordered effect. Steps listed in KNOWN_VETOED are today's
+accepted hit-list (the device-resident-supersteps roadmap item works it
+down); everything else must certify, and a previously-clean step turning
+vetoed fails CI.
+
+    python tools/fastpath_gate.py [--json]
+
+Exit codes: 0 = no regressions, 1 = a step off the hit-list is vetoed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# one entry per bench config in tools' bench suite (same SiddhiQL texts;
+# the bench functions build them inline so they are restated here)
+APPS = {
+    "filter": """
+    define stream TradeStream (symbol string, price double, volume long);
+    @info(name = 'bench')
+    from TradeStream[700.0 > price]
+    select symbol, price
+    insert into OutStream;
+    """,
+    "groupby": """
+    define stream TradeStream (symbol string, price double, volume long);
+    @info(name = 'bench')
+    from TradeStream#window.lengthBatch(10000)
+    select symbol, sum(price) as total, avg(price) as avgPrice
+    group by symbol
+    insert into SummaryStream;
+    """,
+    "distinct": """
+    define stream TradeStream (symbol string, price double, volume long);
+    @info(name = 'bench')
+    from TradeStream#window.time(60 sec)
+    select distinctCount(symbol) as distinctSymbols
+    insert into OutStream;
+    """,
+    "pattern": """
+    define stream StreamA (val int);
+    define stream StreamB (val int);
+    @info(name = 'bench')
+    from every a=StreamA -> b=StreamB[b.val == a.val] within 5 sec
+    select a.val as aVal, b.val as bVal
+    insert into OutStream;
+    """,
+    "join": """
+    define stream LeftStream (k int, v double);
+    define stream RightStream (k int, v double);
+    @info(name = 'bench')
+    from LeftStream#window.length(100000) as a
+    join RightStream#window.length(100000) as b
+    on a.k == b.k
+    select a.k as k, a.v as lv, b.v as rv
+    insert into OutStream;
+    """,
+    "e2e_ingress": """
+    @app:name('IngressBench')
+    define stream TradeStream (symbol string, price double, volume long);
+    @info(name = 'filt')
+    from TradeStream[price < 700.0]
+    select symbol, price, volume
+    insert into MidStream;
+    @info(name = 'agg')
+    from MidStream#window.lengthBatch(10000)
+    select symbol, sum(price) as total, avg(price) as avgPrice
+    group by symbol
+    insert into SummaryStream;
+    """,
+}
+
+#: accepted vetoes, keyed "<app>:<step>" — the supersteps hit-list.
+#: Adding here requires a written justification next to the entry.
+#:
+#: _host_radix_argsort: on the CPU backend, group-by/distinct/join steps
+#: whose sort width exceeds _RADIX_SORT_MIN_LANES (8192) route through the
+#: C radix argsort pure_callback — a measured win over XLA's comparator
+#: sort at those widths (ops/search.py) that deliberately trades the
+#: fastpath away. The supersteps roadmap item retires these by keeping
+#: the sort on-device inside a K-batch lax.scan.
+KNOWN_VETOED: dict = {
+    "groupby:bench": "_host_radix_argsort above lane threshold (CPU)",
+    "distinct:bench": "_host_radix_argsort above lane threshold (CPU)",
+    "join:bench/left": "_host_radix_argsort above lane threshold (CPU)",
+    "join:bench/right": "_host_radix_argsort above lane threshold (CPU)",
+    "e2e_ingress:agg": "_host_radix_argsort above lane threshold (CPU)",
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    from siddhi_tpu.analysis.jaxpr_pass import fastpath_certify
+
+    results: dict = {}
+    regressions = []
+    for app_name, text in APPS.items():
+        verdicts = fastpath_certify(text)
+        if not verdicts:
+            regressions.append(f"{app_name}: no steps traced")
+        for step, v in verdicts.items():
+            key = f"{app_name}:{step}"
+            results[key] = v
+            if not v["certified"] and key not in KNOWN_VETOED:
+                regressions.append(f"{key}: {'; '.join(v['vetoes'])}")
+    for key in KNOWN_VETOED:
+        if key in results and results[key]["certified"]:
+            # hit-list entry went clean: prune it so it can't regress
+            print(f"note: {key} is now certified — remove it from "
+                  f"KNOWN_VETOED", file=sys.stderr)
+
+    if args.as_json:
+        print(json.dumps({"steps": results,
+                          "regressions": regressions}, indent=2))
+    else:
+        n_cert = sum(1 for v in results.values() if v["certified"])
+        print(f"fastpath gate: {n_cert}/{len(results)} steps certified, "
+              f"{len(regressions)} regression(s)")
+        for r in regressions:
+            print(f"REGRESSION {r}")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""Deterministic interleaving sweep: seeded schedule fuzzing over the
+engine's three racy-by-construction flows, with lockdep certification.
+
+For each seed, `util.locks` arms its seeded preemption points (the
+perturbation schedule is a pure function of seed × lock name × per-thread
+acquisition counter — a failing seed replays the same pressure pattern)
+and three scenarios run against conservation oracles:
+
+  ingress   4 producer threads hammer one @Async stream; every event must
+            arrive exactly once, per-producer FIFO intact.
+  upgrade   a producer streams through a blue-green hot swap; every event
+            is processed by exactly one version — no loss, no dupes.
+  shutdown  SLO ticks, flight-recorder triggers, and statistics_report()
+            race shutdown(); nothing may deadlock or raise.
+
+All scenarios run with SIDDHI_LOCK_CHECKS semantics on (the sweep enables
+tracking in-process), so the run double-checks the acceptance invariant:
+ZERO lock-order cycles and ZERO held-across-blocking hazards on the real
+runtime, under schedule pressure.
+
+    python tools/interleave_sweep.py [--seeds 16] [--base 1000] [--json]
+
+Exit codes: 0 = every seed clean, 1 = an oracle or lockdep finding failed.
+One process, one jax import: a 16-seed sweep stays CI-sized.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from siddhi_tpu import SiddhiManager  # noqa: E402
+from siddhi_tpu.state.persistence import InMemoryPersistenceStore  # noqa: E402
+from siddhi_tpu.util import locks  # noqa: E402
+
+
+# --------------------------------------------------------------------------
+# scenarios — each returns None on success, a failure string otherwise
+# --------------------------------------------------------------------------
+
+def scenario_ingress(seed: int):
+    """4 producers × N events through one @Async junction: conservation +
+    per-producer FIFO (the MPSC ring + feeder + controller path)."""
+    n, producers = 150, 4
+    rt = SiddhiManager().create_siddhi_app_runtime(
+        "@Async(buffer.size='32')\n"
+        "define stream S (producer long, seq long);\n"
+        "@info(name='q') from S select producer, seq insert into Out;")
+    got, gl = [], threading.Lock()
+
+    def cb(ts, ins, removed):
+        with gl:
+            got.extend(tuple(e.data) for e in ins or [])
+
+    rt.add_query_callback("q", cb)
+    rt.start()
+    h = rt.get_input_handler("S")
+
+    def produce(pid):
+        for s in range(n):
+            h.send((pid, s))
+
+    threads = [threading.Thread(target=produce, args=(p,))
+               for p in range(producers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    if any(t.is_alive() for t in threads):
+        return "producer thread wedged"
+    rt.flush()
+    rt.shutdown()
+    if len(got) != n * producers:
+        return f"conservation: {len(got)} != {n * producers}"
+    for p in range(producers):
+        seqs = [s for pid, s in got if pid == p]
+        if seqs != list(range(n)):
+            return f"producer {p} FIFO broken"
+    return None
+
+
+def scenario_upgrade(seed: int):
+    """Producer streams through a blue-green swap; every event lands in
+    exactly one version (core/upgrade.py conservation invariant)."""
+    n = 400
+    v1 = ("@app:name('Sweep')\n"
+          "define stream S (k string, v long);\n"
+          "@info(name='q') from S select k, v insert into Out;")
+    v2 = v1 + "\n@info(name='extra') from S select v insert into Copy;"
+    mgr = SiddhiManager()
+    mgr.set_persistence_store(InMemoryPersistenceStore())
+    rt1 = mgr.create_siddhi_app_runtime(v1, batch_size=8)
+    seen, gl = [], threading.Lock()
+    rt1.add_callback("Out", lambda evs: seen.extend(e.data[1] for e in evs))
+    rt1.start()
+    h = rt1.get_input_handler("S")
+    started = threading.Event()
+
+    def produce():
+        for i in range(n):
+            h.send((f"k{i % 5}", i), timestamp=1_000 + i)
+            if i == n // 8:
+                started.set()
+            if i % 32 == 0:
+                mgr.runtimes["Sweep"].flush()
+
+    t = threading.Thread(target=produce)
+    t.start()
+    started.wait(timeout=30)
+    summary = mgr.upgrade(v2)
+    t.join(timeout=60)
+    if t.is_alive():
+        return "producer wedged across the swap"
+    if summary["status"] != "swapped":
+        return f"upgrade not swapped: {summary['status']}"
+    rt2 = mgr.runtimes["Sweep"]
+    rt2.drain()
+    rt2.shutdown()
+    missing = len([x for x in range(n) if x not in set(seen)])
+    if sorted(seen) != list(range(n)):
+        return (f"conservation across swap: {len(seen)} events, "
+                f"{missing} missing")
+    return None
+
+
+def scenario_shutdown(seed: int):
+    """SLO ticks + recorder triggers + statistics_report racing
+    shutdown(): the telemetry locks vs. teardown."""
+    rt = SiddhiManager().create_siddhi_app_runtime(
+        "@app:name('Tick')\n"
+        "@app:slo(stream='S', p99.ms='50', min.rate='1')\n"
+        "define stream S (k string, v long);\n"
+        "@info(name='q') from S select k, v insert into Out;")
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(64):
+        h.send((f"k{i % 3}", i), timestamp=1_000 + i)
+    rt.flush()
+
+    stop = threading.Event()
+    errors: list = []
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            try:
+                if rt.slo_engine is not None:
+                    rt.slo_engine.tick(now=2_000.0 + i)
+                rt.ctx.recorder.trigger("sweep", reason=f"seed {seed}/{i}")
+                rt.statistics_report()
+            except Exception as e:  # noqa: BLE001 — the oracle
+                errors.append(repr(e))
+                return
+            i += 1
+
+    t = threading.Thread(target=churn)
+    t.start()
+    time.sleep(0.05)
+    done = threading.Event()
+
+    def teardown():
+        rt.shutdown()
+        done.set()
+
+    td = threading.Thread(target=teardown)
+    td.start()
+    if not done.wait(timeout=60):
+        stop.set()
+        return "shutdown wedged against telemetry churn"
+    stop.set()
+    t.join(timeout=30)
+    td.join(timeout=5)
+    if errors:
+        return f"telemetry churn raised: {errors[0]}"
+    return None
+
+
+SCENARIOS = (("ingress", scenario_ingress),
+             ("upgrade", scenario_upgrade),
+             ("shutdown", scenario_shutdown))
+
+
+def run_seed(seed: int) -> dict:
+    locks.enable_checks(True)
+    locks.set_schedule_fuzz(seed)
+    locks.lockdep_reset()
+    out: dict = {"seed": seed, "scenarios": {}, "ok": True}
+    for name, fn in SCENARIOS:
+        t0 = time.monotonic()
+        try:
+            failure = fn(seed)
+        except Exception as e:  # noqa: BLE001 — a crash is a failure too
+            failure = f"raised {e!r}"
+        out["scenarios"][name] = {
+            "failure": failure,
+            "seconds": round(time.monotonic() - t0, 2),
+        }
+        if failure:
+            out["ok"] = False
+    rep = locks.lockdep_report()
+    out["lockdep"] = {"cycles": rep["cycles"], "hazards": rep["hazards"],
+                      "edges": len(rep["edges"]), "locks": len(rep["locks"])}
+    if rep["cycles"] or rep["hazards"]:
+        out["ok"] = False
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seeds", type=int, default=16)
+    ap.add_argument("--base", type=int, default=1000)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    results = []
+    failed = 0
+    for k in range(args.seeds):
+        seed = args.base + k
+        r = run_seed(seed)
+        results.append(r)
+        if not r["ok"]:
+            failed += 1
+        if not args.as_json:
+            secs = sum(s["seconds"] for s in r["scenarios"].values())
+            detail = "; ".join(
+                f"{n}: {s['failure']}" for n, s in r["scenarios"].items()
+                if s["failure"])
+            ld = r["lockdep"]
+            if ld["cycles"] or ld["hazards"]:
+                detail += (f" lockdep: {len(ld['cycles'])} cycle(s) "
+                           f"{len(ld['hazards'])} hazard(s)")
+            print(f"seed {seed}: {'FAIL ' + detail if not r['ok'] else 'ok'}"
+                  f" ({secs:.1f}s, {ld['edges']} edges)")
+            sys.stdout.flush()
+    # findings detail at the end so a failing CI log leads with them
+    for r in results:
+        for c in r["lockdep"]["cycles"]:
+            print(f"seed {r['seed']} CYCLE {' -> '.join(c['cycle'])}\n"
+                  f"{c['this_site']}", file=sys.stderr)
+        for h in r["lockdep"]["hazards"]:
+            print(f"seed {r['seed']} HAZARD {h['held']} held across "
+                  f"{h['blocking']}\n{h['site']}", file=sys.stderr)
+    if args.as_json:
+        print(json.dumps({"results": results, "failed": failed}, indent=2))
+    else:
+        print(f"interleave sweep: {args.seeds - failed}/{args.seeds} "
+              f"seeds clean")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
