@@ -942,6 +942,104 @@ def bench_overload() -> dict:
     return res
 
 
+def bench_disorder() -> dict:
+    """Satellite config: out-of-order ingress through the @app:eventTime
+    gate (core/event_time.py). A seeded bounded-disorder permutation (the
+    shuffled-replay oracle's model: displacement < allowed.lateness) feeds
+    the gate, with a deliberate 1-in-128 straggler BEYOND the budget.
+    Reports the sustained gated rate, the displaced-row share, exact late
+    diversions (must equal the injected stragglers — zero silent drops),
+    and the gate's conservation identity."""
+    import random as _random
+
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.upgrade import _bounded_shuffle
+
+    res = {"metric": "disorder_gated_events_per_sec"}
+    if E2E_ONLY:  # host-side gate: no tunnel/topology split
+        return res
+    app = """
+    @app:name('Disorder')
+    @app:eventTime(timestamp='ts', allowed.lateness='50')
+    define stream TradeStream (ts long, v long);
+    @info(name = 'bench')
+    from TradeStream select ts, v insert into OutStream;
+    """
+    rt = SiddhiManager().create_siddhi_app_runtime(app)
+    delivered = [0]
+    rt.add_callback("OutStream", lambda blk: delivered.__setitem__(
+        0, delivered[0] + blk.count), columnar=True)
+    rt.start()
+    h = rt.get_input_handler("TradeStream")
+
+    # sensor-fleet shape: 16 rows per 10 ms event-time tick (so per-ts
+    # delivery groups stay batch-sized), displaced by the oracle's bounded
+    # shuffle; epoch-ms base keeps the telemetry plausibility window open
+    epoch = 1_700_000_000_000
+    n_pre, per_tick, batch = 8192, 16, 256
+    ordered = [("S", epoch + (i // per_tick) * 10,
+                (epoch + (i // per_tick) * 10, i)) for i in range(n_pre)]
+    shuffled = _bounded_shuffle(ordered, 50, RNG_SEED)
+    displaced = sum(1 for a, b in zip(ordered, shuffled) if a is not b)
+    rng = _random.Random(RNG_SEED)
+    rows, stragglers = [], 0
+    for _sid, ts, row in shuffled:
+        if rng.randrange(128) == 0:  # beyond-budget straggler: must divert
+            rows.append((ts - 10_000, row[1]))
+            stragglers += 1
+        else:
+            rows.append(row)
+    batches = [rows[i:i + batch] for i in range(0, len(rows), batch)]
+
+    _phase("disorder:warmup")
+    h.send_batch(batches[0])
+    rt.flush()
+    sent = len(batches[0])
+
+    _phase("disorder:feed")
+    t0 = time.perf_counter()
+    t_end = t0 + 4.0
+    loops = 0
+    while time.perf_counter() < t_end:
+        cycle, idx = divmod(loops, len(batches) - 1)
+        b = batches[1 + idx]
+        if cycle:
+            # each recycle re-bases event time above the released horizon
+            # so recycled batches don't all classify late
+            shift = cycle * 100_000_000
+            b = [(ts + shift, v) for ts, v in b]
+        h.send_batch(b)
+        rt.flush()
+        sent += len(b)
+        loops += 1
+    rt.release_watermarks()
+    elapsed = time.perf_counter() - t0
+    rt.shutdown()
+
+    wm = rt.statistics_report()["watermarks"]["TradeStream"]
+    expected_late = stragglers * max(1, loops // max(1, len(batches) - 1))
+    res.update({
+        "value": round(delivered[0] / elapsed, 1),
+        "unit": "events/sec",
+        "vs_baseline": round(
+            delivered[0] / elapsed
+            / _baseline_for("disorder_gated_events_per_sec"), 3),
+        "sent": sent,
+        "displaced_share": round(displaced / n_pre, 3),
+        "lateness_ms": 50,
+        "late_diverted": wm["late"],
+        "late_expected_about": expected_late,
+        "buffered_after_drain": wm["buffered"],
+        "conservation_ok":
+            wm["admitted"] == wm["released"] + wm["late"] + wm["buffered"]
+            and wm["buffered"] == 0
+            and delivered[0] == wm["released"],
+    })
+    _partial(res)
+    res.update(_preflight(app))
+    return res
+
+
 def bench_upgrade() -> dict:
     """Satellite config: blue-green hot-swap (core/upgrade.py) committed in
     the middle of sustained public-path traffic. Reports the source-paused
@@ -1379,6 +1477,8 @@ CONFIGS = {
     "pattern": bench_pattern,
     "join": bench_join,
     "overload": bench_overload,  # bounded ingress under 10x overload
+    "disorder": bench_disorder,  # out-of-order ingress through the
+    # @app:eventTime gate: gated rate + exact late-diversion counts
     "upgrade": bench_upgrade,  # blue-green hot-swap under live traffic
     "groupby": bench_groupby,
     "e2e_ingress": bench_e2e_ingress,  # wire→pipeline→device rate

@@ -530,6 +530,58 @@ def shareable_work(plan: PlanGraph) -> Iterable:
                        f"its stream hosts shareable work: {reason}")
 
 
+# ------------------------------------------------------------------- SL116
+
+
+_EXTERNAL_TIME_WINDOWS = {"externaltime", "externaltimebatch"}
+
+
+@rule("SL116", Severity.ERROR,
+      "externalTime window fed from an @Async(workers>1) multi-producer "
+      "stream with no @app:eventTime lateness declared: racing producers "
+      "make the max-seen watermark nondeterministic")
+def racing_external_time(plan: PlanGraph) -> Iterable:
+    # N ingress workers race each other into the columnar ring, so the order
+    # the window sees — and therefore every max-seen watermark advance and
+    # pane close — varies run to run. @app:eventTime(allowed.lateness=...)
+    # is the fix: the ingress gate re-sorts arrivals by event time (bounded
+    # by the lateness budget) before the device ever sees them.
+    et_ann = plan.app.annotation("app:eventTime")
+    if et_ann is not None and et_ann.element("allowed.lateness"):
+        return
+
+    def workers(sid: str) -> int:
+        schema = plan.schemas.get(sid)
+        d = schema.defn if schema else None
+        if d is None or not getattr(d, "annotations", None):
+            return 0
+        ann = next((a for a in d.annotations
+                    if a.name.lower() == "async"), None)
+        if ann is None:
+            return 0
+        try:
+            return int(ann.element("workers") or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    for node in plan.queries:
+        for c in node.consumed:
+            w = c.single.handlers.window
+            if w is None or w.name.lower() not in _EXTERNAL_TIME_WINDOWS:
+                continue
+            n = workers(c.stream_id)
+            if n > 1:
+                fix = ("declare @app:eventTime(timestamp='<attr>', "
+                       "allowed.lateness='...') so arrivals sort before "
+                       "the window" if et_ann is None else
+                       "add allowed.lateness to @app:eventTime")
+                yield _q(node, f"#window.{w.name} consumes "
+                               f"{c.stream_id!r} which @Async(workers={n}) "
+                               "fills from racing producers: the max-seen "
+                               "event-time watermark (and every pane close) "
+                               f"becomes nondeterministic — {fix}")
+
+
 def check_query(query: Query) -> None:
     """Hook for future per-query API use; kept minimal."""
     _ = query

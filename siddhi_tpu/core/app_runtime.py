@@ -111,6 +111,28 @@ class SiddhiAppRuntime:
             self.wal = WriteAheadLog(wal_dir, app.name)
         self.ctx.error_store = error_store
         self.ctx.config_manager = config_manager
+        # out-of-order event time: @app:eventTime(timestamp='ts',
+        # allowed.lateness='5 sec', idle.timeout='1 min') — parsed BEFORE
+        # _build() (query runtimes read ctx.event_time to put externalTime
+        # windows into watermark-driven emission), gates attached AFTER
+        # (they hang off the built ingress junctions)
+        et_ann = app.annotation("app:eventTime")
+        self.ctx.event_time = None
+        if et_ann is not None:
+            from .event_time import EventTimeConfig
+            from .partition import _parse_annotation_time
+            attr = et_ann.element("timestamp") or et_ann.element()
+            if not attr:
+                raise SiddhiAppCreationError(
+                    "@app:eventTime needs a timestamp attribute: "
+                    "@app:eventTime(timestamp='ts', ...)")
+            lat = et_ann.element("allowed.lateness")
+            idle = et_ann.element("idle.timeout")
+            self.ctx.event_time = EventTimeConfig(
+                attr=attr,
+                lateness_ms=int(_parse_annotation_time(lat)) if lat else 0,
+                idle_timeout_ms=int(_parse_annotation_time(idle))
+                if idle else None)
         from .event import StringTable
         self.ctx.global_strings = StringTable()
         from ..telemetry import AppTelemetry
@@ -161,6 +183,32 @@ class SiddhiAppRuntime:
             # reproducible from their inputs
             for sid in app.stream_definitions:
                 self.junctions[sid].wal = self.wal
+
+        if self.ctx.event_time is not None:
+            # event-time gates on INGRESS junctions carrying the annotated
+            # attribute (derived streams inherit sorted order from their
+            # inputs, so they never gate). WAL note: rows journal at send
+            # time, BEFORE the gate — replay re-runs them through it, so
+            # buffered/late classification survives a crash.
+            cfg = self.ctx.event_time
+            from ..query_api.definition import AttributeType as _AT
+            gated = 0
+            for sid, sd in app.stream_definitions.items():
+                attr = next((a for a in sd.attributes
+                             if a.name == cfg.attr), None)
+                if attr is None:
+                    continue
+                if attr.type not in (_AT.INT, _AT.LONG):
+                    raise SiddhiAppCreationError(
+                        f"@app:eventTime: attribute {cfg.attr!r} on stream "
+                        f"{sid!r} must be INT or LONG (epoch ms), got "
+                        f"{attr.type.name}")
+                self.junctions[sid].attach_event_time(cfg)
+                gated += 1
+            if gated == 0:
+                raise SiddhiAppCreationError(
+                    f"@app:eventTime: no stream defines the timestamp "
+                    f"attribute {cfg.attr!r}")
 
         # SLO engine (@app:slo / per-query @slo; None when undeclared) and
         # the always-on flight recorder — built AFTER _build() so objective
@@ -609,6 +657,16 @@ class SiddhiAppRuntime:
             logging.getLogger("siddhi_tpu").warning(
                 "shutdown discarded %d staged row(s) (see statistics "
                 "recovery.shutdown_discarded)", remaining)
+        if drain and self.ctx.event_time is not None:
+            # rows the event-time gates still hold are REAL accepted events:
+            # deliver them (watermark jumps to max seen) rather than letting
+            # shutdown silently eat the tail of every pane
+            import logging
+            try:
+                self.release_watermarks()
+            except Exception:  # noqa: BLE001 — shutdown must complete
+                logging.getLogger("siddhi_tpu").exception(
+                    "releasing event-time watermarks at shutdown failed")
         for j in self.junctions.values():
             j.stop_async()
         if self.ctx.decoder is not None:
@@ -785,6 +843,16 @@ class SiddhiAppRuntime:
         if self.ctx.decoder is not None:
             self.ctx.decoder.drain()
 
+    def release_watermarks(self, now: Optional[int] = None) -> None:
+        """End-of-stream drain for @app:eventTime: force every gate's
+        watermark to its max seen event time and deliver the held rows in
+        event-time order. Stragglers sent afterwards classify as late
+        (replayable), never as out-of-order emissions."""
+        for j in self.junctions.values():
+            if j._et is not None:
+                j.release_event_time(now)
+        self.flush(now)
+
     def heartbeat(self, now: Optional[int] = None) -> None:
         """Advance watermarks: flush + deliver empty timer batches to queries
         with time-driven windows (the reference Scheduler's TIMER events).
@@ -814,6 +882,12 @@ class SiddhiAppRuntime:
                 continue
             j = getattr(qr, "input_junction", None)
             if j is not None and id(j) not in seen:
+                seen.add(id(j))
+                j.heartbeat(t)
+        for j in self.junctions.values():
+            # event-time gates ride the heartbeat too (idle.timeout release)
+            # even when no consumer has time semantics
+            if j._et is not None and id(j) not in seen:
                 seen.add(id(j))
                 j.heartbeat(t)
         # overflow counters warn from the heartbeat too, not only when the

@@ -130,6 +130,10 @@ class Statistics:
     breaker_opens: dict = field(default_factory=dict)
     breaker_failures: dict = field(default_factory=dict)
     breaker_diverted: dict = field(default_factory=dict)  # rows diverted
+    #: @app:eventTime rows diverted behind the watermark (kind="late"),
+    #: keyed by stream — tracked regardless of level, like sink_*: a
+    #: diverted row is a correctness signal, not a metric
+    late_events: dict = field(default_factory=dict)
 
     @property
     def detail(self) -> bool:
@@ -206,6 +210,12 @@ class Statistics:
     def track_breaker_divert(self, query: str, n: int) -> None:
         self.breaker_diverted[query] = self.breaker_diverted.get(query, 0) + n
 
+    def track_late(self, stream_id: str, n: int) -> None:
+        """Rows diverted to the ErrorStore as kind="late" (event time behind
+        the watermark). Exact by construction: every gated row either
+        delivers, buffers, or increments this once."""
+        self.late_events[stream_id] = self.late_events.get(stream_id, 0) + n
+
     def track_recovery(self, replayed: int) -> None:
         self.recoveries += 1
         self.wal_replayed += replayed
@@ -263,6 +273,7 @@ class Statistics:
         self.breaker_opens.clear()
         self.breaker_failures.clear()
         self.breaker_diverted.clear()
+        self.late_events.clear()
         self.recoveries = 0
         self.wal_replayed = 0
         self.shutdown_discarded = 0
@@ -343,6 +354,18 @@ class Statistics:
                     "dropped_error_entries":
                         es.dropped_count(runtime.app.name),
                 }
+            wms = {}
+            for sid, j in runtime.junctions.items():
+                et = getattr(j, "_et", None)
+                if et is not None:
+                    wms[sid] = et.snapshot()
+            if wms:
+                # event-time gates (core/event_time.py): watermark position,
+                # reorder-buffer depth, and the exactly-once accounting
+                # (admitted == released + late + buffered)
+                out["watermarks"] = wms
+            if self.late_events:
+                out["late_events"] = dict(self.late_events)
             breakers = {}
             for name, qr in runtime.query_runtimes.items():
                 br = getattr(qr, "breaker", None)
@@ -462,6 +485,9 @@ class SiddhiAppContext:
     #: telemetry.FlightRecorder — always-on evidence ring + anomaly-triggered
     #: diagnostic bundles (set by SiddhiAppRuntime after build)
     recorder: object = None
+    #: event_time.EventTimeConfig parsed from @app:eventTime (None = arrival
+    #: time); read by query runtimes (window lateness) and ingress gates
+    event_time: object = None
 
     @property
     def effective_batch_size(self) -> int:

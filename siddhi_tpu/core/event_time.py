@@ -1,0 +1,271 @@
+"""Event-time processing: per-stream watermarks, allowed lateness, and a
+deterministic reorder gate — the host half of out-of-order handling.
+
+Reference semantics: the operator-semantics survey's bounded-disorder model
+(watermark = max event time seen − allowed lateness; events older than the
+watermark are LATE). The reference engine's externalTime windows assume the
+producer delivers in event-time order; with "millions of devices" feeding one
+stream that assumption fails, and a max-seen watermark silently folds late
+rows into the wrong pane.
+
+Declared per app as
+
+    @app:eventTime(timestamp='ts', allowed.lateness='5 sec',
+                   idle.timeout='1 min')
+
+and attached by the app runtime to every INGRESS junction whose stream
+carries the timestamp attribute. The gate sits at the junction's single
+row->EventBatch choke point (`StreamJunction._flush_rows`):
+
+  admit    each row's event time is read from the annotated attribute; rows
+           older than the current watermark divert to the ErrorStore as
+           REPLAYABLE `kind="late"` entries (never silently dropped); the
+           rest enter a min-heap keyed (event_ts, arrival_seq)
+  release  once the watermark (max_ts − allowed.lateness) passes a buffered
+           row's event time, the row is emitted — in event-time order, with
+           the EVENT time as its batch timestamp
+
+so the stream the device sees is sorted by event time regardless of arrival
+order. That re-binding of the time axis is what makes the downstream plane
+deterministic: junction fan-out, fused SharedStepGroups, join sides, and
+pattern states all consume the same sorted batches, and the device-side
+externalTime watermark (ops/windows.py) merely *lags* it by allowed.lateness
+to keep panes open for the gate's in-flight rows.
+
+Determinism contract (proved by the shuffled-replay oracle in
+core/upgrade.py): for any arrival permutation whose event-time displacement
+is bounded by allowed.lateness, the released sequence — and therefore every
+downstream output — is bit-identical to the in-order run, with zero late
+diversions. Beyond the bound, rows divert to the side output where
+`/errors/replay` re-admits them through `bypass()` for corrected
+(upsert-style) re-emission.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+
+@dataclass(frozen=True)
+class EventTimeConfig:
+    """Parsed `@app:eventTime(...)` (core/app_runtime.py)."""
+
+    #: stream attribute (INT/LONG) carrying the event's occurrence time (ms)
+    attr: str
+    #: bounded-disorder budget: watermark = max_ts − lateness_ms
+    lateness_ms: int = 0
+    #: wall-clock idle window after which buffered rows are force-released
+    #: (heartbeat-driven; None = hold until data or an explicit release)
+    idle_timeout_ms: Optional[int] = None
+
+
+class EventTimeGate:
+    """Per-junction watermark generator + reorder buffer.
+
+    All mutation happens under the app controller lock: `admit` runs inside
+    `StreamJunction._flush_rows` (flush holds the lock), and `bypass()` takes
+    the lock for the whole replay so a concurrent producer flush can never
+    slip rows through the gate while the late-admission flag is up.
+    """
+
+    def __init__(self, junction, cfg: EventTimeConfig) -> None:
+        self.junction = junction
+        self.cfg = cfg
+        names = [a.name for a in junction.definition.attributes]
+        self.attr_idx = names.index(cfg.attr)
+        self.stream = junction.definition.id
+        #: max event time ever admitted (None until the first row)
+        self.max_ts: Optional[int] = None
+        #: watermark floor left behind by a forced release (idle timeout /
+        #: shutdown drain): rows older than a released row must not later
+        #: sneak out in front of it, so the floor pins the watermark at the
+        #: drained max even though max_ts − lateness sits below it
+        self._wm_floor: Optional[int] = None
+        self._heap: list = []  # (event_ts, seq, arrival_ts, row)
+        self._seq = 0
+        self._bypass = 0
+        self._last_wm: Optional[int] = None
+        self._last_admit = time.monotonic()
+        # conservation counters: admitted == released + late + buffered()
+        self.admitted = 0
+        self.released = 0
+        self.late = 0
+        self.bypassed = 0
+
+    # ------------------------------------------------------------- watermark
+
+    def watermark(self) -> Optional[int]:
+        if self.max_ts is None:
+            return self._wm_floor
+        wm = self.max_ts - self.cfg.lateness_ms
+        if self._wm_floor is not None and self._wm_floor > wm:
+            wm = self._wm_floor
+        return wm
+
+    def buffered(self) -> int:
+        return len(self._heap)
+
+    # ----------------------------------------------------------- admit/release
+
+    def admit(self, tss: Sequence[int], rows: Sequence):
+        """Gate one flushed row batch. Returns a list of (event_tss, rows)
+        delivery groups — the rows the watermark has passed, sorted by
+        event time, timestamped WITH their event time, grouped per
+        `_group` — and diverts watermark-older rows to the ErrorStore
+        (kind="late") via the junction. Per-row classification depends
+        only on the arrival prefix, never on how producers happened to
+        chop the batches."""
+        idx = self.attr_idx
+        heap = self._heap
+        bypass = self._bypass > 0
+        late: list = []
+        for ts, row in zip(tss, rows):
+            try:
+                ets = int(row[idx])
+            except (TypeError, ValueError):
+                late.append((ts, row))  # unreadable event time: side output
+                continue
+            if not bypass:
+                wm = self.watermark()
+                if wm is not None and ets < wm:
+                    late.append((ets, row))
+                    continue
+            else:
+                self.bypassed += 1
+            if self.max_ts is None or ets > self.max_ts:
+                self.max_ts = ets
+            self._seq += 1
+            heapq.heappush(heap, (ets, self._seq, ts, row))
+        self.admitted += len(rows)
+        self.late += len(late)
+        released: list = []
+        wm = self.watermark()
+        if wm is not None:
+            # lateness > 0 releases STRICTLY below the watermark: a row
+            # with ets == wm is still admissible (the late check is
+            # `ets < wm`), so releasing at equality could split its
+            # distinct-ts delivery group across two flushes in some
+            # arrival orders — the one seam in the determinism proof.
+            # Holding until wm passes ets means every non-late row with
+            # that ts has already arrived when the group delivers.
+            # lateness == 0 keeps `<=` so in-order streams pass through
+            # with no one-event delay (pure sorter mode).
+            strict = bool(self.cfg.lateness_ms)
+            while heap and (heap[0][0] < wm if strict
+                            else heap[0][0] <= wm):
+                ets, _seq, _ats, row = heapq.heappop(heap)
+                released.append((ets, row))
+        self.released += len(released)
+        if late:
+            self.junction._divert_late(late)
+        if wm is not None and wm != self._last_wm:
+            self._last_wm = wm
+            self._on_advance(wm)
+        self._last_admit = time.monotonic()
+        return self._group(released)
+
+    def release_all(self):
+        """Force the watermark to max_ts and drain the buffer (shutdown /
+        runtime.release_watermarks() / idle timeout) — sorted, exactly-once.
+        The watermark floor stays at the drained max so stragglers arriving
+        afterwards classify as late instead of emitting out of order."""
+        heap = self._heap
+        released: list = []
+        while heap:
+            ets, _seq, _ats, row = heapq.heappop(heap)
+            released.append((ets, row))
+        self.released += len(released)
+        if self.max_ts is not None and (self._wm_floor is None
+                                        or self.max_ts > self._wm_floor):
+            self._wm_floor = self.max_ts
+        wm = self.watermark()
+        if wm is not None and wm != self._last_wm:
+            self._last_wm = wm
+            self._on_advance(wm)
+        return self._group(released)
+
+    def maybe_idle(self):
+        """Heartbeat hook: when idle.timeout wall-clock has passed with no
+        admissions and rows are still buffered, release them — an idle
+        stream must not hold its panes open forever."""
+        cfg = self.cfg
+        if (cfg.idle_timeout_ms is None or not self._heap
+                or (time.monotonic() - self._last_admit) * 1000.0
+                < cfg.idle_timeout_ms):
+            return []
+        return self.release_all()
+
+    def _group(self, released):
+        """Chop released (event_ts, row) pairs into delivery batches — one
+        batch per distinct event time, rows inside a batch in a
+        content-canonical order. Every row carrying event time t releases
+        at the same watermark crossing in EVERY lateness-bounded arrival
+        order, so per-ts batch boundaries (and therefore per-batch
+        aggregate emissions downstream) are permutation-invariant — the
+        property the shuffled-replay oracle certifies. With lateness 0 the
+        gate is a pure pass-through sorter: arrival batching is kept as-is
+        (nothing buffers, so there is no determinism to buy and no
+        batching worth shattering)."""
+        if not released:
+            return []
+        if not self.cfg.lateness_ms:
+            return [([e for e, _ in released], [r for _, r in released])]
+        groups: list = []
+        cur = object()
+        for ets, row in released:
+            if ets != cur:
+                groups.append(([], []))
+                cur = ets
+            g = groups[-1]
+            g[0].append(ets)
+            g[1].append(row)
+        for _tss_g, rows_g in groups:
+            if len(rows_g) > 1:
+                rows_g.sort(key=repr)  # arrival order is not reproducible
+        return groups
+
+    @contextmanager
+    def bypass(self):
+        """Late-admission window for ErrorStore replay: rows flushed while
+        the flag is up skip the lateness check and re-enter the sorted
+        buffer (releasing immediately when older than the watermark), so a
+        replayed correction flows to sinks instead of re-diverting forever.
+        Holds the controller lock for the whole window: no concurrent
+        producer flush can ride the bypass."""
+        with self.junction.ctx.controller_lock:
+            self._bypass += 1
+            try:
+                yield
+            finally:
+                self._bypass -= 1
+
+    # --------------------------------------------------------------- reporting
+
+    def _on_advance(self, wm_ms: int) -> None:
+        tele = getattr(self.junction.ctx, "telemetry", None)
+        if tele is None:
+            return
+        tele.record_watermark(self.stream, wm_ms)
+        if self.max_ts is not None:
+            # delivery lag re-sampled at every watermark advance (not just
+            # at delivery) so an idle stream's lag gauge keeps moving
+            tele.record_lag(self.stream, self.max_ts)
+
+    def snapshot(self) -> dict:
+        """statistics_report()["watermarks"][stream]."""
+        return {
+            "attr": self.cfg.attr,
+            "lateness_ms": self.cfg.lateness_ms,
+            "idle_timeout_ms": self.cfg.idle_timeout_ms,
+            "watermark": self.watermark(),
+            "max_event_ts": self.max_ts,
+            "buffered": self.buffered(),
+            "admitted": self.admitted,
+            "released": self.released,
+            "late": self.late,
+            "bypassed": self.bypassed,
+        }

@@ -182,6 +182,20 @@ class SiddhiManager:
         return replay_wal(self, self._parse(app), wal_dir,
                           app_name=app_name, speed=speed)
 
+    def shuffled_replay(self, app: Union[str, "SiddhiApp"],
+                        wal_dir: Optional[str] = None, *,
+                        app_name: Optional[str] = None, seeds: int = 16,
+                        arrivals: Optional[list] = None) -> dict:
+        """@app:eventTime determinism oracle: replay one event set (from a
+        WAL or an explicit ``(stream, ts, row)`` list) in event-time order
+        plus `seeds` lateness-bounded arrival permutations, asserting
+        bit-identical output digests and zero late diversions. See
+        core/upgrade.py shuffled_replay and docs/EVENT_TIME.md."""
+        from .upgrade import shuffled_replay
+        return shuffled_replay(self, self._parse(app), wal_dir,
+                               app_name=app_name, seeds=seeds,
+                               arrivals=arrivals)
+
     def set_persistence_store(self, store) -> None:
         """Reference: SiddhiManager.setPersistenceStore — shared by all apps."""
         self.persistence_store = store

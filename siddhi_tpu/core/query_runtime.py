@@ -269,6 +269,14 @@ class QueryRuntime(Receiver):
             registry.validate_params(ExtensionKind.WINDOW, wh.namespace,
                                      wh.name, params, what="window")
             self.window: WindowOp = factory.make(layout, batch_cap, params, expired_on)
+            et = getattr(ctx, "event_time", None)
+            if (et is not None and et.lateness_ms
+                    and getattr(self.window, "ts_attr", None) is not None):
+                # @app:eventTime + externalTime(Batch): watermark-driven
+                # emission — the device watermark trails max-seen by the
+                # allowed lateness so panes stay open for rows the ingress
+                # gate still buffers. Set BEFORE first trace (static attr).
+                self.window.lateness_ms = int(et.lateness_ms)
         else:
             self.window = PassThroughWindow(layout, batch_cap)
         # ExpressionWindow shares SlidingState + FIFO suffix semantics, so

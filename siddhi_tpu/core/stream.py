@@ -522,6 +522,11 @@ class StreamJunction:
         #: (pre-interning) values — v1 and v2 own separate string tables,
         #: so encoded columns/codes must never cross the boundary
         self._redirect: Optional["StreamJunction"] = None
+        #: event-time gate (core/event_time.py) — attached by the app
+        #: runtime when @app:eventTime names an attribute of this stream;
+        #: interposes at _flush_rows so delivery is sorted by event time
+        #: and watermark-older rows divert to the ErrorStore (kind="late")
+        self._et = None
 
     def _pad_cap(self, m: int) -> int:
         """Delivery capacity for `m` staged rows: the smallest power-of-two
@@ -878,6 +883,7 @@ class StreamJunction:
             return
         if (self.ingress_workers > 0 and self.overflow_policy == "block"
                 and self.wal is None and not self.taps
+                and self._et is None
                 and not self.codec.object_attrs):
             from .ingress import IngressPipeline
             try:
@@ -1070,6 +1076,19 @@ class StreamJunction:
         self._maybe_resume()
 
     def _flush_rows(self, rows, tss, now) -> None:
+        if self._et is not None:
+            # event-time gate: late rows divert (kind="late"), the rest
+            # buffer until the watermark passes them; what comes back is
+            # sorted by event time, timestamped WITH event time, and (for
+            # lateness > 0) grouped one delivery batch per distinct event
+            # time, so the device plane sees an in-order stream with
+            # arrival-permutation-invariant batch boundaries
+            for g_tss, g_rows in self._et.admit(tss, rows):
+                self._emit_rows(g_rows, g_tss, now)
+            return
+        self._emit_rows(rows, tss, now)
+
+    def _emit_rows(self, rows, tss, now) -> None:
         cap = self.batch_size
         n = len(rows)
         tele = getattr(self.ctx, "telemetry", None)
@@ -1154,12 +1173,58 @@ class StreamJunction:
             "%s; %d event(s) dropped (no fault stream or error store)",
             msg, len(events))
 
+    def _divert_late(self, rows: list) -> None:
+        """Events older than the watermark leave through a REPLAYABLE side
+        output — ErrorStore `kind="late"` entries carrying the original
+        (event_ts, row) pairs so `/errors/replay` can re-admit them through
+        the gate's bypass for corrected re-emission. Never silent: the late
+        counters are exact by construction."""
+        et = self._et
+        msg = (f"late arrival on {self.definition.id!r}: event time behind "
+               f"the watermark (allowed.lateness="
+               f"{et.cfg.lateness_ms if et is not None else 0} ms)")
+        self.ctx.statistics.track_late(self.definition.id, len(rows))
+        tele = getattr(self.ctx, "telemetry", None)
+        if tele is not None:
+            tele.record_late(self.definition.id, len(rows))
+        store = getattr(self.ctx, "error_store", None)
+        if store is not None:
+            store.save(self.ctx.name, self.definition.id,
+                       [(ts, tuple(row)) for ts, row in rows], msg,
+                       kind="late")
+            return
+        logging.getLogger("siddhi_tpu").warning(
+            "%s; %d row(s) dropped (no error store to divert to)",
+            msg, len(rows))
+
+    def attach_event_time(self, cfg) -> None:
+        """App runtime hook: install the @app:eventTime gate (build time,
+        before start_async, so the pipeline gate below sees it)."""
+        from .event_time import EventTimeGate
+        self._et = EventTimeGate(self, cfg)
+
+    def release_event_time(self, now: Optional[int] = None) -> None:
+        """Drain the event-time gate: staged rows pass the gate first, then
+        the watermark jumps to max_ts and every buffered row delivers in
+        event-time order (end-of-stream / shutdown / explicit drain)."""
+        if self._et is None:
+            return
+        with self.ctx.controller_lock:
+            self.flush(now)
+            for g_tss, g_rows in self._et.release_all():
+                self._emit_rows(g_rows, g_tss, now)
+
     def heartbeat(self, now: int) -> None:
         """Advance time with no data: flush staged rows then deliver an empty
         batch so time-window expirations fire (the watermark analogue of the
         reference's Scheduler TIMER events, core/util/Scheduler.java:48)."""
         with self.ctx.controller_lock:
             self.flush(now)
+            if self._et is not None:
+                # idle.timeout elapsed with rows still held: release them —
+                # an idle stream must not pin its panes open forever
+                for g_tss, g_rows in self._et.maybe_idle():
+                    self._emit_rows(g_rows, g_tss, now)
             # timer batches carry no rows: the smallest lane bucket keeps
             # idle heartbeats off the full-capacity kernel
             empty = EventBatch.empty(self.definition, self._pad_cap(0))
@@ -1297,10 +1362,11 @@ class InputHandler:
             if ts_arr.shape[0] < n:
                 raise ValueError(
                     f"send_columns: {n} rows but {ts_arr.shape[0]} timestamps")
-        if j.taps:
-            # multi-stream sequences consume rows in send order: fall back
-            # to the row path with the ORIGINAL (un-encoded) values, in
-            # declaration order with OBJECT attrs included
+        if j.taps or j._et is not None:
+            # multi-stream sequences consume rows in send order, and the
+            # event-time gate classifies/reorders host rows BEFORE batch
+            # formation: both fall back to the row path with the ORIGINAL
+            # (un-encoded) values, in declaration order with OBJECT attrs
             lists = []
             for a in j.definition.attributes:
                 if a.name in columns:

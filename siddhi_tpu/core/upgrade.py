@@ -29,6 +29,12 @@ v1 and v2 own separate string tables.
     digest across runs of the same segments; `speed` paces the virtual
     clock against the wall clock via an injectable sleep (util/faults.py
     virtual-time idiom), default is as-fast-as-possible.
+
+    shuffled_replay(): the @app:eventTime determinism oracle — replay one
+    event set in event-time order, then in N seed-permuted arrival orders
+    whose displacement stays inside allowed.lateness, and assert every
+    run's per-stream output digest is bit-identical with zero late
+    diversions (docs/EVENT_TIME.md; CLI: tools/shuffled_replay.py).
 """
 
 from __future__ import annotations
@@ -38,6 +44,7 @@ import hashlib
 import logging
 import os
 import pickle
+import random
 import signal
 import time
 from typing import Callable, Optional
@@ -457,4 +464,180 @@ def replay_wal(manager, app: SiddhiApp, wal_dir: str, *,
         "virtual_ms": int(virtual_ms),
         "wall_s": wall_s,
         "speedup": (virtual_ms / 1000.0 / wall_s) if wall_s > 0 else None,
+    }
+
+
+def _bounded_shuffle(ordered: list, lateness_ms: int, seed: int,
+                     fanout: int = 64) -> list:
+    """Permute a ts-ascending arrival list under the bounded-disorder model:
+    repeatedly pick uniformly from the eligible prefix — entries whose event
+    time is within `lateness_ms` of the oldest pending entry (capped at
+    `fanout` for O(n·fanout)). Every emitted entry therefore satisfies
+    ts ≤ min(pending ts) + lateness at pick time, so when any later entry u
+    arrives the gate's max_ts ≤ u.ts + lateness ⇒ watermark ≤ u.ts ⇒ u is
+    never late. That is the displacement bound @app:eventTime promises to
+    absorb — the oracle asserts the absorption is bit-exact."""
+    rng = random.Random(seed)
+    pending = list(ordered)
+    out = []
+    while pending:
+        bound = pending[0][1] + lateness_ms
+        hi = 1
+        while hi < len(pending) and hi < fanout and pending[hi][1] <= bound:
+            hi += 1
+        out.append(pending.pop(rng.randrange(hi)))
+    return out
+
+
+def shuffled_replay(manager, app: SiddhiApp, wal_dir: Optional[str] = None,
+                    *, app_name: Optional[str] = None, seeds: int = 16,
+                    arrivals: Optional[list] = None) -> dict:
+    """Determinism oracle for @app:eventTime: replay the same event set
+    once in event-time order (the oracle) and `seeds` more times in
+    seed-permuted arrival orders whose displacement is bounded by
+    allowed.lateness, asserting every run's output digest is bit-identical
+    to the oracle's and that no run diverted a single row as late.
+
+    Events come from the app's WAL (`wal_dir`, via state/wal.read_records)
+    or an explicit `arrivals` list of ``(stream_id, event_ts, row)``. Each
+    run is sandboxed (transports stripped, @app:persist detached), on the
+    virtual playback clock, one flush per arrival; after the last arrival
+    `release_watermarks()` drains the gates, and the digest hashes each
+    stream's CONCATENATED output event list — batch-boundary-insensitive
+    by construction, though with the gate's per-event-time delivery
+    grouping the boundaries themselves are invariant too.
+
+    Returns a summary dict; ``matched`` is the verdict and ``violations``
+    lists any conservation breaks (late diversions, rows still buffered
+    after the drain). tools/shuffled_replay.py exits nonzero on either."""
+    from ..errors import DefinitionNotExistError
+    from .app_runtime import SiddhiAppRuntime
+    from .manager import sandbox_app
+    from .stream import StreamCallback
+
+    app, _interval = _detach_persist(sandbox_app(app))
+
+    if arrivals is None:
+        if wal_dir is None:
+            raise ValueError("shuffled_replay needs wal_dir or arrivals")
+        from ..state.wal import read_records
+        attr_order = {sid: [a.name for a in d.attributes]
+                      for sid, d in app.stream_definitions.items()}
+        arrivals = []
+        for kind, sid, tss, data in read_records(wal_dir,
+                                                 app_name or app.name):
+            if kind == "rows":
+                for ts, row in zip(tss, data):
+                    arrivals.append((sid, int(ts), tuple(row)))
+            else:  # "cols": dict of columns, definition attribute order
+                names = attr_order.get(sid)
+                if names is None:
+                    continue  # stream not on the candidate app
+                cols = [data[nm] for nm in names]
+                for i, ts in enumerate(tss):
+                    row = tuple(c[i].item() if hasattr(c[i], "item")
+                                else c[i] for c in cols)
+                    arrivals.append((sid, int(ts), row))
+
+    def _canon(a):  # deterministic total order; ts is the major key
+        return (a[1], a[0], repr(a[2]))
+
+    ordered = sorted(arrivals, key=_canon)
+
+    def _run(order: list) -> tuple:
+        rt = SiddhiAppRuntime(app, manager.registry,
+                              config_manager=manager.config_manager,
+                              auto_flush_ms=0)
+        et = rt.ctx.event_time
+        if et is None or not et.lateness_ms:
+            rt.shutdown(flush_durable=False)
+            raise ValueError(
+                "shuffled_replay requires @app:eventTime with "
+                "allowed.lateness > 0 — without a disorder budget there is "
+                "nothing for the oracle to certify")
+        tg = rt.ctx.timestamp_generator
+        tg.playback = True
+        rt.ctx.playback = True
+        outputs: dict[str, list] = {}
+
+        class _Tap(StreamCallback):
+            def __init__(self, sid: str) -> None:
+                self.sid = sid
+
+            def receive(self, events) -> None:
+                outputs.setdefault(self.sid, []).extend(
+                    (e.timestamp, tuple(e.data), e.is_expired)
+                    for e in events)
+
+        for sid, j in rt.junctions.items():
+            j.subscribe(_Tap(sid))
+        for sid, f in rt.fault_junctions.items():
+            f.subscribe(_Tap(f"!{sid}"))
+        rt.start()
+        skipped = 0
+        try:
+            for sid, ts, row in order:
+                try:
+                    handler = rt.get_input_handler(sid)
+                except DefinitionNotExistError:
+                    skipped += 1
+                    continue
+                handler.send_batch([row], timestamps=[ts])
+                rt.flush()  # arrival granularity == flush granularity
+            rt.release_watermarks()
+            gates = {sid: j._et.snapshot()
+                     for sid, j in rt.junctions.items()
+                     if j._et is not None}
+        finally:
+            rt.shutdown(flush_durable=False)
+        sha = hashlib.sha256()
+        for sid in sorted(outputs):
+            sha.update(pickle.dumps((sid, outputs[sid]), protocol=4))
+        counts = {sid: len(evs) for sid, evs in sorted(outputs.items())}
+        return sha.hexdigest(), counts, gates, et.lateness_ms, skipped
+
+    def _conservation(seed, gates) -> list:
+        out = []
+        for sid, g in sorted(gates.items()):
+            if g["late"]:
+                out.append(f"seed={seed} stream={sid}: {g['late']} rows "
+                           f"diverted late inside the disorder bound")
+            if g["buffered"]:
+                out.append(f"seed={seed} stream={sid}: {g['buffered']} rows "
+                           f"still buffered after release_watermarks()")
+            if g["admitted"] != g["released"] + g["late"] + g["buffered"]:
+                out.append(f"seed={seed} stream={sid}: conservation broke "
+                           f"(admitted {g['admitted']} != released "
+                           f"{g['released']} + late {g['late']} + buffered "
+                           f"{g['buffered']})")
+        return out
+
+    t0 = time.perf_counter()
+    oracle_digest, counts, gates, lateness_ms, skipped = _run(ordered)
+    violations = _conservation("oracle", gates)
+    runs = []
+    for seed in range(int(seeds)):
+        shuffled = _bounded_shuffle(ordered, lateness_ms, seed)
+        permuted = sum(1 for a, b in zip(ordered, shuffled) if a is not b)
+        digest, _counts, g, _l, _s = _run(shuffled)
+        violations.extend(_conservation(seed, g))
+        runs.append({"seed": seed, "digest": digest,
+                     "match": digest == oracle_digest,
+                     "permuted": permuted})
+    matched = all(r["match"] for r in runs) and not violations
+    log.info("shuffled replay of %r: %d events x %d seeds, lateness %d ms "
+             "-> %s", app.name, len(ordered), len(runs), lateness_ms,
+             "bit-identical" if matched else "MISMATCH")
+    return {
+        "app": app.name,
+        "events": len(ordered),
+        "skipped": skipped,
+        "lateness_ms": lateness_ms,
+        "seeds": int(seeds),
+        "oracle_digest": oracle_digest,
+        "outputs": counts,
+        "runs": runs,
+        "violations": violations,
+        "matched": matched,
+        "wall_s": time.perf_counter() - t0,
     }

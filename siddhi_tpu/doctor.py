@@ -54,6 +54,10 @@ STAGES = ("stage", "h2d", "device", "sink")
 #: distinct compiled widths per query past which we call it a storm
 COMPILE_STORM_WIDTHS = 8
 
+#: share of admitted rows diverted as kind="late" past which the diversion
+#: stops being stragglers and becomes a burst (disorder > allowed.lateness)
+LATE_BURST_SHARE = 0.01
+
 
 class BundleError(Exception):
     pass
@@ -263,6 +267,20 @@ def analyze(bundle: dict, baseline: Optional[dict] = None,
             "info", "error store holds replayable entries",
             f"{es['entries']} entry(ies), "
             f"{es.get('dropped_error_entries', 0)} dropped"))
+    for sid, wm in sorted((stats.get("watermarks") or {}).items()):
+        late, admitted = wm.get("late", 0), wm.get("admitted", 0)
+        if late and admitted and late / admitted >= LATE_BURST_SHARE:
+            findings.append(_finding(
+                "warning", f"late-event burst on stream {sid!r}",
+                f"{late} of {admitted} row(s) arrived behind the watermark "
+                f"and were diverted (kind=\"late\") — disorder exceeds "
+                f"allowed.lateness={wm.get('lateness_ms', 0)} ms; raise the "
+                "lateness budget or replay via POST /errors/replay"))
+        elif late:
+            findings.append(_finding(
+                "info", f"late events diverted on stream {sid!r}",
+                f"{late} row(s) behind the watermark sit in the error "
+                "store (kind=\"late\", replayable)"))
     rec = stats.get("recovery") or {}
     if rec.get("recoveries"):
         findings.append(_finding(

@@ -69,7 +69,12 @@ def _make_delay(layout, batch_cap, params, expired_on):
 
 
 def _make_external_time(layout, batch_cap, params, expired_on):
-    # externalTime(tsAttr, W) — first param is a Variable (attr ref)
+    # externalTime(tsAttr, W) — first param is a Variable (attr ref).
+    # Watermark semantics: expiry advances with max-seen tsAttr; under
+    # @app:eventTime the query runtime sets .lateness_ms so the watermark
+    # trails max-seen by the allowed lateness (panes stay open for rows the
+    # ingress gate still buffers) and the SL116 lint guards the
+    # multi-producer case where max-seen alone is nondeterministic.
     from ..query_api.expression import Variable
     if len(params) < 2 or not isinstance(params[0], Variable):
         raise SiddhiAppCreationError(

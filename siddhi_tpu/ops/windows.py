@@ -517,6 +517,12 @@ class SlidingWindow(WindowOp):
         #: externalTime(tsAttr, W): expiry driven by an event attribute clock
         #: (reference: ExternalTimeWindowProcessor) instead of arrival time
         self.ts_attr = ts_attr
+        #: @app:eventTime allowed lateness (set by the query runtime): the
+        #: device watermark trails max-seen by this much so panes stay open
+        #: for rows the ingress gate still buffers. Static Python attr — the
+        #: default 0 keeps the traced jaxpr identical to the pre-lateness
+        #: form (optimizer parity + SL204 fastpath certification)
+        self.lateness_ms = 0
         # packed FIFO appends require B <= C (no last-C overwrite dance)
         if length is not None and time_ms is None:
             self.C = max(length, batch_cap, 1)
@@ -558,8 +564,15 @@ class SlidingWindow(WindowOp):
             comp_ts = tcols[self.ts_attr].astype(jnp.int64)
             w = jax.lax.bitcast_convert_type(comp_ts, jnp.uint32)
             comp_mat = comp_mat.at[-2].set(w[..., 0]).at[-1].set(w[..., 1])
-            wm = jnp.maximum(state.wm, jnp.max(jnp.where(
-                jnp.arange(B) < n_valid, comp_ts, jnp.int64(-(2**62)))))
+            mx = jnp.max(jnp.where(
+                jnp.arange(B) < n_valid, comp_ts, jnp.int64(-(2**62))))
+            if self.lateness_ms:
+                # watermark-driven emission: trail max-seen by the allowed
+                # lateness so panes close only once the ingress gate can no
+                # longer release rows into them (deterministic regardless
+                # of arrival order)
+                mx = mx - jnp.int64(self.lateness_ms)
+            wm = jnp.maximum(state.wm, mx)
             now = wm
         else:
             comp_ts = _packed_ts(comp_mat)
@@ -857,6 +870,10 @@ class TimeBatchWindow(WindowOp):
         #: externalTimeBatch(tsAttr, W): bucket clock from an event attribute
         #: (reference: ExternalTimeBatchWindowProcessor)
         self.ts_attr = ts_attr
+        #: @app:eventTime allowed lateness (set by the query runtime) — see
+        #: SlidingWindow.lateness_ms: buckets flush only once the trailing
+        #: watermark crosses their end; 0 keeps the jaxpr unchanged
+        self.lateness_ms = 0
         self.C = capacity or max(dtypes.config.default_window_capacity, 2 * batch_cap)
         self.E = max(batch_cap, 1024)  # max emitted current/expired lanes per step
         width = self.E + 1 + (self.E if expired_on else 0)
@@ -880,8 +897,14 @@ class TimeBatchWindow(WindowOp):
         comp_cols, comp_ts, n_valid, _ = compact(batch)
         if self.ts_attr is not None:
             comp_ts = comp_cols[self.ts_attr].astype(jnp.int64)
-            wm = jnp.maximum(state.wm, jnp.max(jnp.where(
-                jnp.arange(B) < n_valid, comp_ts, jnp.int64(-(2**62)))))
+            mx = jnp.max(jnp.where(
+                jnp.arange(B) < n_valid, comp_ts, jnp.int64(-(2**62))))
+            if self.lateness_ms:
+                # hold the bucket open until the watermark (max-seen minus
+                # allowed lateness) passes its end — the ingress gate may
+                # still release rows belonging to it
+                mx = mx - jnp.int64(self.lateness_ms)
+            wm = jnp.maximum(state.wm, mx)
             now = wm
         else:
             wm = state.wm

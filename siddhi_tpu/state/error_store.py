@@ -26,7 +26,8 @@ class ErrorEntry:
     cause: str
     #: origin of the entry — "error" (processing/@OnError), "sink"
     #: (dead-letter), "breaker" (circuit-breaker divert), "overflow"
-    #: (bounded-ingress fault policy) — so operators replay selectively
+    #: (bounded-ingress fault policy), "late" (@app:eventTime rows behind
+    #: the watermark) — so operators replay selectively
     kind: str = "error"
 
 
@@ -57,6 +58,22 @@ class ErrorStore:
         handler = app_runtime.get_input_handler(entry.stream_name)
         tss = [ts for ts, _row in entry.events]
         rows = [row for _ts, row in entry.events]
+        if entry.kind == "late":
+            # late-arrival side output: re-admission must SKIP the lateness
+            # check (the rows are behind the watermark by definition — a
+            # plain resend would divert them right back) and must flush
+            # inside the bypass window, because the gate classifies at
+            # flush time, not at send time. Downstream windows fold the
+            # rows in under their max-seen watermark: the resulting
+            # emissions are the corrections (upsert semantics).
+            j = handler.junction._resolve_redirect()
+            gate = getattr(j, "_et", None)
+            if gate is not None:
+                with gate.bypass():
+                    handler.send_batch(rows, timestamps=tss)
+                    j.flush()
+                self.discard(entry.id)
+                return
         handler.send_batch(rows, timestamps=tss)
         self.discard(entry.id)
 

@@ -41,6 +41,8 @@ ALWAYS_ON_FAMILIES = (
     "siddhi_build_info",
     "siddhi_app_uptime_seconds",
     "siddhi_event_time_lag_seconds",
+    "siddhi_watermark_lag_seconds",
+    "siddhi_late_events_total",
     "siddhi_slo_breaches_total",
 )
 

@@ -131,8 +131,20 @@ class AppTelemetry:
         self.lag_gauge = r.gauge(
             "siddhi_event_time_lag_seconds",
             "Event-time lag at delivery: wall clock minus the newest "
-            "external row timestamp in the batch (epoch-ms producers only)",
+            "external row timestamp in the batch (epoch-ms producers only; "
+            "also re-sampled at every watermark advance so idle streams "
+            "don't freeze)",
             ("stream",))
+        self.wm_gauge = r.gauge(
+            "siddhi_watermark_lag_seconds",
+            "Watermark lag: wall clock minus the stream's event-time "
+            "watermark (max event ts minus allowed.lateness; epoch-ms "
+            "producers only)",
+            ("stream",))
+        self.late_counter = r.counter(
+            "siddhi_late_events_total",
+            "Rows older than the event-time watermark diverted to the "
+            "ErrorStore (kind=\"late\") per stream", ("stream",))
         # tracer state
         self._ids = itertools.count(1)
         self._tls = threading.local()
@@ -150,6 +162,8 @@ class AppTelemetry:
         self._query_cells: dict = {}
         self._sink_cells: dict = {}
         self._lag_cells: dict = {}
+        self._wm_cells: dict = {}
+        self._late_cells: dict = {}
 
     # ---------------------------------------------------------------- tracing
 
@@ -263,6 +277,25 @@ class AppTelemetry:
         if g is None:
             g = self._lag_cells[stream] = self.lag_gauge.labels(stream)
         g.set(max(time.time() - newest_ts_ms / 1e3, 0.0))
+
+    def record_watermark(self, stream: str, wm_ms: int) -> None:
+        """Watermark lag at advance (event-time gates, core/event_time.py).
+        Same epoch-ms plausibility guard as record_lag — synthetic/logical
+        clocks must not render as a ~50-year lag."""
+        if wm_ms < 1_000_000_000_000:
+            return
+        g = self._wm_cells.get(stream)
+        if g is None:
+            g = self._wm_cells[stream] = self.wm_gauge.labels(stream)
+        g.set(max(time.time() - wm_ms / 1e3, 0.0))
+
+    def record_late(self, stream: str, n: int) -> None:
+        """Late-diversion counter — always on (a correctness signal, like
+        the sink families), independent of the batch tracer."""
+        c = self._late_cells.get(stream)
+        if c is None:
+            c = self._late_cells[stream] = self.late_counter.labels(stream)
+        c.inc(n)
 
     def observe_upgrade(self, pause_ms: float) -> None:
         """One committed hot-swap's cutover pause (core/upgrade.py)."""

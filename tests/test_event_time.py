@@ -1,0 +1,349 @@
+"""Out-of-order event time (core/event_time.py + docs/EVENT_TIME.md):
+the @app:eventTime gate — watermarks, allowed lateness, sorted release
+with per-event-time delivery batching — plus the late-event side output
+(ErrorStore kind="late" → /errors/replay corrections), idle/end-of-stream
+drains, telemetry families, the doctor's late-burst finding, the SL116
+lint interplay, and the shuffled-replay determinism oracle."""
+
+import time
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.analysis import analyze
+from siddhi_tpu.errors import SiddhiAppCreationError
+from siddhi_tpu.state.error_store import InMemoryErrorStore
+
+pytestmark = pytest.mark.smoke
+
+# epoch-ms base: real enough for the telemetry plausibility window
+T0 = 1_700_000_000_000
+
+APP = """
+@app:name('etapp')
+@app:eventTime(timestamp='ts', allowed.lateness='100')
+define stream S (ts long, price double);
+@info(name='q') from S select ts, price * 2.0 as d insert into Out;
+"""
+
+
+def _mk(app=APP, store=None, **kw):
+    mgr = SiddhiManager()
+    if store is not None:
+        mgr.set_error_store(store)
+    rt = mgr.create_siddhi_app_runtime(app, **kw)
+    rt.start()
+    return mgr, rt
+
+
+def _collect(rt, sid="Out"):
+    got = []
+    rt.add_callback(sid, lambda evs: got.extend(
+        (e.timestamp, tuple(e.data)) for e in evs))
+    return got
+
+
+class TestGateSemantics:
+    def test_disorder_within_lateness_emits_in_event_time_order(self):
+        mgr, rt = _mk()
+        got = _collect(rt)
+        h = rt.get_input_handler("S")
+        # arrival order scrambled, displacement < 100 ms
+        for off in (30, 0, 60, 40, 90, 150, 120):
+            h.send((T0 + off, float(off)), timestamp=T0 + 999)
+            rt.flush()
+        rt.release_watermarks()
+        assert [ts for ts, _ in got] == sorted(ts for ts, _ in got)
+        assert [row[0] for _, row in got] == \
+            [T0, T0 + 30, T0 + 40, T0 + 60, T0 + 90, T0 + 120, T0 + 150]
+        # released rows are re-timestamped WITH their event time
+        assert all(ts == row[0] for ts, row in got)
+        rt.shutdown()
+
+    def test_same_ts_rows_deliver_as_one_batch_per_event_time(self):
+        """The determinism keystone: all rows carrying one event time
+        release at the same watermark crossing, as ONE delivery batch,
+        in every lateness-bounded arrival order."""
+        mgr, rt = _mk()
+        batches = []
+        rt.add_callback("Out", lambda evs: batches.append(
+            [e.timestamp for e in evs]))
+        h = rt.get_input_handler("S")
+        for off in (10, 0, 10, 0, 20, 10, 300):
+            h.send((T0 + off, 1.0), timestamp=T0)
+            rt.flush()
+        rt.release_watermarks()
+        assert [b[0] for b in batches] == \
+            [T0, T0 + 10, T0 + 20, T0 + 300]
+        assert [len(b) for b in batches] == [2, 3, 1, 1]
+        assert all(len(set(b)) == 1 for b in batches)
+        rt.shutdown()
+
+    def test_watermark_snapshot_and_conservation(self):
+        mgr, rt = _mk()
+        h = rt.get_input_handler("S")
+        for off in (0, 50, 200):
+            h.send((T0 + off, 1.0))
+            rt.flush()
+        h.send((T0 + 90, 1.0))  # < wm (T0+100): late
+        rt.flush()
+        wm = rt.statistics_report()["watermarks"]["S"]
+        assert wm["attr"] == "ts" and wm["lateness_ms"] == 100
+        assert wm["watermark"] == T0 + 100
+        assert wm["max_event_ts"] == T0 + 200
+        assert wm["late"] == 1
+        assert wm["admitted"] == \
+            wm["released"] + wm["late"] + wm["buffered"]
+        rt.shutdown()
+
+    def test_annotation_requires_timestamp_attr(self):
+        bad = APP.replace("timestamp='ts', ", "")
+        with pytest.raises(SiddhiAppCreationError, match="timestamp"):
+            SiddhiManager().create_siddhi_app_runtime(bad)
+
+    def test_annotation_rejects_non_integer_attr(self):
+        bad = APP.replace("timestamp='ts'", "timestamp='price'")
+        with pytest.raises(SiddhiAppCreationError, match="INT or LONG"):
+            SiddhiManager().create_siddhi_app_runtime(bad)
+
+    def test_annotation_must_match_some_stream(self):
+        bad = APP.replace("timestamp='ts'", "timestamp='nope'")
+        with pytest.raises(SiddhiAppCreationError):
+            SiddhiManager().create_siddhi_app_runtime(bad)
+
+
+class TestLateSideOutput:
+    def test_late_row_diverts_to_error_store_then_replays_once(self):
+        store = InMemoryErrorStore()
+        mgr, rt = _mk(store=store)
+        got = _collect(rt)
+        h = rt.get_input_handler("S")
+        for off in (0, 300):
+            h.send((T0 + off, 1.0))
+            rt.flush()
+        h.send((T0 + 10, 7.0))  # wm is T0+200: diverted, never dropped
+        rt.flush()
+        rt.release_watermarks()
+        assert [ts for ts, _ in got] == [T0, T0 + 300]
+        entries = store.load("etapp")
+        assert len(entries) == 1 and entries[0].kind == "late"
+        assert entries[0].events == [(T0 + 10, (T0 + 10, 7.0))]
+        # /errors/replay semantics: the correction flows through the gate's
+        # bypass exactly once and the entry is discarded
+        store.replay(entries[0], rt)
+        assert [r for _, r in got].count((T0 + 10, 14.0)) == 1
+        assert store.load("etapp") == []
+        snap = rt.statistics_report()["watermarks"]["S"]
+        assert snap["late"] == 1 and snap["bypassed"] == 1
+        assert snap["admitted"] == snap["released"] + snap["late"]
+        stats = rt.statistics_report()
+        assert stats["late_events"] == {"S": 1}
+        rt.shutdown()
+
+    def test_late_without_store_counts_and_warns(self, caplog):
+        import logging
+        mgr, rt = _mk()
+        h = rt.get_input_handler("S")
+        h.send((T0 + 300, 1.0))
+        rt.flush()
+        with caplog.at_level(logging.WARNING, logger="siddhi_tpu"):
+            h.send((T0, 1.0))
+            rt.flush()
+        assert any("late" in r.message for r in caplog.records)
+        assert rt.statistics_report()["watermarks"]["S"]["late"] == 1
+        rt.shutdown()
+
+    def test_unreadable_event_time_diverts(self):
+        store = InMemoryErrorStore()
+        mgr, rt = _mk(store=store)
+        h = rt.get_input_handler("S")
+        h.send((None, 1.0))  # event time unreadable: side output, not crash
+        rt.flush()
+        entries = store.load("etapp")
+        assert len(entries) == 1 and entries[0].kind == "late"
+        rt.shutdown()
+
+
+class TestDrains:
+    def test_release_watermarks_drains_in_order(self):
+        mgr, rt = _mk()
+        got = _collect(rt)
+        h = rt.get_input_handler("S")
+        for off in (50, 20, 80):
+            h.send((T0 + off, 1.0))
+        rt.flush()
+        assert got == []  # all inside the lateness horizon: held
+        rt.release_watermarks()
+        assert [ts for ts, _ in got] == [T0 + 20, T0 + 50, T0 + 80]
+        # stragglers after the forced release classify late, never emit
+        # out of order behind delivered rows
+        h.send((T0, 9.0))
+        rt.flush()
+        assert [ts for ts, _ in got] == [T0 + 20, T0 + 50, T0 + 80]
+        assert rt.statistics_report()["watermarks"]["S"]["late"] == 1
+        rt.shutdown()
+
+    def test_shutdown_drain_releases_buffered_rows(self):
+        mgr, rt = _mk()
+        got = _collect(rt)
+        rt.get_input_handler("S").send((T0, 3.0))
+        rt.flush()
+        rt.shutdown()  # drain=True path calls release_watermarks()
+        assert got == [(T0, (T0, 6.0))]
+
+    def test_idle_timeout_releases_via_heartbeat(self):
+        app = APP.replace("allowed.lateness='100'",
+                          "allowed.lateness='100', idle.timeout='10'")
+        mgr, rt = _mk(app=app)
+        got = _collect(rt)
+        rt.get_input_handler("S").send((T0, 3.0))
+        rt.flush()
+        assert got == []
+        time.sleep(0.05)  # > idle.timeout (10 ms) with no admissions
+        rt.heartbeat()
+        assert got == [(T0, (T0, 6.0))]
+        rt.shutdown()
+
+
+class TestTelemetry:
+    def test_watermark_and_late_families(self):
+        store = InMemoryErrorStore()
+        mgr, rt = _mk(store=store)
+        tele = rt.ctx.telemetry
+        h = rt.get_input_handler("S")
+        for off in (0, 500):
+            h.send((T0 + off, 1.0))
+            rt.flush()
+        h.send((T0 + 10, 1.0))
+        rt.flush()
+        fams = {f.name for f in tele.registry.collect()}
+        assert "siddhi_watermark_lag_seconds" in fams
+        assert "siddhi_late_events_total" in fams
+        assert tele.late_counter.labels("S").value() == 1
+        # watermark lag ≈ wall − (T0+400); just assert it was sampled
+        assert tele.wm_gauge.labels("S").value() > 0
+        # frozen-lag fix: delivery lag re-samples at watermark advance,
+        # so the gauge carries the newest event ts even while every row
+        # is still buffered (nothing delivered yet)
+        assert tele.lag_gauge.labels("S").value() > 0
+        rt.shutdown()
+
+    def test_scrape_exports_families_when_off(self, monkeypatch):
+        """Watermark/late families are ALWAYS-ON (correctness signals,
+        like the sink families) — exported even with SIDDHI_METRICS=off."""
+        from siddhi_tpu.telemetry.prometheus import (ALWAYS_ON_FAMILIES,
+                                                     render_manager)
+        monkeypatch.setenv("SIDDHI_METRICS", "off")
+        assert "siddhi_watermark_lag_seconds" in ALWAYS_ON_FAMILIES
+        assert "siddhi_late_events_total" in ALWAYS_ON_FAMILIES
+        mgr, rt = _mk()
+        h = rt.get_input_handler("S")
+        for off in (0, 500, 10):  # the 10 is late: counter increments
+            h.send((T0 + off, 1.0))
+            rt.flush()
+        text = render_manager(mgr)
+        assert "siddhi_late_events_total" in text
+        rt.shutdown()
+
+
+class TestDoctor:
+    def test_late_burst_finding(self):
+        from siddhi_tpu import doctor
+        from siddhi_tpu.telemetry.recorder import SCHEMA_VERSION
+
+        def bundle(late, admitted):
+            return {"manifest": {"schema_version": SCHEMA_VERSION,
+                                 "app": "t",
+                                 "trigger": {"kind": "manual",
+                                             "reason": ""}},
+                    "stats": {"watermarks": {"S": {
+                        "late": late, "admitted": admitted,
+                        "lateness_ms": 100}}},
+                    "traces": {}, "logs": [], "plan": None, "config": None}
+
+        burst = [f for f in doctor.analyze(bundle(50, 1000))
+                 if "late-event burst" in f["title"]]
+        assert burst and burst[0]["severity"] == "warning"
+        assert "allowed.lateness" in burst[0]["evidence"]
+        trickle = doctor.analyze(bundle(1, 1000))
+        assert any("late events diverted" in f["title"] and
+                   f["severity"] == "info" for f in trickle)
+        assert not any("burst" in f["title"] for f in trickle)
+
+
+class TestLintInterplay:
+    # deliberately-hazardous fixture: built from line fragments so the
+    # zero-false-positive sweep in test_lint.py (which collects every
+    # triple-quoted app string that BUILDS) skips it — SL116 is an ERROR
+    # on an app that does build, by design
+    RACY = "\n".join([
+        "@app:name('L')",
+        "@Async(buffer.size='64', workers='4')",
+        "define stream S (ts long, v double);",
+        "from S#window.externalTime(ts, 1 sec) select v insert into Out;",
+    ])
+
+    def test_sl116_fires_without_lateness(self):
+        assert "SL116" in analyze(self.RACY).rule_counts()
+
+    def test_sl116_silent_with_lateness_declared(self):
+        cured = ("@app:eventTime(timestamp='ts', allowed.lateness='2 sec')"
+                 + self.RACY)
+        assert "SL116" not in analyze(cured).rule_counts()
+
+
+class TestShuffledOracle:
+    def _arrivals(self, n=60):
+        import random
+        rng = random.Random(7)
+        return [("S", T0 + (i // 3) * 10,
+                 (T0 + (i // 3) * 10, round(rng.uniform(0, 9), 2)))
+                for i in range(n)]
+
+    def test_bounded_shuffle_respects_displacement_bound(self):
+        from siddhi_tpu.core.upgrade import _bounded_shuffle
+        ordered = sorted(self._arrivals(), key=lambda a: a[1])
+        for seed in range(8):
+            shuf = _bounded_shuffle(ordered, 100, seed)
+            assert sorted(shuf) == sorted(ordered)
+            # every row is emitted within lateness of the oldest pending
+            seen_max = None
+            for _sid, ts, _row in shuf:
+                if seen_max is not None:
+                    assert ts >= seen_max - 100
+                seen_max = ts if seen_max is None else max(seen_max, ts)
+
+    def test_digest_bit_identical_across_16_seeds(self):
+        mgr = SiddhiManager()
+        r = mgr.shuffled_replay(APP, seeds=16, arrivals=self._arrivals())
+        assert r["matched"] is True
+        assert r["violations"] == []
+        assert len(r["runs"]) == 16
+        assert all(run["digest"] == r["oracle_digest"]
+                   for run in r["runs"])
+        assert sum(run["permuted"] for run in r["runs"]) > 0
+        assert r["events"] == 60
+        mgr.shutdown()
+
+    def test_oracle_from_wal_round_trip(self, tmp_path):
+        """End to end on the production read path: journal a disordered
+        send sequence, then certify the journal."""
+        from siddhi_tpu.core.upgrade import _bounded_shuffle
+        mgr, rt = _mk(wal_dir=str(tmp_path))
+        h = rt.get_input_handler("S")
+        ordered = sorted(self._arrivals(30), key=lambda a: a[1])
+        for _sid, ts, row in _bounded_shuffle(ordered, 100, seed=3):
+            h.send(row, timestamp=ts)
+            rt.flush()
+        rt.shutdown()
+        mgr2 = SiddhiManager()
+        r = mgr2.shuffled_replay(APP, str(tmp_path), seeds=4)
+        assert r["matched"] is True and r["events"] == 30
+        mgr2.shutdown()
+
+    def test_requires_lateness_budget(self):
+        app = APP.replace(", allowed.lateness='100'", "")
+        mgr = SiddhiManager()
+        with pytest.raises(ValueError, match="allowed.lateness"):
+            mgr.shuffled_replay(app, arrivals=self._arrivals(6))
+        mgr.shutdown()

@@ -39,6 +39,7 @@ CORPUS_EXPECTATIONS = {
     "sl112": ("SL112", Severity.ERROR),
     "sl113": ("SL113", Severity.WARN),
     "sl114": ("SL114", Severity.INFO),
+    "sl116": ("SL116", Severity.ERROR),
 }
 
 

@@ -86,7 +86,14 @@ def compare(old: dict, new: dict, threshold: float) -> tuple[list, list]:
         p99_ratio = (np99 / op99 if op99 and np99 else None)
         rows.append((metric, o["value"], n["value"], ratio, op99, np99,
                      p99_ratio))
-        if ratio < threshold:
+        if metric.endswith("_ms"):
+            # latency-valued metric: lower is better, growing is the
+            # regression
+            if ratio > 1.0 / threshold:
+                regressions.append(
+                    f"{metric}: latency grew {ratio:.2f}x "
+                    f"({o['value']:.2f} ms -> {n['value']:.2f} ms)")
+        elif ratio < threshold:
             regressions.append(
                 f"{metric}: events/s fell {ratio:.2f}x "
                 f"({o['value']:.0f} -> {n['value']:.0f})")
