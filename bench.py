@@ -232,6 +232,12 @@ def _preflight(app: str) -> dict:
         "validate_ms": round(validate_ms, 2),
         "lint_findings": len(report.diagnostics),
     }
+    if report.cost is not None:
+        # advisory prediction (analysis/cost.py) riding next to the
+        # measurement; bench_compare ignores these when diffing rounds
+        out["cost_predicted_state_bytes"] = \
+            report.cost["predicted_state_bytes"]
+        out["cost_predicted_compiles"] = report.cost["predicted_compiles"]
     _partial(out)
     return out
 

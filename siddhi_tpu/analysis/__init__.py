@@ -13,14 +13,28 @@ Surfaces: `SiddhiManager.validate(app)`, the SIDDHI_LINT startup gate,
 
 from __future__ import annotations
 
+import logging
 from typing import Optional, Union
 
 from .concurrency import lint_package, lint_python_source
+from .cost import (
+    Budget,
+    CostReport,
+    ElementCost,
+    app_budget,
+    compute_cost,
+    cost_for_plan,
+    format_size,
+    measure_runtime_state_bytes,
+    parse_size,
+)
 from .diagnostics import Diagnostic, LintReport, Severity, Suppressions
 from .optimizer import OptimizerReport, analyze_sharing, optimizer_enabled
 from .plan import PlanGraph, build_plan, element_fingerprints, plan_fingerprint
 from .rules import RULES, run_rules
 from .upgrade import UPGRADE_RULES, UpgradeDiff, diff_apps
+
+log = logging.getLogger("siddhi_tpu.lint")
 
 __all__ = [
     "Diagnostic", "LintReport", "Severity", "Suppressions",
@@ -29,6 +43,9 @@ __all__ = [
     "UPGRADE_RULES", "UpgradeDiff", "diff_apps",
     "OptimizerReport", "analyze_sharing", "optimizer_enabled",
     "lint_package", "lint_python_source",
+    "Budget", "CostReport", "ElementCost", "app_budget", "compute_cost",
+    "cost_for_plan", "format_size", "measure_runtime_state_bytes",
+    "parse_size",
 ]
 
 
@@ -47,6 +64,10 @@ def analyze(app: Union[str, "object"], *, jaxpr: bool = False,
                         or "SiddhiApp")
     plan = build_plan(app)
     run_rules(plan, report)
+    try:
+        report.cost = cost_for_plan(plan).to_dict()
+    except Exception:  # the cost pass is advisory — never fail a lint on it
+        log.debug("cost pass crashed", exc_info=True)
     if jaxpr:
         from .jaxpr_pass import run_jaxpr_pass
         run_jaxpr_pass(app, report, plan.suppressions)

@@ -64,6 +64,9 @@ class LintReport:
 
     app_name: str = "SiddhiApp"
     diagnostics: list[Diagnostic] = field(default_factory=list)
+    #: static cost section (analysis/cost.py CostReport.to_dict()); None
+    #: when the cost pass was skipped or crashed — lint never fails on it
+    cost: Optional[dict] = None
 
     def add(self, diag: Diagnostic) -> None:
         self.diagnostics.append(diag)
@@ -103,12 +106,15 @@ class LintReport:
         return "\n".join(lines)
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "app": self.app_name,
             "valid": not self.has_errors,
             "counts": self.rule_counts(),
             "diagnostics": [d.to_dict() for d in self.sorted()],
         }
+        if self.cost is not None:
+            out["cost"] = self.cost
+        return out
 
     def to_json(self, **kw) -> str:
         return json.dumps(self.to_dict(), **kw)

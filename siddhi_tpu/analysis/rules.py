@@ -582,6 +582,178 @@ def racing_external_time(plan: PlanGraph) -> Iterable:
                                f"becomes nondeterministic — {fix}")
 
 
+# ------------------------------------------------------------------- SL5xx
+# capacity certification: the static cost model (analysis/cost.py) priced
+# against the configured budget (@app:budget / SIDDHI_STATE_BUDGET).
+# docs/COST.md documents the per-operator formulas; tools/cost_calibrate.py
+# holds predictions within 2x of live telemetry.
+
+
+def _query_by_index(plan: PlanGraph, index) -> Optional[QueryNode]:
+    return next((n for n in plan.queries if n.index == index), None)
+
+
+def _cost_anchor(plan: PlanGraph, rep) -> Optional[QueryNode]:
+    """Anchor app-level cost findings at the dominant element's query when
+    it has one, else the first query (definitions lack a natural anchor)."""
+    if rep.dominant is not None and rep.dominant.node_index is not None:
+        node = _query_by_index(plan, rep.dominant.node_index)
+        if node is not None:
+            return node
+    return plan.queries[0] if plan.queries else None
+
+
+@rule("SL501", Severity.ERROR,
+      "predicted device state / compile ladder exceeds the configured "
+      "budget (@app:budget / SIDDHI_STATE_BUDGET / SIDDHI_COMPILE_BUDGET)")
+def over_budget(plan: PlanGraph) -> Iterable:
+    from .cost import app_budget, cost_for_plan, format_size
+    budget = app_budget(plan.app)
+    if budget is None:
+        return
+    rep = cost_for_plan(plan)
+    anchor = _cost_anchor(plan, rep)
+    if anchor is None:
+        return
+    if budget.state_bytes is not None and rep.state_bytes > budget.state_bytes:
+        dom = ""
+        if rep.dominant is not None:
+            dom = (f" — dominant element {rep.dominant.element!r} holds "
+                   f"{format_size(rep.dominant.state_bytes)}")
+        yield _q(anchor,
+                 f"predicted device state {format_size(rep.state_bytes)} "
+                 f"exceeds the configured budget "
+                 f"{format_size(budget.state_bytes)} "
+                 f"({budget.source}){dom}; shrink window/table/group "
+                 "capacities or raise the budget (admission control: "
+                 "creation refuses or queues this app)")
+    if budget.compiles is not None and rep.compile_ladder > budget.compiles:
+        yield _q(anchor,
+                 f"predicted compile ladder ({rep.compile_ladder} "
+                 f"executables) exceeds the configured compile budget "
+                 f"({budget.compiles}, {budget.source}); fuse queries "
+                 "(@app:optimize) or disable shape buckets for this app")
+
+
+@rule("SL502", Severity.ERROR,
+      "statically unbounded state growth while a state budget is "
+      "configured: the budget cannot be certified")
+def unbounded_state_growth(plan: PlanGraph) -> Iterable:
+    from .cost import app_budget
+    budget = app_budget(plan.app)
+    if budget is None or budget.state_bytes is None:
+        return
+    for node in plan.queries:
+        ins = node.query.input_stream
+        if isinstance(ins, JoinInputStream):
+            for side in (ins.left, ins.right):
+                schema = plan.schemas.get(side.stream_id)
+                kind = schema.kind if schema is not None else None
+                if kind in ("table", "window", "aggregation"):
+                    continue  # store-backed sides have their own bounds
+                if side.handlers.window is None:
+                    yield _q(node,
+                             f"join side {side.stream_id!r} has no "
+                             "retention window: its state demand is "
+                             "statically unbounded, so the configured "
+                             "state budget cannot be certified — add "
+                             "#window.time/#window.length to the side")
+        frames = _frames_for(node, plan)
+        typer = ExprTyper(frames)
+        for g in node.query.selector.group_by:
+            if typer.type_of(g) == AttributeType.STRING:
+                yield _q(node,
+                         "group by over a raw string key: the host intern "
+                         "table grows with key cardinality without bound, "
+                         "so the configured state budget cannot be "
+                         "certified — bound the key domain or group by an "
+                         "integer key")
+    for sid, schema in plan.schemas.items():
+        if schema.kind != "window" or schema.defn is None:
+            continue
+        if getattr(schema.defn, "window", None) is None:
+            yield _d(sid, schema.defn,
+                     f"named window {sid!r} declares no retention spec: "
+                     "its contents contract is unbounded in the reference "
+                     "semantics, so the configured state budget cannot be "
+                     "certified — declare an explicit window spec")
+
+
+@rule("SL503", Severity.WARN,
+      "compile-ladder explosion: predicted executable count exceeds the "
+      "threshold (budget compiles / SIDDHI_COMPILE_LADDER_WARN, default 64)")
+def compile_ladder_explosion(plan: PlanGraph) -> Iterable:
+    import os
+    from .cost import app_budget, cost_for_plan
+    budget = app_budget(plan.app)
+    if budget is not None and budget.compiles is not None:
+        threshold = budget.compiles
+    else:
+        try:
+            threshold = int(
+                os.environ.get("SIDDHI_COMPILE_LADDER_WARN", "") or 64)
+        except ValueError:
+            threshold = 64
+    rep = cost_for_plan(plan)
+    if rep.compile_ladder <= threshold or not plan.queries:
+        return
+    yield _q(plan.queries[0],
+             f"predicted compile ladder: {rep.compile_ladder} executables "
+             f"(> {threshold}) across shape buckets x queries x steps — "
+             "expect a long warmup and a large executable cache; fuse "
+             "co-resident queries (@app:optimize), reduce query count, or "
+             "set SIDDHI_SHAPE_BUCKETS=0")
+
+
+@rule("SL504", Severity.WARN,
+      "dispatch-heavy plan: a host callback rides every micro-batch "
+      "(CPU radix-sort fastpath veto)")
+def host_hop_per_batch(plan: PlanGraph) -> Iterable:
+    from .cost import cost_for_plan
+    rep = cost_for_plan(plan)
+    for e in rep.elements:
+        if e.dispatch != "host" or e.node_index is None:
+            continue
+        node = _query_by_index(plan, e.node_index)
+        if node is None:
+            continue
+        detail = next((n for n in e.notes if "host" in n), "")
+        yield _q(node,
+                 "this step takes a host-callback hop every micro-batch"
+                 + (f": {detail}" if detail else "")
+                 + " — pjit's C++ fastpath is vetoed for the whole "
+                 "executable (tools/fastpath_gate.py tracks these)")
+
+
+@rule("SL505", Severity.INFO,
+      "cost-dominant element: one element holds >50% of the app's "
+      "predicted device state")
+def cost_dominant_element(plan: PlanGraph) -> Iterable:
+    import os
+    from .cost import cost_for_plan, format_size, parse_size
+    try:
+        floor = parse_size(
+            os.environ.get("SIDDHI_COST_NOTE_MIN", "") or "64MiB")
+    except ValueError:
+        floor = 64 << 20
+    rep = cost_for_plan(plan)
+    if rep.state_bytes < floor or rep.dominant is None:
+        return
+    e = rep.dominant
+    msg = (f"element {e.element!r} holds {format_size(e.state_bytes)} of "
+           f"{format_size(rep.state_bytes)} predicted device state "
+           f"({rep.dominant_share:.0%}) — the first target for capacity "
+           "tuning (docs/COST.md)")
+    if e.node_index is not None:
+        node = _query_by_index(plan, e.node_index)
+        if node is not None:
+            yield _q(node, msg)
+            return
+    schema = plan.schemas.get(e.element)
+    if schema is not None and schema.defn is not None:
+        yield _d(e.element, schema.defn, msg)
+
+
 def check_query(query: Query) -> None:
     """Hook for future per-query API use; kept minimal."""
     _ = query

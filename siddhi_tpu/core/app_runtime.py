@@ -1050,6 +1050,21 @@ class SiddhiAppRuntime:
     def statistics_report(self) -> dict:
         return self.ctx.statistics.report(runtime=self)
 
+    @property
+    def cost_report(self) -> dict:
+        """Static cost prediction for this app (analysis/cost.py), computed
+        lazily under the runtime's effective batch/group capacities and
+        cached — statistics_report()['cost'] pairs it with live telemetry."""
+        rep = getattr(self, "_cost_report", None)
+        if rep is None:
+            from ..analysis.cost import compute_cost
+            rep = compute_cost(self.app,
+                               batch_size=self.ctx.batch_size,
+                               group_capacity=self.ctx.group_capacity
+                               ).to_dict()
+            self._cost_report = rep
+        return rep
+
     def collect_overflow(self) -> None:
         """Sweep every runtime's device state for capacity-overflow counters
         and surface them via Statistics.record_overflow (one-shot warning

@@ -9,6 +9,7 @@ registry snapshot, batching knobs, and statistics.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -17,6 +18,8 @@ from typing import Optional
 from ..extension.registry import Registry
 from ..util.locks import named_lock, named_rlock
 from . import dtypes
+
+log = logging.getLogger("siddhi_tpu.stats")
 
 
 class TimestampGenerator:
@@ -408,6 +411,31 @@ class Statistics:
                 }
             else:
                 out["optimizer"] = {"enabled": False}
+            try:
+                # static cost prediction vs live telemetry (analysis/cost.py
+                # + measure_runtime_state_bytes): the calibration pair that
+                # tools/cost_calibrate.py gates on in CI
+                from ..analysis.cost import measure_runtime_state_bytes
+                pred = runtime.cost_report
+                live = measure_runtime_state_bytes(runtime)
+                live_bytes = sum(live.values())
+                live_compiles = sum(self.compiles.values())
+                out["cost"] = {
+                    "predicted_state_bytes": pred["predicted_state_bytes"],
+                    "live_state_bytes": live_bytes,
+                    "state_ratio": (live_bytes /
+                                    pred["predicted_state_bytes"]
+                                    if pred["predicted_state_bytes"] else
+                                    None),
+                    "predicted_compiles": pred["predicted_compiles"],
+                    "live_compiles": live_compiles,
+                    "exact": pred["exact"],
+                    "dominant": pred.get("dominant"),
+                    "budget": pred.get("budget"),
+                    "live_elements": live,
+                }
+            except Exception:  # advisory — never break a stats report
+                log.debug("cost section crashed", exc_info=True)
             lint = getattr(runtime, "lint_report", None)
             if lint is not None:
                 # what the SIDDHI_LINT gate saw at creation: rule counts +

@@ -56,6 +56,9 @@ class SiddhiManager:
         #: internal: the jaxpr lint pass builds sandbox runtimes through a
         #: private manager and must not re-enter the lint gate
         self._lint_enabled = True
+        #: apps deferred by SIDDHI_BUDGET_MODE=queue admission control:
+        #: [(SiddhiApp, create kwargs)], FIFO; drain with admit_pending()
+        self.pending_apps: list[tuple[SiddhiApp, dict]] = []
 
     @staticmethod
     def _parse(app: Union[str, SiddhiApp]) -> SiddhiApp:
@@ -72,9 +75,21 @@ class SiddhiManager:
         auto_flush_ms=None, aot_warmup: bool = False,
         wal_dir=None, persistence_interval_s=None,
         optimize=None,
-    ) -> SiddhiAppRuntime:
+    ) -> Optional[SiddhiAppRuntime]:
         app = self._parse(app)
         lint_report = self._lint_gate(app)
+        kwargs = dict(batch_size=batch_size, group_capacity=group_capacity,
+                      mesh=mesh, partition_capacity=partition_capacity,
+                      async_callbacks=async_callbacks,
+                      auto_flush_ms=auto_flush_ms, aot_warmup=aot_warmup,
+                      wal_dir=wal_dir,
+                      persistence_interval_s=persistence_interval_s,
+                      optimize=optimize)
+        if self._budget_gate(app, batch_size=batch_size,
+                             group_capacity=group_capacity):
+            # queue mode: defer — no device state has been allocated
+            self.pending_apps.append((app, kwargs))
+            return None
         rt = SiddhiAppRuntime(app, self.registry, batch_size=batch_size,
                               group_capacity=group_capacity,
                               error_store=self.error_store,
@@ -91,6 +106,89 @@ class SiddhiManager:
         rt.lint_report = lint_report
         self.runtimes[app.name] = rt
         return rt
+
+    def _budget_gate(self, app: SiddhiApp, *, batch_size: int,
+                     group_capacity: int) -> bool:
+        """Admission control (SL501): price the app with the static cost
+        model BEFORE any device state is allocated. With a budget configured
+        (@app:budget / SIDDHI_STATE_BUDGET / SIDDHI_COMPILE_BUDGET), an
+        over-budget app is refused (SIDDHI_BUDGET_MODE=error, default) or
+        deferred to `pending_apps` (returns True; SIDDHI_BUDGET_MODE=queue).
+        An env-level state budget is manager-wide: already-admitted apps'
+        predictions count against it. The gate itself never crashes app
+        creation — a cost-model failure admits the app unpriced."""
+        import os
+
+        from ..analysis.cost import app_budget, compute_cost, format_size
+
+        if not self._lint_enabled:
+            return False  # internal analysis manager (sandbox/jaxpr builds)
+        budget = app_budget(app)
+        if budget is None:
+            return False
+        try:
+            rep = compute_cost(app, batch_size=batch_size,
+                               group_capacity=group_capacity)
+        except Exception:
+            import logging
+            logging.getLogger("siddhi_tpu.lint").debug(
+                "cost model crashed; app %r admitted unpriced",
+                app.name, exc_info=True)
+            return False
+        over: list[str] = []
+        if budget.state_bytes is not None:
+            demand = rep.state_bytes
+            fleet = 0
+            if os.environ.get("SIDDHI_STATE_BUDGET", "").strip():
+                for other in self.runtimes.values():
+                    try:
+                        fleet += int(other.cost_report.get(
+                            "predicted_state_bytes", 0))
+                    except Exception:
+                        pass
+            if demand + fleet > budget.state_bytes:
+                held = (f" ({format_size(fleet)} already held by "
+                        f"{len(self.runtimes)} admitted app(s))"
+                        if fleet else "")
+                over.append(
+                    f"predicted device state {format_size(demand)}{held} "
+                    f"exceeds the budget {format_size(budget.state_bytes)} "
+                    f"({budget.source})")
+        if budget.compiles is not None and rep.compile_ladder > budget.compiles:
+            over.append(
+                f"predicted compile ladder {rep.compile_ladder} exceeds the "
+                f"compile budget {budget.compiles} ({budget.source})")
+        if not over:
+            return False
+        if budget.mode == "queue":
+            import logging
+            logging.getLogger("siddhi_tpu.lint").warning(
+                "SL501: app %r deferred (SIDDHI_BUDGET_MODE=queue): %s",
+                app.name, "; ".join(over))
+            return True
+        raise SiddhiAppCreationError(
+            f"SL501: app {app.name!r} refused by admission control: "
+            + "; ".join(over)
+            + ". Shrink capacities, raise the budget, or set "
+            "SIDDHI_BUDGET_MODE=queue to defer (docs/COST.md).")
+
+    def admit_pending(self) -> list[SiddhiAppRuntime]:
+        """Retry every queued app FIFO (after budget headroom freed up —
+        e.g. a runtime shut down or the budget was raised). Apps that still
+        exceed the budget stay queued; admitted ones are returned."""
+        admitted: list[SiddhiAppRuntime] = []
+        still_pending: list[tuple[SiddhiApp, dict]] = []
+        pending, self.pending_apps = self.pending_apps, []
+        for app, kwargs in pending:
+            rt = self.create_siddhi_app_runtime(app, **kwargs)
+            if rt is None:
+                # create re-queued it onto self.pending_apps; keep order
+                still_pending.extend(self.pending_apps)
+                self.pending_apps = []
+            else:
+                admitted.append(rt)
+        self.pending_apps = still_pending
+        return admitted
 
     def _lint_gate(self, app: SiddhiApp):
         """Run the static linter per SIDDHI_LINT (error|warn|off, default

@@ -58,6 +58,14 @@ COMPILE_STORM_WIDTHS = 8
 #: stops being stragglers and becomes a burst (disorder > allowed.lateness)
 LATE_BURST_SHARE = 0.01
 
+#: share of the configured state budget past which attach headroom is an
+#: on-call concern (stats["cost"]; the SL501 admission gate refuses at 100%)
+BUDGET_NEAR_EXHAUSTION = 0.8
+
+#: live/predicted state drift past which the static cost model is lying
+#: (same band tools/cost_calibrate.py gates in CI)
+COST_DRIFT_BAND = 2.0
+
 
 class BundleError(Exception):
     pass
@@ -292,6 +300,37 @@ def analyze(bundle: dict, baseline: Optional[dict] = None,
         findings.append(_finding(
             "warning", "hot-swap upgrade rolled back",
             f"{upg['rollbacks']} rollback(s) — v2 failed pre-commit"))
+
+    # 2b. capacity certification (analysis/cost.py via stats["cost"])
+    cost = stats.get("cost") or {}
+    budget = cost.get("budget") or {}
+    budget_bytes = budget.get("state_bytes")
+    if budget_bytes:
+        used = max(cost.get("live_state_bytes") or 0,
+                   cost.get("predicted_state_bytes") or 0)
+        share = used / budget_bytes
+        if share > BUDGET_NEAR_EXHAUSTION:
+            dom = cost.get("dominant") or {}
+            dom_note = (f"; dominant element (SL505): {dom['element']!r} "
+                        f"holds {dom['state_bytes']} B "
+                        f"({dom.get('share', 0):.0%})" if dom else "")
+            findings.append(_finding(
+                "warning" if share <= 1.0 else "critical",
+                "state budget near exhaustion" if share <= 1.0
+                else "state budget exceeded",
+                f"{used} of {budget_bytes} B ({share:.0%}) of the "
+                f"configured budget ({budget.get('source', '?')})"
+                f"{dom_note} — the next attach may be refused (SL501)"))
+    ratio = cost.get("state_ratio")
+    if ratio is not None and cost.get("live_state_bytes") and not (
+            1.0 / COST_DRIFT_BAND <= ratio <= COST_DRIFT_BAND):
+        findings.append(_finding(
+            "warning", "cost-model drift: live state diverges from the "
+            "static prediction",
+            f"live {cost.get('live_state_bytes')} B vs predicted "
+            f"{cost.get('predicted_state_bytes')} B ({ratio:.2f}x, band "
+            f"{COST_DRIFT_BAND:.1f}x) — an operator allocates state the "
+            "model does not price; run tools/cost_calibrate.py"))
 
     # 3. baseline regression diff
     if baseline is not None:

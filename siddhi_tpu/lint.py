@@ -42,6 +42,38 @@ def lint_text(text: str, *, name: str = "SiddhiApp",
         return report
 
 
+def _print_cost(path: str, cost: dict) -> None:
+    """The --cost pretty-printer over a CostReport.to_dict() section."""
+    from .analysis import format_size
+
+    exact = "" if cost.get("exact") else " (estimate)"
+    print(f"{path}: cost: "
+          f"{format_size(cost['predicted_state_bytes'])} device state, "
+          f"{cost['predicted_compiles']} compile(s){exact}")
+    dom = cost.get("dominant")
+    if dom:
+        print(f"{path}: cost: dominant element {dom['element']!r} holds "
+              f"{format_size(dom['state_bytes'])} ({dom['share']:.0%})")
+    budget = cost.get("budget")
+    if budget:
+        state = budget.get("state_bytes")
+        limit = (format_size(state) if state is not None else "-",
+                 budget.get("compiles"))
+        verdict = "over" if (
+            (state is not None and cost["predicted_state_bytes"] > state)
+            or (budget.get("compiles") is not None
+                and cost["predicted_compiles"] > budget["compiles"])
+        ) else "within"
+        print(f"{path}: cost: budget state={limit[0]} "
+              f"compiles={limit[1] if limit[1] is not None else '-'} "
+              f"({budget.get('source')}, mode={budget.get('mode')}) — "
+              f"{verdict} budget")
+    for e in cost.get("elements", ()):
+        if e.get("dispatch") == "host":
+            print(f"{path}: cost: element {e['element']!r} takes a "
+                  "host-callback hop every batch (SL504)")
+
+
 def _collect(paths: list[str], scan: bool) -> list[Path]:
     files: list[Path] = []
     for p in paths:
@@ -78,6 +110,10 @@ def main(argv: list[str] = None) -> int:
     ap.add_argument("--max-severity", choices=["error", "warn", "info"],
                     default="info",
                     help="hide findings below this severity")
+    ap.add_argument("--cost", action="store_true",
+                    help="also print each app's static cost prediction "
+                         "(state bytes, compile ladder, dominant element, "
+                         "budget verdict — docs/COST.md)")
     args = ap.parse_args(argv)
 
     max_rank = {"error": 0, "warn": 1, "info": 2}[args.max_severity]
@@ -131,6 +167,8 @@ def main(argv: list[str] = None) -> int:
             n_warn = len(report.warnings)
             print(f"{path}: {n_err} error(s), {n_warn} warning(s), "
                   f"{len(report.diagnostics) - n_err - n_warn} info")
+            if args.cost and report.cost is not None:
+                _print_cost(str(path), report.cost)
 
     if args.as_json:
         print(json.dumps(results, indent=2))

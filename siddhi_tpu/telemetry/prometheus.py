@@ -44,6 +44,8 @@ ALWAYS_ON_FAMILIES = (
     "siddhi_watermark_lag_seconds",
     "siddhi_late_events_total",
     "siddhi_slo_breaches_total",
+    "siddhi_cost_predicted_state_bytes",
+    "siddhi_cost_compile_ladder",
 )
 
 
@@ -183,6 +185,23 @@ def _stats_families(exp: _Exposition, app: str, runtime) -> None:
     import time as _time
     exp.add("siddhi_app_uptime_seconds", (app,),
             max(_time.time() - st.started_at, 0.0))
+
+    # static cost model (analysis/cost.py): the prediction the admission
+    # gate priced this app at — pair with live state for drift alerting
+    exp.declare("siddhi_cost_predicted_state_bytes", "gauge",
+                "Statically predicted device-resident state bytes "
+                "(analysis/cost.py; SL501 admission control)", ("app",))
+    exp.declare("siddhi_cost_compile_ladder", "gauge",
+                "Statically predicted compile-ladder size (executables "
+                "across shape buckets x queries x steps)", ("app",))
+    try:
+        cost = runtime.cost_report
+        pred_state = cost.get("predicted_state_bytes", 0)
+        pred_compiles = cost.get("predicted_compiles", 0)
+    except Exception:  # advisory — a scrape must never fail on the model
+        pred_state = pred_compiles = 0
+    exp.add("siddhi_cost_predicted_state_bytes", (app,), pred_state)
+    exp.add("siddhi_cost_compile_ladder", (app,), pred_compiles)
 
     # SLO engine (telemetry/slo.py): compliance + burn per objective
     exp.declare("siddhi_slo_compliance_ratio", "gauge",
@@ -388,6 +407,12 @@ def render_manager(manager) -> str:
         exp.declare("siddhi_slo_breaches_total", "counter",
                     "Objective transitions into the breached state",
                     ("app", "objective"))
+        exp.declare("siddhi_cost_predicted_state_bytes", "gauge",
+                    "Statically predicted device-resident state bytes "
+                    "(analysis/cost.py; SL501 admission control)", ("app",))
+        exp.declare("siddhi_cost_compile_ladder", "gauge",
+                    "Statically predicted compile-ladder size (executables "
+                    "across shape buckets x queries x steps)", ("app",))
     for name, rt in runtimes:
         tele = getattr(rt.ctx, "telemetry", None)
         if tele is not None:

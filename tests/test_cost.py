@@ -1,0 +1,276 @@
+"""Static cost model tests (analysis/cost.py + the SL501 admission gate).
+
+The model's contract is byte-EXACT prediction on closed-schema apps: it
+constructs the same operator objects the runtime would and sizes their
+init_state under jax.eval_shape, so every test here asserts predicted ==
+live to the byte (the 2x band in tools/cost_calibrate.py is headroom for
+future inexact operators, not for these). The admission tests prove the
+ISSUE acceptance criterion: an over-budget app is refused (error mode) or
+deferred (queue mode) BEFORE any device state is allocated.
+"""
+
+import pytest
+
+from siddhi_tpu.analysis.cost import (
+    app_budget,
+    compute_cost,
+    format_size,
+    measure_runtime_state_bytes,
+    parse_size,
+)
+from siddhi_tpu.core import manager as manager_mod
+from siddhi_tpu.core.manager import SiddhiManager
+from siddhi_tpu.errors import SiddhiAppCreationError
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    monkeypatch.setenv("SIDDHI_LINT", "off")
+    for var in ("SIDDHI_STATE_BUDGET", "SIDDHI_COMPILE_BUDGET",
+                "SIDDHI_BUDGET_MODE"):
+        monkeypatch.delenv(var, raising=False)
+
+
+def _predict_vs_live(app: str, **kw):
+    rep = compute_cost(app, **kw)
+    rt = SiddhiManager().create_siddhi_app_runtime(app, **kw)
+    live = sum(measure_runtime_state_bytes(rt).values())
+    rt.shutdown()
+    return rep, live
+
+
+# ------------------------------------------------------------------ sizing
+
+class TestSizeParsing:
+    @pytest.mark.parametrize("text,expected", [
+        ("0", 0), ("123", 123), ("1kb", 1024), ("1KiB", 1024),
+        ("2MB", 2 << 20), ("1.5MiB", int(1.5 * (1 << 20))),
+        ("1GB", 1 << 30), ("1gib", 1 << 30), (" 64 MB ", 64 << 20),
+    ])
+    def test_parse_size(self, text, expected):
+        assert parse_size(text) == expected
+
+    def test_parse_size_rejects_garbage(self):
+        for bad in ("", "MB", "1xb", "-1kb"):
+            with pytest.raises(ValueError):
+                parse_size(bad)
+
+    def test_format_size_round_trips_units(self):
+        assert format_size(1024) == "1.0KiB"
+        assert format_size(96_000_000).endswith("MiB")
+
+
+class TestExactPrediction:
+    """predicted == live to the byte, per operator family."""
+
+    @pytest.mark.parametrize("window", [
+        "#window.length(1000)",
+        "#window.lengthBatch(512)",
+        "#window.time(1 sec)",
+        "#window.externalTime(ts, 2 sec)",
+    ])
+    def test_window_state_bytes_exact(self, window):
+        app = f"""
+        define stream S (ts long, v double);
+        @info(name='q') from S{window} select ts, v insert into Out;
+        """
+        rep, live = _predict_vs_live(app)
+        assert rep.exact
+        assert rep.state_bytes == live
+
+    def test_join_store_bytes_exact(self):
+        app = """
+        define stream L (k int, v double);
+        define stream R (k int, w double);
+        @info(name='q')
+        from L#window.length(1000) as a join R#window.length(2000) as b
+        on a.k == b.k
+        select a.k as k, a.v as v, b.w as w
+        insert into Out;
+        """
+        rep, live = _predict_vs_live(app)
+        assert rep.exact
+        assert rep.state_bytes == live
+
+    def test_pattern_pending_bytes_exact(self):
+        app = """
+        define stream A (val int);
+        define stream B (val int);
+        @info(name='q')
+        from every a=A -> b=B[b.val == a.val] within 5 sec
+        select a.val as av, b.val as bv
+        insert into Out;
+        """
+        rep, live = _predict_vs_live(app)
+        assert rep.exact
+        assert rep.state_bytes == live
+
+    def test_group_by_table_bytes_exact(self):
+        app = """
+        define stream S (sym string, price double);
+        @info(name='q')
+        from S#window.lengthBatch(100)
+        select sym, sum(price) as total
+        group by sym
+        insert into Out;
+        """
+        rep, live = _predict_vs_live(app, group_capacity=1 << 14)
+        assert rep.exact
+        assert rep.state_bytes == live
+
+    def test_named_window_and_table_bytes_exact(self):
+        app = """
+        define stream S (k int, v long);
+        define window W (k int, v long) length(500);
+        define table T (k int, v long);
+        @info(name='in') from S insert into W;
+        @info(name='q') from W select k, v insert into Out;
+        """
+        rep, live = _predict_vs_live(app)
+        assert rep.state_bytes == live
+
+    def test_compile_ladder_matches_warmup(self):
+        app = """
+        define stream S (ts long, v double);
+        @info(name='q') from S#window.time(1 sec)
+        select ts, v insert into Out;
+        """
+        rep = compute_cost(app)
+        rt = SiddhiManager().create_siddhi_app_runtime(app)
+        rt.warmup()
+        live = sum(rt.ctx.statistics.compiles.values())
+        rt.shutdown()
+        assert rep.compile_ladder == live
+
+    def test_dominant_element_named(self):
+        app = """
+        define stream S (a long);
+        define stream T (a long);
+        @info(name='big') from S#window.length(100000)
+        select a insert into Out1;
+        @info(name='small') from T#window.length(10)
+        select a insert into Out2;
+        """
+        rep = compute_cost(app)
+        assert rep.dominant is not None
+        assert rep.dominant.element == "big"
+        assert rep.dominant_share > 0.5
+
+
+# --------------------------------------------------------------- budgeting
+
+BIG_APP = """
+@app:name('Big')
+define stream S (a long);
+@info(name='q') from S#window.length(100000) select a insert into Out;
+"""
+
+
+class TestBudget:
+    def test_annotation_budget_parsed(self):
+        from siddhi_tpu import compiler
+        app = compiler.parse(
+            "@app:name('B') @app:budget(state='2MB', compiles='8')\n"
+            "define stream S (a int);\n"
+            "from S select a insert into Out;")
+        b = app_budget(app)
+        assert b.state_bytes == 2 << 20
+        assert b.compiles == 8
+        assert b.source == "annotation"
+
+    def test_env_budget(self, monkeypatch):
+        from siddhi_tpu import compiler
+        monkeypatch.setenv("SIDDHI_STATE_BUDGET", "1GiB")
+        app = compiler.parse("define stream S (a int);\n"
+                             "from S select a insert into Out;")
+        b = app_budget(app)
+        assert b.state_bytes == 1 << 30
+        assert b.source == "env"
+
+    def test_no_budget_is_none(self):
+        from siddhi_tpu import compiler
+        app = compiler.parse("define stream S (a int);\n"
+                             "from S select a insert into Out;")
+        assert app_budget(app) is None
+
+    def test_over_budget_refused_before_any_state_allocation(
+            self, monkeypatch):
+        """Error mode must raise BEFORE SiddhiAppRuntime is even
+        constructed — patched constructor proves zero device state."""
+        def _boom(*a, **kw):
+            raise AssertionError("runtime constructed for a refused app")
+        monkeypatch.setattr(manager_mod, "SiddhiAppRuntime", _boom)
+        monkeypatch.setenv("SIDDHI_STATE_BUDGET", "1MB")
+        with pytest.raises(SiddhiAppCreationError, match="SL501"):
+            SiddhiManager().create_siddhi_app_runtime(BIG_APP)
+
+    def test_queue_mode_defers_then_admits(self, monkeypatch):
+        monkeypatch.setenv("SIDDHI_STATE_BUDGET", "1MB")
+        monkeypatch.setenv("SIDDHI_BUDGET_MODE", "queue")
+        m = SiddhiManager()
+        assert m.create_siddhi_app_runtime(BIG_APP) is None
+        assert len(m.pending_apps) == 1
+        assert not m.admit_pending()  # still over budget: stays queued
+        assert len(m.pending_apps) == 1
+        monkeypatch.setenv("SIDDHI_STATE_BUDGET", "1GB")  # headroom freed
+        (rt,) = m.admit_pending()
+        assert rt is not None and not m.pending_apps
+        rt.shutdown()
+
+    def test_compile_budget_refuses(self, monkeypatch):
+        monkeypatch.setenv("SIDDHI_COMPILE_BUDGET", "1")
+        with pytest.raises(SiddhiAppCreationError, match="compile"):
+            SiddhiManager().create_siddhi_app_runtime(BIG_APP)
+
+    def test_env_budget_is_manager_wide(self, monkeypatch):
+        """Two apps that fit individually must not both be admitted when
+        their sum exceeds the env (fleet) budget."""
+        one = compute_cost(BIG_APP).state_bytes
+        monkeypatch.setenv("SIDDHI_STATE_BUDGET", str(int(one * 1.5)))
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(BIG_APP)
+        assert rt is not None
+        second = BIG_APP.replace("'Big'", "'Big2'")
+        with pytest.raises(SiddhiAppCreationError, match="already held"):
+            m.create_siddhi_app_runtime(second)
+        rt.shutdown()
+
+    def test_within_budget_admits_and_reports(self, monkeypatch):
+        monkeypatch.setenv("SIDDHI_STATE_BUDGET", "1GB")
+        rt = SiddhiManager().create_siddhi_app_runtime(BIG_APP)
+        rt.start()
+        cost = rt.statistics_report()["cost"]
+        assert cost["predicted_state_bytes"] == cost["live_state_bytes"]
+        assert cost["state_ratio"] == 1.0
+        assert cost["budget"]["state_bytes"] == 1 << 30
+        rt.shutdown()
+
+
+class TestSurfaces:
+    def test_lint_report_carries_cost_section(self):
+        from siddhi_tpu.analysis import analyze
+        rep = analyze(BIG_APP)
+        assert rep.cost is not None
+        d = rep.to_dict()
+        assert d["cost"]["predicted_state_bytes"] > 0
+        assert d["cost"]["predicted_compiles"] > 0
+
+    def test_prometheus_families_exported(self):
+        from siddhi_tpu.telemetry.prometheus import render_manager
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(BIG_APP)
+        body = render_manager(m)
+        assert "siddhi_cost_predicted_state_bytes{app=\"Big\"}" in body
+        assert "siddhi_cost_compile_ladder{app=\"Big\"}" in body
+        rt.shutdown()
+
+    def test_lint_cli_cost_flag(self, tmp_path, capsys):
+        from siddhi_tpu.lint import main as lint_main
+        p = tmp_path / "app.siddhi"
+        p.write_text(BIG_APP)
+        rc = lint_main(["--cost", str(p)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "cost:" in out and "device state" in out

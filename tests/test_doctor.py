@@ -186,6 +186,49 @@ class TestSyntheticDiagnosis:
         assert "dead-letters" in titles
         assert "recompile storm" in titles
 
+    def test_state_budget_near_exhaustion_names_dominant(self):
+        stats = {"cost": {
+            "predicted_state_bytes": 900, "live_state_bytes": 850,
+            "state_ratio": 850 / 900, "predicted_compiles": 4,
+            "live_compiles": 4, "exact": True,
+            "dominant": {"element": "q1", "state_bytes": 800,
+                         "share": 0.89},
+            "budget": {"state_bytes": 1000, "compiles": None,
+                       "mode": "error", "source": "annotation"}}}
+        (f,) = doctor.analyze(_bundle(stats))
+        assert f["severity"] == "warning"
+        assert "state budget near exhaustion" in f["title"]
+        assert "'q1'" in f["evidence"] and "SL505" in f["evidence"]
+        assert "90%" in f["evidence"]
+
+    def test_state_budget_exceeded_is_critical(self):
+        stats = {"cost": {
+            "predicted_state_bytes": 1500, "live_state_bytes": 1500,
+            "state_ratio": 1.0, "predicted_compiles": 1, "live_compiles": 1,
+            "exact": True, "dominant": None,
+            "budget": {"state_bytes": 1000, "compiles": None,
+                       "mode": "queue", "source": "env"}}}
+        (f,) = doctor.analyze(_bundle(stats))
+        assert f["severity"] == "critical"
+        assert "state budget exceeded" in f["title"]
+
+    def test_cost_model_drift_flags_outside_band(self):
+        stats = {"cost": {
+            "predicted_state_bytes": 100, "live_state_bytes": 500,
+            "state_ratio": 5.0, "predicted_compiles": 1, "live_compiles": 1,
+            "exact": True, "dominant": None, "budget": None}}
+        (f,) = doctor.analyze(_bundle(stats))
+        assert f["severity"] == "warning"
+        assert "cost-model drift" in f["title"]
+        assert "cost_calibrate" in f["evidence"]
+
+    def test_calibrated_cost_yields_no_finding(self):
+        stats = {"cost": {
+            "predicted_state_bytes": 100, "live_state_bytes": 110,
+            "state_ratio": 1.1, "predicted_compiles": 2, "live_compiles": 2,
+            "exact": True, "dominant": None, "budget": None}}
+        assert doctor.analyze(_bundle(stats)) == []
+
     def test_baseline_regression_diff(self):
         now = {"latency": {"streams": {"S": {"sink": {"p99_ms": 50.0},
                                              "device": {"p99_ms": 5.0}}}}}

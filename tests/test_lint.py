@@ -40,6 +40,11 @@ CORPUS_EXPECTATIONS = {
     "sl113": ("SL113", Severity.WARN),
     "sl114": ("SL114", Severity.INFO),
     "sl116": ("SL116", Severity.ERROR),
+    "sl501": ("SL501", Severity.ERROR),
+    "sl502": ("SL502", Severity.ERROR),
+    "sl503": ("SL503", Severity.WARN),
+    "sl504": ("SL504", Severity.WARN),
+    "sl505": ("SL505", Severity.INFO),
 }
 
 

@@ -35,6 +35,14 @@ import sys
 #: metrics (or old/new below it for latency) trips
 DEFAULT_THRESHOLD = 0.5
 
+#: preflight fields that are predictions, not measurements — they ride in
+#: the round entries (bench.py _preflight) but must never be diffed as if
+#: a model change were a perf regression
+ADVISORY_FIELDS = frozenset({
+    "cost_predicted_state_bytes",
+    "cost_predicted_compiles",
+})
+
 
 def parse_round(path: str) -> dict:
     """{metric: entry} for one round file, error entries skipped."""
@@ -56,7 +64,8 @@ def parse_round(path: str) -> dict:
         metric = entry.get("metric")
         if not metric or "error" in entry or "value" not in entry:
             continue
-        out[metric] = entry
+        out[metric] = {k: v for k, v in entry.items()
+                       if k not in ADVISORY_FIELDS}
     return out
 
 

@@ -65,8 +65,17 @@ APPS = {
     select a.k as k, a.v as lv, b.v as rv
     insert into OutStream;
     """,
+    "disorder": """
+    @app:name('Disorder')
+    @app:eventTime(timestamp='ts', allowed.lateness='50')
+    define stream TradeStream (ts long, v long);
+    @info(name = 'bench')
+    from TradeStream select ts, v insert into OutStream;
+    """,
     "e2e_ingress": """
     @app:name('IngressBench')
+    @app:slo(stream='TradeStream', p99.ms='60000')
+    @Async(buffer.size='8192', workers='2')
     define stream TradeStream (symbol string, price double, volume long);
     @info(name = 'filt')
     from TradeStream[price < 700.0]
