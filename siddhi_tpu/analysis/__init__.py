@@ -32,6 +32,14 @@ from .diagnostics import Diagnostic, LintReport, Severity, Suppressions
 from .optimizer import OptimizerReport, analyze_sharing, optimizer_enabled
 from .plan import PlanGraph, build_plan, element_fingerprints, plan_fingerprint
 from .rules import RULES, run_rules
+from .sharding import (
+    ShardClass,
+    ShardConfig,
+    check_shardable,
+    classify_plan,
+    shard_config,
+    shard_violations,
+)
 from .upgrade import UPGRADE_RULES, UpgradeDiff, diff_apps
 
 log = logging.getLogger("siddhi_tpu.lint")
@@ -46,6 +54,8 @@ __all__ = [
     "Budget", "CostReport", "ElementCost", "app_budget", "compute_cost",
     "cost_for_plan", "format_size", "measure_runtime_state_bytes",
     "parse_size",
+    "ShardClass", "ShardConfig", "check_shardable", "classify_plan",
+    "shard_config", "shard_violations",
 ]
 
 

@@ -764,6 +764,19 @@ def compute_cost(app_or_plan, *, batch_size: int = 0,
         if top.state_bytes * 2 > report.state_bytes:
             report.dominant = top
 
+    # --- shard fleet pricing: @app:shards runs n full pipeline replicas,
+    # so the admission-relevant totals multiply by the shard count (shard
+    # replica apps have the annotation stripped, so each replica still
+    # prices singly and this never compounds) ---
+    from .sharding import shard_config
+    cfg = shard_config(app)
+    if cfg is not None and cfg.n > 1:
+        report.state_bytes *= cfg.n
+        report.compile_ladder *= cfg.n
+        report.notes.append(
+            f"x{cfg.n} shard fleet ({cfg.source}): state and compile "
+            "ladders price every replica")
+
     report.budget = app_budget(app)
     return report
 

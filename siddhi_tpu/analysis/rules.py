@@ -754,6 +754,60 @@ def cost_dominant_element(plan: PlanGraph) -> Iterable:
         yield _d(e.element, schema.defn, msg)
 
 
+@rule("SL601", Severity.ERROR,
+      "shard-ineligible element under @app:shards: a global operator "
+      "(count window, unkeyed aggregate, pattern, named window, trigger, "
+      "non-key join) would be silently wrong when sharded")
+def shard_ineligible(plan: PlanGraph) -> Iterable:
+    from .sharding import shard_config, shard_violations
+    cfg = shard_config(plan.app)
+    if cfg is None:
+        return
+    for v in shard_violations(plan, cfg.key):
+        msg = (f"not shard-eligible under partition key {cfg.key!r}: "
+               f"{v.reason} — the shard plane will refuse this app "
+               "(docs/SHARDING.md)")
+        if v.node is not None:
+            yield _q(v.node, msg)
+        else:
+            yield _d(v.element, v.defn, msg)
+
+
+@rule("SL602", Severity.WARN,
+      "skewed shard routing: a filter pins the partition key to one "
+      "literal, so every matching row hashes to a single shard")
+def skewed_shard_key(plan: PlanGraph) -> Iterable:
+    from ..query_api.expression import Variable
+    from .sharding import _conjuncts, shard_config
+    cfg = shard_config(plan.app)
+    if cfg is None:
+        return
+    for node in plan.queries:
+        for c in node.consumed:
+            chain = c.single.handlers
+            for f in tuple(chain.filters) + tuple(chain.post_window_filters):
+                for conj in _conjuncts(f):
+                    if not (isinstance(conj, Compare)
+                            and conj.op is CompareOp.EQUAL):
+                        continue
+                    sides = (conj.left, conj.right)
+                    var = next((s for s in sides
+                                if isinstance(s, Variable)
+                                and s.attribute == cfg.key), None)
+                    lit = next((s for s in sides
+                                if isinstance(s, Constant)), None)
+                    if var is None or lit is None:
+                        continue
+                    yield _q(node,
+                             f"filter pins partition key {cfg.key!r} to "
+                             f"literal {lit.value!r}: every matching row "
+                             f"hashes to ONE of the {cfg.n} shards, so "
+                             "this query's traffic cannot scale past one "
+                             "replica — shard by a higher-cardinality "
+                             "key, or drop @app:shards for this app "
+                             "(docs/SHARDING.md)")
+
+
 def check_query(query: Query) -> None:
     """Hook for future per-query API use; kept minimal."""
     _ = query

@@ -1,0 +1,388 @@
+"""Shard-eligibility classification for the sharded execution plane.
+
+`parallel/shard_plane.py` runs one replica of the whole pipeline per shard
+and routes ingress rows by a partition-key hash. That is only CORRECT for
+operators whose output is a function of one key's event subsequence —
+"key-local" in the partitioned-stream semantics taxonomy (per-key ordering
+is preserved by the router; cross-key interleaving is not). Everything
+whose state or emission depends on the GLOBAL arrival sequence — unkeyed
+windows, count-based window boundaries, patterns, non-equi joins — would
+be silently wrong under sharding, so the classifier here refuses it loudly
+(SL601 at lint time, `SiddhiAppCreationError` at creation time).
+
+The taxonomy (docs/SHARDING.md mirrors this table):
+
+key-local (shard-eligible)
+    - stateless per-row queries (filters / projections / scalar functions)
+    - windowless running aggregates whose GROUP BY contains the partition
+      key (emission is per input row; state is per group)
+    - time-driven windows (`time`, `timeBatch`, `externalTime*`, `session`,
+      `delay`) aggregated with the partition key in GROUP BY — eviction
+      depends on timestamps only, never on cross-key arrival counts
+    - joins whose ON condition equates the partition key across both sides,
+      each side windowless (tables) or time-driven
+    - `partition with (key of Stream)` blocks keyed by the partition key
+
+global (refused)
+    - count-based windows (`length`, `lengthBatch`, `sort`, ...): the
+      window boundary counts OTHER keys' arrivals
+    - aggregates without the partition key in GROUP BY
+    - patterns / sequences (cross-key ordered NFA matching)
+    - named `define window` (state shared by reference across queries)
+    - output rate limiting (wall-clock / count batching spans keys)
+    - triggers (each shard's scheduler would fire its own copy)
+    - `@source`-fed streams (each replica would connect the transport)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from ..query_api import SiddhiApp
+from ..query_api.execution import (
+    JoinInputStream,
+    Selector,
+    SingleInputStream,
+    StateInputStream,
+    ValuePartitionType,
+)
+from ..query_api.expression import (
+    And,
+    AttributeFunction,
+    Compare,
+    CompareOp,
+    Constant,
+    Expression,
+    IsNull,
+    MathExpression,
+    Not,
+    Or,
+    Variable,
+)
+from .plan import PlanGraph, QueryNode
+
+#: windows whose eviction/emission boundary is a function of timestamps
+#: only — per-shard replicas see the same boundary for a key's rows as the
+#: serial engine does (count-based boundaries are NOT in this set: they
+#: count other keys' arrivals)
+TIME_DRIVEN_WINDOWS = frozenset({
+    "time", "timebatch", "externaltime", "externaltimebatch", "session",
+    "delay",
+})
+
+KEY_LOCAL = "key-local"
+GLOBAL = "global"
+
+
+@dataclass(frozen=True)
+class ShardConfig:
+    """Parsed `@app:shards(n=, key=)` (+ `SIDDHI_SHARDS` n override)."""
+
+    n: int
+    key: str
+    source: str = "@app:shards"
+
+
+def shard_config(app: Optional[SiddhiApp],
+                 strict: bool = False) -> Optional[ShardConfig]:
+    """The app's shard configuration, or None when it has no `@app:shards`
+    annotation. `SIDDHI_SHARDS` overrides the annotation's `n` (so CI can
+    sweep shard counts over one app text) but never turns sharding on by
+    itself — an env var must not reshard every app on the host. With
+    `strict` a malformed annotation raises `SiddhiAppCreationError`;
+    otherwise (lint paths, which must never crash creation) it returns
+    None."""
+    if app is None:
+        return None
+    ann = app.annotation("app:shards")
+    if ann is None:
+        return None
+    key = ann.element("key")
+    n_s = ann.element("n") or ann.element()
+    source = "@app:shards"
+    env = os.environ.get("SIDDHI_SHARDS", "").strip()
+    if env:
+        n_s, source = env, "SIDDHI_SHARDS"
+
+    def bad(msg: str):
+        if strict:
+            from ..errors import SiddhiAppCreationError
+            raise SiddhiAppCreationError(
+                f"@app:shards on {app.name!r}: {msg} "
+                "(docs/SHARDING.md)")
+        return None
+
+    if not key:
+        return bad("a partition key is required: "
+                   "@app:shards(n='4', key='symbol')")
+    try:
+        n = int(n_s) if n_s else 0
+    except ValueError:
+        return bad(f"shard count {n_s!r} is not an integer")
+    if n < 1:
+        return bad(f"shard count must be >= 1, got {n}")
+    return ShardConfig(n=n, key=key, source=source)
+
+
+# --------------------------------------------------------------------------
+# expression helpers
+# --------------------------------------------------------------------------
+
+
+def _walk(expr) -> list:
+    """Flatten an expression tree to its nodes (pre-order)."""
+    out, stack = [], [expr]
+    while stack:
+        e = stack.pop()
+        if e is None or not isinstance(e, Expression):
+            continue
+        out.append(e)
+        if isinstance(e, (And, Or)):
+            stack += [e.left, e.right]
+        elif isinstance(e, Not):
+            stack.append(e.expression)
+        elif isinstance(e, Compare):
+            stack += [e.left, e.right]
+        elif isinstance(e, MathExpression):
+            stack += [e.left, e.right]
+        elif isinstance(e, AttributeFunction):
+            stack += list(e.parameters)
+        elif isinstance(e, IsNull):
+            stack.append(e.expression)
+    return out
+
+
+def _is_aggregator(fn: AttributeFunction) -> bool:
+    from ..extension.registry import GLOBAL as REG
+    from ..extension.registry import ExtensionKind
+    try:
+        return REG.lookup(ExtensionKind.AGGREGATOR, fn.namespace,
+                          fn.name) is not None
+    except Exception:
+        return False
+
+
+def _selector_has_aggregates(sel: Selector) -> bool:
+    for attr in sel.attributes:
+        for node in _walk(attr.expression):
+            if isinstance(node, AttributeFunction) and _is_aggregator(node):
+                return True
+    if sel.having is not None:
+        for node in _walk(sel.having):
+            if isinstance(node, AttributeFunction) and _is_aggregator(node):
+                return True
+    return False
+
+
+def _group_by_has_key(group_by, key: str) -> bool:
+    return any(isinstance(v, Variable) and v.attribute == key
+               for v in group_by)
+
+
+def _conjuncts(expr) -> list:
+    """Top-level AND-ed conjuncts of a condition."""
+    if expr is None:
+        return []
+    if isinstance(expr, And):
+        return _conjuncts(expr.left) + _conjuncts(expr.right)
+    return [expr]
+
+
+def _equates_key_across_sides(on, key: str, left_refs: set,
+                              right_refs: set) -> bool:
+    """True when some top-level conjunct of `on` is `l.key == r.key` with
+    `l`/`r` referencing opposite join sides (bare variables count for
+    either side)."""
+    for c in _conjuncts(on):
+        if not (isinstance(c, Compare) and c.op is CompareOp.EQUAL):
+            continue
+        lv, rv = c.left, c.right
+        if not (isinstance(lv, Variable) and isinstance(rv, Variable)):
+            continue
+        if lv.attribute != key or rv.attribute != key:
+            continue
+        l_sid, r_sid = lv.stream_id, rv.stream_id
+        l_left = l_sid is None or l_sid in left_refs
+        l_right = l_sid is None or l_sid in right_refs
+        r_left = r_sid is None or r_sid in left_refs
+        r_right = r_sid is None or r_sid in right_refs
+        if (l_left and r_right) or (l_right and r_left):
+            return True
+    return False
+
+
+def _window_time_driven(single: SingleInputStream) -> Optional[bool]:
+    """None = no window; True/False = window present and (not) time-driven."""
+    w = single.handlers.window
+    if w is None:
+        return None
+    return w.name.lower() in TIME_DRIVEN_WINDOWS
+
+
+# --------------------------------------------------------------------------
+# classification
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ShardClass:
+    """One element's verdict: `cls` is KEY_LOCAL or GLOBAL; GLOBAL entries
+    carry the reason sharding would be silently wrong."""
+
+    element: str
+    cls: str
+    reason: str
+    node: Optional[QueryNode] = None  # set for query verdicts
+    defn: object = None  # set for definition-level verdicts
+
+
+def _classify_query(node: QueryNode, plan: PlanGraph,
+                    key: str) -> ShardClass:
+    q = node.query
+    sel = q.selector
+    has_agg = _selector_has_aggregates(sel)
+    gb_key = _group_by_has_key(sel.group_by, key)
+
+    def verdict(cls, reason):
+        return ShardClass(node.name, cls, reason, node=node)
+
+    if q.output_rate is not None:
+        return verdict(GLOBAL, "output rate limiting batches emissions on "
+                               "a per-runtime clock/count that spans keys")
+    if node.partition is not None:
+        for pt in node.partition.partition_types:
+            if not (isinstance(pt, ValuePartitionType)
+                    and isinstance(pt.expression, Variable)
+                    and pt.expression.attribute == key):
+                return verdict(
+                    GLOBAL,
+                    f"partitioned by something other than the partition "
+                    f"key {key!r} — per-shard instances would split one "
+                    "partition group across shards")
+        # partition keyed by the shard key: every inner element is per-key
+        return verdict(KEY_LOCAL, f"partition with ({key} of ...)")
+    istream = q.input_stream
+    if isinstance(istream, StateInputStream):
+        return verdict(GLOBAL, "pattern/sequence matching is ordered "
+                               "across keys (cross-key NFA state)")
+    if isinstance(istream, JoinInputStream):
+        left_refs = {istream.left.reference_id, istream.left.stream_id}
+        right_refs = {istream.right.reference_id, istream.right.stream_id}
+        if not _equates_key_across_sides(istream.on, key, left_refs,
+                                         right_refs):
+            return verdict(
+                GLOBAL,
+                f"join does not equate the partition key {key!r} across "
+                "both sides — matching pairs would land on different "
+                "shards")
+        for side, name in ((istream.left, "left"), (istream.right, "right")):
+            td = _window_time_driven(side)
+            if td is False:
+                return verdict(
+                    GLOBAL,
+                    f"{name} join side uses count-based window "
+                    f"#window.{side.handlers.window.name} — its eviction "
+                    "boundary counts other keys' arrivals")
+        return verdict(KEY_LOCAL, f"equi-join on partition key {key!r}")
+    # single input stream
+    single = node.consumed[0].single if node.consumed else istream
+    td = _window_time_driven(single)
+    if td is None:
+        if has_agg and not gb_key:
+            return verdict(
+                GLOBAL,
+                f"running aggregate without the partition key {key!r} in "
+                "GROUP BY accumulates across keys")
+        if has_agg:
+            return verdict(KEY_LOCAL,
+                           f"per-key running aggregate (group by {key})")
+        return verdict(KEY_LOCAL, "stateless per-row query")
+    if not td:
+        return verdict(
+            GLOBAL,
+            f"count-based window #window.{single.handlers.window.name} — "
+            "its boundary counts other keys' arrivals")
+    if not (has_agg and gb_key):
+        return verdict(
+            GLOBAL,
+            f"windowed query without the partition key {key!r} in GROUP "
+            "BY — window contents span keys")
+    return verdict(KEY_LOCAL,
+                   f"time-driven window grouped by partition key {key!r}")
+
+
+def classify_plan(plan: PlanGraph, key: str) -> list[ShardClass]:
+    """Shard-eligibility verdict for every execution element plus the
+    app-level hazards (key-less ingress streams, triggers, named windows,
+    sources). Order: definition-level verdicts first, then queries in plan
+    order."""
+    app = plan.app
+    out: list[ShardClass] = []
+    consumed_ids = {c.stream_id for node in plan.queries
+                    for c in node.consumed}
+    for sid, sdef in app.stream_definitions.items():
+        attrs = {a.name for a in sdef.attributes}
+        if any(a.name.lower() in ("source",)
+               for a in (sdef.annotations or ())):
+            out.append(ShardClass(
+                sid, GLOBAL,
+                "@source-fed stream: every shard replica would connect "
+                "the transport and double-ingest — feed sharded apps "
+                "through the plane's input handlers / REST frames",
+                defn=sdef))
+            continue
+        externally_fed = sid not in plan.producers or \
+            not plan.producers.get(sid)
+        if externally_fed and sid in consumed_ids and key not in attrs:
+            out.append(ShardClass(
+                sid, GLOBAL,
+                f"externally-fed stream lacks the partition key {key!r} — "
+                "its rows cannot be routed", defn=sdef))
+    for tid, tdef in app.trigger_definitions.items():
+        out.append(ShardClass(
+            tid, GLOBAL,
+            "trigger: each shard's scheduler would fire its own copy "
+            "(n duplicates of every trigger event)", defn=tdef))
+    for wid, wdef in app.window_definitions.items():
+        out.append(ShardClass(
+            wid, GLOBAL,
+            "named window: state shared by reference across queries is "
+            "not key-partitionable", defn=wdef))
+    for aid, adef in app.aggregation_definitions.items():
+        if _group_by_has_key(adef.group_by, key):
+            out.append(ShardClass(
+                aid, KEY_LOCAL,
+                f"incremental aggregation grouped by partition key {key!r}",
+                defn=adef))
+        else:
+            out.append(ShardClass(
+                aid, GLOBAL,
+                f"incremental aggregation without the partition key "
+                f"{key!r} in GROUP BY accumulates across keys", defn=adef))
+    for node in plan.queries:
+        out.append(_classify_query(node, plan, key))
+    return out
+
+
+def shard_violations(plan: PlanGraph, key: str) -> list[ShardClass]:
+    return [v for v in classify_plan(plan, key) if v.cls == GLOBAL]
+
+
+def check_shardable(app: SiddhiApp, key: str) -> None:
+    """Raise `SiddhiAppCreationError` (SL601) when any element of `app` is
+    global under partition key `key` — the plane refuses loudly rather
+    than running silently wrong."""
+    from ..errors import SiddhiAppCreationError
+    from .plan import build_plan
+
+    plan = build_plan(app)
+    bad = shard_violations(plan, key)
+    if bad:
+        lines = "\n".join(f"  [{v.element}] {v.reason}" for v in bad)
+        raise SiddhiAppCreationError(
+            f"SL601: app {app.name!r} is not shard-eligible under "
+            f"partition key {key!r} — {len(bad)} global element(s):\n"
+            f"{lines}\nRemove @app:shards or restructure the queries "
+            "(docs/SHARDING.md has the eligibility taxonomy).")

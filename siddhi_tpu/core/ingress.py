@@ -663,3 +663,220 @@ def _stage_cell(total_ns: int, count: int) -> dict:
     total_ms = total_ns / 1e6
     return {"total_ms": total_ms, "batches": count,
             "mean_ms": total_ms / count if count else 0.0}
+
+
+# ==========================================================================
+# partition-key shard router (parallel/shard_plane.py's ingress half)
+# ==========================================================================
+
+
+class ShardRouter:
+    """Routes rows to shard replicas by partition-key hash BEFORE any
+    interning — dictionary codes are process- (and shard-) local, so the
+    hash runs over ORIGINAL values: raw UTF-8 bytes for strings, the
+    int64/float-bit mixing of `parallel.sharded.np_shard_of` for numerics
+    (host routing stays bit-exact with the device key hash).
+
+    Two-level map: `slot = hash(value) % n_slots` is stable for the life of
+    the app; `assignment[slot] -> shard` is the mutable part — rebalancing
+    republishes the assignment table instead of rehashing the world, and
+    per-slot routed-row counters feed the skew detector. Row order within
+    one (producer, key) pair is preserved: a boolean-mask split keeps
+    relative order, and a key maps to exactly one shard per epoch."""
+
+    #: FNV-1a 64-bit parameters — shared with np_shard_of
+    _FNV_OFFSET = 0xCBF29CE484222325
+    _FNV_PRIME = 0x100000001B3
+    _MASK = (1 << 64) - 1
+
+    def __init__(self, key: str, n_shards: int, n_slots: int = 64,
+                 assignment=None) -> None:
+        import threading
+
+        import numpy as np
+        if n_slots < n_shards:
+            n_slots = n_shards
+        self.key = key
+        self.n_shards = n_shards
+        self.n_slots = n_slots
+        if assignment is not None:
+            assignment = np.asarray(assignment, dtype=np.int64)
+            if assignment.shape[0] != n_slots or \
+                    (len(assignment) and assignment.max() >= n_shards):
+                raise ValueError(
+                    f"shard assignment must map {n_slots} slots to "
+                    f"[0, {n_shards})")
+            self.assignment = assignment.copy()
+        else:
+            self.assignment = np.arange(n_slots, dtype=np.int64) % n_shards
+        self._lock = threading.Lock()
+        #: rows routed per slot / per shard since the current epoch began
+        self.slot_rows = np.zeros(n_slots, dtype=np.int64)
+        self.routed = np.zeros(n_shards, dtype=np.int64)
+        self.total_rows = 0
+        #: string value -> slot memo (the router-side analogue of the
+        #: string table: the key universe is the dictionary universe)
+        self._str_slots: dict = {}
+
+    # ------------------------------------------------------------ hashing
+
+    def _slot_of_str(self, s: str) -> int:
+        slot = self._str_slots.get(s)
+        if slot is None:
+            h = self._FNV_OFFSET
+            for b in s.encode("utf-8"):
+                h = ((h ^ b) * self._FNV_PRIME) & self._MASK
+            h ^= h >> 29
+            slot = (h & 0xFFFFFFFF) % self.n_slots
+            self._str_slots[s] = slot
+        return slot
+
+    def slot_of(self, value) -> int:
+        """Stable slot of one ORIGINAL key value (scalar mirror of
+        `slots_of_column` — tests assert they agree)."""
+        import struct
+        if value is None:
+            return 0
+        if isinstance(value, str):
+            return self._slot_of_str(value)
+        if isinstance(value, bool):
+            value = int(value)
+        if isinstance(value, float):
+            value = struct.unpack("<q", struct.pack("<d", value))[0]
+        x = int(value) & self._MASK
+        h = ((self._FNV_OFFSET ^ x) * self._FNV_PRIME) & self._MASK
+        h ^= h >> 29
+        return (h & 0xFFFFFFFF) % self.n_slots
+
+    def slots_of_column(self, col, n=None):
+        """Vectorized `slot_of` over one key column: a numpy array, an
+        object array of strings, or a `('dict', values, idx)` wire triple
+        (hashed per DISTINCT value, mapped through the index)."""
+        import numpy as np
+        if isinstance(col, tuple) and len(col) == 3 and col[0] == "dict":
+            _tag, values, idx = col
+            idx = np.asarray(idx)[:n] if n is not None else np.asarray(idx)
+            vslots = np.array(
+                [self.slot_of(v) for v in values], dtype=np.int64) \
+                if len(values) else np.zeros(0, dtype=np.int64)
+            out = np.zeros(idx.shape[0], dtype=np.int64)
+            valid = idx >= 0
+            if valid.any():
+                out[valid] = vslots[idx[valid]]
+            return out
+        arr = np.asarray(col)
+        if n is not None:
+            arr = arr[:n]
+        if arr.dtype.kind in ("O", "U"):
+            return np.array([self.slot_of(v) for v in arr.tolist()],
+                            dtype=np.int64)
+        from ..parallel.sharded import np_shard_of
+        return np_shard_of([arr], self.n_slots).astype(np.int64)
+
+    # ------------------------------------------------------------ routing
+
+    def shard_of(self, value) -> int:
+        return int(self.assignment[self.slot_of(value)])
+
+    def note_routed(self, slots) -> None:
+        """Account one routed batch into the skew counters."""
+        import numpy as np
+        counts = np.bincount(slots, minlength=self.n_slots)
+        with self._lock:
+            self.slot_rows += counts
+            np.add.at(self.routed, self.assignment, counts)
+            self.total_rows += int(counts.sum())
+
+    def split_rows(self, tss, rows, key_index: int):
+        """{shard: (tss, rows)} preserving per-shard row order."""
+        groups: dict = {}
+        slots = []
+        for ts, row in zip(tss, rows):
+            slot = self.slot_of(row[key_index])
+            slots.append(slot)
+            shard = int(self.assignment[slot])
+            g = groups.get(shard)
+            if g is None:
+                g = groups[shard] = ([], [])
+            g[0].append(ts)
+            g[1].append(row)
+        import numpy as np
+        self.note_routed(np.asarray(slots, dtype=np.int64))
+        return groups
+
+    def split_columns(self, columns: dict, ts_arr, n: int):
+        """{shard: (ts_sub, cols_sub, count)} — columns may mix numpy
+        arrays and `('dict', values, idx)` triples; dict columns are
+        COMPACTED per shard (`io.wire.subset_dict_column`) so each shard
+        interns only the values its keys reference."""
+        import numpy as np
+
+        from ..io.wire import subset_dict_column
+        key_col = columns.get(self.key)
+        if key_col is None:
+            raise KeyError(
+                f"shard routing: batch has no partition-key column "
+                f"{self.key!r}")
+        slots = self.slots_of_column(key_col, n)
+        self.note_routed(slots)
+        shards = self.assignment[slots]
+        out: dict = {}
+        for shard in np.unique(shards):
+            sel = shards == shard
+            cols_sub = {}
+            for name, col in columns.items():
+                if isinstance(col, tuple) and len(col) == 3 \
+                        and col[0] == "dict":
+                    cols_sub[name] = subset_dict_column(
+                        col[1], np.asarray(col[2])[:n], sel)
+                else:
+                    cols_sub[name] = np.asarray(col)[:n][sel]
+            out[int(shard)] = (np.asarray(ts_arr)[:n][sel], cols_sub,
+                               int(sel.sum()))
+        return out
+
+    # ------------------------------------------------------- skew detector
+
+    def skew_report(self) -> dict:
+        """Per-shard routed totals + the imbalance ratio the rebalance
+        trigger keys off (max shard load over the even-split ideal)."""
+        import numpy as np
+        with self._lock:
+            routed = self.routed.copy()
+            slot_rows = self.slot_rows.copy()
+            total = self.total_rows
+        ideal = total / self.n_shards if self.n_shards else 0.0
+        imbalance = float(routed.max() / ideal) if ideal > 0 else 1.0
+        hot = np.argsort(slot_rows)[::-1][:8]
+        return {
+            "total_rows": int(total),
+            "per_shard": {f"s{i}": int(r) for i, r in enumerate(routed)},
+            "imbalance": imbalance,
+            "hot_slots": [
+                {"slot": int(s), "shard": int(self.assignment[s]),
+                 "rows": int(slot_rows[s])}
+                for s in hot if slot_rows[s] > 0],
+        }
+
+    def propose_assignment(self):
+        """Greedy LPT bin-packing of slots onto shards by observed load —
+        heaviest slot first onto the lightest shard. Slots with no traffic
+        keep their current shard (no gratuitous state moves)."""
+        import numpy as np
+        with self._lock:
+            slot_rows = self.slot_rows.copy()
+        proposal = self.assignment.copy()
+        load = np.zeros(self.n_shards, dtype=np.int64)
+        active = [int(s) for s in np.argsort(slot_rows)[::-1]
+                  if slot_rows[s] > 0]
+        for slot in active:
+            shard = int(np.argmin(load))
+            proposal[slot] = shard
+            load[shard] += int(slot_rows[slot])
+        return proposal
+
+    def reset_counters(self) -> None:
+        with self._lock:
+            self.slot_rows[:] = 0
+            self.routed[:] = 0
+            self.total_rows = 0

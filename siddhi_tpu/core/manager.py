@@ -90,6 +90,33 @@ class SiddhiManager:
             # queue mode: defer — no device state has been allocated
             self.pending_apps.append((app, kwargs))
             return None
+        if self._lint_enabled:
+            # @app:shards (n overridable via SIDDHI_SHARDS) builds a sharded
+            # execution plane — N replicas behind a partition-key router —
+            # in place of a single runtime. Internal analysis managers
+            # (_lint_enabled=False: sandbox/jaxpr builds) never construct
+            # planes: replicas are plain runtimes with the annotation
+            # stripped, so recursion terminates there too.
+            from ..analysis.sharding import shard_config
+            cfg = shard_config(app, strict=True)
+            if cfg is not None:
+                from ..parallel.shard_plane import ShardPlane
+                plane = ShardPlane(
+                    app, self.registry, config=cfg,
+                    batch_size=batch_size, group_capacity=group_capacity,
+                    error_store=self.error_store,
+                    config_manager=self.config_manager,
+                    mesh=mesh, partition_capacity=partition_capacity,
+                    async_callbacks=async_callbacks,
+                    auto_flush_ms=auto_flush_ms, aot_warmup=aot_warmup,
+                    wal_dir=wal_dir,
+                    persistence_interval_s=persistence_interval_s,
+                    optimize=optimize)
+                if self.persistence_store is not None:
+                    plane.persistence_store = self.persistence_store
+                plane.lint_report = lint_report
+                self.runtimes[app.name] = plane
+                return plane
         rt = SiddhiAppRuntime(app, self.registry, batch_size=batch_size,
                               group_capacity=group_capacity,
                               error_store=self.error_store,
@@ -266,6 +293,13 @@ class SiddhiManager:
             raise SiddhiAppCreationError(
                 f"cannot upgrade {new_app.name!r}: no running app by that "
                 "name (deploy it instead)")
+        if getattr(old, "is_shard_plane", False):
+            raise SiddhiAppCreationError(
+                f"cannot upgrade sharded app {new_app.name!r} in place: "
+                "the blue-green upgrade path swaps ONE runtime, not a "
+                "shard fleet — redeploy the plane, or move replicas one "
+                "at a time with rebalance()/move_shard() "
+                "(docs/SHARDING.md)")
         return upgrade_app(self, old, new_app, force=force)
 
     def replay(self, app: Union[str, "SiddhiApp"], wal_dir: str, *,
@@ -304,7 +338,12 @@ class SiddhiManager:
         """Reference: SiddhiManager.setErrorStore — shared by all apps."""
         self.error_store = store
         for rt in self.runtimes.values():
-            rt.ctx.error_store = store
+            if getattr(rt, "is_shard_plane", False):
+                for srt in rt.shards:
+                    if srt is not None:
+                        srt.ctx.error_store = store
+            else:
+                rt.ctx.error_store = store
 
     def set_config_manager(self, config_manager) -> None:
         """Reference: SiddhiManager.setConfigManager — deployment config for

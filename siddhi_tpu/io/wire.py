@@ -243,11 +243,36 @@ def materialize_strings(col) -> np.ndarray:
     return lut[idx.astype(np.int64) + 1]
 
 
+def subset_dict_column(values, idx, sel) -> tuple:
+    """A ('dict', values, idx) column restricted to boolean mask `sel`,
+    with the value list COMPACTED to just the entries the surviving rows
+    reference — the shard router's pre-interning subset: a shard's string
+    table interns only the keys routed to it, never the whole frame
+    dictionary."""
+    idx = np.asarray(idx)
+    sub = idx[sel]
+    valid = sub >= 0
+    used = np.unique(sub[valid]) if valid.any() else \
+        np.zeros(0, dtype=np.int64)
+    remap = np.full(len(values), -1, dtype=np.int32)
+    remap[used] = np.arange(len(used), dtype=np.int32)
+    new_idx = np.where(valid, remap[np.clip(sub, 0, None)],
+                       np.int32(-1)).astype(np.int32)
+    return ("dict", [values[int(i)] for i in used], new_idx)
+
+
 def deliver_frames(handler, body) -> int:
     """Decode every frame in `body` and feed it through `handler`'s
     junction: straight into the ingress pipeline when one is running
     (zero-copy: numeric views + dictionary interning per distinct value),
-    else through the ordinary send_columns path. Returns rows accepted."""
+    else through the ordinary send_columns path. Returns rows accepted.
+
+    A handler carrying its own `deliver_frames` (the shard plane's routing
+    handler) owns the whole decode-route-deliver sequence: frames are
+    hashed on ORIGINAL dictionary values and split per shard BEFORE any
+    interning."""
+    if hasattr(handler, "deliver_frames"):
+        return handler.deliver_frames(body)
     j = handler.junction
     plan = schema_plan(j.definition)
     total = 0

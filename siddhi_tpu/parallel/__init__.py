@@ -1,0 +1,20 @@
+"""siddhi_tpu.parallel — multi-device and multi-replica execution.
+
+`sharded.py` shards columnar batches across mesh devices inside ONE
+runtime; `multihost.py` coordinates multi-process ingestion; and
+`shard_plane.py` runs N full pipeline replicas behind a partition-key
+router (`@app:shards(n=, key=)` — the manager builds a `ShardPlane`
+transparently)."""
+
+from __future__ import annotations
+
+__all__ = ["ShardPlane", "ShardInputHandler"]
+
+
+def __getattr__(name: str):
+    # lazy: importing the plane pulls in the whole runtime stack, which
+    # the light-weight mesh helpers in sharded.py must not pay for
+    if name in __all__:
+        from . import shard_plane
+        return getattr(shard_plane, name)
+    raise AttributeError(name)

@@ -1,0 +1,716 @@
+"""Sharded execution plane: partition-key sharding of stateful queries.
+
+One `ShardPlane` runs N full replicas of an app's pipeline (windows,
+group-bys, joins, breakers, SLO engines, telemetry — everything a
+`SiddhiAppRuntime` owns), and routes every ingress row to exactly one
+replica by partition-key hash BEFORE interning: each shard's string table
+holds only the dictionary values its keys reference, each shard journals
+its own subset into its own WAL directory (`<wal_dir>/<App>@s<i>/`,
+extending the journal naming of state/wal.py), and each shard trips its
+own breakers and burns its own SLO budget. The plane itself duck-types the
+`SiddhiAppRuntime` surface the service layer and the manager use —
+`SiddhiManager.create_siddhi_app_runtime` builds one transparently when
+the app carries `@app:shards(n=, key=)` (SIDDHI_SHARDS overrides n).
+
+Correctness envelope: only key-local plans are admitted
+(`analysis.sharding.check_shardable` refuses global operators loudly —
+SL601). For an admitted plan, the merged output is a key-interleaving of
+per-key output sequences that are bit-identical to the serial engine's:
+per-key input order is preserved by the router, per-key state never leaves
+its shard, and windowless running aggregates emit per input row.
+
+Rebalancing: `slot = hash(key) % n_slots` is fixed; `assignment[slot] ->
+shard` is the mutable table. `rebalance()` consults the router's skew
+counters, computes a greedy LPT re-assignment, and performs a tiny
+blue-green swap in the spirit of core/upgrade.py: pause intake at the
+gate, drain every shard, rebuild the fleet from the full per-shard WAL
+history re-routed through the NEW assignment (device state is not
+key-addressable, so slot moves reconstruct state from the journal — which
+is why rebalance() requires WAL-backed planes with an unrotated journal),
+commit the new epoch's meta file atomically, cut the router over, retire
+the old replicas. `move_shard()` is the single-shard primitive that DOES
+reuse the per-element snapshot/restore + WAL-handover recipe verbatim
+(same epoch, same keys, fresh runtime) — the building block for moving a
+replica off a sick device.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..analysis.sharding import ShardConfig, check_shardable, shard_config
+from ..core.ingress import ShardRouter
+from ..errors import SiddhiAppCreationError
+from ..query_api import SiddhiApp
+
+log = logging.getLogger("siddhi_tpu")
+
+#: slots in the hash ring (env-tunable; more slots = finer-grained
+#: rebalancing at the cost of a bigger assignment table)
+DEFAULT_SLOTS = 64
+
+
+def _n_slots() -> int:
+    v = os.environ.get("SIDDHI_SHARD_SLOTS", "").strip()
+    try:
+        return max(1, int(v)) if v else DEFAULT_SLOTS
+    except ValueError:
+        return DEFAULT_SLOTS
+
+
+class _IngressGate:
+    """Pause/resume gate for routed sends: senders pass through
+    concurrently (work fans out to per-shard runtimes, each with its own
+    controller lock); `pause()` blocks new sends and waits out in-flight
+    ones so a rebalance/move sees a quiesced router."""
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._active = 0
+        self._paused = False
+
+    def __enter__(self):
+        with self._cond:
+            while self._paused:
+                self._cond.wait()
+            self._active += 1
+        return self
+
+    def __exit__(self, *exc):
+        with self._cond:
+            self._active -= 1
+            self._cond.notify_all()
+        return False
+
+    def pause(self) -> None:
+        with self._cond:
+            self._paused = True
+            while self._active:
+                self._cond.wait()
+
+    def resume(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+
+class ShardInputHandler:
+    """The plane's routing input handler: same send surface as
+    `core.stream.InputHandler`, but every path hashes the partition key
+    over ORIGINAL values and fans per-shard subsets out to the replica
+    handlers. `wire.deliver_frames` dispatches to `deliver_frames` here,
+    so SXF1 frames are split (dictionaries compacted per shard) before any
+    interning."""
+
+    def __init__(self, plane: "ShardPlane", stream_id: str) -> None:
+        self.plane = plane
+        self.stream_id = stream_id
+        defn = plane.shards[0].junctions[stream_id].definition
+        self.definition = defn
+        names = [a.name for a in defn.attributes]
+        if plane.key not in names:
+            raise SiddhiAppCreationError(
+                f"stream {stream_id!r} has no partition-key attribute "
+                f"{plane.key!r}; it cannot be routed (docs/SHARDING.md)")
+        self._key_index = names.index(plane.key)
+
+    def _shard_handler(self, shard: int):
+        return self.plane.shards[shard].get_input_handler(self.stream_id)
+
+    def send(self, data, timestamp: Optional[int] = None) -> None:
+        from ..core.event import Event
+        if isinstance(data, Event):
+            self.send_batch([tuple(data.data)], timestamps=[data.timestamp])
+            return
+        if isinstance(data, list) and data and isinstance(data[0], Event):
+            self.send_batch([tuple(e.data) for e in data],
+                            timestamps=[e.timestamp for e in data])
+            return
+        self.send_batch([tuple(data)], timestamps=timestamp)
+
+    def send_batch(self, rows, timestamps=None) -> None:
+        n = len(rows)
+        if n == 0:
+            return
+        plane = self.plane
+        with plane.gate:
+            if timestamps is None or isinstance(timestamps, int):
+                ts = timestamps if timestamps is not None else \
+                    plane.shards[0].ctx.timestamp_generator.current_time()
+                tss = [ts] * n
+            else:
+                tss = [int(t) for t in timestamps]
+            for shard, (stss, srows) in plane.router.split_rows(
+                    tss, rows, self._key_index).items():
+                self._shard_handler(shard).send_batch(srows, timestamps=stss)
+
+    def send_columns(self, columns: dict, timestamps=None,
+                     count: Optional[int] = None) -> None:
+        n = count if count is not None else \
+            min(len(v[2]) if isinstance(v, tuple) else len(v)
+                for v in columns.values())
+        if n == 0:
+            return
+        plane = self.plane
+        with plane.gate:
+            if timestamps is None or isinstance(timestamps, int):
+                ts = timestamps if timestamps is not None else \
+                    plane.shards[0].ctx.timestamp_generator.current_time()
+                ts_arr = np.full(n, ts, dtype=np.int64)
+            else:
+                ts_arr = np.asarray(timestamps, dtype=np.int64)
+            from ..io import wire
+            for shard, (ts_sub, cols_sub, cnt) in plane.router.split_columns(
+                    columns, ts_arr, n).items():
+                plain = {k: (wire.materialize_strings(v)
+                             if isinstance(v, tuple) else v)
+                         for k, v in cols_sub.items()}
+                self._shard_handler(shard).send_columns(
+                    plain, timestamps=ts_sub, count=cnt)
+
+    def deliver_frames(self, body) -> int:
+        """SXF1 frame path: decode once, hash the key column's DISTINCT
+        dictionary values, split per shard with compacted dictionaries,
+        deliver each subset through the shard's own frame-speed path."""
+        from ..io import wire
+        plan = wire.schema_plan(self.definition)
+        total = 0
+        plane = self.plane
+        for payload in wire.iter_frames(body):
+            ts, cols, n = wire.decode_frame(payload, plan)
+            if n == 0:
+                continue
+            with plane.gate:
+                if ts is None:
+                    now = plane.shards[0].ctx.timestamp_generator \
+                        .current_time()
+                    ts = np.full(n, now, dtype=np.int64)
+                for shard, (ts_sub, cols_sub, cnt) in \
+                        plane.router.split_columns(cols, ts, n).items():
+                    h = self._shard_handler(shard)
+                    plain = {
+                        k: (wire.materialize_strings(v)
+                            if isinstance(v, tuple) else v)
+                        for k, v in cols_sub.items()}
+                    h.send_columns(plain, timestamps=ts_sub, count=cnt)
+                total += n
+        return total
+
+
+class ShardPlane:
+    """N-replica sharded runtime behind the `SiddhiAppRuntime` duck-typed
+    surface (service.py, manager registry, persist/recover, statistics all
+    work unchanged)."""
+
+    is_shard_plane = True
+
+    def __init__(self, app: SiddhiApp, registry, *,
+                 config: Optional[ShardConfig] = None,
+                 wal_dir: Optional[str] = None,
+                 persistence_interval_s=None, **runtime_kwargs) -> None:
+        if config is None:
+            config = shard_config(app, strict=True)
+        if config is None:
+            raise SiddhiAppCreationError(
+                f"app {app.name!r} has no @app:shards annotation")
+        check_shardable(app, config.key)  # refuse global plans loudly
+        self.app = app
+        self.name = app.name
+        self.config = config
+        self.n_shards = config.n
+        self.key = config.key
+        self.wal_base = wal_dir
+        self._registry = registry
+        self._runtime_kwargs = dict(runtime_kwargs)
+        self._persistence_interval_s = persistence_interval_s
+        self._persistence_store = None
+        self._callbacks: list[tuple] = []  # ("stream"|"query", id, args)
+        self._handlers: dict[str, ShardInputHandler] = {}
+        self.lint_report = None
+        self.gate = _IngressGate()
+        self.rebalances = 0
+        self._persisted_since_epoch = False
+        self._started = False
+
+        self.epoch, assignment = self._read_meta()
+        self.router = ShardRouter(config.key, config.n,
+                                  n_slots=_n_slots(),
+                                  assignment=assignment)
+        self.shards = [self._build_shard(i) for i in range(self.n_shards)]
+
+    # ------------------------------------------------------------- replicas
+
+    def _shard_name(self, i: int) -> str:
+        return f"{self.name}@s{i}"
+
+    def _shard_app(self, i: int) -> SiddhiApp:
+        """The replica app: renamed `<app>@s<i>` (per-shard WAL directory
+        and persistence revisions fall out of the app name) with
+        @app:shards stripped (a replica must never build its own plane or
+        fleet-multiply its own cost report)."""
+        import dataclasses as dc
+
+        from ..query_api.annotation import Annotation, Element
+        anns = [a for a in (self.app.annotations or ())
+                if a.name.lower() not in ("app:shards", "app:name")]
+        anns.insert(0, Annotation(
+            "app:name", (Element(None, self._shard_name(i)),)))
+        return dc.replace(self.app, annotations=anns)
+
+    def _epoch_wal_dir(self, epoch: int) -> Optional[str]:
+        """Epoch 0 journals directly under the user's wal_dir (the PR 7
+        layout, suffixed app names); later epochs live in `e<N>/` so a
+        rebalance can write the re-routed journal WITHOUT touching the old
+        epoch's segments until the meta commit point."""
+        if self.wal_base is None:
+            return None
+        return self.wal_base if epoch == 0 else \
+            os.path.join(self.wal_base, f"e{epoch}")
+
+    def _build_shard(self, i: int, *, epoch: Optional[int] = None,
+                     with_wal: bool = True):
+        from ..core.app_runtime import SiddhiAppRuntime
+        wd = self._epoch_wal_dir(self.epoch if epoch is None else epoch) \
+            if with_wal else None
+        rt = SiddhiAppRuntime(
+            self._shard_app(i), self._registry, wal_dir=wd,
+            persistence_interval_s=self._persistence_interval_s,
+            **self._runtime_kwargs)
+        if self._persistence_store is not None:
+            rt.persistence_store = self._persistence_store
+        if not rt.ctx.statistics.enabled:
+            # per-shard statistics sections and the conservation identity
+            # need per-stream delivery counts; BASIC is dict increments
+            rt.set_statistics_level("BASIC")
+        return rt
+
+    # ------------------------------------------------------------ meta file
+
+    def _meta_path(self) -> Optional[str]:
+        if self.wal_base is None:
+            return None
+        return os.path.join(self.wal_base, f"{self.name}.shardmeta.json")
+
+    def _read_meta(self):
+        path = self._meta_path()
+        if path is None or not os.path.exists(path):
+            return 0, None
+        try:
+            with open(path) as f:
+                meta = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            log.warning("shard meta %s unreadable; starting at epoch 0",
+                        path)
+            return 0, None
+        if meta.get("n_shards") != self.config.n or \
+                meta.get("n_slots") != _n_slots() or \
+                meta.get("key") != self.config.key:
+            raise SiddhiAppCreationError(
+                f"shard meta {path} was written for "
+                f"n={meta.get('n_shards')} key={meta.get('key')!r} "
+                f"slots={meta.get('n_slots')}; the app now asks for "
+                f"n={self.config.n} key={self.config.key!r} "
+                f"slots={_n_slots()} — recover with the original layout "
+                "first (docs/SHARDING.md)")
+        return int(meta.get("epoch", 0)), meta.get("assignment")
+
+    def _write_meta(self, epoch: int, assignment) -> None:
+        path = self._meta_path()
+        if path is None:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"epoch": epoch, "n_shards": self.n_shards,
+                       "n_slots": self.router.n_slots,
+                       "key": self.key,
+                       "assignment": [int(s) for s in assignment]}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # the rebalance commit point
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self, **kw) -> None:
+        for rt in self.shards:
+            rt.start(**kw)
+        self._started = True
+
+    def shutdown(self, *, flush_durable: bool = True, **kw) -> None:
+        for rt in self.shards:
+            if rt is not None:
+                rt.shutdown(flush_durable=flush_durable, **kw)
+        self._started = False
+
+    def flush(self, now: Optional[int] = None) -> None:
+        for rt in self.shards:
+            rt.flush(now)
+
+    def drain(self) -> None:
+        for rt in self.shards:
+            rt.drain()
+
+    def warmup(self, buckets=None) -> dict:
+        return {f"s{i}": rt.warmup(buckets)
+                for i, rt in enumerate(self.shards)}
+
+    def connect_sources(self) -> None:  # duck-typing: planes have none
+        pass
+
+    # ----------------------------------------------------------- ingestion
+
+    def get_input_handler(self, stream_id: str) -> ShardInputHandler:
+        h = self._handlers.get(stream_id)
+        if h is None:
+            h = self._handlers[stream_id] = ShardInputHandler(
+                self, stream_id)
+        return h
+
+    # ----------------------------------------------------------- callbacks
+
+    def add_callback(self, stream_id: str, callback,
+                     columnar: bool = False) -> None:
+        self._callbacks.append(("stream", stream_id, (callback, columnar)))
+        for rt in self.shards:
+            rt.add_callback(stream_id, callback, columnar=columnar)
+
+    def add_query_callback(self, query_name: str, callback) -> None:
+        self._callbacks.append(("query", query_name, (callback,)))
+        for rt in self.shards:
+            rt.add_query_callback(query_name, callback)
+
+    def _resubscribe(self, rt) -> None:
+        for kind, name, args in self._callbacks:
+            if kind == "stream":
+                cb, columnar = args
+                rt.add_callback(name, cb, columnar=columnar)
+            else:
+                rt.add_query_callback(name, args[0])
+
+    # ---------------------------------------------------------- durability
+
+    @property
+    def persistence_store(self):
+        return self._persistence_store
+
+    @persistence_store.setter
+    def persistence_store(self, store) -> None:
+        self._persistence_store = store
+        for rt in self.shards:
+            rt.persistence_store = store
+
+    def persist(self) -> dict:
+        """Snapshot + journal-rotate every shard. NOTE: rotation prunes
+        each shard's full WAL history, which `rebalance()` needs — a
+        post-persist rebalance is refused until the next epoch."""
+        out = {f"s{i}": rt.persist() for i, rt in enumerate(self.shards)}
+        self._persisted_since_epoch = True
+        return out
+
+    def restore_last_revision(self) -> dict:
+        return {f"s{i}": rt.restore_last_revision()
+                for i, rt in enumerate(self.shards)}
+
+    def recover(self) -> dict:
+        """Per-shard crash recovery (restore last revision + replay the
+        shard's own journal). Total `wal_replayed` sums the fleet."""
+        per = {}
+        replayed = 0
+        for i, rt in enumerate(self.shards):
+            r = rt.recover()
+            per[f"s{i}"] = r
+            replayed += int(r.get("wal_replayed", 0))
+        return {"revision": {k: v.get("revision") for k, v in per.items()},
+                "wal_replayed": replayed, "shards": per}
+
+    # -------------------------------------------------------------- health
+
+    def health(self) -> dict:
+        """Worst-state merge: one degraded/recovering shard degrades the
+        plane (load balancers should drain while a shard sheds load).
+        Breakers/queues are namespaced `s<i>/...`."""
+        order = {"stopped": 3, "recovering": 2, "degraded": 1, "running": 0}
+        state = "stopped" if not self.shards else "running"
+        breakers: dict = {}
+        queues: dict = {}
+        for i, rt in enumerate(self.shards):
+            if rt is None:
+                state = "stopped"
+                continue
+            h = rt.health()
+            if order.get(h["state"], 3) > order.get(state, 0):
+                state = h["state"]
+            for k, v in h["breakers"].items():
+                breakers[f"s{i}/{k}"] = v
+            for k, v in h["queues"].items():
+                queues[f"s{i}/{k}"] = v
+        return {"state": state, "breakers": breakers, "queues": queues}
+
+    # ---------------------------------------------------------- statistics
+
+    @property
+    def cost_report(self) -> dict:
+        """Fleet-priced static prediction: `@app:shards` makes
+        analysis/cost.py multiply state and compile ladders by the shard
+        count, so this is the number admission control charges."""
+        rep = getattr(self, "_cost_report", None)
+        if rep is None:
+            from ..analysis.cost import compute_cost
+            ctx = self.shards[0].ctx
+            rep = compute_cost(self.app, batch_size=ctx.batch_size,
+                               group_capacity=ctx.group_capacity).to_dict()
+            self._cost_report = rep
+        return rep
+
+    def conservation_report(self) -> dict:
+        """The routing conservation identity, checkable after `drain()`:
+        every routed row is delivered to, dropped by, or diverted from
+        exactly one shard — `sent == sum(delivered + dropped + diverted)`
+        per routed stream and in total."""
+        routed_streams = set(self._handlers)
+        per_shard = {}
+        delivered = dropped = diverted = 0
+        for i, rt in enumerate(self.shards):
+            st = rt.ctx.statistics
+            d = sum(int(st.events_in.get(s, 0)) for s in routed_streams)
+            dr = sum(sum(pol.values())
+                     for s, pol in st.ingress_dropped.items()
+                     if s in routed_streams)
+            dv = sum(int(n) for s, n in st.late_events.items()
+                     if s in routed_streams)
+            per_shard[f"s{i}"] = {"delivered": d, "dropped": dr,
+                                  "diverted": dv,
+                                  "routed": int(self.router.routed[i])}
+            delivered += d
+            dropped += dr
+            diverted += dv
+        sent = int(self.router.total_rows)
+        return {"sent": sent, "delivered": delivered, "dropped": dropped,
+                "diverted": diverted,
+                "conserved": sent == delivered + dropped + diverted,
+                "per_shard": per_shard}
+
+    def skew_report(self) -> dict:
+        rep = self.router.skew_report()
+        rep["epoch"] = self.epoch
+        rep["rebalances"] = self.rebalances
+        return rep
+
+    def statistics_report(self) -> dict:
+        """Per-shard sections + the plane's own routing/skew/conservation
+        view (the service's /statistics endpoint serves this verbatim)."""
+        return {
+            "app": self.name,
+            "shard_plane": {
+                "n_shards": self.n_shards,
+                "key": self.key,
+                "epoch": self.epoch,
+                "n_slots": self.router.n_slots,
+                "rebalances": self.rebalances,
+                "skew": self.router.skew_report(),
+            },
+            "conservation": self.conservation_report(),
+            "shards": {f"s{i}": rt.statistics_report()
+                       for i, rt in enumerate(self.shards)
+                       if rt is not None},
+            "cost": self.cost_report,
+        }
+
+    # --------------------------------------------------------- shard moves
+
+    def move_shard(self, i: int) -> dict:
+        """Blue-green swap of ONE shard replica onto a fresh runtime —
+        core/upgrade.py's recipe at shard granularity: shadow-build, pause
+        intake, drain, per-element snapshot/restore, WAL handover (the new
+        runtime ADOPTS the journal object — no re-journaling, no second
+        append handle), callback re-subscription, atomic cutover, retire.
+        The key->shard assignment does not change; this moves the replica,
+        e.g. off a sick device."""
+        old = self.shards[i]
+        new = self._build_shard(i, with_wal=False)
+        new.start(connect_sources=False, start_persist_scheduler=False)
+        self.gate.pause()
+        try:
+            old.flush()
+            old.drain()
+            blob = old.snapshot()
+            new.restore(blob)
+            wal = old.wal
+            if wal is not None:
+                old.wal = None
+                for j in old.junctions.values():
+                    j.wal = None
+                new.wal = wal
+                for sid in new.app.stream_definitions:
+                    j2 = new.junctions.get(sid)
+                    if j2 is not None:
+                        j2.wal = wal
+            self._resubscribe(new)
+            self.shards[i] = new
+        except Exception:
+            new.shutdown(flush_durable=False)
+            raise
+        finally:
+            self.gate.resume()
+        old.shutdown(flush_durable=False)
+        return {"moved": i, "epoch": self.epoch}
+
+    def kill_shard(self, i: int) -> None:
+        """Chaos helper: simulate a shard replica dying without any clean
+        shutdown (its WAL handle is released the way process death would
+        release it; staged-but-unflushed work is lost). Pair with
+        `recover_shard`."""
+        rt = self.shards[i]
+        if rt is None:
+            return
+        try:
+            if rt.wal is not None:
+                rt.wal.close()
+        except Exception:
+            pass
+        self.shards[i] = None
+
+    def recover_shard(self, i: int) -> dict:
+        """Rebuild a dead shard from its durable state: fresh replica on
+        the same WAL directory (torn tails truncate on resume), restore
+        the last persisted revision, replay the journal — the per-shard
+        half of `recover()`."""
+        if self.shards[i] is not None:
+            raise SiddhiAppCreationError(
+                f"shard {i} of {self.name!r} is alive; kill it first")
+        rt = self._build_shard(i)
+        rt.start()
+        self._resubscribe(rt)
+        out = rt.recover()
+        self.shards[i] = rt
+        return out
+
+    # ----------------------------------------------------------- rebalance
+
+    def rebalance(self, assignment=None, *, force: bool = False,
+                  threshold: float = 1.25) -> dict:
+        """Skew-triggered live resharding. Consults the router's skew
+        counters; below `threshold` imbalance (max shard load over the
+        even-split ideal) it is a no-op unless `force`d or given an
+        explicit `assignment`. The move itself is a fleet-wide blue-green
+        swap: pause intake, drain, rebuild every replica from the full
+        per-shard WAL history re-routed through the new assignment, commit
+        the epoch meta atomically, cut over, retire the old fleet. Refused
+        without a WAL or after a `persist()` rotated the journal (device
+        state is not key-addressable — the journal IS the migration
+        format)."""
+        skew = self.router.skew_report()
+        if assignment is None:
+            if not force and skew["imbalance"] < threshold:
+                return {"rebalanced": False, "reason":
+                        f"imbalance {skew['imbalance']:.2f} below "
+                        f"threshold {threshold:.2f}", "skew": skew}
+            proposal = self.router.propose_assignment()
+        else:
+            proposal = np.asarray(assignment, dtype=np.int64)
+            if proposal.shape[0] != self.router.n_slots or \
+                    (len(proposal) and proposal.max() >= self.n_shards):
+                raise SiddhiAppCreationError(
+                    f"rebalance: assignment must map "
+                    f"{self.router.n_slots} slots to [0, {self.n_shards})")
+        moved = [s for s in range(self.router.n_slots)
+                 if int(proposal[s]) != int(self.router.assignment[s])]
+        if not moved:
+            return {"rebalanced": False, "reason": "assignment unchanged",
+                    "skew": skew}
+        if self.wal_base is None:
+            raise SiddhiAppCreationError(
+                f"rebalance of {self.name!r} needs a WAL (wal_dir=): "
+                "device state is reconstructed by re-routing the journal")
+        if self._persisted_since_epoch:
+            raise SiddhiAppCreationError(
+                f"rebalance of {self.name!r} refused: persist() rotated "
+                "the journal this epoch, so the full per-key history is "
+                "gone — rebalance before persisting (docs/SHARDING.md)")
+
+        new_epoch = self.epoch + 1
+        old_router = self.router
+        new_router = ShardRouter(self.key, self.n_shards,
+                                 n_slots=old_router.n_slots,
+                                 assignment=proposal)
+        self.gate.pause()
+        new_shards: list = []
+        try:
+            for rt in self.shards:
+                rt.flush()
+                rt.drain()
+            # shadow fleet on the NEW epoch's journal directory; replayed
+            # sends re-journal themselves there (the recover() idiom)
+            for i in range(self.n_shards):
+                rt = self._build_shard(i, epoch=new_epoch)
+                rt.start(connect_sources=False,
+                         start_persist_scheduler=False)
+                new_shards.append(rt)
+            replayed = 0
+            for old_rt in self.shards:
+                if old_rt is None or old_rt.wal is None:
+                    continue
+                for kind, sid, tss, data in old_rt.wal.records():
+                    # key-local plans make cross-key interleaving
+                    # irrelevant: a key's records are contiguous within
+                    # ONE old shard's journal, so shard-by-shard replay
+                    # preserves every per-key sequence
+                    if kind == "rows":
+                        key_idx = [a.name for a in old_rt.junctions[sid]
+                                   .definition.attributes].index(self.key)
+                        for shard, (stss, srows) in new_router.split_rows(
+                                tss, data, key_idx).items():
+                            new_shards[shard].get_input_handler(sid) \
+                                .send_batch(srows, timestamps=stss)
+                    else:  # "cols"
+                        ts_arr = np.asarray(tss, dtype=np.int64)
+                        for shard, (ts_sub, cols_sub, cnt) in \
+                                new_router.split_columns(
+                                    data, ts_arr, len(tss)).items():
+                            new_shards[shard].get_input_handler(sid) \
+                                .send_columns(cols_sub, timestamps=ts_sub,
+                                              count=cnt)
+                    replayed += len(tss)
+            for rt in new_shards:
+                rt.flush()
+                rt.drain()
+            # replay accounting is not live traffic: the new router starts
+            # the epoch with clean skew counters
+            new_router.reset_counters()
+            # COMMIT: the meta rename is the atomic cutover point — a
+            # crash before it recovers the old epoch, after it the new
+            self._write_meta(new_epoch, proposal)
+            for rt in new_shards:
+                self._resubscribe(rt)
+            old_shards, self.shards = self.shards, new_shards
+            self.router = new_router
+            self.epoch = new_epoch
+            self.rebalances += 1
+            self._persisted_since_epoch = False
+            self._handlers.clear()
+        except Exception:
+            for rt in new_shards:
+                try:
+                    rt.shutdown(flush_durable=False)
+                except Exception:  # pragma: no cover — best-effort rollback
+                    pass
+            raise
+        finally:
+            self.gate.resume()
+        for rt in old_shards:
+            if rt is not None:
+                try:
+                    rt.shutdown(flush_durable=False)
+                except Exception:  # pragma: no cover
+                    pass
+        log.info("rebalance %s: epoch %d -> %d, %d slot(s) moved, "
+                 "%d event(s) re-routed", self.name, new_epoch - 1,
+                 new_epoch, len(moved), replayed)
+        return {"rebalanced": True, "epoch": new_epoch,
+                "moved_slots": len(moved), "replayed": replayed,
+                "assignment": [int(s) for s in proposal], "skew": skew}

@@ -383,6 +383,31 @@ def _stats_families(exp: _Exposition, app: str, runtime) -> None:
                     cell["total_ms"] / 1e3)
 
 
+def _plane_families(exp: _Exposition, app: str, plane) -> None:
+    """Shard-plane routing/skew families (parallel/shard_plane.py). The
+    replicas themselves export the full per-app family set labelled
+    `app="<name>@s<i>"`; these are the plane-level extras."""
+    exp.declare("siddhi_shard_count", "gauge",
+                "Replicas in the sharded execution plane", ("app",))
+    exp.add("siddhi_shard_count", (app,), plane.n_shards)
+    exp.declare("siddhi_shard_epoch", "gauge",
+                "Current shard-assignment epoch (bumps on rebalance)",
+                ("app",))
+    exp.add("siddhi_shard_epoch", (app,), plane.epoch)
+    exp.declare("siddhi_shard_rebalances_total", "counter",
+                "Committed rebalance() epoch swaps", ("app",))
+    exp.add("siddhi_shard_rebalances_total", (app,), plane.rebalances)
+    exp.declare("siddhi_shard_routed_rows_total", "counter",
+                "Rows routed to each shard this epoch", ("app", "shard"))
+    skew = plane.router.skew_report()
+    for shard, n in skew["per_shard"].items():
+        exp.add("siddhi_shard_routed_rows_total", (app, shard), n)
+    exp.declare("siddhi_shard_imbalance_ratio", "gauge",
+                "Max shard load over the even-split ideal (the rebalance "
+                "trigger)", ("app",))
+    exp.add("siddhi_shard_imbalance_ratio", (app,), skew["imbalance"])
+
+
 def render_manager(manager) -> str:
     """Full /metrics body for every deployed app. Lock-free: iterates a
     point-in-time snapshot of the runtime table."""
@@ -414,6 +439,20 @@ def render_manager(manager) -> str:
                     "Statically predicted compile-ladder size (executables "
                     "across shape buckets x queries x steps)", ("app",))
     for name, rt in runtimes:
+        if getattr(rt, "is_shard_plane", False):
+            # one full family set PER REPLICA (app="<name>@s<i>") + the
+            # plane-level routing/skew extras under the plane's own name
+            _plane_families(exp, name, rt)
+            for i, srt in enumerate(rt.shards):
+                if srt is None:
+                    continue
+                sub = f"{name}@s{i}"
+                tele = getattr(srt.ctx, "telemetry", None)
+                if tele is not None:
+                    for fam in tele.registry.collect():
+                        _add_family(exp, fam, sub)
+                _stats_families(exp, sub, srt)
+            continue
         tele = getattr(rt.ctx, "telemetry", None)
         if tele is not None:
             for fam in tele.registry.collect():

@@ -1,0 +1,104 @@
+"""Subprocess worker for tests/test_shard_recovery.py.
+
+Runs ONE sharded plane (4 replicas, per-shard WAL directories under the
+base dir) and is driven line-by-line over stdin, acknowledging every
+command before blocking on the next read — so a SIGKILL issued after an
+ack lands while the plane is idle with a KNOWN set of accepted (journaled)
+rows. Commands:
+
+    send <lo> <hi>     send rows lo..hi-1 (deterministic key/value/ts),
+                       drain, reply "OK <hi>"
+    kill <i>           in-process chaos: drop shard i's runtime without
+                       shutdown (WAL handle released as death would),
+                       reply "KILLED <i>"
+    recover_shard <i>  rebuild shard i from its own WAL dir,
+                       reply "SHARD-RECOVERED <i> <replayed>"
+    recover            whole-plane recovery (every shard restores +
+                       replays its journal), reply "RECOVERED <replayed>"
+    rebalance          force a skew rebalance (epoch bump, WAL re-route),
+                       reply "REBALANCED <epoch> <replayed>"
+    result             drain, reply "RESULT <json>" — the last emitted
+                       row per key (running aggregates are monotone, so
+                       last == final; at-least-once replay re-emission
+                       makes a multiset comparison invalid here)
+    exit               clean shutdown, reply "BYE"
+"""
+
+import json
+import os
+import sys
+
+
+def row(i: int):
+    # multiples of 0.25: per-key partial sums are exactly representable
+    return (f"K{i % 13}", ((i * 7 + 3) % 400 + 1) * 0.25)
+
+
+APP = """
+@app:name('ShardCrashApp')
+@app:shards(n='4', key='k')
+define stream S (k string, v double);
+@info(name='agg')
+from S select k, sum(v) as total, count() as n group by k insert into Out;
+"""
+
+
+def main() -> None:
+    base = sys.argv[1]
+    from siddhi_tpu.util.platform import force_cpu_platform
+    force_cpu_platform(1)
+    from siddhi_tpu import SiddhiManager
+
+    mgr = SiddhiManager()
+    plane = mgr.create_siddhi_app_runtime(APP, wal_dir=base)
+    last: dict = {}
+
+    def cb(events):
+        for e in events:
+            last[e.data[0]] = list(e.data)
+
+    plane.add_callback("Out", cb)
+    plane.start()
+    print("READY", flush=True)
+
+    for line in sys.stdin:
+        parts = line.split()
+        if not parts:
+            continue
+        cmd = parts[0]
+        if cmd == "send":
+            lo, hi = int(parts[1]), int(parts[2])
+            h = plane.get_input_handler("S")
+            h.send_batch([row(i) for i in range(lo, hi)],
+                         timestamps=[1000 + i for i in range(lo, hi)])
+            plane.drain()
+            print(f"OK {hi}", flush=True)
+        elif cmd == "kill":
+            i = int(parts[1])
+            plane.kill_shard(i)
+            print(f"KILLED {i}", flush=True)
+        elif cmd == "recover_shard":
+            i = int(parts[1])
+            r = plane.recover_shard(i)
+            plane.drain()
+            print(f"SHARD-RECOVERED {i} {r.get('wal_replayed', 0)}",
+                  flush=True)
+        elif cmd == "recover":
+            r = plane.recover()
+            plane.drain()
+            print(f"RECOVERED {r['wal_replayed']}", flush=True)
+        elif cmd == "rebalance":
+            r = plane.rebalance(force=True)
+            plane.drain()
+            print(f"REBALANCED {r['epoch']} {r['replayed']}", flush=True)
+        elif cmd == "result":
+            plane.drain()
+            print("RESULT " + json.dumps(last, sort_keys=True), flush=True)
+        elif cmd == "exit":
+            plane.shutdown()
+            print("BYE", flush=True)
+            return
+
+
+if __name__ == "__main__":
+    main()

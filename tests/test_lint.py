@@ -45,6 +45,8 @@ CORPUS_EXPECTATIONS = {
     "sl503": ("SL503", Severity.WARN),
     "sl504": ("SL504", Severity.WARN),
     "sl505": ("SL505", Severity.INFO),
+    "sl601": ("SL601", Severity.ERROR),
+    "sl602": ("SL602", Severity.WARN),
 }
 
 

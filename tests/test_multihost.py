@@ -41,6 +41,28 @@ init_distributed(coordinator=coordinator, num_processes=2, process_id=pid)
 assert jax.process_count() == 2, jax.process_count()
 assert len(jax.devices()) == 4, len(jax.devices())  # 2 local x 2 processes
 
+
+def _die_if_backend_cannot(e: BaseException):
+    # jaxlib's CPU backend cannot execute cross-process computations at
+    # all (XLA INVALID_ARGUMENT). That is an environment capability limit,
+    # not an engine defect: report it as a sentinel the test harness turns
+    # into a named skip, so the test stays REAL on TPU/GPU multi-host.
+    if "Multiprocess computations aren't implemented" in str(e):
+        print("MULTIHOST UNSUPPORTED:", str(e).strip().splitlines()[-1])
+        sys.stdout.flush()
+        os._exit(0)
+
+
+_orig_excepthook = sys.excepthook
+
+
+def _capability_hook(tp, val, tb):
+    _die_if_backend_cannot(val)
+    _orig_excepthook(tp, val, tb)
+
+
+sys.excepthook = _capability_hook
+
 import numpy as np
 from siddhi_tpu import SiddhiManager
 
@@ -174,6 +196,12 @@ def test_two_process_sharded_aggregation(tmp_path):
                 q.kill()
             pytest.fail("multi-host worker timed out")
         outs.append(out)
+    if any("MULTIHOST UNSUPPORTED" in out for out in outs):
+        pytest.skip(
+            "jax CPU backend cannot execute cross-process computations "
+            "(XLA INVALID_ARGUMENT: \"Multiprocess computations aren't "
+            "implemented on the CPU backend\") — this capability test "
+            "needs a real multi-host TPU/GPU backend")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
     assert "MULTIHOST PASS" in outs[0], outs[0][-3000:]

@@ -1,0 +1,150 @@
+"""Shard-plane crash proof: SIGKILL the whole plane process mid-stream,
+restart on the same per-shard WAL layout, recover, keep streaming — the
+final per-key aggregates must match a no-crash oracle exactly.
+
+The worker (tests/shard_crash_worker.py) acknowledges every command before
+blocking on stdin, so SIGKILL lands while the plane is idle with a known
+journaled set (the tests/crash_worker.py discipline). The oracle is the
+LAST emitted row per key, not the output multiset: recovery replays each
+shard's journal at-least-once, so rows re-emit — but a running per-key
+aggregate is monotone in its input prefix, so the last row per key is the
+final state, and THAT must be exact.
+
+Covers the recovery shapes the plane adds over single-runtime recovery:
+whole-fleet restart from per-shard WAL dirs, a single shard dying and
+recovering in-process while the rest of the fleet keeps serving, and a
+post-recovery forced rebalance (epoch bump re-routing the replayed
+journal) that must not lose or double-count state.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+WORKER = os.path.join(os.path.dirname(__file__), "shard_crash_worker.py")
+
+
+class _Worker:
+    """One plane subprocess with a watchdog so a wedged child fails the
+    test instead of hanging the suite."""
+
+    def __init__(self, base: str, timeout_s: float = 300.0):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "PYTHONPATH": repo + os.pathsep
+               + os.environ.get("PYTHONPATH", "")}
+        self.proc = subprocess.Popen(
+            [sys.executable, WORKER, base],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, bufsize=1, env=env)
+        self._watchdog = threading.Timer(timeout_s, self.proc.kill)
+        self._watchdog.daemon = True
+        self._watchdog.start()
+        self.expect("READY")
+
+    def expect(self, prefix: str) -> str:
+        while True:
+            line = self.proc.stdout.readline()
+            if not line:
+                raise AssertionError(
+                    f"worker died waiting for {prefix!r} "
+                    f"(rc={self.proc.poll()})")
+            if line.startswith(prefix):
+                return line.strip()
+
+    def cmd(self, line: str, reply_prefix: str) -> str:
+        self.proc.stdin.write(line + "\n")
+        self.proc.stdin.flush()
+        return self.expect(reply_prefix)
+
+    def result(self) -> dict:
+        import json
+        return json.loads(self.cmd("result", "RESULT")[len("RESULT "):])
+
+    def sigkill(self) -> None:
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait()
+        self._watchdog.cancel()
+
+    def close(self) -> None:
+        try:
+            self.cmd("exit", "BYE")
+        finally:
+            self.proc.wait()
+            self._watchdog.cancel()
+
+
+def _oracle(tmp_path, sends) -> dict:
+    w = _Worker(str(tmp_path / "oracle"))
+    for lo, hi in sends:
+        w.cmd(f"send {lo} {hi}", f"OK {hi}")
+    out = w.result()
+    w.close()
+    return out
+
+
+def test_sigkill_whole_plane_then_recover(tmp_path):
+    want = _oracle(tmp_path, [(0, 40), (40, 80)])
+
+    base = str(tmp_path / "crash")
+    w = _Worker(base)
+    w.cmd("send 0 40", "OK 40")
+    w.sigkill()  # idle kill: rows 0..39 are journaled, nothing in flight
+
+    w2 = _Worker(base)  # fresh process, same per-shard WAL layout
+    rec = w2.cmd("recover", "RECOVERED")
+    assert int(rec.split()[1]) == 40  # every accepted row replays
+    w2.cmd("send 40 80", "OK 80")
+    got = w2.result()
+    w2.close()
+    assert got == want
+
+
+def test_sigkill_one_shard_recovers_against_oracle(tmp_path):
+    """One replica dies without shutdown while the fleet keeps serving:
+    recover_shard rebuilds it from its OWN journal directory, and the
+    merged final state matches the no-crash oracle."""
+    want = _oracle(tmp_path, [(0, 40), (40, 60), (60, 80)])
+
+    base = str(tmp_path / "chaos")
+    w = _Worker(base)
+    w.cmd("send 0 40", "OK 40")
+    w.cmd("kill 1", "KILLED 1")
+    rec = w.cmd("recover_shard 1", "SHARD-RECOVERED 1")
+    replayed = int(rec.split()[2])
+    assert replayed > 0  # the dead shard owned SOME of rows 0..39
+    w.cmd("send 40 60", "OK 60")
+    w.cmd("send 60 80", "OK 80")
+    got = w.result()
+    w.close()
+    assert got == want
+
+
+def test_recover_then_rebalance_then_stream(tmp_path):
+    """Crash, recover, force an epoch-bumping rebalance (the replayed
+    journal re-routes through the new assignment), keep streaming — state
+    must survive BOTH transitions."""
+    want = _oracle(tmp_path, [(0, 50), (50, 70), (70, 90)])
+
+    base = str(tmp_path / "reb")
+    w = _Worker(base)
+    w.cmd("send 0 50", "OK 50")
+    w.sigkill()
+
+    w2 = _Worker(base)
+    w2.cmd("recover", "RECOVERED")
+    # the restarted router's skew counters start empty — the LPT proposal
+    # only moves slots it has SEEN load on, so stream first, then rebalance
+    w2.cmd("send 50 70", "OK 70")
+    reb = w2.cmd("rebalance", "REBALANCED")
+    assert int(reb.split()[1]) == 1  # epoch bumped
+    w2.cmd("send 70 90", "OK 90")
+    got = w2.result()
+    w2.close()
+    assert got == want

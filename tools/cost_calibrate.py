@@ -49,10 +49,28 @@ def calibrate(band: float) -> tuple[dict, list[str]]:
     mgr._lint_enabled = False  # calibration measures, it doesn't gate
     for name, text in APPS.items():
         rep = compute_cost(text)
-        rt = mgr.create_siddhi_app_runtime(text)
-        live_bytes = sum(measure_runtime_state_bytes(rt).values())
-        rt.warmup()
-        live_compiles = sum(rt.ctx.statistics.compiles.values())
+        if _shard_count(text) > 1:
+            # @app:shards prediction is fleet-priced (x n), so the live
+            # oracle must measure the REAL plane: build it through a
+            # normal manager (the calibration one has plane construction
+            # disabled along with the gates) and sum every replica
+            pmgr = SiddhiManager()
+            plane = pmgr.create_siddhi_app_runtime(text)
+            live_bytes = sum(
+                sum(measure_runtime_state_bytes(s).values())
+                for s in plane.shards)
+            plane.warmup()
+            live_compiles = sum(
+                sum(s.ctx.statistics.compiles.values())
+                for s in plane.shards)
+            rt = plane
+            mgr_of_rt = pmgr
+        else:
+            rt = mgr.create_siddhi_app_runtime(text)
+            live_bytes = sum(measure_runtime_state_bytes(rt).values())
+            rt.warmup()
+            live_compiles = sum(rt.ctx.statistics.compiles.values())
+            mgr_of_rt = mgr
         r_state = _ratio(live_bytes, rep.state_bytes)
         r_comp = _ratio(live_compiles, rep.compile_ladder)
         results[name] = {
@@ -70,8 +88,18 @@ def calibrate(band: float) -> tuple[dict, list[str]]:
                     f"{name}: {label} drifted {r:.3f}x outside "
                     f"[{1.0 / band:.2f}, {band:.2f}]")
         rt.shutdown()
-        mgr.runtimes.pop(rt.app.name, None)
+        mgr_of_rt.runtimes.pop(rt.app.name, None)
     return results, failures
+
+
+def _shard_count(text: str) -> int:
+    from siddhi_tpu import compiler
+    from siddhi_tpu.analysis.sharding import shard_config
+    try:
+        cfg = shard_config(compiler.parse(text))
+    except Exception:
+        return 0
+    return 0 if cfg is None else cfg.n
 
 
 TRIPLE = re.compile(r"(\"\"\"|''')(.*?)\1", re.DOTALL)

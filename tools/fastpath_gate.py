@@ -87,6 +87,24 @@ APPS = {
     group by symbol
     insert into SummaryStream;
     """,
+    # the sharded execution plane's bench app (bench.py sharded_e2e): a
+    # key-local pipeline — windowless running aggregate grouped by the
+    # partition key — replicated per shard behind the partition-key router
+    "sharded_e2e": """
+    @app:name('ShardedBench')
+    @app:shards(n='4', key='symbol')
+    @Async(buffer.size='8192', workers='2')
+    define stream TradeStream (symbol string, price double, volume long);
+    @info(name = 'filt')
+    from TradeStream[price < 700.0]
+    select symbol, price, volume
+    insert into MidStream;
+    @info(name = 'agg')
+    from MidStream
+    select symbol, sum(price) as total, count() as n
+    group by symbol
+    insert into SummaryStream;
+    """,
 }
 
 #: accepted vetoes, keyed "<app>:<step>" — the supersteps hit-list.
@@ -104,6 +122,7 @@ KNOWN_VETOED: dict = {
     "join:bench/left": "_host_radix_argsort above lane threshold (CPU)",
     "join:bench/right": "_host_radix_argsort above lane threshold (CPU)",
     "e2e_ingress:agg": "_host_radix_argsort above lane threshold (CPU)",
+    "sharded_e2e:agg": "_host_radix_argsort above lane threshold (CPU)",
 }
 
 
