@@ -16,8 +16,12 @@ app, WITHOUT building a runtime or allocating any device state:
   buckets x queries x steps (join directions, pattern per-stream steps +
   heartbeat), respecting SharedStepGroup fusion (analysis/optimizer.py)
   when the multi-query optimizer is enabled.
-- **dispatch class** — whether the per-batch step stays on device or takes
-  a host callback hop (the CPU radix-sort fastpath veto, ops/search.py).
+- **dispatch class** — whether the per-batch step stays on device
+  (``device``), amortizes its dispatch over a K-batch superstep scan
+  (``superstep``, core/superstep.py: dispatches-per-event divided by K),
+  or takes a host callback hop (``host`` — today only the deprecated
+  ``SIDDHI_RADIX_CALLBACK=1`` escape hatch; the packed-key device sort
+  retired the CPU radix pure_callback, ops/search.py).
 
 Enforcement rides on top: `app_budget` reads ``@app:budget(state=,
 compiles=)`` / ``SIDDHI_STATE_BUDGET`` / ``SIDDHI_COMPILE_BUDGET`` and
@@ -55,7 +59,7 @@ from .plan import ExprTyper, PlanGraph, QueryNode, _frames_for, build_plan
 __all__ = [
     "Budget", "CostReport", "ElementCost", "app_budget", "compute_cost",
     "cost_for_plan", "format_size", "measure_runtime_state_bytes",
-    "parse_size",
+    "parse_size", "superstep_k",
 ]
 
 _SIZE_RE = re.compile(
@@ -176,6 +180,8 @@ class CostReport:
     #: fused-group ladder summary when the optimizer is enabled:
     #: [{"stream": sid, "members": [...], "compiles": rungs}]
     fusion: list = field(default_factory=list)
+    #: resolved @app:superstep(k=) / SIDDHI_SUPERSTEP_K depth (1 = per-batch)
+    superstep_k: int = 1
 
     @property
     def dominant_share(self) -> float:
@@ -196,6 +202,7 @@ class CostReport:
             "budget": None if self.budget is None else self.budget.to_dict(),
             "elements": [e.to_dict() for e in self.elements],
             "fusion": list(self.fusion),
+            "superstep_k": self.superstep_k,
             "notes": list(self.notes),
         }
 
@@ -238,6 +245,32 @@ def _itemsize(t: AttributeType) -> int:
 def _radix_min() -> int:
     from ..ops.search import _radix_min_lanes
     return _radix_min_lanes()
+
+
+def _legacy_radix_callback() -> bool:
+    from ..ops.search import _legacy_callback_enabled
+    return _legacy_callback_enabled()
+
+
+def superstep_k(app: Optional[SiddhiApp]) -> int:
+    """Resolved superstep depth for an app: ``@app:superstep(k=)`` with the
+    ``SIDDHI_SUPERSTEP_K`` env overriding (same precedence the runtime
+    applies in core/app_runtime.py). 1 = per-batch dispatch."""
+    k = 1
+    ann = app.annotation("app:superstep") if app is not None else None
+    if ann is not None:
+        v = ann.element("k") or ann.element()
+        try:
+            k = int(v) if v else 1
+        except ValueError:
+            k = 1
+    env_k = os.environ.get("SIDDHI_SUPERSTEP_K", "").strip()
+    if env_k:
+        try:
+            k = int(env_k)
+        except ValueError:
+            pass
+    return max(1, k)
 
 
 def _closed(attrs: Optional[dict]) -> Optional[dict]:
@@ -349,11 +382,13 @@ def _single_query_cost(node: QueryNode, plan: PlanGraph, registry,
     grouped_or_custom = bool(selector.group_vars) or any(
         spec.custom_scan is not None for _, spec, _ in selector.agg_specs)
     if (selector.has_aggregators and grouped_or_custom
-            and window.chunk_width >= _radix_min()):
+            and window.chunk_width >= _radix_min()
+            and _legacy_radix_callback()):
         ec.dispatch = "host"
         ec.notes.append(
             f"group-key radix argsort over {window.chunk_width} lanes runs "
-            "as a host callback on CPU (pjit fastpath veto, ops/search.py)")
+            "as a host callback (SIDDHI_RADIX_CALLBACK=1 legacy escape "
+            "hatch; pjit fastpath veto, ops/search.py)")
     return ec
 
 
@@ -445,11 +480,13 @@ def _join_query_cost(node: QueryNode, plan: PlanGraph, registry,
             ec.compiles += 1
 
     build_caps = [getattr(w, "C", 0) for w in (lwin, rwin) if w is not None]
-    if probe_keys and build_caps and max(build_caps) >= _radix_min():
+    if (probe_keys and build_caps and max(build_caps) >= _radix_min()
+            and _legacy_radix_callback()):
         ec.dispatch = "host"
         ec.notes.append(
             "equi-join build-side indexing radix-sorts "
-            f"{max(build_caps)} ring lanes via a host callback on CPU")
+            f"{max(build_caps)} ring lanes via a host callback "
+            "(SIDDHI_RADIX_CALLBACK=1 legacy escape hatch)")
     return ec
 
 
@@ -757,6 +794,25 @@ def compute_cost(app_or_plan, *, batch_size: int = 0,
                                   "compiles": rungs})
             for m in members:
                 m.notes.append(f"fused into shared step on {g.stream_id!r}")
+
+    # --- superstep dispatch class: with @app:superstep(k=K>1) the eligible
+    # plan runs K batches per device dispatch (one lax.scan, one fetch), so
+    # the per-event dispatch cost divides by K. Host-hop elements keep their
+    # "host" class — a callback makes the plan superstep-ineligible
+    # (core/superstep.py), which SL506 reports. ---
+    k = superstep_k(app)
+    report.superstep_k = k
+    if k > 1:
+        for e in report.elements:
+            if e.dispatch == "device" and e.kind in ("query", "join"):
+                e.dispatch = "superstep"
+                e.notes.append(
+                    f"superstep k={k}: one device dispatch per {k} "
+                    f"micro-batches (per-event dispatch cost / {k}) when "
+                    "the plan is eligible at runtime")
+        report.notes.append(
+            f"superstep k={k}: step dispatches-per-event divide by {k} "
+            "for the eligible sub-plan (core/superstep.py)")
 
     # --- dominant element ---
     if report.state_bytes > 0:

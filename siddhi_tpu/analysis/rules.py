@@ -707,9 +707,16 @@ def compile_ladder_explosion(plan: PlanGraph) -> Iterable:
 
 @rule("SL504", Severity.WARN,
       "dispatch-heavy plan: a host callback rides every micro-batch "
-      "(CPU radix-sort fastpath veto)")
+      "(today only the SIDDHI_RADIX_CALLBACK=1 legacy escape hatch)")
 def host_hop_per_batch(plan: PlanGraph) -> Iterable:
-    from .cost import cost_for_plan
+    from .cost import cost_for_plan, superstep_k
+    if (superstep_k(plan.app) > 1
+            and _superstep_ineligibility(plan,
+                                         include_dispatch=False) is None):
+        # the plan rides K-batch supersteps (or, when the hop itself is
+        # the only blocker, SL506 names the callback as the decline
+        # reason): don't double-report the same dispatch
+        return
     rep = cost_for_plan(plan)
     for e in rep.elements:
         if e.dispatch != "host" or e.node_index is None:
@@ -752,6 +759,92 @@ def cost_dominant_element(plan: PlanGraph) -> Iterable:
     schema = plan.schemas.get(e.element)
     if schema is not None and schema.defn is not None:
         yield _d(e.element, schema.defn, msg)
+
+
+def _superstep_ineligibility(plan: PlanGraph, *,
+                             include_dispatch: bool = True):
+    """First STATIC reason the superstep scan would decline this plan, as
+    (reason, anchor-node-or-None) — or None when nothing statically rules
+    it out. A lightweight mirror of core/superstep.py's runtime decline
+    taxonomy: only the facts visible in the AST/plan are checked (the
+    runtime additionally declines on breakers, tables, sinks, callbacks
+    registered after creation, ...)."""
+    import os
+    app = plan.app
+    try:
+        env_workers = int(os.environ.get("SIDDHI_INGRESS_WORKERS", "0") or 0)
+    except ValueError:
+        env_workers = 0
+    async_sids = []
+    for sid, schema in plan.schemas.items():
+        d = schema.defn
+        if schema.kind != "stream" or d is None or not d.annotations:
+            continue
+        ann = d.annotation("async")
+        if ann is None:
+            continue
+        try:
+            w = ann.element("workers")
+            workers = int(w) if w else env_workers
+        except ValueError:
+            workers = env_workers
+        if workers > 0:
+            async_sids.append(sid)
+    if not async_sids:
+        return ("no @Async(workers=) stream: the ingress pipeline — and "
+                "with it the superstep feeder — never engages", None)
+    if app is not None and app.annotation("app:playback") is not None:
+        return ("@app:playback drives virtual time per delivered batch, "
+                "but a superstep samples `now` once per K batches", None)
+    for sid in async_sids:
+        schema = plan.schemas[sid]
+        if any(a.type == AttributeType.OBJECT
+               for a in schema.defn.attributes):
+            return (f"stream {sid!r} carries OBJECT columns, which stay "
+                    "host-side", None)
+        for node in plan.queries:
+            if all(c.stream_id != sid for c in node.consumed):
+                continue
+            if node.partition is not None:
+                return ("a partitioned query consumes the @Async stream "
+                        f"{sid!r}: per-key instances dispatch host-side",
+                        node)
+            if isinstance(node.query.input_stream, StateInputStream):
+                return ("a pattern/sequence query consumes the @Async "
+                        f"stream {sid!r}: NFA steps are not scannable "
+                        "receivers", node)
+    if include_dispatch:
+        from .cost import cost_for_plan
+        rep = cost_for_plan(plan)
+        for e in rep.elements:
+            if e.dispatch == "host":
+                node = (None if e.node_index is None
+                        else _query_by_index(plan, e.node_index))
+                return (f"step {e.element!r} takes a host-callback hop "
+                        "(SIDDHI_RADIX_CALLBACK=1 legacy radix sort)",
+                        node)
+    return None
+
+
+@rule("SL506", Severity.INFO,
+      "superstep requested (@app:superstep k>1) but the plan is statically "
+      "ineligible: the ingress feeder will fall back to per-batch dispatch")
+def superstep_ineligible(plan: PlanGraph) -> Iterable:
+    from .cost import superstep_k
+    k = superstep_k(plan.app)
+    if k <= 1 or not plan.queries:
+        return
+    found = _superstep_ineligibility(plan)
+    if found is None:
+        return
+    reason, node = found
+    anchor = node if node is not None else plan.queries[0]
+    yield _q(anchor,
+             f"@app:superstep(k={k}) cannot engage: {reason} — the "
+             "ingress feeder falls back to per-batch (K=1) dispatch at "
+             "runtime, loudly, with the reason in stats_snapshot()"
+             "['superstep_decline'] (core/superstep.py decline taxonomy, "
+             "docs/PERFORMANCE.md)")
 
 
 @rule("SL601", Severity.ERROR,

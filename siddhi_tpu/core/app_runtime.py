@@ -9,6 +9,7 @@ pipeline and cascades device-to-device until quiescent.
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Optional
 
 from ..errors import (
@@ -149,6 +150,27 @@ class SiddhiAppRuntime:
                 self.ctx.statistics.set_level(level)
             except ValueError as e:
                 raise SiddhiAppCreationError(str(e)) from e
+
+        # device-resident supersteps: @app:superstep(k='8') batches K async
+        # ingress chunks into one lax.scan dispatch (core/superstep.py).
+        # Env SIDDHI_SUPERSTEP_K overrides the annotation (bench sweeps, CI
+        # parity runs); ineligible plans decline loudly at first dispatch.
+        ss_k = 1
+        ss_ann = app.annotation("app:superstep")
+        if ss_ann is not None:
+            v = ss_ann.element("k") or ss_ann.element()
+            try:
+                ss_k = int(v) if v else 1
+            except ValueError as e:
+                raise SiddhiAppCreationError(
+                    f"@app:superstep k must be an integer, got {v!r}") from e
+        env_k = os.environ.get("SIDDHI_SUPERSTEP_K", "").strip()
+        if env_k:
+            try:
+                ss_k = int(env_k)
+            except ValueError:
+                pass
+        self.ctx.superstep_k = max(1, ss_k)
 
         self.junctions: dict[str, StreamJunction] = {}
         self.input_handlers: dict[str, InputHandler] = {}

@@ -516,6 +516,11 @@ class SiddhiAppContext:
     #: event_time.EventTimeConfig parsed from @app:eventTime (None = arrival
     #: time); read by query runtimes (window lateness) and ingress gates
     event_time: object = None
+    #: device-resident supersteps (@app:superstep(k=) / SIDDHI_SUPERSTEP_K):
+    #: the async ingress feeder stages this many ring slots into one [K, B]
+    #: chunk and runs the query chain as a single lax.scan dispatch
+    #: (core/superstep.py). 1 = off; ineligible plans fall back loudly.
+    superstep_k: int = 1
 
     @property
     def effective_batch_size(self) -> int:

@@ -225,10 +225,29 @@ class IngressPipeline:
         self._feeder: Optional[threading.Thread] = None
         self._feeder_stop = threading.Event()
         self._flush_req = threading.Event()
+        # a BARRIER flush (drain/stop: everything submitted must deliver)
+        # as opposed to the producer-backpressure flush _claim_blocking
+        # raises while the ring is full. Only a barrier may disassemble a
+        # staged superstep stack: under backpressure the staging itself
+        # keeps popping the ring, so space frees without flushing — and
+        # at steady state backpressure is the NORMAL state, so honoring
+        # it would stop supersteps from ever reaching K staged chunks.
+        self._barrier_req = threading.Event()
         self._feeder_idle = threading.Event()
         self._feeder_idle.set()
         self._double_buffer = os.environ.get(
             "SIDDHI_DOUBLE_BUFFER", "1").strip() != "0"
+        # device-resident supersteps (@app:superstep(k=) / SIDDHI_SUPERSTEP_K,
+        # core/superstep.py): the feeder stages K full chunks and runs the
+        # eligible query chain as one lax.scan dispatch. Built lazily at the
+        # first staged superstep; a decline is logged once and recorded here
+        # (statistics_report surfaces it), then the K=1 path runs forever.
+        self._ss_k = 1
+        self._ss_runner = None
+        self._ss_decline: Optional[str] = None
+        self._ss_supersteps = 0  # feeder only: dispatched supersteps
+        self._ss_scan_ns = 0    # feeder only: lax.scan + device_get wall
+        self._ss_replay_ns = 0  # feeder only: host replay/distribution wall
         # --- statistics (each slot has a single writer thread) ---
         self._t0 = time.monotonic()
         self._worker_busy_ns = [0] * self.workers
@@ -267,6 +286,7 @@ class IngressPipeline:
             self._q.put(None)
         for t in self._threads:
             t.join(timeout=120)
+        self._barrier_req.set()
         self._flush_req.set()
         self._feeder_stop.set()
         if self._feeder is not None:
@@ -512,6 +532,68 @@ class IngressPipeline:
         self._device_ns += time.perf_counter_ns() - t0
         self._batches += 1
 
+    def _superstep_dispatch(self, sstack: list) -> bool:
+        """Run the staged chunks as ONE K-batch lax.scan dispatch
+        (core/superstep.py). Returns False when the staged chunks must fall
+        back to the per-batch path (plan declined, debugger attached,
+        topology changed)."""
+        if self._ss_decline is not None:
+            return False
+        if self._ss_runner is None or not self._ss_runner.revalidate():
+            from .superstep import build_runner
+            self._ss_runner, reason = build_runner(self, self._ss_k)
+            if self._ss_runner is None:
+                # decline LOUDLY, once — then the K=1 path runs forever
+                self._ss_decline = reason
+                _log.warning(
+                    "superstep(k=%d) declined for stream %r: %s — "
+                    "falling back to per-batch dispatch (see SL506)",
+                    self._ss_k, self.j.definition.id, reason)
+                return False
+        try:
+            dispatched = self._ss_runner.dispatch(sstack)
+        except Exception as e:
+            # A dispatch error must not kill the feeder thread (producers
+            # would wedge in _claim_blocking forever). Disable supersteps
+            # for this stream and keep running on the K=1 path. Whether the
+            # staged slots were consumed depends on WHERE it failed: after
+            # the scan wrote state back (superstep_committed), re-delivering
+            # them through the per-batch path would double-count every
+            # window and aggregate — report them consumed instead.
+            committed = bool(getattr(e, "superstep_committed", False))
+            self._ss_decline = f"runtime error during dispatch: {e!r}"
+            self._ss_runner = None
+            _log.exception(
+                "superstep(k=%d) dispatch failed for stream %r "
+                "(committed=%s) — disabling supersteps, falling back to "
+                "per-batch dispatch", self._ss_k, self.j.definition.id,
+                committed)
+            return committed
+        if dispatched:
+            self._ss_supersteps += 1
+            return True
+        return False
+
+    def _deliver_chunk(self, ts_buf, col_bufs, fill_t0: int) -> None:  # noqa: SL402 — feeder-thread only (called from _feed_loop / superstep fallback)
+        """K=1 delivery of one staged full chunk (the superstep fallback
+        path — identical to the inline full-chunk branch of _feed_loop)."""
+        from .event import EventBatch
+        tele = getattr(self.ctx, "telemetry", None)
+        tracing = tele is not None and tele.on
+        bs = self.j.batch_size
+        t0 = time.perf_counter_ns()
+        batch = EventBatch.from_numpy(
+            ts_buf, dict(zip(self.attrs, col_bufs)), bs)
+        h2d = time.perf_counter_ns() - t0
+        self._h2d_ns += h2d
+        self._h2d_count += 1
+        if tracing:
+            trace = tele.mint(self.j.definition.id, bs, t0=fill_t0)
+            trace.h2d_ns = h2d
+            batch._trace = trace
+            tele.record_lag(self.j.definition.id, int(ts_buf[bs - 1]))
+        self._deliver_locked(batch, bs)
+
     def _feed_loop(self) -> None:
         from .event import EventBatch
         j = self.j
@@ -521,6 +603,9 @@ class IngressPipeline:
         tele = getattr(self.ctx, "telemetry", None)
         tracing = tele is not None and tele.on
         sid = j.definition.id
+        self._ss_k = max(1, int(getattr(self.ctx, "superstep_k", 1) or 1))
+        superstep = self._ss_k > 1
+        sstack: list = []  # staged full chunks awaiting one K-batch dispatch
         pending = None  # the double buffer: built + transferring, undelivered
         fill = 0
         fill_t0 = 0  # when the first row popped into the (empty) chunk
@@ -530,10 +615,27 @@ class IngressPipeline:
             got = ring.pop(bs - fill, ts_buf[fill:],
                            tuple(c[fill:] for c in col_bufs))
             if got:
-                if fill == 0 and tracing:
+                if fill == 0 and (tracing or superstep):
                     fill_t0 = time.perf_counter_ns()
                 fill += got
             if fill == bs:
+                if superstep:
+                    # stage the host chunk; at K staged chunks the whole
+                    # stack rides one device dispatch. The staging itself
+                    # is the pipelining, so the double buffer is bypassed.
+                    sstack.append((ts_buf, col_bufs, fill_t0))
+                    ts_buf = np.zeros(bs, dtype=np.int64)
+                    col_bufs = [np.zeros(bs, dtype=dt)
+                                for dt in self.np_dtypes]
+                    fill = 0
+                    if len(sstack) >= self._ss_k:
+                        if not self._superstep_dispatch(sstack):
+                            for c_ts, c_cols, c_t0 in sstack:
+                                self._deliver_chunk(c_ts, c_cols, c_t0)
+                        sstack = []
+                        if self._ss_decline is not None:
+                            superstep = False
+                    continue
                 # full chunk: start its H2D NOW (from_numpy = device_put),
                 # then deliver the PREVIOUS chunk while this transfer runs
                 t0 = time.perf_counter_ns()
@@ -562,7 +664,23 @@ class IngressPipeline:
                 continue  # partially filled; keep popping while data flows
             # ring momentarily empty
             flushing = self._flush_req.is_set()
-            if flushing and (fill or pending is not None):
+            if flushing and sstack and not self._barrier_req.is_set() \
+                    and not self._feeder_stop.is_set():
+                # producer-backpressure flush (_claim_blocking: ring full)
+                # while a superstep stack is staging: ignore it. Staging
+                # keeps popping the ring, so producer space frees without
+                # delivering anything — and delivering the partial fill
+                # ahead of the staged chunks would reorder rows. Only a
+                # drain()/stop() barrier flushes a staged stack.
+                flushing = False
+            if flushing and (fill or pending is not None or sstack):
+                if sstack:
+                    # partial superstep at a flush barrier: the staged
+                    # chunks deliver per-batch (same step math, same state
+                    # — bit-identical), oldest first
+                    for c_ts, c_cols, c_t0 in sstack:
+                        self._deliver_chunk(c_ts, c_cols, c_t0)
+                    sstack = []
                 if pending is not None:
                     self._deliver_locked(pending, bs)
                     pending = None
@@ -593,12 +711,13 @@ class IngressPipeline:
                                 for dt in self.np_dtypes]
                     self._deliver_locked(batch, m)
                 continue
-            if fill == 0 and pending is None and ring.size() == 0 \
-                    and self._q.unfinished_tasks == 0:
+            if fill == 0 and pending is None and not sstack \
+                    and ring.size() == 0 and self._q.unfinished_tasks == 0:
                 self._feeder_idle.set()
                 if self._feeder_stop.is_set():
                     return
                 self._flush_req.clear()
+                self._barrier_req.clear()
                 self._flush_req.wait(timeout=0.001)
             elif self._feeder_stop.is_set() and ring.size() == 0 \
                     and self._q.unfinished_tasks == 0:
@@ -616,6 +735,7 @@ class IngressPipeline:
         self._q.join()  # all claimed runs are encoded + published
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
+            self._barrier_req.set()
             self._flush_req.set()
             if self._feeder_idle.is_set() and self.ring.size() == 0 \
                     and self._q.unfinished_tasks == 0:
@@ -635,6 +755,11 @@ class IngressPipeline:
         delivered = self._batches
         return {
             "workers": self.workers,
+            "superstep_k": self._ss_k,
+            "supersteps_dispatched": self._ss_supersteps,
+            "superstep_decline": self._ss_decline,
+            "superstep_scan_ms": self._ss_scan_ns / 1e6,
+            "superstep_replay_ms": self._ss_replay_ns / 1e6,
             "ring_capacity": self.ring.capacity,
             "ring_depth_hwm": self.ring.hwm(),
             "rows_in": self._rows_in,
@@ -709,7 +834,7 @@ class ShardRouter:
             self.assignment = assignment.copy()
         else:
             self.assignment = np.arange(n_slots, dtype=np.int64) % n_shards
-        self._lock = threading.Lock()
+        self._lock = named_lock("ingress.shard_router")
         #: rows routed per slot / per shard since the current epoch began
         self.slot_rows = np.zeros(n_slots, dtype=np.int64)
         self.routed = np.zeros(n_shards, dtype=np.int64)

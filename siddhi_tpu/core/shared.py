@@ -118,7 +118,13 @@ def _apply_pushdown(qr: QueryRuntime) -> int:
 
 class SharedStepGroup(Receiver):
     """One fused receiver standing in for a contiguous run of member
-    QueryRuntimes on the same junction."""
+    QueryRuntimes on the same junction.
+
+    The superstep runner (core/superstep.py) scans groups too: it reuses
+    `_steps` (the untracked member step closures) inside its `lax.scan`
+    body and `_current_emit_flags()` for its per-dispatch emit/DCE
+    revalidation, and replays `_post_step_maintenance` + the equal-share
+    telemetry attribution per inner batch — keep those surfaces stable."""
 
     #: junction._deliver consults this before dispatch; members with
     #: breakers never fuse, so the group itself is never diverted

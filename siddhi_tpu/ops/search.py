@@ -54,14 +54,15 @@ def _host_radix_argsort(a):
     return out
 
 
-#: lane count below which CPU uses XLA's native sort instead of the radix
-#: pure_callback. A host callback anywhere in a jitted program disables
-#:  pjit's C++ fastpath for EVERY call of that executable (jax
-#: `_get_fastpath_data` vetoes host_callbacks), costing ~0.5-6 ms of python
-#: dispatch per step — far more than a small comparator sort. Measured on
-#: this backend: native argsort 45 us @256 lanes / 2.5 ms @8192; radix
-#: callback ~0.7 ms flat. Above the threshold the radix asymptotics win
-#: (74 ms vs 4 ms at 262k lanes).
+#: lane count below which the plain native argsort is used instead of the
+#: packed single-key sort. Historical meaning (kept for the cost model and
+#: the legacy-callback escape hatch): on CPU this was the width above which
+#: the C radix argsort pure_callback won over XLA's comparator sort. A host
+#: callback anywhere in a jitted program disables pjit's C++ fastpath for
+#: EVERY call of that executable (jax `_get_fastpath_data` vetoes
+#: host_callbacks), costing ~0.5-6 ms of python dispatch per step — so the
+#: callback traded per-sort time for per-dispatch time. The packed-key sort
+#: below keeps the asymptotic win on device with no callback.
 _RADIX_SORT_MIN_LANES = 8192
 
 
@@ -74,22 +75,32 @@ def _radix_min_lanes() -> int:
         return _RADIX_SORT_MIN_LANES
 
 
+def _legacy_callback_enabled() -> bool:
+    """Deprecated escape hatch: SIDDHI_RADIX_CALLBACK=1 restores the old
+    CPU `pure_callback` radix argsort (testing / A-B only — it vetoes
+    pjit's fastpath and makes the step superstep-ineligible)."""
+    import os
+    return os.environ.get("SIDDHI_RADIX_CALLBACK", "").strip() == "1"
+
+
 def stable_argsort_bounded(x):
     """Stable argsort of NON-NEGATIVE int32 keys, as int32 positions.
 
-    TPU/other accelerators: native `jnp.argsort` (fast there). CPU backend,
-    wide batches only: an LSD radix argsort in C reached via
-    `jax.pure_callback` — XLA CPU's comparator sort runs ~260 ns/elem
-    (74 ms at 282k lanes, measured) while the radix pass is ~10 ns/elem.
-    Narrow batches stay on the native sort: the callback would knock the
-    whole compiled step off pjit's C++ fastpath (see _RADIX_SORT_MIN_LANES)
-    — which also matters for fused multi-query steps (core/shared.py),
-    where one callback-bearing member would slow every co-resident query.
-    The callback is batch-aware (trailing axis) so it stays vmappable."""
+    Narrow batches: native `jnp.argsort(stable=True)`. Wide batches: pack
+    `(key << 32) | lane` into one int64 word and run a SINGLE unstable
+    single-operand `lax.sort` — the lane index in the low bits makes the
+    order stable by construction and the low 32 bits of the sorted words
+    ARE the argsort. One sort over one operand instead of argsort's
+    internal (key, iota) co-sort, and — unlike the retired CPU radix
+    `pure_callback` — it stays on device, so the compiled step keeps
+    pjit's C++ fastpath and can ride inside a superstep `lax.scan`
+    (core/superstep.py). Keys are bounded (< 2^31), so the shifted word
+    never overflows int64. The deprecated callback path survives behind
+    SIDDHI_RADIX_CALLBACK=1 for A/B tests only."""
     import jax
     from jax import lax, pure_callback
 
-    def cpu_fn(v):
+    def legacy_cpu_fn(v):
         return pure_callback(
             _host_radix_argsort,
             jax.ShapeDtypeStruct(v.shape, jnp.int32), v,
@@ -98,9 +109,19 @@ def stable_argsort_bounded(x):
     def default_fn(v):
         return jnp.argsort(v, axis=-1, stable=True).astype(jnp.int32)
 
+    def packed_fn(v):
+        lane = lax.broadcasted_iota(jnp.int64, v.shape, v.ndim - 1)
+        packed = (v.astype(jnp.int64) << 32) | lane
+        swords = lax.sort(packed, dimension=v.ndim - 1, is_stable=False)
+        return (swords & jnp.int64(0xFFFFFFFF)).astype(jnp.int32)
+
     if x.shape[-1] < _radix_min_lanes():
         return default_fn(x)
-    return lax.platform_dependent(x, cpu=cpu_fn, default=default_fn)
+    if _legacy_callback_enabled():
+        return lax.platform_dependent(x, cpu=legacy_cpu_fn,
+                                      default=default_fn)
+    # int64 lane math is emulated on TPU — keep the native argsort there
+    return lax.platform_dependent(x, cpu=packed_fn, default=default_fn)
 
 
 def searchsorted32(a, v, side: str = "left"):

@@ -48,6 +48,7 @@ from ..analysis.sharding import ShardConfig, check_shardable, shard_config
 from ..core.ingress import ShardRouter
 from ..errors import SiddhiAppCreationError
 from ..query_api import SiddhiApp
+from ..util.locks import named_condition
 
 log = logging.getLogger("siddhi_tpu")
 
@@ -71,7 +72,7 @@ class _IngressGate:
     ones so a rebalance/move sees a quiesced router."""
 
     def __init__(self) -> None:
-        self._cond = threading.Condition()
+        self._cond = named_condition("shard.ingress_gate")
         self._active = 0
         self._paused = False
 

@@ -55,7 +55,7 @@ RECENT_RING = 64
 
 class BatchTrace:
     __slots__ = ("batch_id", "stream", "size", "t0", "h2d_ns", "device_ns",
-                 "sink_ns", "deliver_t0", "queries")
+                 "sink_ns", "deliver_t0", "queries", "superstep")
 
     def __init__(self, batch_id: int, stream: str, size: Optional[int],
                  t0: int) -> None:
@@ -68,6 +68,9 @@ class BatchTrace:
         self.sink_ns = 0
         self.deliver_t0 = 0
         self.queries: list[str] = []
+        #: K of the superstep this batch rode in (core/superstep.py), 0 for
+        #: per-batch dispatch — the trace stays per INNER batch either way
+        self.superstep = 0
 
     def summary(self, t_end: int) -> dict:
         e2e = t_end - self.t0
@@ -75,7 +78,7 @@ class BatchTrace:
         # sink publishes run nested inside query spans: report device
         # exclusive of sink so the stage shares stay additive
         device = max(self.device_ns - self.sink_ns, 0)
-        return {
+        out = {
             "batch_id": self.batch_id,
             "stream": self.stream,
             "batch_size": self.size,
@@ -88,6 +91,9 @@ class BatchTrace:
                 "sink": self.sink_ns / 1e6,
             },
         }
+        if self.superstep:
+            out["superstep_k"] = self.superstep
+        return out
 
 
 class AppTelemetry:

@@ -3,14 +3,17 @@
 
 Every compiled step of every bench-suite app is certified against pjit's
 C++ dispatch fastpath via `analysis.jaxpr_pass.fastpath_certify`: no host
-callback, no ordered effect. Steps listed in KNOWN_VETOED are today's
-accepted hit-list (the device-resident-supersteps roadmap item works it
-down); everything else must certify, and a previously-clean step turning
-vetoed fails CI.
+callback, no ordered effect. KNOWN_VETOED is EMPTY — the device-resident
+supersteps work retired the last hit-list entries (the CPU radix-argsort
+pure_callbacks, replaced by the on-device packed-key sort in
+ops/search.py) — and the gate is now hard: ANY vetoed step in ANY bench
+app fails CI outright. A host callback in a step would also make the
+plan superstep-ineligible (core/superstep.py), so this gate doubles as
+the superstep-eligibility floor for the bench suite.
 
     python tools/fastpath_gate.py [--json]
 
-Exit codes: 0 = no regressions, 1 = a step off the hit-list is vetoed.
+Exit codes: 0 = all steps certified, 1 = any step is vetoed.
 """
 
 from __future__ import annotations
@@ -107,23 +110,11 @@ APPS = {
     """,
 }
 
-#: accepted vetoes, keyed "<app>:<step>" — the supersteps hit-list.
-#: Adding here requires a written justification next to the entry.
-#:
-#: _host_radix_argsort: on the CPU backend, group-by/distinct/join steps
-#: whose sort width exceeds _RADIX_SORT_MIN_LANES (8192) route through the
-#: C radix argsort pure_callback — a measured win over XLA's comparator
-#: sort at those widths (ops/search.py) that deliberately trades the
-#: fastpath away. The supersteps roadmap item retires these by keeping
-#: the sort on-device inside a K-batch lax.scan.
-KNOWN_VETOED: dict = {
-    "groupby:bench": "_host_radix_argsort above lane threshold (CPU)",
-    "distinct:bench": "_host_radix_argsort above lane threshold (CPU)",
-    "join:bench/left": "_host_radix_argsort above lane threshold (CPU)",
-    "join:bench/right": "_host_radix_argsort above lane threshold (CPU)",
-    "e2e_ingress:agg": "_host_radix_argsort above lane threshold (CPU)",
-    "sharded_e2e:agg": "_host_radix_argsort above lane threshold (CPU)",
-}
+#: accepted vetoes, keyed "<app>:<step>". EMPTY by design since the
+#: packed-key device sort retired the radix pure_callbacks — adding an
+#: entry here requires a written justification next to it, and note that
+#: any entry also forfeits superstep eligibility for its plan.
+KNOWN_VETOED: dict = {}
 
 
 def main(argv=None) -> int:
@@ -144,11 +135,6 @@ def main(argv=None) -> int:
             results[key] = v
             if not v["certified"] and key not in KNOWN_VETOED:
                 regressions.append(f"{key}: {'; '.join(v['vetoes'])}")
-    for key in KNOWN_VETOED:
-        if key in results and results[key]["certified"]:
-            # hit-list entry went clean: prune it so it can't regress
-            print(f"note: {key} is now certified — remove it from "
-                  f"KNOWN_VETOED", file=sys.stderr)
 
     if args.as_json:
         print(json.dumps({"steps": results,
