@@ -1362,6 +1362,142 @@ def bench_e2e_ingress() -> dict:
     return res
 
 
+def _bench_failover_leg(reps: int = 2) -> dict:
+    """ADVISORY leg of sharded_e2e (bench_compare strips it): the
+    multi-host kill-one-host drill timed end to end. Two real
+    `python -m siddhi_tpu.service` worker subprocesses, a FrontTier
+    router in-process, one worker SIGKILLed under traffic — reports
+    detection (heartbeat misses → confirmed dead), takeover (epoch
+    commit + WAL-replay adoption + spool drain) and post-failover
+    drain wall times, p50/p99 over `reps` drills. Wall-clock numbers
+    depend on worker boot and scheduler jitter: trends, not gates."""
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from siddhi_tpu.parallel.front_tier import FrontTier
+    from siddhi_tpu.util.faults import kill_host
+    from siddhi_tpu.io import wire
+
+    # leave the throughput phases their share of the config budget
+    if time.monotonic() - T0 > CONFIG_SECONDS * 0.6:
+        return {"skipped": "config time budget exhausted"}
+
+    fo_app = """
+    @app:name('FailoverBench')
+    @app:shards(n='4', key='symbol')
+    define stream TradeStream (symbol string, price double);
+    @info(name='agg')
+    from TradeStream select symbol, sum(price) as total, count() as n
+    group by symbol insert into SummaryStream;
+    """
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    rng = np.random.default_rng(RNG_SEED + 11)
+    detect_ms, takeover_ms, drain_ms = [], [], []
+    errors = []
+    for rep in range(reps):
+        tmp = tempfile.mkdtemp(prefix="siddhi-bench-failover-")
+        procs = []
+        front = None
+        try:
+            ports = [free_port(), free_port()]
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            env["PYTHONPATH"] = repo + os.pathsep + env.get(
+                "PYTHONPATH", "")
+            env.pop("SIDDHI_FAULT_SPEC", None)  # chaos stays in tests
+            for p in ports:
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "siddhi_tpu.service", str(p)],
+                    env=env, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL))
+            for p in ports:
+                boot_by = time.monotonic() + 90
+                while time.monotonic() < boot_by:
+                    try:
+                        urllib.request.urlopen(
+                            f"http://127.0.0.1:{p}/health",
+                            timeout=2.0).read()
+                        break
+                    except OSError:
+                        time.sleep(0.05)
+                else:
+                    raise RuntimeError(f"worker :{p} never came up")
+
+            front = FrontTier(
+                fo_app, [f"http://127.0.0.1:{p}" for p in ports],
+                wal_dir=os.path.join(tmp, "wal"),
+                heartbeat_interval_s=0.2, miss_threshold=2,
+                max_retries=0, retry_initial_s=0.01, retry_max_s=0.02)
+            front.start()
+            h = front.get_input_handler("TradeStream")
+
+            def frame(n_rows):
+                ks = rng.integers(0, 64, n_rows)
+                return [(f"S{int(k)}", float(v) * 0.25)
+                        for k, v in zip(ks,
+                                        rng.integers(1, 100, n_rows))]
+
+            for _ in range(6):
+                h.send_batch(frame(256))
+            kill_host(procs[1])
+            for _ in range(6):  # spools toward the dead owner
+                h.send_batch(frame(256))
+            by = time.monotonic() + 30
+            while front.failovers_total < 1 and time.monotonic() < by:
+                time.sleep(0.02)
+            if not front.failover_timings:
+                raise RuntimeError("takeover never completed")
+            t0 = time.perf_counter()
+            front.drain(timeout_s=30)
+            drain_ms.append((time.perf_counter() - t0) * 1e3)
+            timing = front.failover_timings[0]
+            detect_ms.append(float(timing["detect_ms"] or 0.0))
+            takeover_ms.append(float(timing["takeover_ms"]))
+            cons = front.conservation_report()
+            if not cons["conserved"]:
+                raise RuntimeError(f"conservation broke: {cons}")
+        except Exception as e:  # noqa: BLE001 — advisory leg never fails
+            errors.append(f"rep{rep}: {type(e).__name__}: {e}")
+        finally:
+            if front is not None:
+                try:
+                    front.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+            for pr in procs:
+                kill_host(pr)
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    if not takeover_ms:
+        return {"error": "; ".join(errors) or "no successful drill"}
+
+    def pct(xs, q):
+        return round(float(np.percentile(np.asarray(xs), q)), 1)
+
+    out = {
+        "reps": len(takeover_ms),
+        "detect_ms_p50": pct(detect_ms, 50),
+        "detect_ms_p99": pct(detect_ms, 99),
+        "takeover_ms_p50": pct(takeover_ms, 50),
+        "takeover_ms_p99": pct(takeover_ms, 99),
+        "drain_ms_p50": pct(drain_ms, 50),
+        "drain_ms_p99": pct(drain_ms, 99),
+    }
+    if errors:
+        out["rep_errors"] = "; ".join(errors)
+    return out
+
+
 def bench_sharded_e2e() -> dict:
     """MULTICHIP config: the sharded execution plane under sustained SXF1
     frame traffic (parallel/shard_plane.py). One app text, shard counts
@@ -1560,6 +1696,8 @@ def bench_sharded_e2e() -> dict:
         "conserved": all(bool(c) for c in conservation.values()),
         "producers": n_producers,
     }
+    _phase("sharded_e2e:failover")
+    res["failover"] = _bench_failover_leg()
     _partial(res)
     assert parity, f"shard-vs-serial output digests diverged: {digests}"
     if not E2E_ONLY:

@@ -903,6 +903,22 @@ class ShardRouter:
     def shard_of(self, value) -> int:
         return int(self.assignment[self.slot_of(value)])
 
+    def republish(self, assignment) -> None:
+        """Atomically swap the slot→shard table. A rebalance (or a front
+        tier refreshing its view from a newer shardmeta epoch) republishes
+        the assignment instead of rehashing the world — `slot = hash(key)
+        % n_slots` never changes, so in-flight `slot_of` results stay
+        valid across the swap."""
+        import numpy as np
+        arr = np.asarray(assignment, dtype=np.int64)
+        if arr.shape[0] != self.n_slots or \
+                (len(arr) and arr.max() >= self.n_shards):
+            raise ValueError(
+                f"shard assignment must map {self.n_slots} slots to "
+                f"[0, {self.n_shards})")
+        with self._lock:
+            self.assignment = arr.copy()
+
     def note_routed(self, slots) -> None:
         """Account one routed batch into the skew counters."""
         import numpy as np

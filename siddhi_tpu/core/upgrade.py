@@ -417,6 +417,8 @@ def replay_wal(manager, app: SiddhiApp, wal_dir: str, *,
     try:
         for kind, sid, tss, data in read_records(wal_dir,
                                                  app_name or app.name):
+            if kind not in ("rows", "cols"):
+                continue  # generic journal marks are not events
             records += 1
             try:
                 handler = rt.get_input_handler(sid)
@@ -529,7 +531,7 @@ def shuffled_replay(manager, app: SiddhiApp, wal_dir: Optional[str] = None,
             if kind == "rows":
                 for ts, row in zip(tss, data):
                     arrivals.append((sid, int(ts), tuple(row)))
-            else:  # "cols": dict of columns, definition attribute order
+            elif kind == "cols":  # dict of columns, attribute order
                 names = attr_order.get(sid)
                 if names is None:
                     continue  # stream not on the candidate app

@@ -231,7 +231,43 @@ def analyze(bundle: dict, baseline: Optional[dict] = None,
             f"fast-window burn rate {burn:.2f}; {basis} = {ms:.2f} ms",
             oid))
 
-    # 2. engine failure surfaces
+    # 2. front-tier failover surfaces (parallel/front_tier.py bundles):
+    # a dead shard owner / unowned slots means frames are spooling or
+    # diverting RIGHT NOW — the on-call page for the multi-host tier
+    ft = stats.get("front_tier") or {}
+    if ft:
+        def _slots(slots):
+            s = ", ".join(str(x) for x in slots[:12])
+            return s + (f", … ({len(slots)} total)"
+                        if len(slots) > 12 else "")
+        spool = ft.get("spool") or {}
+        depth = spool.get("frames", 0)
+        dead_hosts = [u for u, h in (ft.get("hosts") or {}).items()
+                      if not h.get("up")]
+        unowned = ft.get("unowned_slots") or []
+        dead_slots = ft.get("dead_owner_slots") or []
+        if unowned:
+            findings.append(_finding(
+                "critical",
+                "unowned shard slots: frames divert to the error store",
+                f"slots [{_slots(unowned)}] have NO live owner; "
+                f"{ft.get('unowned_diverts', 0)} divert(s), spool depth "
+                f"{depth} frame(s) — replay via /errors/replay "
+                "(kind=unowned) once a host adopts the shards"))
+        if dead_slots:
+            findings.append(_finding(
+                "critical",
+                "dead shard owner: slots routed to an unreachable host",
+                f"host(s) {', '.join(dead_hosts) or '?'} down; slots "
+                f"[{_slots(dead_slots)}] affected, spool depth {depth} "
+                "frame(s) awaiting takeover/replay"))
+        elif depth:
+            findings.append(_finding(
+                "warning", "router spool is non-empty",
+                f"{depth} frame(s) spooled awaiting replay; failovers so "
+                f"far: {ft.get('failovers_total', 0)}"))
+
+    # 3. engine failure surfaces
     for q, br in (stats.get("breakers") or {}).items():
         if br.get("state") and br["state"] != "closed":
             findings.append(_finding(
@@ -332,7 +368,7 @@ def analyze(bundle: dict, baseline: Optional[dict] = None,
             f"{COST_DRIFT_BAND:.1f}x) — an operator allocates state the "
             "model does not price; run tools/cost_calibrate.py"))
 
-    # 3. baseline regression diff
+    # 4. baseline regression diff
     if baseline is not None:
         base_stats = baseline.get("stats") or {}
         now_p99 = _stage_p99s(stats)

@@ -1,0 +1,1424 @@
+"""Multi-host shard serving tier: SXF1 frame routing with failover.
+
+PR 14 built the sharded execution plane, but every replica still lives in
+one process. This module stretches the ShardRouter across processes:
+
+  ``FrontTier``   the router — speaks SXF1, hashes partition keys with the
+                  SAME FNV-1a two-level slot map as the in-process plane
+                  (``ShardRouter.split_columns`` reused verbatim, hashing
+                  ORIGINAL pre-interning values), re-encodes each shard's
+                  subset as its own frame, and forwards it over HTTP to
+                  the worker host that owns the shard.
+  ``ShardHost``   the worker side — lives inside ``service.py`` behind
+                  ``/shard-host/*`` endpoints; builds replica runtimes
+                  (``shard_plane.shard_app`` — identical identity to a
+                  local plane's replicas, per-shard WAL dirs and all),
+                  validates the epoch stamped on every frame, journals a
+                  per-frame ``"mark"`` seq record for duplicate detection,
+                  and performs shard adoption after a host death.
+
+Delivery semantics (the operator-semantics survey's vocabulary, arXiv
+2303.00793): **at-least-once across the ack window, exactly-once
+everywhere else**. A worker ack implies the frame's rows are journaled
+(the WAL append in the send path is synchronous), so a frame whose ack
+was lost is spooled by the router and — on replay — rejected by the
+worker as a duplicate via the journaled seq mark. The only unclosable
+window is a SIGKILL that lands between the rows append and the mark
+append of one frame: that frame replays twice (never zero times).
+
+Failure handling:
+
+  * per-host heartbeat (``/shard-host/ping``) with a miss-count deadline
+    detector; forwards use bounded exponential-backoff retries;
+  * frames addressed to an unreachable owner land in a durable per-shard
+    **spool** (the state/wal.py segment format, generic ``"frame"``
+    records) in arrival order, and replay — original seqs, re-stamped
+    epochs — when the owner recovers or a survivor adopts the shard;
+  * on detector-confirmed death the router drives **takeover**: bump the
+    dead shards' epochs, commit the new ``<App>.shardmeta.json``
+    atomically (the fence point), have a surviving worker adopt each
+    shard by replaying its per-shard WAL dirs (the recover_shard /
+    move_shard journal-is-the-migration-format discipline), then replay
+    the spool with the adoption's ``last_seq`` deduping the ack window;
+  * a zombie host returning mid-takeover is fenced by epoch: its deploy
+    at a stale epoch is refused against the durable meta, stale-epoch
+    frames are rejected at the worker (409), counted, and re-routed by
+    the sender after it refreshes its view from the meta file;
+  * slots whose shard has NO live owner divert to the replayable
+    ErrorStore (kind="unowned") instead of blocking — and ``/ready``
+    answers 503 while any plane is degraded.
+
+Conservation identity, checkable after ``drain()``::
+
+    sent == delivered + spool_replayed + diverted        (+ spooled_pending
+                                                          before drain)
+
+Shared-filesystem contract: the router and every worker see the same
+``wal_dir`` (one machine, or a shared mount). The meta file doubles as
+the fence ledger, and adoption reads the dead host's journals directly.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+from urllib.parse import quote
+
+import numpy as np
+
+from ..analysis.sharding import check_shardable, shard_config
+from ..core.ingress import ShardRouter
+from ..errors import SiddhiAppCreationError, SiddhiError
+from ..io import wire
+from ..state.wal import WriteAheadLog, read_records
+from ..util.locks import named_lock, named_rlock, note_blocking
+from .shard_plane import _n_slots, epoch_wal_dir, shard_app, shard_app_name
+
+log = logging.getLogger("siddhi_tpu")
+
+#: spool journal sub-directory under the front tier's wal_dir
+SPOOL_DIR = "_router_spool"
+
+
+def _meta_path(wal_dir: str, app_name: str) -> str:
+    return os.path.join(wal_dir, f"{app_name}.shardmeta.json")
+
+
+def _read_meta_file(path: str) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        log.warning("shard meta %s unreadable", path)
+        return None
+
+
+def _py(v):
+    """JSON-safe scalar (numpy → python)."""
+    return v.item() if hasattr(v, "item") else v
+
+
+def _http(method: str, url: str, *, body: Optional[bytes] = None,
+          ctype: str = "application/json", token: Optional[str] = None,
+          timeout: float = 5.0) -> tuple[int, dict]:
+    """One HTTP exchange. 4xx/5xx come back as (status, body) — only
+    transport-level failures raise (OSError/URLError)."""
+    headers = {"Content-Type": ctype}
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(url, data=body, method=method,
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+            status = resp.status
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        status = e.code
+    try:
+        payload = json.loads(raw) if raw else {}
+    except (ValueError, UnicodeDecodeError):
+        payload = {"raw": repr(raw[:200])}
+    return status, payload
+
+
+# ========================================================================= #
+# worker side
+# ========================================================================= #
+
+
+class ShardHost:
+    """The worker-side adoption hooks: owns this process's shard replicas
+    for any number of sharded apps, enforces epoch fencing against the
+    durable shardmeta ledger, and journals per-frame seq marks so an
+    adoption can report ``last_seq`` for spool dedupe. Constructed lazily
+    by SiddhiService and driven through the ``/shard-host/*`` routes."""
+
+    def __init__(self, manager) -> None:
+        self.manager = manager
+        self._lock = named_rlock("shard_host.registry")
+        #: (app, shard) -> {"epoch", "runtime", "wal_base", "capture"}
+        self.owned: dict = {}
+        #: (app, shard) -> last frame seq journaled as a "mark"
+        self.last_seq: dict = {}
+        #: (app, shard) -> list of [stream, ts, [values...]] in emit order
+        self.captured: dict = {}
+        #: app -> (meta path, last seen mtime_ns)
+        self._meta_seen: dict = {}
+        self._app_texts: dict = {}
+        self.stale_rejected = 0
+        self.fenced_shards = 0
+        self.fenced_deploys = 0
+        self.duplicate_frames = 0
+
+    # ---------------------------------------------------------------- meta
+
+    def _meta_epoch_for(self, meta: Optional[dict], shard: int) -> int:
+        if not meta:
+            return 0
+        eps = meta.get("shard_epochs")
+        if isinstance(eps, list) and shard < len(eps):
+            return int(eps[shard])
+        return int(meta.get("epoch", 0))
+
+    def _check_meta(self, app_name: str, *, force: bool = False) -> None:
+        """Self-fencing: re-read the durable meta when its mtime moved (or
+        on demand) and drop any owned shard whose committed epoch has
+        advanced past ours — a zombie learns of its own death here."""
+        seen = self._meta_seen.get(app_name)
+        if seen is None:
+            return
+        path, mtime = seen
+        try:
+            now = os.stat(path).st_mtime_ns
+        except OSError:
+            return
+        if not force and now == mtime:
+            return
+        self._meta_seen[app_name] = (path, now)
+        meta = _read_meta_file(path)
+        if meta is None:
+            return
+        with self._lock:
+            for (a, i), ent in list(self.owned.items()):
+                if a != app_name:
+                    continue
+                want = self._meta_epoch_for(meta, i)
+                if ent["epoch"] < want:
+                    self._drop_replica(a, i, reason=f"meta epoch {want}")
+
+    def _drop_replica(self, app_name: str, shard: int, reason: str) -> None:
+        ent = self.owned.pop((app_name, shard), None)
+        if ent is None:
+            return
+        self.fenced_shards += 1
+        rname = shard_app_name(app_name, shard)
+        self.manager.runtimes.pop(rname, None)
+        try:
+            ent["runtime"].shutdown(flush_durable=False)
+        except Exception:  # noqa: BLE001 — fencing must not wedge
+            pass
+        log.warning("shard host: fenced %s shard %d at epoch %d (%s)",
+                    app_name, shard, ent["epoch"], reason)
+
+    # -------------------------------------------------------------- deploy
+
+    def _build_replica(self, app, shard: int, wal_base: Optional[str],
+                       epoch: int, capture, runtime_kwargs: dict):
+        replica = shard_app(app, shard)
+        wd = epoch_wal_dir(wal_base, epoch)
+        rt = self.manager.create_siddhi_app_runtime(
+            replica, wal_dir=wd, **runtime_kwargs)
+        if rt is None:  # budget-queued — not a serving replica
+            raise SiddhiError(
+                f"replica {replica.name} was queued by admission control; "
+                "a shard host cannot defer a shard")
+        rt.start()
+        key = (app.name, shard)
+        self.captured.setdefault(key, [])
+        sink = self.captured[key]
+        for sid in capture or ():
+            rt.add_callback(sid, self._make_capture(sink, sid))
+        # env-driven chaos (SIDDHI_FAULT_SPEC) applies per replica, so the
+        # kill-one-host drill runs with the same seeded faults a local
+        # soak run would inject
+        from ..util.faults import apply_fault_spec
+        apply_fault_spec(rt)
+        return rt
+
+    @staticmethod
+    def _make_capture(sink: list, stream: str):
+        def cb(events):
+            for e in events:
+                sink.append([stream, int(e.timestamp),
+                             [_py(v) for v in e.data]])
+        return cb
+
+    def deploy(self, app_text: str, shards, wal_dir: Optional[str],
+               epoch: int = 0, shard_epochs: Optional[dict] = None,
+               capture=(), runtime_kwargs: Optional[dict] = None) -> dict:
+        """Build + start replicas for `shards` of the app in `app_text`.
+        Each shard's epoch is fence-checked against the durable meta: a
+        deploy at a stale epoch (a zombie re-announcing itself after a
+        takeover) is refused and counted."""
+        from .. import compiler
+        app = compiler.parse(app_text)
+        kwargs = dict(runtime_kwargs or {})
+        if wal_dir is not None:
+            path = _meta_path(wal_dir, app.name)
+            try:
+                mtime = os.stat(path).st_mtime_ns
+            except OSError:
+                mtime = 0
+            self._meta_seen[app.name] = (path, mtime)
+        self._app_texts[app.name] = app_text
+        meta = _read_meta_file(_meta_path(wal_dir, app.name)) \
+            if wal_dir is not None else None
+        deployed, fenced = [], []
+        with self._lock:
+            for i in shards:
+                i = int(i)
+                ep = int((shard_epochs or {}).get(str(i), epoch))
+                want = self._meta_epoch_for(meta, i)
+                if ep < want:
+                    self.fenced_deploys += 1
+                    fenced.append({"shard": i, "epoch": ep,
+                                   "committed_epoch": want})
+                    log.warning(
+                        "shard host: REFUSED deploy of %s shard %d at "
+                        "stale epoch %d (committed epoch %d) — zombie "
+                        "fenced", app.name, i, ep, want)
+                    continue
+                if (app.name, i) in self.owned:
+                    self._drop_replica(app.name, i, reason="redeploy")
+                    self.fenced_shards -= 1  # a redeploy is not a fence
+                rt = self._build_replica(app, i, wal_dir, ep, capture,
+                                         kwargs)
+                self.owned[(app.name, i)] = {
+                    "epoch": ep, "runtime": rt, "wal_base": wal_dir,
+                    "capture": list(capture or ())}
+                deployed.append(i)
+        return {"app": app.name, "deployed": deployed, "fenced": fenced}
+
+    # --------------------------------------------------------------- adopt
+
+    def adopt(self, app_name: str, shard: int, epoch: int,
+              wal_dir: str, capture=(),
+              runtime_kwargs: Optional[dict] = None) -> dict:
+        """Take over a dead host's shard: build a fresh replica journaling
+        into the NEW epoch's WAL dir, then replay the newest prior-epoch
+        journal (which is always the complete history: an adoption
+        re-journals everything it replays, so each epoch's journal
+        subsumes the ones before it). Returns ``last_seq`` — the highest
+        frame seq the dead host journaled a mark for — so the router's
+        spool replay can skip frames that were applied but whose ack was
+        lost."""
+        app_text = self._app_texts.get(app_name)
+        if app_text is None:
+            raise SiddhiError(
+                f"shard host has no app text for {app_name!r}; deploy at "
+                "least one shard of the app before adopting others")
+        from .. import compiler
+        app = compiler.parse(app_text)
+        meta = _read_meta_file(_meta_path(wal_dir, app_name))
+        want = self._meta_epoch_for(meta, int(shard))
+        if int(epoch) < want:
+            self.fenced_deploys += 1
+            raise SiddhiError(
+                f"adopt of {app_name} shard {shard} at epoch {epoch} is "
+                f"fenced: committed epoch is {want}")
+        rname = shard_app_name(app_name, int(shard))
+        # a failed earlier adoption attempt at this epoch leaves a partial
+        # re-journal; wipe it so replay starts from the intact prior epoch
+        target_dir = os.path.join(epoch_wal_dir(wal_dir, int(epoch)), rname)
+        shutil.rmtree(target_dir, ignore_errors=True)
+        with self._lock:
+            if (app_name, int(shard)) in self.owned:
+                self._drop_replica(app_name, int(shard), reason="re-adopt")
+                self.fenced_shards -= 1
+            rt = self._build_replica(app, int(shard), wal_dir, int(epoch),
+                                     capture, dict(runtime_kwargs or {}))
+            last_seq = -1
+            replayed = 0
+            # newest prior epoch with a journal = the complete history
+            for e in range(int(epoch) - 1, -1, -1):
+                src = epoch_wal_dir(wal_dir, e)
+                recs = list(read_records(src, rname))
+                if not recs:
+                    continue
+                for kind, sid, tss, data in recs:
+                    if kind == "mark":
+                        last_seq = max(last_seq, int(data))
+                    elif kind == "rows":
+                        rt.get_input_handler(sid).send_batch(
+                            data, timestamps=tss)
+                        replayed += len(data)
+                    elif kind == "cols":
+                        rt.get_input_handler(sid).send_columns(
+                            data,
+                            timestamps=np.asarray(tss, dtype=np.int64))
+                        replayed += len(tss)
+                break
+            rt.flush()
+            rt.drain()
+            self.owned[(app_name, int(shard))] = {
+                "epoch": int(epoch), "runtime": rt, "wal_base": wal_dir,
+                "capture": list(capture or ())}
+            self.last_seq[(app_name, int(shard))] = last_seq
+        log.warning("shard host: adopted %s shard %d at epoch %d "
+                    "(%d event(s) replayed, last_seq=%d)",
+                    app_name, shard, epoch, replayed, last_seq)
+        return {"app": app_name, "shard": int(shard), "epoch": int(epoch),
+                "replayed": replayed, "last_seq": last_seq}
+
+    # --------------------------------------------------------------- fence
+
+    def fence(self, app_name: str,
+              shard_epochs: Optional[dict] = None) -> dict:
+        """Drop every owned shard of `app_name` whose epoch is behind the
+        committed one (from the request, falling back to the durable
+        meta). Idempotent; the takeover flow broadcasts this to every
+        reachable host."""
+        dropped = []
+        with self._lock:
+            for (a, i), ent in list(self.owned.items()):
+                if a != app_name:
+                    continue
+                want = None
+                if shard_epochs is not None:
+                    want = shard_epochs.get(str(i))
+                if want is None:
+                    seen = self._meta_seen.get(app_name)
+                    if seen:
+                        meta = _read_meta_file(seen[0])
+                        want = self._meta_epoch_for(meta, i)
+                if want is not None and ent["epoch"] < int(want):
+                    self._drop_replica(a, i,
+                                       reason=f"fence to epoch {want}")
+                    dropped.append(i)
+        return {"app": app_name, "fenced": dropped}
+
+    # ------------------------------------------------------------- deliver
+
+    def deliver(self, app_name: str, stream: str, shard: int,
+                epoch: int, seq: Optional[int],
+                body: bytes) -> tuple[int, dict]:
+        """One forwarded frame. Epoch-validated (409 for a stale or
+        unowned stamp — the router recounts and re-routes), seq-deduped
+        (200 with ``duplicate: true`` when the frame's rows are already
+        journaled), and mark-journaled after the rows land."""
+        self._check_meta(app_name)
+        key = (app_name, int(shard))
+        ent = self.owned.get(key)
+        if ent is not None and int(epoch) != ent["epoch"]:
+            # maybe we are the zombie: re-check the ledger before ruling
+            self._check_meta(app_name, force=True)
+            ent = self.owned.get(key)
+        if ent is None:
+            self.stale_rejected += 1
+            return 409, {"error": "not-owner", "app": app_name,
+                         "shard": int(shard)}
+        if int(epoch) != ent["epoch"]:
+            self.stale_rejected += 1
+            return 409, {"error": "stale-epoch", "app": app_name,
+                         "shard": int(shard), "got": int(epoch),
+                         "want": ent["epoch"]}
+        if seq is not None and seq <= self.last_seq.get(key, -1):
+            self.duplicate_frames += 1
+            return 200, {"accepted": 0, "duplicate": True}
+        rt = ent["runtime"]
+        n = wire.deliver_frames(rt.get_input_handler(stream), body)
+        if seq is not None:
+            if rt.wal is not None:
+                rt.wal.append_record("mark", stream, [], int(seq))
+            self.last_seq[key] = int(seq)
+        return 200, {"accepted": n}
+
+    # ------------------------------------------------------------ plumbing
+
+    def ping(self) -> dict:
+        apps: dict = {}
+        for (a, i) in list(self.owned):
+            apps.setdefault(a, []).append(i)
+        return {"ok": True,
+                "apps": {a: sorted(s) for a, s in apps.items()}}
+
+    def state(self, app_name: str) -> dict:
+        with self._lock:
+            return {
+                "app": app_name,
+                "owned": {str(i): {"epoch": ent["epoch"],
+                                   "last_seq": self.last_seq.get((a, i), -1)}
+                          for (a, i), ent in self.owned.items()
+                          if a == app_name},
+                "stale_rejected": self.stale_rejected,
+                "fenced_shards": self.fenced_shards,
+                "fenced_deploys": self.fenced_deploys,
+                "duplicate_frames": self.duplicate_frames,
+            }
+
+    def outputs(self, app_name: str,
+                shard: Optional[int] = None) -> dict:
+        out = {}
+        for (a, i), rows in self.captured.items():
+            if a != app_name or (shard is not None and i != int(shard)):
+                continue
+            out[str(i)] = list(rows)
+        return {"app": app_name, "outputs": out}
+
+    def drain(self, app_name: str) -> dict:
+        drained = []
+        for (a, i), ent in list(self.owned.items()):
+            if a != app_name:
+                continue
+            ent["runtime"].flush()
+            ent["runtime"].drain()
+            drained.append(i)
+        return {"app": app_name, "drained": sorted(drained)}
+
+
+# ========================================================================= #
+# router side
+# ========================================================================= #
+
+
+class _HostState:
+    def __init__(self, url: str) -> None:
+        self.url = url.rstrip("/")
+        self.up = True
+        self.confirmed_dead = False
+        self.misses = 0
+        self.first_miss_t: Optional[float] = None
+
+
+class _RoutingHandler:
+    """Input-handler duck type over the front tier: rows are encoded into
+    one SXF1 frame and routed like any external frame — which is what
+    makes ``ErrorStore.replay`` (and the JSON ingestion path of the
+    router's own HTTP server) work against the tier."""
+
+    def __init__(self, front: "FrontTier", stream_id: str) -> None:
+        self.front = front
+        self.stream_id = stream_id
+
+    def send(self, data, timestamp: Optional[int] = None) -> None:
+        self.send_batch([tuple(data)], timestamps=timestamp)
+
+    def send_batch(self, rows, timestamps=None) -> None:
+        n = len(rows)
+        if n == 0:
+            return
+        if timestamps is None or isinstance(timestamps, int):
+            ts = timestamps if timestamps is not None \
+                else int(time.time() * 1000)
+            tss = np.full(n, ts, dtype=np.int64)
+        else:
+            tss = np.asarray([int(t) for t in timestamps], dtype=np.int64)
+        plan = self.front._plan(self.stream_id)
+        cols = {}
+        for k, (name, _dt, code) in enumerate(plan):
+            vals = [r[k] for r in rows]
+            cols[name] = np.array(vals, dtype=object) if code == "s" \
+                else np.asarray(vals)
+        body = wire.encode_frame(plan, cols, n, tss)
+        self.front.deliver_frames(self.stream_id, body)
+
+
+class FrontTier:
+    """The multi-host router. See the module docstring for the protocol;
+    the public surface is deliberately runtime-shaped (`app`,
+    `get_input_handler`, `statistics_report`, `conservation_report`,
+    `flush`) so the flight recorder, the error store, and the service
+    idioms all compose with it."""
+
+    def __init__(self, app_text: str, hosts, *, wal_dir: str,
+                 token: Optional[str] = None,
+                 heartbeat_interval_s: float = 0.5,
+                 miss_threshold: int = 3,
+                 request_timeout_s: float = 5.0,
+                 max_retries: int = 2,
+                 retry_initial_s: float = 0.05,
+                 retry_max_s: float = 0.4,
+                 capture=(), runtime_kwargs: Optional[dict] = None,
+                 auto_failover: bool = True,
+                 error_store=None,
+                 bundle_dir: Optional[str] = None,
+                 recorder_cooldown_s: Optional[float] = None,
+                 recorder_min_interval_s: Optional[float] = None) -> None:
+        from .. import compiler
+        self.app_text = app_text
+        self.app = compiler.parse(app_text)
+        self.name = self.app.name
+        cfg = shard_config(self.app, strict=True)
+        if cfg is None:
+            raise SiddhiAppCreationError(
+                f"app {self.name!r} has no @app:shards annotation; the "
+                "front tier routes by partition key (docs/SHARDING.md)")
+        check_shardable(self.app, cfg.key)
+        self.key = cfg.key
+        self.n_shards = cfg.n
+        if not hosts:
+            raise SiddhiAppCreationError("front tier needs >= 1 host URL")
+        self.hosts = [_HostState(u) for u in hosts]
+        self.wal_dir = wal_dir
+        os.makedirs(wal_dir, exist_ok=True)
+        self.token = token
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.miss_threshold = int(miss_threshold)
+        self.request_timeout_s = float(request_timeout_s)
+        self.max_retries = int(max_retries)
+        self.retry_initial_s = float(retry_initial_s)
+        self.retry_max_s = float(retry_max_s)
+        self.capture = list(capture or ())
+        self.runtime_kwargs = dict(runtime_kwargs or {})
+        self.auto_failover = auto_failover
+
+        self._state = named_rlock("front_tier.state")
+        self._shard_locks = [named_lock("front_tier.shard_dispatch")
+                             for _ in range(self.n_shards)]
+        self._plans: dict = {}
+
+        meta = _read_meta_file(_meta_path(wal_dir, self.name))
+        assignment = None
+        self.epoch = 0
+        self.shard_epochs = [0] * self.n_shards
+        owners = [i % len(self.hosts) for i in range(self.n_shards)]
+        if meta is not None:
+            self._validate_meta(meta)
+            assignment = meta.get("assignment")
+            self.epoch = int(meta.get("epoch", 0))
+            eps = meta.get("shard_epochs")
+            if isinstance(eps, list) and len(eps) == self.n_shards:
+                self.shard_epochs = [int(e) for e in eps]
+            hosts_m = meta.get("shard_hosts")
+            if isinstance(hosts_m, list) and len(hosts_m) == self.n_shards:
+                by_url = {h.url: k for k, h in enumerate(self.hosts)}
+                owners = [by_url.get(u) if u is not None else None
+                          for u in hosts_m]
+        #: shard -> host index (None = no live owner; frames divert)
+        self.shard_owner: list = owners
+        self.router = ShardRouter(self.key, self.n_shards,
+                                  n_slots=_n_slots(),
+                                  assignment=assignment)
+
+        # durable per-shard spool (lazy) + in-memory pending accounting
+        self._spools: dict = {}
+        self._spool_frames = [0] * self.n_shards
+        self._spool_rows = [0] * self.n_shards
+        base = (int(time.time() * 1000) & 0x7FFFFFFFF) << 20
+        self._seq = [base] * self.n_shards
+
+        # counters (conservation identity + observability)
+        self.frames_in = 0
+        self.sent_rows = 0
+        self.delivered_rows = 0
+        self.replayed_rows = 0
+        self.diverted_rows = 0
+        self.spooled_frames_total = 0
+        self.spooled_rows_total = 0
+        self.deduped_frames = 0
+        self.stale_epoch_rejections = 0
+        self.reroutes = 0
+        self.forward_errors = 0
+        self.failovers_total = 0
+        self.unowned_diverts = 0
+        #: per-failover timing samples (bench's advisory failover leg)
+        self.failover_timings: list = []
+        self._load_spools()
+
+        if error_store is None:
+            from ..state.error_store import InMemoryErrorStore
+            error_store = InMemoryErrorStore()
+        self.error_store = error_store
+
+        from ..telemetry.recorder import FlightRecorder
+        self.recorder = FlightRecorder(
+            self, bundle_dir=bundle_dir, cooldown_s=recorder_cooldown_s,
+            min_interval_s=recorder_min_interval_s)
+
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._started = False
+
+    # ------------------------------------------------------------ metadata
+
+    def _validate_meta(self, meta: dict) -> None:
+        if meta.get("n_shards") != self.n_shards or \
+                meta.get("n_slots") != _n_slots() or \
+                meta.get("key") != self.key:
+            raise SiddhiAppCreationError(
+                f"shard meta for {self.name!r} was written for "
+                f"n={meta.get('n_shards')} key={meta.get('key')!r} "
+                f"slots={meta.get('n_slots')}; the app now asks for "
+                f"n={self.n_shards} key={self.key!r} slots={_n_slots()}")
+
+    def _write_meta(self) -> None:
+        """Commit the routing view durably — same atomic tmp+fsync+replace
+        discipline as ShardPlane._write_meta, extended with the per-shard
+        epoch and owner-host columns the fence protocol needs. THE commit
+        point of a takeover."""
+        path = _meta_path(self.wal_dir, self.name)
+        tmp = path + ".tmp"
+        with self._state:
+            doc = {"epoch": self.epoch, "n_shards": self.n_shards,
+                   "n_slots": self.router.n_slots, "key": self.key,
+                   "assignment": [int(s) for s in self.router.assignment],
+                   "shard_epochs": list(self.shard_epochs),
+                   "shard_hosts": [
+                       self.hosts[o].url if o is not None else None
+                       for o in self.shard_owner]}
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _refresh_view(self) -> bool:
+        """Reload the durable meta (another router instance may have
+        committed a newer epoch — the stale-router path of the fence
+        protocol). Returns True when the view changed."""
+        meta = _read_meta_file(_meta_path(self.wal_dir, self.name))
+        if meta is None:
+            return False
+        eps = meta.get("shard_epochs") or []
+        if int(meta.get("epoch", 0)) <= self.epoch and \
+                [int(e) for e in eps] == self.shard_epochs:
+            return False
+        self._validate_meta(meta)
+        by_url = {h.url: k for k, h in enumerate(self.hosts)}
+        with self._state:
+            self.epoch = int(meta.get("epoch", 0))
+            if isinstance(eps, list) and len(eps) == self.n_shards:
+                self.shard_epochs = [int(e) for e in eps]
+            hosts_m = meta.get("shard_hosts")
+            if isinstance(hosts_m, list) and len(hosts_m) == self.n_shards:
+                self.shard_owner = [
+                    by_url.get(u) if u is not None else None
+                    for u in hosts_m]
+            asg = meta.get("assignment")
+            if asg is not None:
+                self.router.republish(asg)
+        log.warning("front tier %s: refreshed routing view to epoch %d "
+                    "from shardmeta", self.name, self.epoch)
+        return True
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """Commit the initial view, push the app to every worker host,
+        start the heartbeat detector."""
+        self._write_meta()
+        for k, host in enumerate(self.hosts):
+            shards = [i for i, o in enumerate(self.shard_owner) if o == k]
+            if not shards:
+                continue
+            status, body = self._post_json(host.url, "/shard-host/apps", {
+                "app": self.app_text, "shards": shards,
+                "wal_dir": self.wal_dir,
+                "shard_epochs": {str(i): self.shard_epochs[i]
+                                 for i in shards},
+                "capture": self.capture,
+                "runtime_kwargs": self.runtime_kwargs})
+            if status != 200 or body.get("fenced"):
+                raise SiddhiError(
+                    f"front tier bring-up: deploy to {host.url} failed "
+                    f"({status}): {body}")
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, name=f"front-tier-hb-{self.name}",
+            daemon=True)
+        self._hb_thread.start()
+        self._started = True
+
+    def shutdown(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5.0)
+        self.recorder.close()
+        for wal in self._spools.values():
+            wal.close()
+        self._started = False
+
+    def flush(self, now=None) -> None:  # runtime duck-typing (error replay)
+        pass
+
+    # ------------------------------------------------------------- HTTP io
+
+    def _post_json(self, base: str, path: str, obj: dict,
+                   timeout: Optional[float] = None) -> tuple[int, dict]:
+        return self._post(base + path, json.dumps(obj).encode(),
+                          ctype="application/json", timeout=timeout)
+
+    def _post(self, url: str, body: bytes, *, ctype: str,
+              timeout: Optional[float] = None) -> tuple[int, dict]:
+        """One POST exchange (instance method so chaos tests can wrap it —
+        e.g. raise AFTER the worker processed the request to simulate a
+        lost ack)."""
+        note_blocking("front_tier.forward",
+                      allow=("front_tier.shard_dispatch",
+                             "front_tier.state"))
+        return _http("POST", url, body=body, ctype=ctype, token=self.token,
+                     timeout=timeout if timeout is not None
+                     else self.request_timeout_s)
+
+    def _get_json(self, base: str, path: str,
+                  timeout: Optional[float] = None) -> tuple[int, dict]:
+        note_blocking("front_tier.forward",
+                      allow=("front_tier.shard_dispatch",
+                             "front_tier.state"))
+        return _http("GET", base + path, token=self.token,
+                     timeout=timeout if timeout is not None
+                     else self.request_timeout_s)
+
+    # ------------------------------------------------------------ ingestion
+
+    def _plan(self, stream: str):
+        plan = self._plans.get(stream)
+        if plan is None:
+            defn = self.app.stream_definitions.get(stream)
+            if defn is None:
+                raise KeyError(f"stream {stream!r} is not defined on "
+                               f"{self.name!r}")
+            names = [a.name for a in defn.attributes]
+            if self.key not in names:
+                raise SiddhiAppCreationError(
+                    f"stream {stream!r} has no partition-key attribute "
+                    f"{self.key!r}; it cannot be routed")
+            plan = self._plans[stream] = wire.schema_plan(defn)
+        return plan
+
+    def get_input_handler(self, stream_id: str) -> _RoutingHandler:
+        self._plan(stream_id)  # validate early
+        return _RoutingHandler(self, stream_id)
+
+    def deliver_frames(self, stream: str, body) -> int:
+        """SXF1 ingress: decode once, split per shard on ORIGINAL values
+        (``ShardRouter.split_columns`` — compacted dictionaries), re-encode
+        each shard's subset as its own frame, dispatch."""
+        plan = self._plan(stream)
+        total = 0
+        for payload in wire.iter_frames(body):
+            ts, cols, n = wire.decode_frame(payload, plan)
+            if n == 0:
+                continue
+            if ts is None:
+                ts = np.full(n, int(time.time() * 1000), dtype=np.int64)
+            self.frames_in += 1
+            with self._state:
+                self.sent_rows += n
+            split = self.router.split_columns(cols, ts, n)
+            for shard, (ts_sub, cols_sub, cnt) in split.items():
+                plain = {k: (wire.materialize_strings(v)
+                             if isinstance(v, tuple) else v)
+                         for k, v in cols_sub.items()}
+                frame = wire.encode_frame(plan, plain, cnt, ts_sub)
+                self._dispatch(shard, stream, frame, cnt)
+            total += n
+        return total
+
+    # ------------------------------------------------------------- dispatch
+
+    def _next_seq(self, shard: int) -> int:
+        with self._state:
+            self._seq[shard] += 1
+            return self._seq[shard]
+
+    def _dispatch(self, shard: int, stream: str, frame: bytes,
+                  rows: int) -> None:
+        with self._shard_locks[shard]:
+            self._dispatch_locked(shard, stream, frame, rows, depth=0)
+
+    def _dispatch_locked(self, shard: int, stream: str, frame: bytes,
+                         rows: int, depth: int) -> None:
+        with self._state:
+            owner = self.shard_owner[shard]
+            epoch = self.shard_epochs[shard]
+            spooling = self._spool_frames[shard] > 0
+            host = self.hosts[owner] if owner is not None else None
+        if owner is None:
+            self._divert(shard, stream, frame, rows)
+            return
+        seq = self._next_seq(shard)
+        if spooling or not host.up:
+            # spool-first: arrival order through the spool is the
+            # ordering contract — a live frame must not overtake one
+            # waiting for replay
+            self._spool(shard, stream, frame, rows, seq)
+            return
+        outcome, dup = self._send(host, shard, epoch, seq, stream, frame)
+        if outcome == "ok":
+            with self._state:
+                self.delivered_rows += rows
+                if dup:
+                    self.deduped_frames += 1
+            return
+        if outcome == "stale" and depth == 0:
+            with self._state:
+                self.stale_epoch_rejections += 1
+            self._refresh_view()
+            with self._state:
+                self.reroutes += 1
+            self._dispatch_locked(shard, stream, frame, rows, depth=1)
+            return
+        # transport failure (or a second stale bounce): spool + let the
+        # detector decide about the host
+        self._note_forward_failure(host)
+        self._spool(shard, stream, frame, rows, seq)
+
+    def _send(self, host: _HostState, shard: int, epoch: int, seq: int,
+              stream: str, frame: bytes) -> tuple[str, bool]:
+        """Bounded exponential-backoff forward of ONE frame.
+        Returns ("ok", duplicate) | ("stale", False) | ("fail", False)."""
+        url = (f"{host.url}/shard-host/frames/{quote(self.name)}/"
+               f"{quote(stream)}?shard={shard}&epoch={epoch}&seq={seq}")
+        delay = self.retry_initial_s
+        for attempt in range(self.max_retries + 1):
+            try:
+                status, body = self._post(
+                    url, frame, ctype="application/x-siddhi-frames")
+            except OSError:
+                with self._state:
+                    self.forward_errors += 1
+                if attempt < self.max_retries:
+                    time.sleep(delay)
+                    delay = min(delay * 2, self.retry_max_s)
+                continue
+            if status == 200:
+                return "ok", bool(body.get("duplicate"))
+            if status == 409:
+                return "stale", False
+            with self._state:
+                self.forward_errors += 1
+            if attempt < self.max_retries:
+                time.sleep(delay)
+                delay = min(delay * 2, self.retry_max_s)
+        return "fail", False
+
+    def _note_forward_failure(self, host: _HostState) -> None:
+        with self._state:
+            host.misses += 1
+            if host.first_miss_t is None:
+                host.first_miss_t = time.monotonic()
+
+    # --------------------------------------------------------------- spool
+
+    def _spool_wal(self, shard: int) -> WriteAheadLog:
+        wal = self._spools.get(shard)
+        if wal is None:
+            wal = self._spools[shard] = WriteAheadLog(
+                os.path.join(self.wal_dir, SPOOL_DIR),
+                shard_app_name(self.name, shard))
+        return wal
+
+    def _load_spools(self) -> None:
+        """Adopt a previous incarnation's pending spool (a router restart
+        must not orphan spooled frames). Adopted frames count as sent AND
+        spooled in THIS incarnation so the conservation identity balances
+        from the first report; new seqs start above the highest spooled
+        one, keeping the worker-side dedupe monotone across restarts."""
+        base = os.path.join(self.wal_dir, SPOOL_DIR)
+        if not os.path.isdir(base):
+            return
+        for shard in range(self.n_shards):
+            d = os.path.join(base, shard_app_name(self.name, shard))
+            if not os.path.isdir(d):
+                continue
+            for kind, _sid, _tss, data in read_records(d):
+                if kind != "frame":
+                    continue
+                seq, _stream, rows, _fb = data
+                self._spool_frames[shard] += 1
+                self._spool_rows[shard] += int(rows)
+                self.sent_rows += int(rows)
+                self.spooled_frames_total += 1
+                self.spooled_rows_total += int(rows)
+                self._seq[shard] = max(self._seq[shard], int(seq))
+
+    def _spool(self, shard: int, stream: str, frame: bytes, rows: int,
+               seq: int) -> None:
+        wal = self._spool_wal(shard)
+        wal.append_record("frame", stream, [],
+                          (int(seq), stream, int(rows), bytes(frame)))
+        with self._state:
+            self._spool_frames[shard] += 1
+            self._spool_rows[shard] += rows
+            self.spooled_frames_total += 1
+            self.spooled_rows_total += rows
+
+    def _replay_spool_locked(self, shard: int,
+                             min_seq: Optional[int] = None) -> bool:
+        """Replay the shard's spool — in order, original seqs, epochs
+        re-stamped to the CURRENT shard epoch — to the current owner.
+        Caller holds the shard's dispatch lock. Frames with seq <=
+        `min_seq` (the adoption's last journaled mark) are already in the
+        adopted journal: counted as replayed without a resend. Returns
+        True when the spool fully drained."""
+        if self._spool_frames[shard] == 0:
+            return True
+        with self._state:
+            owner = self.shard_owner[shard]
+            epoch = self.shard_epochs[shard]
+        if owner is None:
+            return False
+        host = self.hosts[owner]
+        wal = self._spool_wal(shard)
+        recs = [r for r in wal.records() if r[0] == "frame"]
+        sent = 0
+        failed_at: Optional[int] = None
+        for k, (_kind, _sid, _tss, data) in enumerate(recs):
+            seq, stream, rows, fb = data
+            if min_seq is not None and int(seq) <= min_seq:
+                with self._state:
+                    self.replayed_rows += int(rows)
+                    self.deduped_frames += 1
+                sent += 1
+                continue
+            outcome, dup = self._send(host, shard, epoch, int(seq),
+                                      stream, bytes(fb))
+            if outcome == "stale":
+                self._refresh_view()
+                with self._state:
+                    self.stale_epoch_rejections += 1
+                    owner2 = self.shard_owner[shard]
+                    epoch2 = self.shard_epochs[shard]
+                if owner2 is None:
+                    failed_at = k
+                    break
+                host = self.hosts[owner2]
+                epoch = epoch2
+                outcome, dup = self._send(host, shard, epoch, int(seq),
+                                          stream, bytes(fb))
+            if outcome != "ok":
+                failed_at = k
+                break
+            with self._state:
+                self.replayed_rows += int(rows)
+                if dup:
+                    self.deduped_frames += 1
+            sent += 1
+        remainder = recs[sent if failed_at is None else failed_at:]
+        wal.rotate(f"e{epoch}")
+        with self._state:
+            self._spool_frames[shard] = 0
+            self._spool_rows[shard] = 0
+        for _kind, _sid, _tss, data in remainder:
+            seq, stream, rows, fb = data
+            wal.append_record("frame", stream, [],
+                              (int(seq), stream, int(rows), bytes(fb)))
+            with self._state:
+                self._spool_frames[shard] += 1
+                self._spool_rows[shard] += int(rows)
+        if failed_at is not None:
+            self._note_forward_failure(host)
+            return False
+        return True
+
+    # -------------------------------------------------------------- divert
+
+    def _divert(self, shard: int, stream: str, frame: bytes,
+                rows: int) -> None:
+        """No live owner: decode the sub-frame back to rows and park them
+        in the replayable ErrorStore (kind="unowned") — degradation, not
+        loss; `replay_errors` re-routes them once an owner exists."""
+        plan = self._plan(stream)
+        for payload in wire.iter_frames(frame):
+            ts, cols, n = wire.decode_frame(payload, plan)
+            plain = {k: (wire.materialize_strings(v)
+                         if isinstance(v, tuple) else v)
+                     for k, v in cols.items()}
+            names = [p[0] for p in plan]
+            if ts is None:
+                ts = np.full(n, int(time.time() * 1000), dtype=np.int64)
+            events = [(int(ts[r]),
+                       tuple(_py(plain[nm][r]) for nm in names))
+                      for r in range(n)]
+            self.error_store.save(
+                self.name, stream, events,
+                cause=f"no live owner for shard {shard}", kind="unowned")
+        with self._state:
+            self.diverted_rows += rows
+            self.unowned_diverts += 1
+
+    # ----------------------------------------------------------- heartbeat
+
+    def _ping(self, host: _HostState) -> bool:
+        try:
+            status, body = self._get_json(
+                host.url, "/shard-host/ping",
+                timeout=max(0.25, min(self.heartbeat_interval_s * 2, 2.0)))
+        except OSError:
+            return False
+        return status == 200 and bool(body.get("ok"))
+
+    def _hb_loop(self) -> None:
+        while not self._hb_stop.wait(self.heartbeat_interval_s):
+            for k, host in enumerate(self.hosts):
+                try:
+                    self._hb_tick(k, host)
+                except Exception:  # noqa: BLE001 — detector must survive
+                    log.exception("front tier %s: heartbeat tick failed "
+                                  "for %s", self.name, host.url)
+
+    def _hb_tick(self, k: int, host: _HostState) -> None:
+        if self._ping(host):
+            was_dead = host.confirmed_dead
+            with self._state:
+                host.misses = 0
+                host.first_miss_t = None
+                host.up = True
+                host.confirmed_dead = False
+            if was_dead:
+                # a zombie (or a healed partition): fence it to the
+                # committed epochs before it can accept anything stale
+                self._post_json(host.url, "/shard-host/fence", {
+                    "app": self.name,
+                    "shard_epochs": {str(i): e for i, e in
+                                     enumerate(self.shard_epochs)}})
+                log.warning("front tier %s: host %s came back — fenced "
+                            "to committed epochs", self.name, host.url)
+            # recovery replay: spooled frames whose owner is healthy again
+            for shard in range(self.n_shards):
+                if self.shard_owner[shard] == k and \
+                        self._spool_frames[shard] > 0:
+                    with self._shard_locks[shard]:
+                        self._replay_spool_locked(shard)
+            return
+        with self._state:
+            host.misses += 1
+            if host.first_miss_t is None:
+                host.first_miss_t = time.monotonic()
+            newly_dead = (not host.confirmed_dead
+                          and host.misses >= self.miss_threshold)
+            if newly_dead:
+                host.up = False
+                host.confirmed_dead = True
+        if newly_dead:
+            detect_ms = (time.monotonic() - (host.first_miss_t or
+                                             time.monotonic())) * 1e3
+            log.warning("front tier %s: host %s confirmed dead "
+                        "(%d missed heartbeats)", self.name, host.url,
+                        host.misses)
+            # bundle #1: the pre-takeover state (dead owner, spool depth)
+            self.recorder.trigger(
+                "shard_failover",
+                reason=f"host {host.url} confirmed dead after "
+                       f"{host.misses} missed heartbeats")
+            if self.auto_failover:
+                self.failover(k, detect_ms=detect_ms)
+
+    # ------------------------------------------------------------- takeover
+
+    def failover(self, dead_idx: int,
+                 detect_ms: Optional[float] = None) -> dict:
+        """Shard takeover of every shard owned by host `dead_idx`: bump
+        the shards' epochs, COMMIT the meta (the fence point — a zombie
+        deploy/adopt after this instant is refused), have survivors adopt
+        the shards by WAL replay, drain the spool through the adoption's
+        last_seq, and fence every other host. With no survivors the
+        shards become unowned (divert-to-ErrorStore degradation)."""
+        t0 = time.monotonic()
+        with self._state:
+            dead = self.hosts[dead_idx]
+            dead.up = False
+            dead.confirmed_dead = True
+            dead_shards = [i for i, o in enumerate(self.shard_owner)
+                           if o == dead_idx]
+            survivors = [k for k, h in enumerate(self.hosts)
+                         if k != dead_idx and h.up]
+        if not dead_shards:
+            return {"failover": False, "reason": "host owned no shards"}
+        if not survivors:
+            with self._state:
+                for i in dead_shards:
+                    self.shard_owner[i] = None
+                self.epoch += 1
+                for i in dead_shards:
+                    self.shard_epochs[i] += 1
+            self._write_meta()
+            self.failovers_total += 1
+            log.error("front tier %s: host %s died with NO survivors — "
+                      "shards %s unowned; frames divert to the error "
+                      "store", self.name, dead.url, dead_shards)
+            return {"failover": True, "adopted": [],
+                    "unowned": dead_shards}
+        # balance adoptions across survivors by current ownership count
+        with self._state:
+            load = {k: sum(1 for o in self.shard_owner if o == k)
+                    for k in survivors}
+            plan = {}
+            for i in dead_shards:
+                k = min(survivors, key=lambda s: load[s])
+                plan[i] = k
+                load[k] += 1
+            self.epoch += 1
+            for i in dead_shards:
+                self.shard_epochs[i] += 1
+                self.shard_owner[i] = plan[i]
+        # COMMIT — after this rename a zombie is fenced by epoch
+        self._write_meta()
+        # bundle #2: the takeover commit (standard per-kind cooldown may
+        # coalesce it with the detection bundle)
+        self.recorder.trigger(
+            "shard_failover",
+            reason=f"takeover committed: shards {dead_shards} from "
+                   f"{dead.url} at epoch {self.epoch}")
+        adopted, lost = [], []
+        for i in dead_shards:
+            k = plan[i]
+            with self._shard_locks[i]:
+                try:
+                    status, body = self._post_json(
+                        self.hosts[k].url, "/shard-host/adopt", {
+                            "app": self.name, "shard": i,
+                            "epoch": self.shard_epochs[i],
+                            "wal_dir": self.wal_dir,
+                            "capture": self.capture,
+                            "runtime_kwargs": self.runtime_kwargs},
+                        timeout=max(self.request_timeout_s, 60.0))
+                except OSError:
+                    status, body = 0, {}
+                if status != 200:
+                    log.error("front tier %s: adoption of shard %d by %s "
+                              "failed (%s): %s — shard is unowned",
+                              self.name, i, self.hosts[k].url, status,
+                              body)
+                    with self._state:
+                        self.shard_owner[i] = None
+                    lost.append(i)
+                    continue
+                adopted.append(i)
+                last_seq = int(body.get("last_seq", -1))
+                self._replay_spool_locked(
+                    i, min_seq=last_seq if last_seq >= 0 else None)
+        if lost:
+            self._write_meta()  # record the unowned outcome durably
+        # fence everything else (best-effort, incl. the dead host)
+        eps = {str(i): e for i, e in enumerate(self.shard_epochs)}
+        for k, h in enumerate(self.hosts):
+            try:
+                self._post_json(h.url, "/shard-host/fence",
+                                {"app": self.name, "shard_epochs": eps},
+                                timeout=2.0)
+            except OSError:
+                pass
+        with self._state:
+            self.failovers_total += 1
+        takeover_ms = (time.monotonic() - t0) * 1e3
+        timing = {"detect_ms": detect_ms, "takeover_ms": takeover_ms,
+                  "shards": len(dead_shards)}
+        self.failover_timings.append(timing)
+        log.warning("front tier %s: takeover complete — %d shard(s) "
+                    "adopted in %.1f ms (epoch %d)", self.name,
+                    len(adopted), takeover_ms, self.epoch)
+        return {"failover": True, "adopted": adopted, "unowned": lost,
+                "epoch": self.epoch, "timing": timing}
+
+    # ---------------------------------------------------------------- drain
+
+    def drain(self, timeout_s: float = 60.0) -> None:
+        """Replay every drainable spool, then drain every live worker —
+        after this, the conservation identity must balance exactly."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            pending = [i for i in range(self.n_shards)
+                       if self._spool_frames[i] > 0
+                       and self.shard_owner[i] is not None
+                       and self.hosts[self.shard_owner[i]].up]
+            if not pending:
+                break
+            for i in pending:
+                with self._shard_locks[i]:
+                    self._replay_spool_locked(i)
+            time.sleep(0.02)
+        for h in self.hosts:
+            if not h.up:
+                continue
+            try:
+                self._post_json(h.url, "/shard-host/drain",
+                                {"app": self.name},
+                                timeout=max(self.request_timeout_s, 60.0))
+            except OSError:
+                pass
+
+    # ---------------------------------------------------------- reporting
+
+    def conservation_report(self) -> dict:
+        """sent == delivered + spool_replayed + diverted (+ pending)."""
+        with self._state:
+            pending = sum(self._spool_rows)
+            sent = self.sent_rows
+            delivered = self.delivered_rows
+            replayed = self.replayed_rows
+            diverted = self.diverted_rows
+        return {
+            "sent": sent, "delivered": delivered,
+            "spool_replayed": replayed, "diverted": diverted,
+            "spooled_pending": pending,
+            "deduped_frames": self.deduped_frames,
+            "conserved":
+                sent == delivered + replayed + diverted + pending,
+        }
+
+    def _degraded_slots(self) -> tuple[list, list]:
+        """(unowned_slots, dead_owner_slots) by the two-level map."""
+        unowned, dead = [], []
+        with self._state:
+            assignment = self.router.assignment.copy()
+            owner = list(self.shard_owner)
+            up = [h.up for h in self.hosts]
+        for slot in range(len(assignment)):
+            s = int(assignment[slot])
+            o = owner[s]
+            if o is None:
+                unowned.append(slot)
+            elif not up[o]:
+                dead.append(slot)
+        return unowned, dead
+
+    def ready(self) -> tuple[int, dict]:
+        """(http_status, body): 200 only with every shard owned by a live
+        host and no spooled backlog — load balancers drain a degraded tier
+        the same way /ready drains a degraded app. A dead host that owns
+        nothing (post-takeover) does NOT hold readiness hostage: the tier
+        is serving; the loss shows in metrics and the doctor finding."""
+        unowned, dead = self._degraded_slots()
+        with self._state:
+            hosts = {h.url: {"up": h.up,
+                             "confirmed_dead": h.confirmed_dead}
+                     for h in self.hosts}
+            pending = sum(self._spool_frames)
+        ok = not unowned and not dead and pending == 0
+        return (200 if ok else 503), {
+            "ready": ok, "hosts": hosts, "unowned_slots": unowned,
+            "dead_owner_slots": dead, "spooled_frames": pending}
+
+    def statistics_report(self) -> dict:
+        unowned, dead = self._degraded_slots()
+        with self._state:
+            hosts = {}
+            for k, h in enumerate(self.hosts):
+                hosts[h.url] = {
+                    "up": h.up, "misses": h.misses,
+                    "confirmed_dead": h.confirmed_dead,
+                    "shards": [i for i, o in enumerate(self.shard_owner)
+                               if o == k]}
+            spool_per_shard = {
+                f"s{i}": {"frames": self._spool_frames[i],
+                          "rows": self._spool_rows[i]}
+                for i in range(self.n_shards) if self._spool_frames[i]}
+            front = {
+                "n_shards": self.n_shards,
+                "key": self.key,
+                "epoch": self.epoch,
+                "shard_epochs": list(self.shard_epochs),
+                "shard_hosts": [
+                    self.hosts[o].url if o is not None else None
+                    for o in self.shard_owner],
+                "hosts": hosts,
+                "unowned_slots": unowned,
+                "dead_owner_slots": dead,
+                "spool": {"frames": sum(self._spool_frames),
+                          "rows": sum(self._spool_rows),
+                          "per_shard": spool_per_shard},
+                "frames_in": self.frames_in,
+                "failovers_total": self.failovers_total,
+                "stale_epoch_rejections": self.stale_epoch_rejections,
+                "reroutes": self.reroutes,
+                "forward_errors": self.forward_errors,
+                "spooled_frames_total": self.spooled_frames_total,
+                "spooled_rows_total": self.spooled_rows_total,
+                "deduped_frames": self.deduped_frames,
+                "unowned_diverts": self.unowned_diverts,
+            }
+        return {
+            "app": self.name,
+            "front_tier": front,
+            "conservation": self.conservation_report(),
+            "skew": self.router.skew_report(),
+            "recorder": self.recorder.report(),
+        }
+
+    def metrics_text(self) -> str:
+        from ..telemetry import prometheus
+        return prometheus.render_front_tier(self)
+
+    # ---------------------------------------------------------------- HTTP
+
+    def make_server(self, port: int, host: str = "127.0.0.1"):
+        """The tier's own serving surface: the service.py stream-ingestion
+        contract (SXF1 or JSON) plus the probe endpoints, minus the
+        deployment surface (the tier serves exactly one app)."""
+        import hmac
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+        front = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _reply(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _authorized(self) -> bool:
+                if front.token is None:
+                    return True
+                got = self.headers.get("Authorization", "")
+                want = f"Bearer {front.token}"
+                if hmac.compare_digest(got.encode(), want.encode()):
+                    return True
+                self._reply(401, {"error": "missing or bad bearer token"})
+                return False
+
+            def do_GET(self):
+                note_blocking("http.handle")
+                path = self.path.split("?", 1)[0].strip("/")
+                if path == "health":
+                    self._reply(200, {"status": "up", "app": front.name})
+                elif path == "ready":
+                    code, body = front.ready()
+                    self._reply(code, body)
+                elif path == "metrics":
+                    from ..telemetry import prometheus
+                    body = front.metrics_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     prometheus.CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "statistics":
+                    if self._authorized():
+                        self._reply(200, front.statistics_report())
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                note_blocking("http.handle")
+                if not self._authorized():
+                    return
+                path = self.path.split("?", 1)[0].strip("/")
+                parts = path.split("/")
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n)
+                try:
+                    if len(parts) == 4 and parts[0] == "siddhi-apps" \
+                            and parts[2] == "streams":
+                        if parts[1] != front.name:
+                            self._reply(404, {"error": "unknown app"})
+                            return
+                        ctype = (self.headers.get("Content-Type") or "")
+                        if ctype.split(";")[0].strip() == \
+                                "application/x-siddhi-frames":
+                            accepted = front.deliver_frames(parts[3], raw)
+                        else:
+                            data = json.loads(raw.decode())
+                            h = front.get_input_handler(parts[3])
+                            events = data.get("events", [])
+                            h.send_batch([tuple(r) for r in events])
+                            accepted = len(events)
+                        self._reply(200, {"accepted": accepted})
+                    elif parts == ["drain"]:
+                        front.drain()
+                        self._reply(200, front.conservation_report())
+                    else:
+                        self._reply(404, {"error": "not found"})
+                except KeyError as e:
+                    self._reply(404, {"error": f"unknown: {e}"})
+                except (ValueError, SiddhiError) as e:
+                    self._reply(400, {"error": str(e)})
+
+        return ThreadingHTTPServer((host, port), Handler)

@@ -65,6 +65,39 @@ def _n_slots() -> int:
         return DEFAULT_SLOTS
 
 
+def shard_app_name(name: str, i: int) -> str:
+    return f"{name}@s{i}"
+
+
+def shard_app(app: SiddhiApp, i: int) -> SiddhiApp:
+    """The replica app for shard `i`: renamed `<app>@s<i>` (per-shard WAL
+    directory and persistence revisions fall out of the app name) with
+    @app:shards stripped (a replica must never build its own plane or
+    fleet-multiply its own cost report). Module-level so the multi-host
+    worker side (parallel/front_tier.py's ShardHost) builds replicas with
+    the exact same identity a local plane would."""
+    import dataclasses as dc
+
+    from ..query_api.annotation import Annotation, Element
+    anns = [a for a in (app.annotations or ())
+            if a.name.lower() not in ("app:shards", "app:name")]
+    anns.insert(0, Annotation(
+        "app:name", (Element(None, shard_app_name(app.name, i)),)))
+    return dc.replace(app, annotations=anns)
+
+
+def epoch_wal_dir(base: Optional[str], epoch: int) -> Optional[str]:
+    """Epoch 0 journals directly under the user's wal_dir (the PR 7
+    layout, suffixed app names); later epochs live in `e<N>/` so a
+    rebalance or a shard takeover can write the new epoch's journal
+    WITHOUT touching the old epoch's segments until the meta commit
+    point — and so a fenced zombie's late appends land in a directory
+    no adoption will ever read again."""
+    if base is None:
+        return None
+    return base if epoch == 0 else os.path.join(base, f"e{epoch}")
+
+
 class _IngressGate:
     """Pause/resume gate for routed sends: senders pass through
     concurrently (work fans out to per-shard runtimes, each with its own
@@ -248,31 +281,13 @@ class ShardPlane:
     # ------------------------------------------------------------- replicas
 
     def _shard_name(self, i: int) -> str:
-        return f"{self.name}@s{i}"
+        return shard_app_name(self.name, i)
 
     def _shard_app(self, i: int) -> SiddhiApp:
-        """The replica app: renamed `<app>@s<i>` (per-shard WAL directory
-        and persistence revisions fall out of the app name) with
-        @app:shards stripped (a replica must never build its own plane or
-        fleet-multiply its own cost report)."""
-        import dataclasses as dc
-
-        from ..query_api.annotation import Annotation, Element
-        anns = [a for a in (self.app.annotations or ())
-                if a.name.lower() not in ("app:shards", "app:name")]
-        anns.insert(0, Annotation(
-            "app:name", (Element(None, self._shard_name(i)),)))
-        return dc.replace(self.app, annotations=anns)
+        return shard_app(self.app, i)
 
     def _epoch_wal_dir(self, epoch: int) -> Optional[str]:
-        """Epoch 0 journals directly under the user's wal_dir (the PR 7
-        layout, suffixed app names); later epochs live in `e<N>/` so a
-        rebalance can write the re-routed journal WITHOUT touching the old
-        epoch's segments until the meta commit point."""
-        if self.wal_base is None:
-            return None
-        return self.wal_base if epoch == 0 else \
-            os.path.join(self.wal_base, f"e{epoch}")
+        return epoch_wal_dir(self.wal_base, epoch)
 
     def _build_shard(self, i: int, *, epoch: Optional[int] = None,
                      with_wal: bool = True):
@@ -668,7 +683,7 @@ class ShardPlane:
                                 tss, data, key_idx).items():
                             new_shards[shard].get_input_handler(sid) \
                                 .send_batch(srows, timestamps=stss)
-                    else:  # "cols"
+                    elif kind == "cols":
                         ts_arr = np.asarray(tss, dtype=np.int64)
                         for shard, (ts_sub, cols_sub, cnt) in \
                                 new_router.split_columns(
@@ -676,6 +691,8 @@ class ShardPlane:
                             new_shards[shard].get_input_handler(sid) \
                                 .send_columns(cols_sub, timestamps=ts_sub,
                                               count=cnt)
+                    else:  # generic journal marks are not events
+                        continue
                     replayed += len(tss)
             for rt in new_shards:
                 rt.flush()

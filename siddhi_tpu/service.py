@@ -49,6 +49,30 @@ Endpoints (JSON):
   GET    /metrics                     → Prometheus text exposition
                                         (docs/OBSERVABILITY.md)
 
+Shard-host endpoints (parallel/front_tier.py — this service doubles as a
+worker host of the multi-host shard serving tier; docs/SHARDING.md):
+  GET    /shard-host/ping             → liveness for the front tier's
+                                        failure detector (auth-exempt,
+                                        like the other probes)
+  GET    /shard-host/state?app=       → owned shards, epochs, last seqs
+  GET    /shard-host/outputs?app=&shard=  → captured output rows (tests)
+  POST   /shard-host/apps             body = {app, shards, wal_dir,
+                                        shard_epochs, capture,
+                                        runtime_kwargs} → build + start
+                                        shard replicas (epoch fence-checked)
+  POST   /shard-host/adopt            body = {app, shard, epoch, wal_dir,
+                                        capture, runtime_kwargs} → take
+                                        over a dead host's shard by WAL
+                                        replay; returns last_seq
+  POST   /shard-host/fence            body = {app, shard_epochs} → drop
+                                        owned shards behind the committed
+                                        epochs (zombie fencing)
+  POST   /shard-host/drain            body = {app} → flush+drain replicas
+  POST   /shard-host/frames/<app>/<stream>?shard=&epoch=&seq=
+                                      body = raw SXF1 frames → deliver to
+                                        the owned replica; 409 not-owner /
+                                        stale-epoch (the sender re-routes)
+
 Probe note: /health, /ready, and /metrics skip bearer-token auth by design —
 orchestrator probes and scrapers carry no credentials; the bodies expose
 only app names, health states, and metric aggregates, never data or query
@@ -93,6 +117,18 @@ class SiddhiService:
             # capture work out of the box on a fresh service
             from .state.error_store import InMemoryErrorStore
             self.manager.set_error_store(InMemoryErrorStore())
+        self._shard_host = None
+
+    @property
+    def shard_host(self):
+        """Worker-side shard adoption hooks, built on first /shard-host/*
+        request — a service that never joins a front tier pays nothing."""
+        if self._shard_host is None:
+            with self.lock:
+                if self._shard_host is None:
+                    from .parallel.front_tier import ShardHost
+                    self._shard_host = ShardHost(self.manager)
+        return self._shard_host
 
     # ------------------------------------------------------------- operations
 
@@ -399,10 +435,23 @@ class SiddhiService:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                if parts == ["shard-host", "ping"]:
+                    # auth-exempt liveness for the front tier's failure
+                    # detector (same contract as /health)
+                    self._reply(200, service.shard_host.ping())
+                    return
                 if not self._authorized():
                     return
                 try:
-                    if parts == ["siddhi-apps"]:
+                    if parts == ["shard-host", "state"]:
+                        self._reply(200, service.shard_host.state(
+                            query.get("app", "")))
+                    elif parts == ["shard-host", "outputs"]:
+                        shard = query.get("shard")
+                        self._reply(200, service.shard_host.outputs(
+                            query.get("app", ""),
+                            int(shard) if shard is not None else None))
+                    elif parts == ["siddhi-apps"]:
                         self._reply(200, {"apps": service.list_apps()})
                     elif (len(parts) == 3 and parts[0] == "siddhi-apps"
                           and parts[2] == "statistics"):
@@ -423,7 +472,41 @@ class SiddhiService:
                     return
                 parts, query = self._route()
                 try:
-                    if parts == ["siddhi-apps"]:
+                    if (len(parts) == 4 and parts[0] == "shard-host"
+                            and parts[1] == "frames"):
+                        seq = query.get("seq")
+                        code, body = service.shard_host.deliver(
+                            parts[2], parts[3],
+                            shard=int(query.get("shard", 0)),
+                            epoch=int(query.get("epoch", 0)),
+                            seq=int(seq) if seq is not None else None,
+                            body=self._raw_body())
+                        self._reply(code, body)
+                    elif parts == ["shard-host", "apps"]:
+                        data = json.loads(self._body())
+                        self._reply(200, service.shard_host.deploy(
+                            data["app"], data.get("shards", []),
+                            data.get("wal_dir"),
+                            epoch=int(data.get("epoch", 0)),
+                            shard_epochs=data.get("shard_epochs"),
+                            capture=data.get("capture", ()),
+                            runtime_kwargs=data.get("runtime_kwargs")))
+                    elif parts == ["shard-host", "adopt"]:
+                        data = json.loads(self._body())
+                        self._reply(200, service.shard_host.adopt(
+                            data["app"], int(data["shard"]),
+                            int(data["epoch"]), data["wal_dir"],
+                            capture=data.get("capture", ()),
+                            runtime_kwargs=data.get("runtime_kwargs")))
+                    elif parts == ["shard-host", "fence"]:
+                        data = json.loads(self._body())
+                        self._reply(200, service.shard_host.fence(
+                            data["app"], data.get("shard_epochs")))
+                    elif parts == ["shard-host", "drain"]:
+                        data = json.loads(self._body())
+                        self._reply(200, service.shard_host.drain(
+                            data["app"]))
+                    elif parts == ["siddhi-apps"]:
                         name = service.deploy(self._body())
                         self._reply(201, {"app": name})
                     elif parts == ["siddhi-apps", "validate"]:

@@ -27,7 +27,8 @@ class ErrorEntry:
     #: origin of the entry — "error" (processing/@OnError), "sink"
     #: (dead-letter), "breaker" (circuit-breaker divert), "overflow"
     #: (bounded-ingress fault policy), "late" (@app:eventTime rows behind
-    #: the watermark) — so operators replay selectively
+    #: the watermark), "unowned" (front-tier frames whose shard has no
+    #: live owner host) — so operators replay selectively
     kind: str = "error"
 
 

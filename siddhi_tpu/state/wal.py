@@ -21,6 +21,11 @@ revision the segment FOLLOWS ("0" before any persist). Each record is
 with payload one of
     ("rows", stream_id, [ts, ...], [row_tuple, ...])
     ("cols", stream_id, [ts, ...], {attr: numpy_host_array})
+    (other,  stream_id, [ts, ...], data)   — generic records via
+        append_record(): non-event journal marks (e.g. the shard host's
+        per-frame "mark" seq records, the front tier's spooled frames).
+        replay() skips kinds it does not understand, so a journal carrying
+        marks stays replayable by any engine version
 
 A torn tail (crash mid-append) fails the length/CRC check and cleanly ends
 replay at the last whole record; re-opening a torn segment truncates it back
@@ -126,8 +131,12 @@ class WriteAheadLog:
             self._file.write(rec)
             self._file.flush()
             if self.fsync:
+                # front_tier.shard_dispatch: the router spool fsyncs under
+                # the per-shard dispatch lock on purpose — spool order ==
+                # arrival order is the replay-ordering contract
                 note_blocking("wal.fsync",
-                              allow=("wal.journal", "app.controller"))
+                              allow=("wal.journal", "app.controller",
+                                     "front_tier.shard_dispatch"))
                 # fsync under the journal lock IS the durability
                 # contract: append order == disk order
                 os.fsync(self._file.fileno())  # noqa: SL404
@@ -143,6 +152,17 @@ class WriteAheadLog:
         """Journal one columnar batch with its ORIGINAL column values."""
         self._append(("cols", stream_id, [int(t) for t in tss], dict(cols)))
         self.appended_events += len(tss)
+
+    def append_record(self, kind: str, stream_id: str, tss, data) -> None:
+        """Journal one generic (non-event) record — e.g. the shard host's
+        per-frame `"mark"` seq records or the front tier's `"frame"` spool
+        entries. Not counted as events; `replay()` skips kinds other than
+        rows/cols, so marked journals stay replayable everywhere."""
+        if kind in ("rows", "cols"):
+            raise ValueError(
+                "append_record is for generic kinds; use append_rows/"
+                "append_columns for event records")
+        self._append((kind, stream_id, [int(t) for t in tss], data))
 
     # --------------------------------------------------------------- rotate
 
@@ -226,6 +246,8 @@ class WriteAheadLog:
             with open(path, "rb") as f:
                 for payload, _end in self._iter_payloads(f, path):
                     kind, sid, tss, data = pickle.loads(payload)
+                    if kind not in ("rows", "cols"):
+                        continue  # generic marks are not events
                     try:
                         handler = runtime.get_input_handler(sid)
                     except DefinitionNotExistError:
@@ -260,7 +282,8 @@ class WriteAheadLog:
                 self._file.flush()
                 if self.fsync:
                     note_blocking("wal.fsync",
-                                  allow=("wal.journal", "app.controller"))
+                                  allow=("wal.journal", "app.controller",
+                                         "front_tier.shard_dispatch"))
                     os.fsync(self._file.fileno())  # noqa: SL404 — close() drains
                 self._file.close()
                 self._file = None

@@ -408,6 +408,98 @@ def _plane_families(exp: _Exposition, app: str, plane) -> None:
     exp.add("siddhi_shard_imbalance_ratio", (app,), skew["imbalance"])
 
 
+#: families a front-tier router exposes on every scrape, even with zero
+#: traffic and zero hosts (tests/test_shard_failover.py asserts these the
+#: way the CI smoke asserts ALWAYS_ON_FAMILIES against the main service —
+#: deliberately a SEPARATE tuple: the plain service never exports them)
+FRONT_TIER_ALWAYS_ON = (
+    "siddhi_shard_failovers_total",
+    "siddhi_router_spool_depth",
+    "siddhi_router_spooled_frames_total",
+    "siddhi_router_host_up",
+    "siddhi_router_stale_epoch_total",
+)
+
+
+def render_front_tier(front) -> str:
+    """/metrics body for one FrontTier router (parallel/front_tier.py).
+    Lock-light: reads the tier's GIL-atomic counters and the same
+    statistics snapshot the JSON report serves."""
+    exp = _Exposition()
+    stats = front.statistics_report()
+    ft = stats["front_tier"]
+    app = front.name
+
+    exp.declare("siddhi_shard_failovers_total", "counter",
+                "Completed shard takeovers (host death -> adoption commit)",
+                ("app",))
+    exp.add("siddhi_shard_failovers_total", (app,), ft["failovers_total"])
+    exp.declare("siddhi_router_spool_depth", "gauge",
+                "Frames durably spooled and awaiting replay, per shard",
+                ("app", "shard"))
+    for i in range(front.n_shards):
+        exp.add("siddhi_router_spool_depth", (app, f"s{i}"),
+                front._spool_frames[i])
+    exp.declare("siddhi_router_spooled_frames_total", "counter",
+                "Lifetime frames written to the durable router spool",
+                ("app",))
+    exp.add("siddhi_router_spooled_frames_total", (app,),
+            ft["spooled_frames_total"])
+    exp.declare("siddhi_router_host_up", "gauge",
+                "1 while the worker host answers heartbeats", ("app",
+                                                               "host"))
+    for url, h in ft["hosts"].items():
+        exp.add("siddhi_router_host_up", (app, url), 1 if h["up"] else 0)
+    exp.declare("siddhi_router_stale_epoch_total", "counter",
+                "Frames rejected by workers with 409 stale-epoch/not-owner "
+                "(each is recounted and re-routed, never lost)", ("app",))
+    exp.add("siddhi_router_stale_epoch_total", (app,),
+            ft["stale_epoch_rejections"])
+
+    exp.declare("siddhi_router_rows_total", "counter",
+                "Rows through the front tier by outcome (the conservation "
+                "identity: sent == delivered + replayed + diverted + "
+                "pending)", ("app", "outcome"))
+    cons = stats["conservation"]
+    for outcome, key in (("sent", "sent"), ("delivered", "delivered"),
+                         ("replayed", "spool_replayed"),
+                         ("diverted", "diverted")):
+        exp.add("siddhi_router_rows_total", (app, outcome), cons[key])
+    exp.declare("siddhi_router_reroutes_total", "counter",
+                "Frames re-dispatched after a 409 view refresh", ("app",))
+    exp.add("siddhi_router_reroutes_total", (app,), ft["reroutes"])
+    exp.declare("siddhi_router_forward_errors_total", "counter",
+                "Transport-level forward failures (pre-retry)", ("app",))
+    exp.add("siddhi_router_forward_errors_total", (app,),
+            ft["forward_errors"])
+    exp.declare("siddhi_router_deduped_frames_total", "counter",
+                "Spool-replay frames skipped as already journaled "
+                "(lost-ack dedupe)", ("app",))
+    exp.add("siddhi_router_deduped_frames_total", (app,),
+            ft["deduped_frames"])
+    exp.declare("siddhi_router_unowned_slots", "gauge",
+                "Slots whose shard has no live owner (frames divert to "
+                "the error store)", ("app",))
+    exp.add("siddhi_router_unowned_slots", (app,),
+            len(ft["unowned_slots"]))
+    exp.declare("siddhi_shard_epoch", "gauge",
+                "Current shard-assignment epoch (bumps on rebalance)",
+                ("app",))
+    exp.add("siddhi_shard_epoch", (app,), ft["epoch"])
+
+    rec = stats.get("recorder") or {}
+    exp.declare("siddhi_diag_bundles_total", "counter",
+                "Diagnostic bundles written by the flight recorder",
+                ("app",))
+    exp.add("siddhi_diag_bundles_total", (app,),
+            rec.get("bundles_written", 0))
+    exp.declare("siddhi_diag_triggers_total", "counter",
+                "Flight-recorder trigger requests by kind", ("app", "kind"))
+    for kind, n in (rec.get("triggers") or {}).items():
+        exp.add("siddhi_diag_triggers_total", (app, kind), n)
+    return exp.render()
+
+
 def render_manager(manager) -> str:
     """Full /metrics body for every deployed app. Lock-free: iterates a
     point-in-time snapshot of the runtime table."""

@@ -66,7 +66,8 @@ DEAD_LETTER_BURST = 100
 DEAD_LETTER_WINDOW_S = 60.0
 
 TRIGGER_KINDS = ("slo_breach", "breaker_open", "recovery",
-                 "upgrade_rollback", "dead_letter_burst", "manual")
+                 "upgrade_rollback", "dead_letter_burst", "manual",
+                 "shard_failover")
 
 log = logging.getLogger("siddhi_tpu")
 
@@ -244,8 +245,10 @@ class FlightRecorder:
             stats = rt.statistics_report()
         except Exception:  # noqa: BLE001
             stats = {"error": "statistics_report failed"}
-        # traces.json: freeze the rings NOW (they keep rolling after)
-        tele = getattr(rt.ctx, "telemetry", None)
+        # traces.json: freeze the rings NOW (they keep rolling after).
+        # `rt` may be a runtime-shaped duck type without a ctx (the front
+        # tier's shard_failover bundles) — its stats section stands alone
+        tele = getattr(getattr(rt, "ctx", None), "telemetry", None)
         traces = {"recent": [], "slow_batches": []}
         if tele is not None:
             try:

@@ -42,6 +42,11 @@ bounded-ingress/backpressure paths engage).
 Source flapping (`inject_source_flap`) exercises the pause/resume path
 deterministically: every `every`-th payload pauses the source, and after
 `down` more payloads it resumes (buffered payloads re-deliver).
+
+Process-level chaos: `kill_host()` SIGKILLs a worker subprocess mid-traffic
+(the multi-host failover drill's host-kill fault), and `inject_after()`
+consults a plan AFTER the wrapped call returns — the lost-ack shape, where
+the side effect happened but the caller never heard back.
 """
 
 from __future__ import annotations
@@ -131,12 +136,49 @@ def inject(obj, method_name: str, plan: FaultPlan) -> FaultPlan:
     return plan
 
 
+def inject_after(obj, method_name: str, plan: FaultPlan) -> FaultPlan:
+    """Like `inject`, but the plan is consulted AFTER the wrapped call
+    completed — the side effect happened, then the caller sees the fault.
+    This is the lost-ack chaos shape: a front-tier forward whose worker
+    processed the frame but whose response never arrived must be retried
+    AND deduplicated, not double-applied."""
+    orig = getattr(obj, method_name)
+
+    @functools.wraps(orig)
+    def ack_lost(*args, **kwargs):
+        result = orig(*args, **kwargs)
+        plan.check(f"{type(obj).__name__}.{method_name} (post)")
+        return result
+
+    ack_lost.__wrapped_original__ = orig
+    setattr(obj, method_name, ack_lost)
+    return plan
+
+
 def restore(obj, method_name: str) -> None:
     """Remove an injected wrapper (no-op if none present)."""
     fn = getattr(obj, method_name, None)
     orig = getattr(fn, "__wrapped_original__", None)
     if orig is not None:
         setattr(obj, method_name, orig)
+
+
+def kill_host(proc) -> None:
+    """SIGKILL a worker subprocess and reap it — the host-kill fault of the
+    multi-host failover drill (docs/FAULT_TOLERANCE.md). SIGKILL, not
+    terminate(): the dead host must get no chance to flush, close sockets,
+    or say goodbye — the front tier's failure detector has to find out the
+    hard way, and the WAL's torn-tail handling has to absorb whatever was
+    mid-append."""
+    import signal
+    try:
+        proc.send_signal(signal.SIGKILL)
+    except (ProcessLookupError, OSError):
+        pass  # already gone
+    try:
+        proc.wait(timeout=30)
+    except Exception:  # noqa: BLE001 — unreaped zombie; the test will fail
+        pass
 
 
 # --------------------------------------------------------------------------- #

@@ -11,17 +11,10 @@ equals the plain single-process result.
 
 Runs outside the conftest CPU-mesh process on purpose: jax.distributed
 must be initialized before any backend touch, so the workers are fresh
-interpreters configured by env vars.
+interpreters configured by env vars. The subprocess bring-up (ports,
+PYTHONPATH, output capture, teardown, the "MULTIHOST UNSUPPORTED" named
+skip) lives in conftest.WorkerFleet so every multi-process test shares it.
 """
-
-import os
-import socket
-import subprocess
-import sys
-
-import pytest
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 WORKER = r"""
 import os, sys
@@ -165,43 +158,13 @@ else:
 """
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def test_two_process_sharded_aggregation(tmp_path):
-    worker = tmp_path / "worker.py"
-    worker.write_text(WORKER)
-    coordinator = f"127.0.0.1:{_free_port()}"
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)  # the worker sets its own platform
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [
-        subprocess.Popen([sys.executable, str(worker), coordinator, str(i)],
-                         cwd=str(tmp_path), env=env,
-                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                         text=True)
-        for i in range(2)
-    ]
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=420)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("multi-host worker timed out")
-        outs.append(out)
-    if any("MULTIHOST UNSUPPORTED" in out for out in outs):
-        pytest.skip(
-            "jax CPU backend cannot execute cross-process computations "
-            "(XLA INVALID_ARGUMENT: \"Multiprocess computations aren't "
-            "implemented on the CPU backend\") — this capability test "
-            "needs a real multi-host TPU/GPU backend")
+def test_two_process_sharded_aggregation(worker_fleet):
+    coordinator = f"127.0.0.1:{worker_fleet.free_port()}"
+    procs = [worker_fleet.spawn_script(WORKER, [coordinator, i],
+                                       name=f"worker{i}.py")
+             for i in range(2)]
+    outs = worker_fleet.communicate_all(timeout=420)
+    worker_fleet.skip_if_unsupported(outs)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
     assert "MULTIHOST PASS" in outs[0], outs[0][-3000:]
