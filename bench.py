@@ -210,6 +210,11 @@ _DENOMINATORS = {
     # reference's comparable deployment is one JVM per partition group
     # behind an external partitioner, bounded by its single-JVM ring rate
     "sharded_e2e_events_per_sec": 1_000_000.0,
+    # sustained rate under Poisson attach/detach churn: the reference
+    # redeploys the whole app per membership change (stop-the-world), so
+    # its sustained number under churn collapses toward redeploy time;
+    # denominator matches the fanout shape it churns over
+    "churn_sustained_events_per_sec": 100_000.0,
 }
 
 
@@ -1863,6 +1868,231 @@ def bench_fanout() -> dict:
     return res
 
 
+def _churn_query(i: int) -> str:
+    thr = (i * 900.0) / 1024.0
+    return (f"@info(name='cq{i}') from TradeStream[price > {thr:.1f}] "
+            f"select symbol, price insert into ChurnOut{i};")
+
+
+def _churn_app(n_queries: int) -> str:
+    lines = [
+        "@app:name('ChurnBench')",
+        "define stream TradeStream (symbol string, price double, "
+        "volume long);",
+    ]
+    for i in range(n_queries):
+        lines.append(_churn_query(i))
+    return "\n".join(lines)
+
+
+def bench_churn() -> dict:
+    """Churn drill: Poisson attach/detach against a live fused fleet under
+    sustained SXF1 traffic (the multi-tenant churn proof). Queries splice
+    into/out of live SharedStepGroups with ONE retrace — no drain, no
+    stop-the-world redeploy — so the bar is threefold: attach deploy
+    latency p50/p99 (parse → spliced → warmed), the throughput of the
+    block of rounds IMMEDIATELY after each splice vs a settled block at
+    the same membership (churn_splice_throughput_ratio, advisory floor
+    0.9 — no cliff at splice points; pairing at equal membership keeps
+    deliberate fleet growth from masquerading as one), and bit-identical
+    output from a sampled spliced-in query vs a from-scratch
+    single-query build fed identical frames.
+    SIDDHI_STATE_BUDGET is set for the drill so EVERY attach is priced by
+    the per-splice SL501 admission gate (one deliberately oversized attach
+    proves refusal), and the final fleet must sit under the budget.
+    SIDDHI_CHURN_QUERIES scales the drill (default 1000 on accelerators,
+    64 on CPU where each retrace is an XLA:CPU compile)."""
+    from siddhi_tpu import SiddhiManager, compiler
+    from siddhi_tpu.analysis.cost import compute_cost
+    from siddhi_tpu.errors import SiddhiAppCreationError
+    from siddhi_tpu.io import wire
+    from siddhi_tpu.service import SiddhiService
+
+    cpu = _is_cpu()
+    total_q = int(os.environ.get("SIDDHI_CHURN_QUERIES", 0)) or \
+        (64 if cpu else 1000)
+    base_n = max(2, min(64, total_q // 4))
+    bb = int(os.environ.get("SIDDHI_FANOUT_BATCH", 0)) or 128
+    n_keys = 100
+    rng = np.random.default_rng(RNG_SEED + 5)
+    res: dict = {"metric": "churn_sustained_events_per_sec",
+                 "unit": "events/sec", "batch": bb,
+                 "queries_target": total_q, "queries_base": base_n}
+    deadline = time.monotonic() + max(CONFIG_SECONDS - 30.0, 60.0)
+
+    # price the FULL drill fleet once and set the budget with headroom:
+    # admission control runs on every attach without starving the churn
+    budget = int(compute_cost(compiler.parse(_churn_app(total_q)),
+                              batch_size=bb).state_bytes * 1.5) + 1
+    os.environ["SIDDHI_STATE_BUDGET"] = str(budget)
+
+    _phase("churn:build")
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(_churn_app(base_n), batch_size=bb,
+                                       optimize=True)
+    svc = SiddhiService(mgr)
+    rt.start()
+    rt.warmup((bb,))
+    plan = wire.schema_plan(rt.junctions["TradeStream"].definition)
+    bodies = []
+    for _ in range(3):
+        ks = rng.integers(1, n_keys + 1, bb)
+        cols = {
+            "symbol": np.array([f"S{int(k)}" for k in ks], dtype=object),
+            "price": rng.uniform(1.0, 1000.0, bb),
+            "volume": rng.integers(1, 1000, bb),
+        }
+        bodies.append(wire.encode_frames(plan, cols, bb))
+    r = [0]
+
+    def send_round() -> None:
+        svc.send_frames("ChurnBench", "TradeStream",
+                        bodies[r[0] % len(bodies)])
+        r[0] += 1
+
+    # no-churn baseline: median over blocks of the SAME shape the drill
+    # times at each splice point (BLOCK rounds + one drain), so the ratio
+    # compares like with like; the block is wide enough that the one-time
+    # post-attach first-touch (~1 ms of lazy output-path init) reads as
+    # the noise it is, not as a sustained cliff
+    BLOCK = 24
+
+    def block_rate() -> float:
+        t0 = time.perf_counter()
+        for _ in range(BLOCK):
+            send_round()
+        rt.drain()
+        return BLOCK * bb / (time.perf_counter() - t0)
+
+    _phase("churn:baseline")
+    for _ in range(4):
+        send_round()
+    rt.drain()
+    base_rate = float(np.median([block_rate() for _ in range(3)]))
+    _partial({"churn_no_churn_events_per_sec": round(base_rate, 1)})
+
+    # the drill: Poisson-paced attach/detach under continuous traffic
+    _phase("churn:drill")
+    deploy_ms: list = []
+    post_splice_rates: list = []
+    attaches = detaches = refused = 0
+    active = list(range(base_n))
+    next_i = base_n
+    ev_total = 0
+    churn_t0 = time.perf_counter()
+    while next_i < total_q and time.monotonic() < deadline:
+        for _ in range(1 + int(rng.poisson(1.0))):
+            send_round()
+            ev_total += bb
+        rt.drain()
+        if len(active) > base_n and rng.random() < 0.35:
+            victim = active.pop(int(rng.integers(len(active))))
+            mgr.detach_query("ChurnBench", f"cq{victim}")
+            detaches += 1
+        else:
+            try:
+                out = mgr.attach_query("ChurnBench", _churn_query(next_i))
+            except SiddhiAppCreationError:
+                refused += 1
+                next_i += 1
+                continue
+            deploy_ms.append(out["deploy_ms"])
+            attaches += 1
+            active.append(next_i)
+            next_i += 1
+            # no-cliff check AT the splice point: the block of rounds
+            # immediately after the splice vs a settled block right after
+            # it — SAME membership, so fleet growth (more queries = more
+            # work per batch, by design) doesn't masquerade as a cliff
+            at_splice = block_rate()
+            settled = block_rate()
+            post_splice_rates.append(at_splice / max(settled, 1e-9))
+            ev_total += 2 * BLOCK * bb
+        if (attaches + detaches) % 32 == 0 and deploy_ms:
+            _partial({"churn_attaches": attaches,
+                      "churn_detaches": detaches,
+                      "churn_deploy_p99_ms": round(
+                          float(np.percentile(deploy_ms, 99)), 2)})
+    churn_elapsed = time.perf_counter() - churn_t0
+
+    # one deliberately oversized attach: the per-splice SL501 gate must
+    # refuse it (splices never queue) without disturbing the fleet
+    _phase("churn:admission")
+    try:
+        mgr.attach_query(
+            "ChurnBench",
+            "@info(name='cqbig') from TradeStream#window.length(1048576) "
+            "select symbol, sum(price) as t insert into BigOut;")
+        sl501_ok = 0.0
+    except SiddhiAppCreationError:
+        refused += 1
+        sl501_ok = 1.0
+    predicted = int(rt.cost_report.get("predicted_state_bytes", 0))
+    assert predicted <= budget, \
+        f"fleet {predicted} over SIDDHI_STATE_BUDGET {budget}"
+
+    # oracle digest: the most recently spliced-in survivor must match a
+    # from-scratch single-query build bit-for-bit on identical frames
+    _phase("churn:oracle")
+    sample = active[-1]
+    got_live: list = []
+    rt.add_callback(f"ChurnOut{sample}", lambda evs: got_live.extend(
+        tuple(e.data) for e in evs))
+    for _ in range(4):
+        send_round()
+    rt.drain()
+    m2 = SiddhiManager()
+    rt2 = m2.create_siddhi_app_runtime(
+        "@app:name('ChurnBench')\n"
+        "define stream TradeStream (symbol string, price double, "
+        "volume long);\n" + _churn_query(sample),
+        batch_size=bb, optimize=False)
+    got_scratch: list = []
+    rt2.add_callback(f"ChurnOut{sample}", lambda evs: got_scratch.extend(
+        tuple(e.data) for e in evs))
+    rt2.start()
+    svc2 = SiddhiService(m2)
+    for i in range(r[0] - 4, r[0]):
+        svc2.send_frames("ChurnBench", "TradeStream",
+                         bodies[i % len(bodies)])
+    rt2.drain()
+    assert got_live and got_live == got_scratch, \
+        "spliced-in query diverged from its from-scratch build"
+    rt2.shutdown()
+
+    stats = rt.statistics_report()
+    opt = rt.optimizer_report or {}
+    rt.shutdown()
+    os.environ.pop("SIDDHI_STATE_BUDGET", None)
+    ratio = (float(np.median(post_splice_rates))
+             if post_splice_rates else 0.0)
+    res.update({
+        "value": round(ev_total / churn_elapsed, 1),
+        "churn_no_churn_events_per_sec": round(base_rate, 1),
+        "churn_splice_throughput_ratio": round(ratio, 3),
+        "churn_deploy_p50_ms": round(
+            float(np.percentile(deploy_ms, 50)), 2) if deploy_ms else None,
+        "churn_deploy_p99_ms": round(
+            float(np.percentile(deploy_ms, 99)), 2) if deploy_ms else None,
+        "churn_attaches": attaches,
+        "churn_detaches": detaches,
+        "churn_sl501_refused": refused,
+        "churn_sl501_gate_ok": sl501_ok,
+        "churn_oracle_ok": 1.0,
+        "churn_queries_final": len(active),
+        "churn_groups": opt.get("groups", 0),
+        "churn_splices": (stats.get("splices") or {}).get("counts", {}),
+        "churn_state_budget_bytes": budget,
+        "churn_predicted_state_bytes": predicted,
+    })
+    _partial({k: res[k] for k in res if k.startswith("churn_")})
+    res["vs_baseline"] = round(
+        res["value"] / _baseline_for("churn_sustained_events_per_sec"), 3)
+    if not E2E_ONLY:
+        res.update(_preflight(_churn_app(16)))
+    return res
+
+
 def bench_hang() -> dict:
     """HIDDEN config (`python bench.py _hang`): deliberately wedges before
     importing anything heavy AND swallows the in-process alarm — the
@@ -1891,6 +2121,8 @@ CONFIGS = {
     "e2e_ingress": bench_e2e_ingress,  # wire→pipeline→device rate
     "sharded_e2e": bench_sharded_e2e,  # partition-key shard plane: parity,
     # conservation, and same-host scaling at shards {1, 4, 8}
+    "churn": bench_churn,  # Poisson attach/detach splice drill: deploy
+    # latency p50/p99, no-cliff ratio at splice points, SL501 per splice
     "fanout": bench_fanout,  # HEADLINE: keep last — drivers that parse only
     # the final line track the multi-tenant shared-execution rate
 }

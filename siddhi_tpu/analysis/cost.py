@@ -59,7 +59,7 @@ from .plan import ExprTyper, PlanGraph, QueryNode, _frames_for, build_plan
 __all__ = [
     "Budget", "CostReport", "ElementCost", "app_budget", "compute_cost",
     "cost_for_plan", "format_size", "measure_runtime_state_bytes",
-    "parse_size", "superstep_k",
+    "parse_size", "price_splice", "superstep_k",
 ]
 
 _SIZE_RE = re.compile(
@@ -844,6 +844,34 @@ def cost_for_plan(plan: PlanGraph) -> CostReport:
         rep = compute_cost(plan)
         plan._cost_report = rep
     return rep
+
+
+def price_splice(app, query, *, batch_size: int = 0,
+                 group_capacity: int = 0) -> dict:
+    """Incremental re-price for a single-query splice: cost of the app
+    WITH `query` attached minus the app as it stands.  Admission control
+    (SL501) gates each splice on the *delta* plus the post-splice totals,
+    not a whole-app re-admission — a detach therefore frees exactly the
+    bytes this predicted.  Returns::
+
+        {"pre": <CostReport dict>, "post": <CostReport dict>,
+         "delta_state_bytes": int, "delta_compiles": int}
+    """
+    import dataclasses as dc
+    pre = compute_cost(app, batch_size=batch_size,
+                       group_capacity=group_capacity)
+    post_app = dc.replace(
+        app, execution_elements=list(app.execution_elements) + [query])
+    post = compute_cost(post_app, batch_size=batch_size,
+                        group_capacity=group_capacity)
+    return {
+        "pre": pre.to_dict(),
+        "post": post.to_dict(),
+        "post_state_bytes": post.state_bytes,
+        "post_compiles": post.compile_ladder,
+        "delta_state_bytes": post.state_bytes - pre.state_bytes,
+        "delta_compiles": post.compile_ladder - pre.compile_ladder,
+    }
 
 
 # --------------------------------------------------------------------------

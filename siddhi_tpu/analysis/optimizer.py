@@ -49,6 +49,14 @@ DECLINE_TABLE = "`in Table` dependency: table state is a step argument"
 DECLINE_CUSTOM_AGG = ("custom aggregator state (distinctCount pair table) "
                       "needs host-side compaction between steps")
 
+#: splice-specific decline reasons (core/shared.py splice_in): a query can
+#: be fusion-eligible in general yet unspliceable into one concrete group
+SPLICE_DECLINE_NO_GROUP = ("no live fused group on the input stream to "
+                           "splice into")
+SPLICE_DECLINE_SHAPE = ("batch capacity differs from the group's traced "
+                        "shape (would force a full ladder rebuild)")
+SPLICE_DECLINE_CAP = "group already at SIDDHI_OPTIMIZE_GROUP_CAP members"
+
 
 def optimizer_enabled(app: SiddhiApp,
                       override: Optional[bool] = None) -> bool:

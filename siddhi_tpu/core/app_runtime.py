@@ -185,6 +185,19 @@ class SiddhiAppRuntime:
         self.fault_junctions: dict[str, StreamJunction] = {}
         self._started = False
 
+        # multi-tenant quotas (@app:tenant + per-query @tenant): registry
+        # wired BEFORE _build() so build-time assignment enforces queries=
+        # quotas, and onto the context as the always-on device-time meter
+        from .tenant import tenants_from_app
+        self.tenants = tenants_from_app(app)
+        self.ctx.tenant_meter = self.tenants
+        if self.tenants is not None:
+            self.tenants.bind_telemetry(self.ctx.telemetry)
+        #: bumped by every attach/detach (splice churn) — the flusher loop
+        #: and other cached plan-shape decisions recompute when it moves
+        self._plan_epoch = 0
+        self._splice_seq = 0
+
         self._build()
 
         # multi-query shared execution (@app:optimize / SIDDHI_OPTIMIZE /
@@ -327,6 +340,14 @@ class SiddhiAppRuntime:
         from ..query_api.execution import JoinInputStream
         name = query.name or default_name
 
+        if self.tenants is not None:
+            # quota check BEFORE any runtime state exists: an over-quota
+            # tenant's attach raises here with nothing to unwind
+            from .tenant import query_tenant
+            tid = query_tenant(query)
+            if tid is not None:
+                self.tenants.assign(name, tid)
+
         from ..query_api.execution import StateInputStream
         if isinstance(query.input_stream, JoinInputStream):
             qr = self._add_join_query(query, name)
@@ -455,6 +476,302 @@ class SiddhiAppRuntime:
                 table, out, qr.selector.out_types, qr.output_codec,
                 self.ctx.registry, out_frame_aliases=aliases)
 
+    # ------------------------------------------------------ churn (splice)
+    #
+    # attach_query/detach_query are the no-stop-the-world deploy path:
+    # membership changes splice into/out of the live SharedStepGroup
+    # (core/shared.py) with ONE retrace and sibling queries undisturbed —
+    # no drain, no rebuild of anything but the fused jit. Splice-ineligible
+    # queries fall back LOUDLY to standalone dispatch (the pre-splice
+    # behaviour) and the reason lands in optimizer_report["splice_declined"].
+
+    def _all_junctions(self) -> list:
+        js = list(self.junctions.values())
+        js += list(self.fault_junctions.values())
+        js += [w.output_junction for w in self.windows.values()
+               if getattr(w, "output_junction", None) is not None]
+        return js
+
+    def attach_query(self, query, *, name: Optional[str] = None,
+                     state: Optional[bytes] = None) -> dict:
+        """Attach one query to the RUNNING app. `query` is SiddhiQL text
+        (single query) or a parsed Query. `state` optionally seeds the new
+        query's state tensors via the per-element restore primitive
+        (state/persistence.py — same path upgrades migrate state through).
+        Returns {"name", "deploy_ms", "fused", ...}; raises
+        SiddhiAppCreationError (bad query / tenant quota) without touching
+        the live plan."""
+        import time as _time
+        if isinstance(query, str):
+            from .. import compiler
+            query = compiler.parse_query(query)
+        t0 = _time.perf_counter_ns()
+        with self.ctx.controller_lock:
+            qname = query.name or name
+            if qname is None:
+                i = len(self.query_runtimes) + 1
+                while f"query{i}" in self.query_runtimes:
+                    i += 1
+                qname = f"query{i}"
+            if qname in self.query_runtimes:
+                raise SiddhiAppCreationError(
+                    f"query {qname!r} is already attached")
+            # transactional wiring: snapshot receiver lists + junction map
+            # so a failed attach (bad output target, quota...) unwinds to
+            # the exact pre-attach plan
+            recv_snap = [(j, list(j.receivers)) for j in
+                         self._all_junctions()]
+            junc_snap = dict(self.junctions)
+            try:
+                self._add_query(query, qname)
+            except BaseException:
+                self.query_runtimes.pop(qname, None)
+                if self.tenants is not None:
+                    self.tenants.release(qname)
+                self.junctions.clear()
+                self.junctions.update(junc_snap)
+                for j, receivers in recv_snap:
+                    j.receivers[:] = receivers
+                raise
+            qr = self.query_runtimes[qname]
+            self.app.execution_elements.append(query)
+            self._cost_report = None
+            if state is not None:
+                self.restore(state, elements={"queries": {qname}})
+            splice = self._try_splice_in(qr)
+            self._plan_epoch += 1
+        deploy_ms = (_time.perf_counter_ns() - t0) / 1e6
+        return {"name": qname, "deploy_ms": deploy_ms, **splice}
+
+    def detach_query(self, name: str) -> dict:
+        """Detach a query from the RUNNING app: spliced out of its fused
+        group (siblings keep running; the departing step body is DCE'd on
+        the one retrace) or simply unsubscribed when standalone. Raises
+        KeyError for an unknown query."""
+        import time as _time
+        t0 = _time.perf_counter_ns()
+        with self.ctx.controller_lock:
+            qr = self.query_runtimes[name]
+            if getattr(qr, "_fused_group", None) is not None:
+                self._unfuse_query(qr, keep=False)
+            for j in self._all_junctions():
+                j.receivers[:] = [
+                    r for r in j.receivers
+                    if r is not qr and getattr(r, "runtime", None) is not qr]
+            self.query_runtimes.pop(name, None)
+            q = qr.query
+            self.app.execution_elements[:] = [
+                e for e in self.app.execution_elements if e is not q]
+            if self.tenants is not None:
+                self.tenants.release(name)
+            self._cost_report = None
+            self._plan_epoch += 1
+        return {"name": name,
+                "detach_ms": (_time.perf_counter_ns() - t0) / 1e6}
+
+    def _try_splice_in(self, qr) -> dict:
+        """One-retrace splice of a freshly attached (or quota-recovered)
+        standalone receiver into a fused group on its junction: an
+        existing group with room, else a NEW group formed from the
+        trailing run of spliceable standalone receivers. Never raises —
+        failure/decline leaves `qr` standalone (the loud fallback) and
+        returns why."""
+        from ..analysis.optimizer import SPLICE_DECLINE_NO_GROUP
+        from .shared import SharedStepGroup, group_cap, runtime_decline
+        if self.optimizer_report is None:
+            return {"fused": False}  # optimizer off: standalone by design
+        junction = getattr(qr, "input_junction", None)
+        reason = runtime_decline(qr)
+        if reason is None and junction is None:
+            reason = SPLICE_DECLINE_NO_GROUP
+        group = None
+        if reason is None:
+            for g in self.shared_groups:
+                if g.junction is not junction:
+                    continue
+                r = g.splice_decline(qr)
+                if r is None:
+                    group = g
+                    break
+                reason = r
+        if group is not None:
+            try:
+                ms = group.splice_in(qr)
+            except Exception as e:  # noqa: BLE001 — group rolled back
+                self._splice_failed(f"splice_in {qr.name} -> "
+                                    f"{group.name}: {e}")
+                return {"fused": False, "failed": str(e)}
+            junction.receivers[:] = [r for r in junction.receivers
+                                     if r is not qr]
+            self._track_splice("in", ms)
+            self._refresh_optimizer_report()
+            return {"fused": True, "group": group.name, "retrace_ms": ms}
+        # no group with room: try forming a new one from the trailing
+        # contiguous run of spliceable standalones (contiguity preserves
+        # delivery order exactly, like build_shared_groups' run splice)
+        if junction is not None and runtime_decline(qr) is None:
+            run = []
+            for r in reversed(junction.receivers):
+                if (type(r) is QueryRuntime
+                        and runtime_decline(r) is None
+                        and getattr(r, "_fused_group", None) is None
+                        and r._batch_cap == qr._batch_cap
+                        and len(run) < group_cap()):
+                    run.append(r)
+                else:
+                    break
+            run.reverse()
+            if len(run) >= 2:
+                import time as _time
+                self._splice_seq += 1
+                gname = (f"shared:{junction.definition.id}:"
+                         f"live{self._splice_seq}")
+                t0 = _time.perf_counter_ns()
+                try:
+                    g = SharedStepGroup(gname, run, junction)
+                    g.warmup((g._batch_cap,))
+                except Exception as e:  # noqa: BLE001
+                    for m in run:
+                        m._fused_group = None
+                    self._splice_failed(f"form {gname}: {e}")
+                    return {"fused": False, "failed": str(e)}
+                ms = (_time.perf_counter_ns() - t0) / 1e6
+                first = run[0]
+                out = []
+                for r in junction.receivers:
+                    if r is first:
+                        out.append(g)
+                    elif any(r is m for m in run):
+                        continue
+                    else:
+                        out.append(r)
+                junction.receivers[:] = out
+                self.shared_groups.append(g)
+                self._track_splice("in", ms)
+                self._refresh_optimizer_report()
+                return {"fused": True, "group": gname, "retrace_ms": ms}
+            reason = reason or SPLICE_DECLINE_NO_GROUP
+        self._track_splice("declined")
+        rep = self.optimizer_report
+        rep.setdefault("splice_declined", {})[qr.name] = reason
+        return {"fused": False, "declined": reason}
+
+    def _unfuse_query(self, qr, *, keep: bool) -> None:
+        """Take `qr` out of its fused group: splice-out when the group
+        survives (>2 members), else dissolve the pair back to standalone
+        receivers in their original slot. keep=True re-subscribes `qr`
+        standalone (the quota-divert path); keep=False drops it (detach).
+        A failed splice-out falls back LOUDLY to dissolving the whole
+        group — the old full-rebuild path."""
+        group = qr._fused_group
+        junction = group.junction
+        if len(group.members) > 2:
+            try:
+                ms = group.splice_out(qr)
+                self._track_splice("out", ms)
+                if keep:
+                    junction.subscribe(qr)
+                self._refresh_optimizer_report()
+                return
+            except Exception as e:  # noqa: BLE001 — group rolled back
+                self._splice_failed(f"splice_out {qr.name}: {e}")
+        members = group.dissolve()
+        survivors = [m for m in members if m is not qr or keep]
+        out = []
+        for r in junction.receivers:
+            if r is group:
+                out.extend(survivors)
+            else:
+                out.append(r)
+        junction.receivers[:] = out
+        self.shared_groups[:] = [g for g in self.shared_groups
+                                 if g is not group]
+        self._track_splice("out")
+        self._refresh_optimizer_report()
+
+    def _refresh_optimizer_report(self) -> None:
+        rep = self.optimizer_report
+        if rep is None:
+            return
+        rep["groups"] = len(self.shared_groups)
+        rep["queries_fused"] = sum(len(g.members)
+                                   for g in self.shared_groups)
+        rep["group_members"] = {g.name: [m.name for m in g.members]
+                                for g in self.shared_groups}
+
+    def _track_splice(self, kind: str, ms: Optional[float] = None) -> None:
+        self.ctx.statistics.track_splice(kind, ms)
+        tele = self.ctx.telemetry
+        if tele is not None:
+            tele.record_splice(kind, ms)
+
+    def _splice_failed(self, reason: str) -> None:
+        import logging
+        logging.getLogger("siddhi_tpu").warning(
+            "splice failed, falling back to standalone dispatch: %s",
+            reason)
+        self._track_splice("failed")
+        rec = self.ctx.recorder
+        if rec is not None:
+            rec.trigger("splice_failure", reason=reason)
+
+    # -------------------------------------------------- tenant enforcement
+
+    def _enforce_tenant_quotas(self) -> None:
+        """Flush-boundary device-time quota enforcement (NEVER inside
+        junction dispatch — _deliver iterates receivers directly). An
+        over-budget tenant's queries are spliced out of their groups and
+        force-trip quota breakers, so the junction diverts their batches
+        (dead-letter path, replayable) while siblings run untouched. Once
+        the rolling window drains under budget the breakers lift and the
+        queries re-splice automatically."""
+        tenants = self.tenants
+        if tenants is None:
+            return
+        over = set(tenants.over_budget())
+        rec = self.ctx.recorder
+        for tid in tenants.ids():
+            if tid in over:
+                if tenants.note_breach(tid):
+                    self.ctx.statistics.track_tenant_breach(tid)
+                    dom = tenants.dominant_query(tid) or "?"
+                    if rec is not None:
+                        rec.trigger(
+                            "tenant_quota_breach",
+                            reason=f"tenant {tid!r} over device.ms budget "
+                                   f"(dominant query {dom!r})")
+                quota = tenants.quota(tid)
+                from .breaker import CircuitBreaker
+                for qname in tenants.queries_of(tid):
+                    qr = self.query_runtimes.get(qname)
+                    if qr is None:
+                        continue
+                    br = getattr(qr, "breaker", None)
+                    if br is not None and getattr(br, "quota_tenant",
+                                                  None) is None:
+                        continue  # user-declared breaker: never touched
+                    if getattr(qr, "_fused_group", None) is not None:
+                        self._unfuse_query(qr, keep=True)
+                    if br is None:
+                        br = CircuitBreaker(
+                            threshold=1, window_s=quota.window_s,
+                            cooldown_s=quota.window_s, owner=qname)
+                        br.quota_tenant = tid
+                        qr.breaker = br
+                    if br.state != "open":
+                        br.record_failure()  # (re-)trip: divert until lift
+            elif tenants.diverting(tid):
+                tenants.note_recovery(tid)
+                for qname in tenants.queries_of(tid):
+                    qr = self.query_runtimes.get(qname)
+                    if qr is None:
+                        continue
+                    br = getattr(qr, "breaker", None)
+                    if br is not None and getattr(br, "quota_tenant",
+                                                  None) == tid:
+                        qr.breaker = None
+                        self._try_splice_in(qr)
+
     # ---------------------------------------------------------------- control
 
     def start(self, *, connect_sources: bool = True,
@@ -573,13 +890,23 @@ class SiddhiAppRuntime:
         Also drives heartbeats for time-semantic queries in realtime mode
         so absences/time windows fire on wall clock during idle."""
         interval = self.auto_flush_ms / 1000.0
-        needs_hb = any(
-            getattr(qr, "has_time_semantics", False)
-            for qr in self.query_runtimes.values()) or any(
-            w.has_time_semantics for w in self.windows.values())
+
+        def _needs_hb() -> bool:
+            return any(
+                getattr(qr, "has_time_semantics", False)
+                for qr in self.query_runtimes.values()) or any(
+                w.has_time_semantics for w in self.windows.values())
+
+        epoch = self._plan_epoch
+        needs_hb = _needs_hb()
         while not self._flusher_stop.wait(interval / 2):
             if not self._started:
                 return
+            if self._plan_epoch != epoch:
+                # attach/detach changed the plan shape: recompute whether
+                # any live query still needs wall-clock heartbeats
+                epoch = self._plan_epoch
+                needs_hb = _needs_hb()
             try:
                 # async junctions drain via their own feeder threads;
                 # the flusher covers synchronous staging. The whole tick
@@ -854,8 +1181,11 @@ class SiddhiAppRuntime:
             t = now if now is not None else self.ctx.timestamp_generator.current_time()
             for tr in self.triggers.values():
                 tr.poll(t)
-        for j in self.junctions.values():
+        for j in list(self.junctions.values()):
             j.flush(now)
+        # tenant device-time quotas enforce at this boundary — never inside
+        # junction dispatch, where receiver lists must not be mutated
+        self._enforce_tenant_quotas()
 
     def drain(self) -> None:
         """Flush staged rows AND block until every async callback has fired.

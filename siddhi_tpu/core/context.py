@@ -137,6 +137,14 @@ class Statistics:
     #: keyed by stream — tracked regardless of level, like sink_*: a
     #: diverted row is a correctness signal, not a metric
     late_events: dict = field(default_factory=dict)
+    #: one-retrace splice counters (core/shared.py splice_in/splice_out),
+    #: keyed by kind: in | out | declined | failed — tracked regardless of
+    #: level: a failed/declined splice is an operational event. The ms
+    #: figure is the LAST successful splice's retrace+compile wall time.
+    splices: dict = field(default_factory=dict)
+    splice_retrace_ms: float = 0.0
+    #: tenant device-time quota breaches, keyed by tenant id (core/tenant.py)
+    tenant_breaches: dict = field(default_factory=dict)
 
     @property
     def detail(self) -> bool:
@@ -213,6 +221,16 @@ class Statistics:
     def track_breaker_divert(self, query: str, n: int) -> None:
         self.breaker_diverted[query] = self.breaker_diverted.get(query, 0) + n
 
+    def track_splice(self, kind: str, retrace_ms: float = None) -> None:
+        """kind: in | out | declined | failed. retrace_ms records the
+        successful splice's trace+compile wall time (deploy latency)."""
+        self.splices[kind] = self.splices.get(kind, 0) + 1
+        if retrace_ms is not None:
+            self.splice_retrace_ms = float(retrace_ms)
+
+    def track_tenant_breach(self, tenant: str) -> None:
+        self.tenant_breaches[tenant] = self.tenant_breaches.get(tenant, 0) + 1
+
     def track_late(self, stream_id: str, n: int) -> None:
         """Rows diverted to the ErrorStore as kind="late" (event time behind
         the watermark). Exact by construction: every gated row either
@@ -277,6 +295,9 @@ class Statistics:
         self.breaker_failures.clear()
         self.breaker_diverted.clear()
         self.late_events.clear()
+        self.splices.clear()
+        self.splice_retrace_ms = 0.0
+        self.tenant_breaches.clear()
         self.recoveries = 0
         self.wal_replayed = 0
         self.shutdown_discarded = 0
@@ -334,6 +355,14 @@ class Statistics:
                 "runs": self.replay_runs,
                 "events": self.replay_events,
             },
+            # one-retrace membership churn (core/shared.py splice_in/out):
+            # always reported — a failed or declined splice means a deploy
+            # fell back to standalone dispatch, an operational event
+            "splices": {
+                "counts": dict(self.splices),
+                "last_retrace_ms": self.splice_retrace_ms,
+                "tenant_breaches": dict(self.tenant_breaches),
+            },
             # always-on, like overflow: a serialized ingress pipeline is a
             # performance regression operators must see in production.
             # Populated below from the live pipelines (ring depth HWM,
@@ -381,6 +410,11 @@ class Statistics:
                 }
             if breakers:
                 out["breakers"] = breakers
+            tenants = getattr(runtime, "tenants", None)
+            if tenants is not None:
+                # per-tenant quota accounting (core/tenant.py): rolling
+                # device-ms spend vs budget, breach counts, diverted rows
+                out["tenants"] = tenants.report(self)
             tele = getattr(runtime.ctx, "telemetry", None)
             if tele is not None:
                 # always-on (independent of statistics level): the batch
@@ -521,6 +555,11 @@ class SiddhiAppContext:
     #: chunk and runs the query chain as a single lax.scan dispatch
     #: (core/superstep.py). 1 = off; ineligible plans fall back loudly.
     superstep_k: int = 1
+    #: tenant.TenantRegistry when the app declares @app:tenant quotas —
+    #: the ALWAYS-ON device-time meter both dispatch paths feed (unlike
+    #: track_latency it is not gated on statistics detail, because quota
+    #: enforcement reads it)
+    tenant_meter: object = None
 
     @property
     def effective_batch_size(self) -> int:

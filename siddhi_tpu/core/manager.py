@@ -199,10 +199,103 @@ class SiddhiManager:
             + ". Shrink capacities, raise the budget, or set "
             "SIDDHI_BUDGET_MODE=queue to defer (docs/COST.md).")
 
+    def attach_query(self, app_name: str, query, *,
+                     name: Optional[str] = None,
+                     state: Optional[bytes] = None) -> dict:
+        """Attach one query to a RUNNING app (one-retrace splice; see
+        SiddhiAppRuntime.attach_query). The splice is priced incrementally
+        (analysis/cost.py price_splice) and SL501 is enforced PER SPLICE:
+        an over-budget attach raises before any device state exists —
+        splices never queue (there is no deferred half-deployed query).
+        Raises KeyError for an unknown app."""
+        rt = self.runtimes[app_name]
+        if getattr(rt, "is_shard_plane", False):
+            raise SiddhiAppCreationError(
+                f"cannot splice into sharded app {app_name!r}: redeploy "
+                "the plane (docs/SHARDING.md)")
+        if isinstance(query, str):
+            text = (compiler.update_variables(query) if "${" in query
+                    else query)
+            query = compiler.parse_query(text)
+        self._splice_budget_gate(rt, query)
+        return rt.attach_query(query, name=name, state=state)
+
+    def detach_query(self, app_name: str, query_name: str) -> dict:
+        """Detach a query from a RUNNING app (splice-out, siblings keep
+        running), then retry the pending-app queue: the freed budget is
+        visible immediately because the runtime's cost report re-prices
+        against the post-splice plan. Raises KeyError for an unknown app
+        or query."""
+        rt = self.runtimes[app_name]
+        out = rt.detach_query(query_name)
+        admitted = self.admit_pending()
+        if admitted:
+            out["admitted_pending"] = [a.app.name for a in admitted]
+        return out
+
+    def _splice_budget_gate(self, rt, query) -> None:
+        """Per-splice SL501: price the app WITH the query attached (delta
+        + post totals) against the budget, counting the rest of the fleet
+        exactly like _budget_gate. Never queues — an over-budget splice
+        raises. A cost-model crash admits the splice unpriced."""
+        import os
+
+        from ..analysis.cost import app_budget, format_size, price_splice
+
+        if not self._lint_enabled:
+            return
+        budget = app_budget(rt.app)
+        if budget is None:
+            return
+        try:
+            delta = price_splice(rt.app, query,
+                                 batch_size=rt.ctx.batch_size,
+                                 group_capacity=rt.ctx.group_capacity)
+        except Exception:
+            import logging
+            logging.getLogger("siddhi_tpu.lint").debug(
+                "cost model crashed; splice into %r admitted unpriced",
+                rt.app.name, exc_info=True)
+            return
+        over: list[str] = []
+        if budget.state_bytes is not None:
+            demand = delta["post_state_bytes"]
+            fleet = 0
+            if os.environ.get("SIDDHI_STATE_BUDGET", "").strip():
+                for other in self.runtimes.values():
+                    if other is rt:
+                        continue
+                    try:
+                        fleet += int(other.cost_report.get(
+                            "predicted_state_bytes", 0))
+                    except Exception:
+                        pass
+            if demand + fleet > budget.state_bytes:
+                over.append(
+                    f"post-splice device state {format_size(demand)} "
+                    f"(splice adds "
+                    f"{format_size(max(delta['delta_state_bytes'], 0))}) "
+                    f"exceeds the budget {format_size(budget.state_bytes)} "
+                    f"({budget.source})")
+        if (budget.compiles is not None
+                and delta["post_compiles"] > budget.compiles):
+            over.append(
+                f"post-splice compile ladder {delta['post_compiles']} "
+                f"exceeds the compile budget {budget.compiles} "
+                f"({budget.source})")
+        if over:
+            raise SiddhiAppCreationError(
+                f"SL501: splice into app {rt.app.name!r} refused by "
+                "admission control: " + "; ".join(over)
+                + ". Detach queries or raise the budget (docs/COST.md).")
+
     def admit_pending(self) -> list[SiddhiAppRuntime]:
         """Retry every queued app FIFO (after budget headroom freed up —
-        e.g. a runtime shut down or the budget was raised). Apps that still
-        exceed the budget stay queued; admitted ones are returned."""
+        e.g. a runtime shut down, a query was DETACHED (the fleet sum
+        re-prices against each runtime's post-splice plan), or the budget
+        was raised). Apps that still exceed the budget stay queued;
+        admitted ones are returned. detach_query() calls this
+        automatically."""
         admitted: list[SiddhiAppRuntime] = []
         still_pending: list[tuple[SiddhiApp, dict]] = []
         pending, self.pending_apps = self.pending_apps, []

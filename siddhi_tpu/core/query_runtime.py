@@ -616,6 +616,9 @@ class QueryRuntime(Receiver):
         self._distribute(out, now)
         elapsed = time.perf_counter_ns() - t0
         self.ctx.statistics.track_latency(self.name, elapsed)
+        meter = getattr(self.ctx, "tenant_meter", None)
+        if meter is not None:
+            meter.record(self.name, elapsed)
         tele = getattr(self.ctx, "telemetry", None)
         if tele is not None:
             if tele.on:

@@ -53,6 +53,8 @@ from ..analysis.optimizer import (
     DECLINE_OBJECT,
     DECLINE_PARTITION,
     DECLINE_TABLE,
+    SPLICE_DECLINE_CAP,
+    SPLICE_DECLINE_SHAPE,
     analyze_sharing,
 )
 
@@ -217,6 +219,10 @@ class SharedStepGroup(Receiver):
         elapsed = time.perf_counter_ns() - t0
         share = elapsed // len(self.members)
         stats = self.ctx.statistics
+        meter = getattr(self.ctx, "tenant_meter", None)
+        if meter is not None:
+            # equal-share attribution, same split as stats/telemetry below
+            meter.record_block(self._member_names, share)
         tele = getattr(self.ctx, "telemetry", None)
         outs_it = iter(outs)
         stats_on = stats.detail
@@ -265,6 +271,130 @@ class SharedStepGroup(Receiver):
             batch = EventBatch.empty(self.junction.definition, cap)
             aot_warm(self._step, states, batch, now)
         return self.ctx.statistics.compiles.get(self.name, 0) - n0
+
+    # -------------------------------------------------------------- splice
+    #
+    # One-retrace membership change: the dark-sink re-light mechanism
+    # above (emit-flag flip -> _make_jit once) generalized to the member
+    # list itself. `_make_jit` reads `self._steps` when BUILDING the jit,
+    # so every splice REBINDS members/_steps/_member_names to fresh lists
+    # — the pre-splice jit keeps closing over the old list object and
+    # stays valid, which is what makes rollback a pure attribute restore.
+    # Sibling state tensors need no migration: states are assembled from
+    # `m.state` per dispatch and written back per member, so the unfused
+    # layout IS the fused layout (same property snapshots/upgrades rely
+    # on). The retrace covers exactly one compile; departing members are
+    # dead-code-eliminated the same way dark sinks are.
+
+    def splice_decline(self, qr) -> Optional[str]:
+        """Why `qr` cannot splice into THIS group (None = spliceable).
+        Extends runtime_decline with the group-shape facts."""
+        reason = runtime_decline(qr)
+        if reason is not None:
+            return reason
+        if qr._batch_cap != self._batch_cap:
+            return SPLICE_DECLINE_SHAPE
+        if len(self.members) >= group_cap():
+            return SPLICE_DECLINE_CAP
+        return None
+
+    def splice_in(self, qr: QueryRuntime) -> float:
+        """Trace `qr` into the group: siblings' step bodies unchanged,
+        their state tensors carried over untouched, ONE retrace eagerly
+        compiled before return (deploy pays the compile, not traffic).
+        Transactional — any failure restores the exact pre-splice
+        bindings and re-raises. Returns wall milliseconds spent."""
+        snap = (self.members, self._steps, self._member_names,
+                self._emit_flags, self._step, self._bucket_ok,
+                self.has_time_semantics, self._tele_cells)
+        t0 = time.perf_counter_ns()
+        try:
+            _apply_pushdown(qr)
+            self.members = self.members + [qr]
+            self._steps = self._steps + [
+                qr._make_step(track_compiles=False)]
+            self._member_names = self._member_names + [qr.name]
+            self._bucket_ok = self._bucket_ok and qr._bucket_ok
+            self.has_time_semantics = (self.has_time_semantics
+                                       or qr.has_time_semantics)
+            self._emit_flags = self._current_emit_flags()
+            self._step = self._splice_commit(self._emit_flags)
+            self._tele_cells = None
+            qr._fused_group = self
+        except BaseException:
+            (self.members, self._steps, self._member_names,
+             self._emit_flags, self._step, self._bucket_ok,
+             self.has_time_semantics, self._tele_cells) = snap
+            qr._fused_group = None
+            raise
+        return (time.perf_counter_ns() - t0) / 1e6
+
+    def splice_out(self, qr: QueryRuntime) -> float:
+        """Remove `qr` from the group with siblings undisturbed: the
+        departing member's step body drops out of the fused return value
+        and XLA DCEs it on the (single) retrace. The caller dissolves
+        instead when membership would fall below 2. Returns wall ms."""
+        idx = self.members.index(qr)
+        assert len(self.members) > 2, "dissolve() below 2 members"
+        snap = (self.members, self._steps, self._member_names,
+                self._emit_flags, self._step, self._bucket_ok,
+                self.has_time_semantics, self._tele_cells)
+        t0 = time.perf_counter_ns()
+        try:
+            self.members = self.members[:idx] + self.members[idx + 1:]
+            self._steps = self._steps[:idx] + self._steps[idx + 1:]
+            self._member_names = (self._member_names[:idx]
+                                  + self._member_names[idx + 1:])
+            self._bucket_ok = all(m._bucket_ok for m in self.members)
+            self.has_time_semantics = any(m.has_time_semantics
+                                          for m in self.members)
+            self._emit_flags = self._current_emit_flags()
+            self._step = self._splice_commit(self._emit_flags)
+            self._tele_cells = None
+            qr._fused_group = None
+        except BaseException:
+            (self.members, self._steps, self._member_names,
+             self._emit_flags, self._step, self._bucket_ok,
+             self.has_time_semantics, self._tele_cells) = snap
+            qr._fused_group = self
+            raise
+        return (time.perf_counter_ns() - t0) / 1e6
+
+    def dissolve(self) -> list:
+        """Unfuse every member (group shrank below 2, or a full rebuild
+        was requested). Members keep their own steps/state — the caller
+        re-inserts them into the junction's receiver slot in order."""
+        members = list(self.members)
+        for m in members:
+            m._fused_group = None
+        return members
+
+    def _splice_commit(self, emit_flags: tuple):
+        """Build the post-splice jit and eagerly compile it at the group's
+        traced capacity, so the one retrace lands inside deploy latency
+        instead of stalling the next traffic batch. (Smaller warmed
+        buckets of the pre-splice jit recompile lazily if the group is
+        bucket-eligible — full-capacity traffic never stalls.)
+
+        The warm is an actual EXECUTION on an empty batch, not just
+        lower().compile(): on this jax line the AOT executable is not
+        shared with the normal dispatch cache, so a lower-only warm still
+        leaves the first traffic batch paying the backend compile (~100s
+        of ms — the exact cliff the splice exists to avoid). The step is
+        pure and the batch empty, so the run has no observable effect;
+        states are deep-copied first because donate_argnums=(0,) would
+        otherwise invalidate the live member state buffers.
+
+        A separate method so fault injection (util.faults.inject) can
+        fail a splice mid-flight; splice_in/splice_out roll back to the
+        pre-splice bindings on any exception raised here."""
+        step = self._make_jit(emit_flags)
+        now = jnp.int64(self.ctx.timestamp_generator.current_time())
+        states = jax.tree_util.tree_map(
+            jnp.array, tuple(m.state for m in self.members))
+        batch = EventBatch.empty(self.junction.definition, self._batch_cap)
+        jax.block_until_ready(step(states, batch, now))
+        return step
 
 
 # ---------------------------------------------------------------- formation

@@ -1,0 +1,291 @@
+"""Per-tenant quotas and device-time metering for multi-tenant apps.
+
+An app declares tenants with app-level annotations::
+
+    @app:tenant(id='acme', device.ms='40', queries='8', window='60')
+
+and maps each query to one with a query-level ``@tenant('acme')``. Two
+budgets exist:
+
+- ``queries``  — hard ceiling on concurrently attached queries. Checked
+  synchronously at build/attach time (SiddhiAppCreationError), so an
+  over-quota attach_query never allocates device state.
+- ``device.ms`` — rolling-window budget of *metered device time* (the
+  per-query latency attribution every dispatch path already computes;
+  fused groups report an equal share per member). Enforced asynchronously
+  by the runtime's flush/heartbeat boundary: every query of an over-budget
+  tenant is spliced OUT of its fused group (siblings untouched) and given
+  a force-tripped quota CircuitBreaker, so the junction diverts its
+  batches to the dead-letter path until the window drains. Recovery is
+  automatic — once the tenant is back under budget the quota breakers are
+  removed and the queries re-splice.
+
+Blast radius is therefore per tenant: a noisy tenant's queries are the
+only receivers diverted, and because splice-out is a one-retrace
+operation the siblings never stop.
+
+Like CircuitBreaker, the registry has NO internal locking: recording and
+enforcement both run under the app's controller discipline (delivery and
+flush hold ctx.controller_lock).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..errors import SiddhiAppCreationError
+
+__all__ = ["TenantQuota", "TenantRegistry", "tenants_from_app"]
+
+
+@dataclass(frozen=True)
+class TenantQuota:
+    """One tenant's declared budgets (None = unlimited)."""
+
+    id: str
+    max_queries: Optional[int] = None
+    device_ms: Optional[float] = None
+    window_s: float = 60.0
+
+
+@dataclass
+class _TenantLedger:
+    """Rolling-window device-time entries: (monotonic_s, ns, query)."""
+
+    quota: TenantQuota
+    entries: deque = field(default_factory=deque)
+    total_ns: int = 0  # sum over entries (kept incrementally)
+    breaches: int = 0
+    diverting: bool = False  # quota breakers currently attached
+
+
+class TenantRegistry:
+    """Query→tenant ownership plus rolling device-time accounting."""
+
+    def __init__(self, quotas: dict[str, TenantQuota],
+                 clock=time.monotonic) -> None:
+        self._clock = clock
+        self._ledgers: dict[str, _TenantLedger] = {
+            tid: _TenantLedger(q) for tid, q in quotas.items()}
+        self._owner: dict[str, str] = {}  # query name -> tenant id
+        self._tele = None
+        self._ms_cells: dict[str, object] = {}
+        self._q_cells: dict[str, object] = {}
+
+    # ------------------------------------------------------------ ownership
+
+    def ids(self) -> list[str]:
+        return list(self._ledgers)
+
+    def quota(self, tid: str) -> TenantQuota:
+        return self._ledgers[tid].quota
+
+    def tenant_of(self, query: str) -> Optional[str]:
+        return self._owner.get(query)
+
+    def queries_of(self, tid: str) -> list[str]:
+        return [q for q, t in self._owner.items() if t == tid]
+
+    def query_count(self, tid: str) -> int:
+        return sum(1 for t in self._owner.values() if t == tid)
+
+    def assign(self, query: str, tid: str) -> None:
+        """Bind `query` to tenant `tid`; raises SiddhiAppCreationError on
+        an unknown tenant or a full `queries=` quota — the caller must
+        check BEFORE allocating runtime state."""
+        led = self._ledgers.get(tid)
+        if led is None:
+            raise SiddhiAppCreationError(
+                f"query {query!r} names undeclared tenant {tid!r} "
+                f"(declare @app:tenant(id='{tid}', ...))")
+        q = led.quota
+        if (q.max_queries is not None
+                and self.query_count(tid) >= q.max_queries):
+            raise SiddhiAppCreationError(
+                f"SL502: tenant {tid!r} at query quota "
+                f"({q.max_queries}): cannot attach {query!r}")
+        self._owner[query] = tid
+        self._set_query_gauge(tid)
+
+    def release(self, query: str) -> None:
+        tid = self._owner.pop(query, None)
+        if tid is not None:
+            self._set_query_gauge(tid)
+
+    # ------------------------------------------------------------- metering
+
+    def record(self, query: str, elapsed_ns: int) -> None:
+        """Attribute one dispatch's wall time to the owning tenant (no-op
+        for unowned queries). Always on — NOT gated on statistics detail
+        or telemetry enablement, because quota enforcement reads it."""
+        tid = self._owner.get(query)
+        if tid is None:
+            return
+        led = self._ledgers[tid]
+        led.entries.append((self._clock(), int(elapsed_ns), query))
+        led.total_ns += int(elapsed_ns)
+        cell = self._ms_cells.get(tid)
+        if cell is not None:
+            cell.inc(elapsed_ns / 1e6)
+
+    def record_block(self, queries, share_ns: int) -> None:
+        """Fused-group attribution: an equal share per member (the same
+        split SharedStepGroup reports to statistics/telemetry)."""
+        for q in queries:
+            self.record(q, share_ns)
+
+    def _prune(self, led: _TenantLedger, now_s: float) -> None:
+        horizon = now_s - led.quota.window_s
+        ent = led.entries
+        while ent and ent[0][0] < horizon:
+            led.total_ns -= ent.popleft()[1]
+
+    def spent_ms(self, tid: str) -> float:
+        """Device ms attributed to `tid` within its rolling window."""
+        led = self._ledgers[tid]
+        self._prune(led, self._clock())
+        return led.total_ns / 1e6
+
+    def over_budget(self) -> list[str]:
+        """Tenants currently past their device.ms window budget."""
+        out = []
+        for tid, led in self._ledgers.items():
+            if led.quota.device_ms is None:
+                continue
+            if self.spent_ms(tid) > led.quota.device_ms:
+                out.append(tid)
+        return out
+
+    def dominant_query(self, tid: str) -> Optional[str]:
+        """The query consuming the most device time in the window — the
+        doctor names it in tenant_quota_breach findings."""
+        led = self._ledgers[tid]
+        self._prune(led, self._clock())
+        by_q: dict[str, int] = {}
+        for _, ns, q in led.entries:
+            by_q[q] = by_q.get(q, 0) + ns
+        if not by_q:
+            return None
+        return max(by_q, key=by_q.get)
+
+    # ---------------------------------------------------------- enforcement
+
+    def note_breach(self, tid: str) -> bool:
+        """Mark `tid` breached; True when this is a NEW breach (tenant was
+        not already diverting) — the edge the FlightRecorder triggers on."""
+        led = self._ledgers[tid]
+        fresh = not led.diverting
+        if fresh:
+            led.breaches += 1
+        led.diverting = True
+        return fresh
+
+    def note_recovery(self, tid: str) -> None:
+        self._ledgers[tid].diverting = False
+
+    def diverting(self, tid: str) -> bool:
+        return self._ledgers[tid].diverting
+
+    # ------------------------------------------------------------ reporting
+
+    def bind_telemetry(self, tele) -> None:
+        """Cache per-tenant Prometheus cells (always-on families declared
+        by AppTelemetry): device-ms counter + query-count gauge."""
+        self._tele = tele
+        reg = tele.registry
+        ms_fam = reg.counter("siddhi_tenant_device_ms_total",
+                             "Metered device milliseconds per tenant",
+                             ("tenant",))
+        q_fam = reg.gauge("siddhi_tenant_queries",
+                          "Attached queries per tenant", ("tenant",))
+        for tid in self._ledgers:
+            self._ms_cells[tid] = ms_fam.labels(tid)
+            self._q_cells[tid] = q_fam.labels(tid)
+            self._set_query_gauge(tid)
+
+    def _set_query_gauge(self, tid: str) -> None:
+        cell = self._q_cells.get(tid)
+        if cell is not None:
+            cell.set(self.query_count(tid))
+
+    def report(self, stats=None) -> dict:
+        """statistics_report()['tenants'] section."""
+        out = {}
+        for tid, led in self._ledgers.items():
+            q = led.quota
+            queries = self.queries_of(tid)
+            entry = {
+                "queries": sorted(queries),
+                "query_count": len(queries),
+                "max_queries": q.max_queries,
+                "device_ms_window": round(self.spent_ms(tid), 3),
+                "device_ms_budget": q.device_ms,
+                "window_s": q.window_s,
+                "breaches": led.breaches,
+                "diverting": led.diverting,
+            }
+            if stats is not None:
+                entry["diverted_rows"] = sum(
+                    stats.breaker_diverted.get(name, 0)
+                    for name in queries)
+            dom = self.dominant_query(tid)
+            if dom is not None:
+                entry["dominant_query"] = dom
+            out[tid] = entry
+        return out
+
+
+# ------------------------------------------------------------------ parsing
+
+
+def _parse_float(ann, key: str, tid: str) -> Optional[float]:
+    raw = ann.element(key)
+    if raw is None:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        raise SiddhiAppCreationError(
+            f"@app:tenant(id={tid!r}): {key}={raw!r} is not a number")
+
+
+def tenants_from_app(app, clock=time.monotonic) -> Optional[TenantRegistry]:
+    """Build the registry from `@app:tenant(...)` annotations (None when
+    the app declares no tenants). Query ownership comes from query-level
+    `@tenant('id')` annotations and is validated against `queries=`
+    quotas here, before any runtime state exists."""
+    quotas: dict[str, TenantQuota] = {}
+    for ann in app.annotations:
+        if ann.name.lower() != "app:tenant":
+            continue
+        tid = ann.element("id") or ann.element()
+        if not tid:
+            raise SiddhiAppCreationError(
+                "@app:tenant requires id= (or a bare tenant id)")
+        if tid in quotas:
+            raise SiddhiAppCreationError(
+                f"duplicate @app:tenant(id={tid!r})")
+        mq = ann.element("queries")
+        try:
+            max_queries = int(mq) if mq is not None else None
+        except ValueError:
+            raise SiddhiAppCreationError(
+                f"@app:tenant(id={tid!r}): queries={mq!r} is not an int")
+        quotas[tid] = TenantQuota(
+            id=tid, max_queries=max_queries,
+            device_ms=_parse_float(ann, "device.ms", tid),
+            window_s=_parse_float(ann, "window", tid) or 60.0)
+    if not quotas:
+        return None
+    return TenantRegistry(quotas, clock=clock)
+
+
+def query_tenant(query) -> Optional[str]:
+    """The tenant id a query claims via `@tenant('id')` (None = unowned)."""
+    for ann in getattr(query, "annotations", ()) or ():
+        if ann.name.lower() == "tenant":
+            return ann.element("id") or ann.element()
+    return None

@@ -275,6 +275,28 @@ def analyze(bundle: dict, baseline: Optional[dict] = None,
                 f"{br['state']}",
                 f"{br.get('failures', 0)} failure(s), "
                 f"{br.get('diverted_rows', 0)} row(s) diverted"))
+    for tid, t in sorted((stats.get("tenants") or {}).items()):
+        if t.get("diverting"):
+            dom = t.get("dominant_query")
+            findings.append(_finding(
+                "warning", f"tenant {tid!r} is over its device-time quota",
+                f"{t.get('device_ms_window', 0):.1f} ms spent of "
+                f"{t.get('device_ms_budget')} ms budget in the last "
+                f"{t.get('window_s', 0):.0f} s"
+                + (f"; dominant query {dom!r}" if dom else "")
+                + f"; {t.get('diverted_rows', 0)} row(s) diverted "
+                "(replayable) — siblings unaffected"))
+        elif t.get("breaches"):
+            findings.append(_finding(
+                "info", f"tenant {tid!r} breached its quota earlier",
+                f"{t['breaches']} breach(es); now under budget"))
+    splices = (stats.get("splices") or {}).get("counts") or {}
+    if splices.get("failed"):
+        findings.append(_finding(
+            "warning", "query splices failed (fell back to standalone "
+            "dispatch)",
+            f"{splices['failed']} failure(s) — see the flight recorder's "
+            "splice_failure bundle(s); affected queries run unfused"))
     dead = stats.get("sink_dead_letters") or {}
     if sum(dead.values()):
         findings.append(_finding(

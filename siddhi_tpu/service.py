@@ -192,6 +192,20 @@ class SiddhiService:
             rt = self.manager.runtimes[app]
             return [list(e.data) for e in rt.query(text)]
 
+    def attach_query(self, app: str, query_text: str,
+                     name: str | None = None) -> dict:
+        """Splice one query into a RUNNING app (manager.attach_query:
+        per-splice SL501 admission + one-retrace splice, siblings
+        undisturbed). Returns the deploy summary incl. deploy_ms."""
+        with self.lock:
+            return self.manager.attach_query(app, query_text, name=name)
+
+    def detach_query(self, app: str, query_name: str) -> dict:
+        """Splice one query out of a RUNNING app; frees its budget and
+        retries the pending-app queue (manager.detach_query)."""
+        with self.lock:
+            return self.manager.detach_query(app, query_name)
+
     def statistics(self, app: str) -> dict:
         with self.lock:
             return self.manager.runtimes[app].statistics_report()
@@ -530,6 +544,21 @@ class SiddhiService:
                         rows = service.query(parts[1], data["query"])
                         self._reply(200, {"records": rows})
                     elif (len(parts) == 3 and parts[0] == "siddhi-apps"
+                          and parts[2] == "queries"):
+                        # attach: JSON {"query": ..., "name": ...} or a
+                        # raw SiddhiQL query body
+                        body = self._body()
+                        ctype = (self.headers.get("Content-Type") or "")
+                        if ctype.split(";")[0].strip() == \
+                                "application/json":
+                            data = json.loads(body)
+                            out = service.attach_query(
+                                parts[1], data["query"],
+                                name=data.get("name"))
+                        else:
+                            out = service.attach_query(parts[1], body)
+                        self._reply(201, out)
+                    elif (len(parts) == 3 and parts[0] == "siddhi-apps"
                           and parts[2] == "persist"):
                         self._reply(200,
                                     {"revision": service.persist(parts[1])})
@@ -582,12 +611,21 @@ class SiddhiService:
                 if not self._authorized():
                     return
                 parts, _query = self._route()
-                if len(parts) == 2 and parts[0] == "siddhi-apps":
-                    ok = service.undeploy(parts[1])
-                    self._reply(200 if ok else 404,
-                                {"undeployed": ok})
-                else:
-                    self._reply(404, {"error": "not found"})
+                try:
+                    if len(parts) == 2 and parts[0] == "siddhi-apps":
+                        ok = service.undeploy(parts[1])
+                        self._reply(200 if ok else 404,
+                                    {"undeployed": ok})
+                    elif (len(parts) == 4 and parts[0] == "siddhi-apps"
+                          and parts[2] == "queries"):
+                        self._reply(200, service.detach_query(
+                            parts[1], parts[3]))
+                    else:
+                        self._reply(404, {"error": "not found"})
+                except KeyError as e:
+                    self._reply(404, {"error": f"unknown: {e}"})
+                except SiddhiError as e:
+                    self._reply(400, {"error": str(e)})
 
         return ThreadingHTTPServer((host, port), Handler)
 

@@ -46,6 +46,10 @@ ALWAYS_ON_FAMILIES = (
     "siddhi_slo_breaches_total",
     "siddhi_cost_predicted_state_bytes",
     "siddhi_cost_compile_ladder",
+    "siddhi_tenant_device_ms_total",
+    "siddhi_tenant_queries",
+    "siddhi_splices_total",
+    "siddhi_splice_retrace_ms",
 )
 
 

@@ -67,7 +67,7 @@ DEAD_LETTER_WINDOW_S = 60.0
 
 TRIGGER_KINDS = ("slo_breach", "breaker_open", "recovery",
                  "upgrade_rollback", "dead_letter_burst", "manual",
-                 "shard_failover")
+                 "shard_failover", "splice_failure", "tenant_quota_breach")
 
 log = logging.getLogger("siddhi_tpu")
 

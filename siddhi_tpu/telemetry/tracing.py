@@ -151,6 +151,20 @@ class AppTelemetry:
             "siddhi_late_events_total",
             "Rows older than the event-time watermark diverted to the "
             "ErrorStore (kind=\"late\") per stream", ("stream",))
+        self.tenant_ms = r.counter(
+            "siddhi_tenant_device_ms_total",
+            "Metered device milliseconds per tenant (equal-share "
+            "attribution inside fused groups)", ("tenant",))
+        self.tenant_queries = r.gauge(
+            "siddhi_tenant_queries",
+            "Attached queries per tenant", ("tenant",))
+        self.splices = r.counter(
+            "siddhi_splices_total",
+            "One-retrace query splices by kind (in|out|declined|failed)",
+            ("kind",))
+        self.splice_ms = r.gauge(
+            "siddhi_splice_retrace_ms",
+            "Last successful splice's retrace+compile wall milliseconds")
         # tracer state
         self._ids = itertools.count(1)
         self._tls = threading.local()
@@ -269,6 +283,14 @@ class AppTelemetry:
         if tr is not None:
             tr.device_ns += ns * len(names)
             tr.queries.extend(names)
+
+    def record_splice(self, kind: str, ms=None) -> None:
+        """One splice event (kind: in|out|declined|failed) — always on,
+        like the counters in statistics: a failed/declined splice is an
+        operational event, not a metric."""
+        self.splices.labels(kind).inc()
+        if ms is not None:
+            self.splice_ms.labels().set(float(ms))
 
     def record_lag(self, stream: str, newest_ts_ms: int) -> None:
         """Event-time lag at delivery: how stale the newest row of the

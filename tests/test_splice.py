@@ -1,0 +1,408 @@
+"""Multi-tenant churn suite (ISSUE 18): one-retrace splice attach/detach.
+
+The correctness bar mirrors the optimizer parity suite: a query spliced
+into a LIVE fused group mid-run must produce BIT-IDENTICAL output to the
+same query built from scratch and fed the same post-attach rows, and the
+sibling queries' full-run output must equal a no-churn run exactly —
+state tensors carry over, nothing drains, nothing re-seeds. Comparison
+is exact equality, no tolerances.
+
+Also covered here: the loud fallback (a fault injected into the splice
+commit rolls the group back to the exact pre-splice jit with exact event
+conservation), tenant device-time quotas (breach diverts ONLY the
+offending tenant's queries; the window draining re-splices them), the
+per-splice SL501 admission gate, the detach-frees-budget →
+admit_pending() regression, and the REST attach/detach routes.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.analysis.cost import compute_cost
+from siddhi_tpu.compiler import parse
+from siddhi_tpu.errors import SiddhiAppCreationError
+from siddhi_tpu.util.faults import inject, parse_fault_spec
+
+pytestmark = pytest.mark.smoke
+
+STREAM = "define stream S (symbol string, price double, volume long);\n"
+
+BASE = ("@app:name('churn')\n" + STREAM +
+        "@info(name='q1') from S select symbol, sum(price) as total "
+        "group by symbol insert into OutA;\n"
+        "@info(name='q2') from S[price > 10.0] select symbol, price "
+        "insert into OutB;\n")
+
+Q3 = ("@info(name='q3') from S[volume > 3] select symbol, volume "
+      "insert into OutC;")
+
+#: from-scratch oracle for the attached query: same query, own app
+Q3_APP = "@app:name('oracle3')\n" + STREAM + Q3
+
+
+def trades(n, *, t0=1000, dt=100):
+    sym = ("IBM", "WSO2", "ORCL")
+    return [(t0 + i * dt, (sym[i % 3], float((i * 7) % 50) + 0.25, i + 1))
+            for i in range(n)]
+
+
+PH1 = trades(24)
+PH2 = trades(24, t0=9000)
+
+
+def feed(rt, rows):
+    h = rt.get_input_handler("S")
+    for ts, row in rows:
+        h.send(row, timestamp=ts)
+    rt.flush()
+
+
+def collect(rt, got, *streams):
+    for s in streams:
+        got.setdefault(s, [])
+        rt.add_callback(s, lambda evs, s=s: got[s].extend(
+            tuple(e.data) for e in evs))
+
+
+def run_phases(app, phases, out_streams, *, optimize, batch_size=8):
+    """No-churn oracle run: same rows, same flush boundaries, no splice."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app, batch_size=batch_size,
+                                     optimize=optimize)
+    got = {}
+    collect(rt, got, *out_streams)
+    rt.start()
+    for rows in phases:
+        feed(rt, rows)
+    m.shutdown()
+    return got
+
+
+def churn_run(*, optimize, batch_size=8):
+    """Phase 1 → live attach of q3 → phase 2. The OutC callback must be
+    registered AFTER the attach (the output stream only exists then)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(BASE, batch_size=batch_size,
+                                     optimize=optimize)
+    got = {}
+    collect(rt, got, "OutA", "OutB")
+    rt.start()
+    feed(rt, PH1)
+    res = m.attach_query("churn", Q3)
+    collect(rt, got, "OutC")
+    feed(rt, PH2)
+    rep = dict(rt.optimizer_report or {})
+    stats = rt.statistics_report()
+    m.shutdown()
+    return got, res, rep, stats
+
+
+class TestSpliceParity:
+    """Spliced-in output == built-from-scratch output, bit for bit."""
+
+    def _check(self, *, optimize):
+        got, res, rep, stats = churn_run(optimize=optimize)
+        base = run_phases(BASE, (PH1, PH2), ("OutA", "OutB"),
+                          optimize=optimize)
+        assert got["OutA"] == base["OutA"], "splice disturbed sibling q1"
+        assert got["OutB"] == base["OutB"], "splice disturbed sibling q2"
+        scratch = run_phases(Q3_APP, (PH2,), ("OutC",), optimize=False)
+        assert got["OutC"] == scratch["OutC"], \
+            "spliced-in q3 diverged from from-scratch build"
+        return res, rep, stats
+
+    def test_spliced_output_matches_from_scratch(self):
+        res, rep, stats = self._check(optimize=True)
+        assert res["fused"] is True
+        assert res["retrace_ms"] > 0
+        assert res["deploy_ms"] >= res["retrace_ms"]
+        # spliced to the END of the group: siblings' step order unchanged
+        assert rep["group_members"][res["group"]][-1] == "q3"
+        assert stats["splices"]["counts"]["in"] == 1
+        assert stats["splices"]["last_retrace_ms"] == res["retrace_ms"]
+
+    def test_optimizer_off_attach_stays_standalone(self):
+        res, _rep, _stats = self._check(optimize=False)
+        assert res["fused"] is False
+
+    def test_superstep_variant(self, monkeypatch):
+        monkeypatch.setenv("SIDDHI_SUPERSTEP_K", "8")
+        res, _rep, _stats = self._check(optimize=True)
+        assert res["fused"] is True
+
+    def test_lock_checks_variant(self, monkeypatch):
+        monkeypatch.setenv("SIDDHI_LOCK_CHECKS", "1")
+        res, _rep, _stats = self._check(optimize=True)
+        assert res["fused"] is True
+
+    def test_detach_mid_run_siblings_undisturbed(self):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(BASE + Q3, batch_size=8,
+                                         optimize=True)
+        got = {}
+        collect(rt, got, "OutA", "OutB", "OutC")
+        rt.start()
+        feed(rt, PH1)
+        before_c = list(got["OutC"])
+        out = m.detach_query("churn", "q3")
+        assert out["name"] == "q3" and out["detach_ms"] > 0
+        feed(rt, PH2)
+        assert got["OutC"] == before_c, "detached q3 still produced output"
+        rep = rt.optimizer_report
+        assert all("q3" not in ms for ms in rep["group_members"].values())
+        stats = rt.statistics_report()
+        assert stats["splices"]["counts"]["out"] == 1
+        m.shutdown()
+        base = run_phases(BASE, (PH1, PH2), ("OutA", "OutB"), optimize=True)
+        assert got["OutA"] == base["OutA"]
+        assert got["OutB"] == base["OutB"]
+
+
+class TestSpliceChaos:
+    def test_mid_splice_failure_rolls_back(self, monkeypatch):
+        """SIDDHI_FAULT_SPEC-driven chaos: the splice commit dies mid-way.
+        The group must roll back to the EXACT pre-splice trace (same jit
+        object — no retrace happened), q3 lands standalone (loud
+        fallback), and every event is conserved bit-exactly."""
+        monkeypatch.setenv("SIDDHI_FAULT_SPEC", "query:nth=1,exc=error")
+        plan = parse_fault_spec(os.environ["SIDDHI_FAULT_SPEC"])["query"]
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(BASE, batch_size=8, optimize=True)
+        got = {}
+        collect(rt, got, "OutA", "OutB")
+        rt.start()
+        feed(rt, PH1)
+        group = rt.shared_groups[0]
+        members = list(group.members)
+        step = group._step
+        inject(group, "_splice_commit", plan)
+        res = m.attach_query("churn", Q3)
+        assert res["fused"] is False and "failed" in res
+        assert list(group.members) == members
+        assert group._step is step
+        qr = rt.query_runtimes["q3"]
+        assert qr._fused_group is None
+        # both the rolled-back group and standalone q3 keep delivering:
+        collect(rt, got, "OutC")
+        feed(rt, PH2)
+        base = run_phases(BASE, (PH1, PH2), ("OutA", "OutB"), optimize=True)
+        assert got["OutA"] == base["OutA"]
+        assert got["OutB"] == base["OutB"]
+        scratch = run_phases(Q3_APP, (PH2,), ("OutC",), optimize=False)
+        assert got["OutC"] == scratch["OutC"]
+        stats = rt.statistics_report()
+        assert stats["splices"]["counts"].get("failed") == 1
+        assert rt.ctx.recorder.report()["triggers"].get(
+            "splice_failure") == 1
+        # the fault was one-shot (nth=1): the NEXT splice lands normally
+        res2 = m.attach_query(
+            "churn", "@info(name='q4') from S[volume > 20] select symbol "
+                     "insert into OutD;")
+        assert res2["fused"] is True
+        assert stats["splices"]["counts"].get("failed") == 1
+        m.shutdown()
+
+
+TENANT_APP = ("@app:name('mt')\n"
+              "@app:tenant(id='acme', device.ms='0.000001', window='0.4')\n"
+              "@app:tenant(id='beta', queries='1')\n" + STREAM +
+              "@info(name='a1') @tenant('acme') from S select symbol, "
+              "sum(volume) as v group by symbol insert into A1;\n"
+              "@info(name='b1') @tenant('beta') from S[price > 10.0] "
+              "select symbol insert into B1;\n"
+              "@info(name='free') from S select symbol, price "
+              "insert into F1;\n")
+
+
+class TestTenantQuotas:
+    def test_breach_diverts_tenant_then_window_drain_resplices(self):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(TENANT_APP, batch_size=8,
+                                         optimize=True)
+        got = {}
+        collect(rt, got, "A1", "B1", "F1")
+        rt.start()
+        feed(rt, PH1)  # metered; enforcement trips AFTER this flush
+        len_a, len_b, len_f = (len(got[s]) for s in ("A1", "B1", "F1"))
+        feed(rt, PH1)
+        # acme diverted; beta and untenanted queries untouched
+        assert len(got["A1"]) == len_a, "breached tenant still dispatched"
+        assert len(got["B1"]) > len_b and len(got["F1"]) > len_f
+        stats = rt.statistics_report()
+        t = stats["tenants"]["acme"]
+        assert t["diverting"] is True and t["breaches"] >= 1
+        assert t["dominant_query"] == "a1"
+        assert t["diverted_rows"] > 0
+        assert stats["splices"]["tenant_breaches"]["acme"] >= 1
+        a1 = rt.query_runtimes["a1"]
+        assert a1.breaker is not None and a1.breaker.state == "open"
+        assert a1.breaker.quota_tenant == "acme"
+        assert a1._fused_group is None  # spliced OUT, siblings kept fused
+        rep = rt.optimizer_report
+        assert all("a1" not in ms for ms in rep["group_members"].values())
+        assert rt.ctx.recorder.report()["triggers"].get(
+            "tenant_quota_breach") == 1
+        # let the rolling device-time window (0.4 s) drain → auto-recover
+        time.sleep(0.5)
+        rt.flush()
+        t = rt.statistics_report()["tenants"]["acme"]
+        assert t["diverting"] is False
+        assert a1.breaker is None
+        assert a1._fused_group is not None, "recovered query not re-spliced"
+        len_a = len(got["A1"])
+        feed(rt, PH2)
+        assert len(got["A1"]) > len_a, "recovered tenant still diverted"
+        m.shutdown()
+
+    def test_query_count_quota_refuses_attach(self):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(TENANT_APP, batch_size=8,
+                                         optimize=True)
+        rt.start()
+        n_elements = len(rt.app.execution_elements)
+        recv = {id(j): len(j.receivers) for j in rt.junctions.values()}
+        with pytest.raises(SiddhiAppCreationError, match="SL502"):
+            m.attach_query(
+                "mt", "@info(name='b2') @tenant('beta') from S "
+                      "select symbol insert into B2;")
+        # the failed attach unwound completely: no runtime, no plan change
+        assert "b2" not in rt.query_runtimes
+        assert len(rt.app.execution_elements) == n_elements
+        assert {id(j): len(j.receivers)
+                for j in rt.junctions.values()} == recv
+        m.shutdown()
+
+
+APP_A = ("@app:name('A')\n" + STREAM +
+         "@info(name='qa1') from S[price > 0.0] select symbol "
+         "insert into OA;\n"
+         "@info(name='qa2') from S#window.length(64) select sum(price) "
+         "as t insert into OB;\n")
+APP_B = ("@app:name('B')\ndefine stream T (y double);\n"
+         "@info(name='qb') from T[y > 0.0] select y insert into OC;\n")
+
+
+class TestSpliceAdmission:
+    def test_detach_frees_budget_and_admits_pending(self, monkeypatch):
+        """Regression (ISSUE 18 small fix): a detach re-prices the fleet
+        against the post-splice plan, so freed budget immediately admits
+        a queued app — no manual admit_pending() poke needed."""
+        cost_a = compute_cost(parse(APP_A)).state_bytes
+        cost_b = compute_cost(parse(APP_B)).state_bytes
+        assert cost_a > 0 and cost_b > 0
+        monkeypatch.setenv("SIDDHI_STATE_BUDGET", str(cost_a + cost_b - 1))
+        monkeypatch.setenv("SIDDHI_BUDGET_MODE", "queue")
+        m = SiddhiManager()
+        rt_a = m.create_siddhi_app_runtime(APP_A)
+        assert rt_a is not None
+        assert m.create_siddhi_app_runtime(APP_B) is None  # deferred
+        assert [a.name for a, _ in m.pending_apps] == ["B"]
+        rt_a.start()
+        out = m.detach_query("A", "qa2")  # frees the window state
+        assert out.get("admitted_pending") == ["B"]
+        assert "B" in m.runtimes and not m.pending_apps
+        m.shutdown()
+
+    def test_over_budget_splice_refused_never_queued(self, monkeypatch):
+        cost_a = compute_cost(parse(APP_A)).state_bytes
+        monkeypatch.setenv("SIDDHI_STATE_BUDGET", str(cost_a + 8))
+        monkeypatch.setenv("SIDDHI_BUDGET_MODE", "queue")
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(APP_A)
+        rt.start()
+        with pytest.raises(SiddhiAppCreationError, match="SL501"):
+            m.attach_query(
+                "A", "@info(name='qbig') from S#window.length(4096) "
+                     "select sum(price) as t insert into OD;")
+        # even in queue mode a splice RAISES — and leaves no trace behind
+        assert "qbig" not in rt.query_runtimes
+        assert m.pending_apps == []
+        m.shutdown()
+
+
+QS = ("@info(name='qs') from S select symbol, sum(volume) as v "
+      "group by symbol insert into OV;")
+
+
+class TestAttachWithState:
+    def test_attach_carries_restored_state_into_splice(self):
+        """attach_query(state=) seeds the new query through the
+        element-mapped restore primitive BEFORE the splice, so the fused
+        trace starts from the migrated tensors. The donor app must share
+        the target's app name (snapshots are app-scoped)."""
+        donor_mgr = SiddhiManager()
+        donor = donor_mgr.create_siddhi_app_runtime(
+            BASE + QS, batch_size=8, optimize=True)
+        got_d = {}
+        collect(donor, got_d, "OV")
+        donor.start()
+        feed(donor, PH1)
+        blob = donor.snapshot()
+        n_before = len(got_d["OV"])
+        feed(donor, PH2)
+        continuation = got_d["OV"][n_before:]
+
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(BASE, batch_size=8, optimize=True)
+        got = {}
+        collect(rt, got, "OutA")
+        rt.start()
+        feed(rt, PH1)
+        res = m.attach_query("churn", QS, state=blob)
+        assert res["fused"] is True
+        collect(rt, got, "OV")
+        feed(rt, PH2)
+        assert got["OV"] == continuation, \
+            "restored aggregate state did not carry into the splice"
+        m.shutdown()
+        donor_mgr.shutdown()
+
+
+@pytest.fixture()
+def server():
+    from siddhi_tpu.service import SiddhiService
+    svc = SiddhiService()
+    httpd = svc.make_server(port=0)  # ephemeral port
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", svc
+    httpd.shutdown()
+
+
+def _req(url, method="GET", body=None, ctype=None):
+    data = body.encode() if isinstance(body, str) else body
+    headers = {"Content-Type": ctype} if ctype else {}
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers)
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class TestSpliceRest:
+    def test_attach_detach_routes(self, server):
+        base, _svc = server
+        code, _ = _req(f"{base}/siddhi-apps", "POST", BASE)
+        assert code == 201
+        # raw SiddhiQL body
+        code, out = _req(f"{base}/siddhi-apps/churn/queries", "POST", Q3)
+        assert code == 201 and out["name"] == "q3"
+        assert out["deploy_ms"] > 0
+        # JSON body with an explicit name for an anonymous query
+        q = "from S[price > 40.0] select symbol insert into OutE;"
+        code, out = _req(f"{base}/siddhi-apps/churn/queries", "POST",
+                         json.dumps({"query": q, "name": "q9"}),
+                         ctype="application/json")
+        assert code == 201 and out["name"] == "q9"
+        code, out = _req(f"{base}/siddhi-apps/churn/queries/q9", "DELETE")
+        assert code == 200 and out["name"] == "q9"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(f"{base}/siddhi-apps/churn/queries/nope", "DELETE")
+        assert ei.value.code == 404
+        _req(f"{base}/siddhi-apps/churn", "DELETE")

@@ -45,6 +45,17 @@ ADVISORY_FIELDS = frozenset({
     # times are environment-dependent (subprocess boot, scheduler jitter),
     # reported for trend-watching, never diffed as a regression
     "failover",
+    # churn drill sub-metrics: deploy latency is dominated by one XLA
+    # retrace (host/compiler dependent), the splice-point ratio is an
+    # advisory floor checked in CI, and the counts vary with the Poisson
+    # draw — trend data, not regression signals
+    "churn_deploy_p50_ms",
+    "churn_deploy_p99_ms",
+    "churn_splice_throughput_ratio",
+    "churn_attaches",
+    "churn_detaches",
+    "churn_sl501_refused",
+    "churn_splices",
 })
 
 
